@@ -1,0 +1,70 @@
+"""raft_tpu — a TPU-native library of reusable ML/data-science primitives.
+
+A ground-up re-design, for TPU (JAX/XLA/Pallas/pjit), of the capabilities of
+RAFT (RAPIDS Reusable Accelerated Functions and Tools, reference: csadorf/raft
+@ 22.12): pairwise distances, fused L2 nearest-neighbor, dense & sparse linear
+algebra, top-k selection, k-means and single-linkage clustering, spectral
+partitioning, brute-force and ANN search (IVF-Flat, IVF-PQ, ball cover),
+statistics, RNG/data generators, a linear-assignment solver, and a
+multi-node communicator layer over XLA collectives.
+
+Layer map (mirrors reference SURVEY.md §1, re-imagined TPU-first):
+
+  core      resource handle (device/mesh/dispatch), mdarray containers,
+            errors, interruptible cancellation, logging, tracing
+  util      shape/tile math, Pow2 helpers, host utilities
+  linalg    dense linear algebra (XLA lowerings; Pallas for fused paths)
+  matrix    matrix manipulation primitives
+  stats     summary statistics + model-evaluation metrics
+  random    counter-based RNG + data generators (blobs/regression/rmat)
+  distance  pairwise distances (20 metrics), fused L2 NN, gram kernels
+  cluster   k-means (++/balanced), single-linkage HAC
+  neighbors brute-force kNN, IVF-Flat, IVF-PQ, ball cover, eps-neighborhood
+  sparse    COO/CSR containers, conversions, sparse linalg/distance/solvers
+  spectral  spectral partitioning / modularity maximization
+  solver    linear assignment problem
+  label     label utilities
+  comms     comms_t-shaped collectives over ICI/DCN (shard_map/pjit)
+"""
+
+__version__ = "0.1.0"
+
+from raft_tpu.core import (  # noqa: F401
+    Handle,
+    RaftError,
+    LogicError,
+    expects,
+)
+
+# Subpackages are imported lazily to keep `import raft_tpu` fast and to avoid
+# pulling in optional heavy deps at import time.
+_SUBMODULES = (
+    "core",
+    "util",
+    "linalg",
+    "matrix",
+    "stats",
+    "random",
+    "distance",
+    "cluster",
+    "neighbors",
+    "sparse",
+    "spectral",
+    "solver",
+    "label",
+    "comms",
+)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        import importlib
+
+        mod = importlib.import_module(f"raft_tpu.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'raft_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals().keys()) + list(_SUBMODULES))

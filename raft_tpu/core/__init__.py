@@ -1,0 +1,48 @@
+"""Core runtime: handle, containers, errors, cancellation, logging.
+
+Mirrors reference layer 1 (cpp/include/raft/core/ — SURVEY.md §2.1).
+"""
+
+from raft_tpu.core.error import (  # noqa: F401
+    CudaError,
+    DeviceError,
+    InterruptedError_,
+    LogicError,
+    RaftError,
+    expects,
+    fail,
+)
+from raft_tpu.core.handle import (  # noqa: F401
+    DeviceResources,
+    Handle,
+    Stream,
+    auto_sync_handle,
+    default_handle,
+)
+from raft_tpu.core.kvp import KeyValuePair, kvp_min  # noqa: F401
+from raft_tpu.core.logger import (  # noqa: F401
+    Logger,
+    log_debug,
+    log_error,
+    log_info,
+    log_trace,
+    log_warn,
+    time_range,
+)
+from raft_tpu.core.mdarray import (  # noqa: F401
+    Layout,
+    MdArray,
+    MdSpan,
+    MemoryType,
+    as_device_array,
+    col_major,
+    make_device_matrix,
+    make_device_mdarray,
+    make_device_scalar,
+    make_device_vector,
+    make_host_matrix,
+    make_host_scalar,
+    make_host_vector,
+    row_major,
+)
+from raft_tpu.core import interruptible  # noqa: F401

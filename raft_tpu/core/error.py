@@ -1,0 +1,60 @@
+"""Exception hierarchy and check helpers.
+
+TPU-native counterpart of the reference error machinery
+(cpp/include/raft/core/error.hpp:154,170 — ``raft::exception``,
+``raft::logic_error``, ``RAFT_EXPECTS``, ``RAFT_FAIL``).  There is no CUDA
+error channel here; XLA/JAX errors are re-raised wrapped so callers see one
+exception family.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RaftError(Exception):
+    """Base exception, with an optional captured traceback summary.
+
+    Mirrors ``raft::exception`` (reference core/error.hpp:52) which captures a
+    backtrace into the message at construction time.
+    """
+
+    def __init__(self, message: str = ""):
+        super().__init__(message)
+        self.message = message
+        # Captured eagerly like the reference's backtrace collection.
+        self.trace = "".join(traceback.format_stack(limit=16)[:-1])
+
+    def __str__(self) -> str:  # pragma: no cover - trivial
+        return self.message
+
+
+class LogicError(RaftError):
+    """Invalid API usage / failed precondition (``raft::logic_error``)."""
+
+
+class CudaError(RaftError):
+    """Device-side failure surfaced from XLA (named for API parity)."""
+
+
+class DeviceError(CudaError):
+    """Preferred alias for device-side failures on TPU."""
+
+
+class InterruptedError_(RaftError):
+    """Raised by :mod:`raft_tpu.core.interruptible` on cancellation.
+
+    (``raft::interrupted_exception``, reference core/interruptible.hpp:41.)
+    """
+
+
+def expects(condition: bool, message: str = "precondition violated") -> None:
+    """``RAFT_EXPECTS`` (reference core/error.hpp:154): raise LogicError unless
+    *condition* holds."""
+    if not condition:
+        raise LogicError(message)
+
+
+def fail(message: str = "") -> None:
+    """``RAFT_FAIL`` (reference core/error.hpp:170): unconditional LogicError."""
+    raise LogicError(message)

@@ -1,0 +1,228 @@
+"""Central resource container — the TPU-native ``raft::handle_t``.
+
+The reference handle (cpp/include/raft/core/handle.hpp:54) owns: device id,
+main CUDA stream, an optional stream pool, lazily-created vendor-library
+handles (cuBLAS/cuSOLVER/cuSPARSE), and an injected communicator.  On TPU the
+equivalents are:
+
+  device id            → a ``jax.Device`` (and optionally a ``jax.sharding.Mesh``)
+  CUDA stream          → XLA's async dispatch; a :class:`Stream` here is a
+                         dispatch lane that *tracks* in-flight arrays so that
+                         ``sync`` has something to wait on
+  stream pool          → a pool of such lanes for concurrently dispatched
+                         batched work (reference handle.hpp:88-130)
+  cublas/cusolver      → nothing to hold: XLA lowers dot/eigh/svd/qr itself
+  comms_t slot         → :meth:`Handle.set_comms` / :meth:`get_comms` /
+                         :meth:`get_subcomm` (reference handle.hpp:239-262)
+
+Every public raft_tpu function takes a ``Handle`` first (or creates a default
+one), matching the reference's calling convention.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Dict, List, Optional
+
+from raft_tpu.core import interruptible
+from raft_tpu.core.error import LogicError, expects
+
+
+class Stream:
+    """An async dispatch lane.
+
+    XLA dispatch is stream-ordered per device already; this object exists so
+    callers can group work and wait on just that group, like
+    ``handle.get_stream()`` / ``handle.sync_stream()`` in the reference.
+    In-flight arrays are held weakly — once garbage collected they no longer
+    need waiting on (their buffers are owned by the runtime).
+    """
+
+    def __init__(self, name: str = "main"):
+        self.name = name
+        self._inflight: "weakref.WeakSet" = weakref.WeakSet()
+        self._lock = threading.Lock()
+
+    def record(self, *arrays: Any) -> None:
+        """Note device work whose completion this stream owns."""
+        import jax
+
+        with self._lock:
+            for a in arrays:
+                for leaf in jax.tree_util.tree_leaves(a):
+                    if hasattr(leaf, "is_ready"):
+                        try:
+                            self._inflight.add(leaf)
+                        except TypeError:  # non-weakrefable leaf
+                            pass
+
+    def synchronize(self) -> None:
+        """Interruptibly wait for all recorded work (reference
+        ``handle.sync_stream`` → ``interruptible::synchronize``)."""
+        with self._lock:
+            pending = list(self._inflight)
+            self._inflight = weakref.WeakSet()
+        interruptible.synchronize(*pending)
+
+    def query(self) -> bool:
+        """True if all recorded work has completed (``cudaStreamQuery``-like)."""
+        with self._lock:
+            return all(getattr(a, "is_ready", lambda: True)() for a in self._inflight)
+
+
+class Handle:
+    """Resource handle: device (or mesh), dispatch streams, comms.
+
+    Reference: ``raft::handle_t`` (core/handle.hpp:54).  Constructed with an
+    optional ``jax.Device`` (default: first local device), an optional number
+    of pool streams (``n_streams``, mirroring pylibraft's
+    ``Handle(n_streams=...)``, python/pylibraft/common/handle.pyx:31-70), and
+    an optional ``jax.sharding.Mesh`` for distributed use.
+    """
+
+    def __init__(self, device: Any = None, n_streams: int = 0, mesh: Any = None):
+        import jax
+
+        if device is None:
+            if mesh is not None:
+                device = mesh.devices.flat[0]
+            else:
+                device = jax.local_devices()[0]
+        self._device = device
+        self._mesh = mesh
+        self._stream = Stream("main")
+        expects(n_streams >= 0, "n_streams must be >= 0")
+        self._stream_pool: List[Stream] = [Stream(f"pool{i}") for i in range(n_streams)]
+        self._comms = None
+        self._subcomms: Dict[str, Any] = {}
+        self._attrs: Dict[str, Any] = {}  # lazily-created per-handle resources
+
+    # -- device / mesh -------------------------------------------------------
+    @property
+    def device(self):
+        return self._device
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def set_mesh(self, mesh) -> None:
+        self._mesh = mesh
+
+    def get_device(self):
+        return self._device
+
+    # -- streams (reference core/handle.hpp:70,88-130,190) -------------------
+    def get_stream(self) -> Stream:
+        return self._stream
+
+    @property
+    def stream_pool_size(self) -> int:
+        return len(self._stream_pool)
+
+    def is_stream_pool_initialized(self) -> bool:
+        return len(self._stream_pool) > 0
+
+    def get_stream_from_stream_pool(self, idx: Optional[int] = None) -> Stream:
+        expects(self._stream_pool, "ERROR: rmm stream pool does not exist")
+        if idx is None:
+            idx = 0
+        return self._stream_pool[idx % len(self._stream_pool)]
+
+    def get_next_usable_stream(self, idx: Optional[int] = None) -> Stream:
+        """Reference handle.hpp:117-130: pool stream if a pool exists, else
+        the main stream."""
+        if self._stream_pool:
+            return self.get_stream_from_stream_pool(idx)
+        return self._stream
+
+    def sync_stream(self, stream: Optional[Stream] = None) -> None:
+        (stream or self._stream).synchronize()
+
+    def sync_stream_pool(self) -> None:
+        for s in self._stream_pool:
+            s.synchronize()
+
+    def wait_stream_pool_on_stream(self) -> None:
+        """Reference handle.hpp:190: order pool work after main-stream work.
+        XLA already orders same-device dispatch; we conservatively wait."""
+        self._stream.synchronize()
+
+    def sync(self) -> None:
+        """Sync everything (pylibraft ``Handle.sync()``)."""
+        self.sync_stream()
+        self.sync_stream_pool()
+
+    # -- comms (reference core/handle.hpp:231-262) ---------------------------
+    def set_comms(self, comms) -> None:
+        self._comms = comms
+
+    def get_comms(self):
+        expects(self._comms is not None, "ERROR: Communicator was not initialized on the handle")
+        return self._comms
+
+    def comms_initialized(self) -> bool:
+        return self._comms is not None
+
+    def set_subcomm(self, key: str, comms) -> None:
+        self._subcomms[key] = comms
+
+    def get_subcomm(self, key: str):
+        expects(key in self._subcomms, f"ERROR: Subcommunicator {key} was never initialized")
+        return self._subcomms[key]
+
+    # -- lazily-created per-handle resources ---------------------------------
+    def get_resource(self, key: str, factory):
+        """Generic lazily-created resource slot, playing the role of the
+        reference's lazily-created cublas/cusolver handles."""
+        if key not in self._attrs:
+            self._attrs[key] = factory()
+        return self._attrs[key]
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return (f"Handle(device={self._device}, n_pool_streams={len(self._stream_pool)}, "
+                f"mesh={self._mesh}, comms={'yes' if self._comms else 'no'})")
+
+
+# ``device_resources`` is the forward-looking name in newer reference versions.
+DeviceResources = Handle
+
+_default_handle: Optional[Handle] = None
+_default_lock = threading.Lock()
+
+
+def default_handle() -> Handle:
+    """Process-wide default handle (created on first use)."""
+    global _default_handle
+    with _default_lock:
+        if _default_handle is None:
+            _default_handle = Handle()
+        return _default_handle
+
+
+def auto_sync_handle(fn):
+    """Decorator: inject a default ``handle=`` kwarg and sync it after the
+    call — mirrors pylibraft's ``auto_sync_handle``
+    (python/pylibraft/common/handle.pyx wrapper, used at
+    distance/pairwise_distance.pyx:94)."""
+    import functools
+    import inspect
+
+    sig = inspect.signature(fn)
+    has_handle = "handle" in sig.parameters
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not has_handle:
+            return fn(*args, **kwargs)
+        supplied = kwargs.get("handle")
+        if supplied is None:
+            kwargs["handle"] = default_handle()
+            out = fn(*args, **kwargs)
+            kwargs["handle"].get_stream().record(out)
+            kwargs["handle"].sync_stream()
+            return out
+        return fn(*args, **kwargs)
+
+    return wrapper

@@ -1,0 +1,132 @@
+"""Cooperative cancellation of host threads blocked on device sync.
+
+TPU-native counterpart of ``raft::interruptible`` (reference
+core/interruptible.hpp:34-270): a per-thread token registry; ``synchronize``
+polls device readiness (the analogue of ``cudaStreamQuery`` polling at
+reference core/interruptible.hpp:256) while yielding, so another thread can
+``cancel()`` the waiter, which then raises :class:`InterruptedError_`.
+
+JAX's ``block_until_ready`` is an uninterruptible C++ wait; this module
+instead polls ``jax.Array.is_ready()`` with exponential backoff, preserving
+the reference's interruptible-wait semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Any, Dict, Optional
+
+from raft_tpu.core.error import InterruptedError_
+
+_registry_lock = threading.Lock()
+_registry: Dict[int, "Token"] = {}
+
+
+class Token:
+    """Cancellation token for one thread (``interruptible`` instance,
+    reference core/interruptible.hpp:205 ``get_token``)."""
+
+    __slots__ = ("_flag",)
+
+    def __init__(self):
+        self._flag = threading.Event()
+
+    def cancel(self) -> None:
+        """Request cancellation (reference core/interruptible.hpp:126)."""
+        self._flag.set()
+
+    def cancelled(self) -> bool:
+        return self._flag.is_set()
+
+    def yield_(self) -> None:
+        """Raise if cancelled, clearing the flag (reference ``yield``,
+        core/interruptible.hpp:110)."""
+        if self._flag.is_set():
+            self._flag.clear()
+            raise InterruptedError_("interruptible::yield: cancelled")
+
+    def yield_no_throw(self) -> bool:
+        if self._flag.is_set():
+            self._flag.clear()
+            return True
+        return False
+
+
+def get_token(thread_id: Optional[int] = None) -> Token:
+    """Get (creating if needed) the token for *thread_id* (default: calling
+    thread) — reference core/interruptible.hpp:205,214."""
+    tid = threading.get_ident() if thread_id is None else thread_id
+    with _registry_lock:
+        tok = _registry.get(tid)
+        if tok is None:
+            tok = Token()
+            _registry[tid] = tok
+        return tok
+
+
+def cancel(thread_id: int) -> None:
+    """Cancel whatever interruptible wait thread *thread_id* is in."""
+    get_token(thread_id).cancel()
+
+
+def yield_() -> None:
+    """Check the calling thread's token; raise InterruptedError_ if cancelled."""
+    get_token().yield_()
+
+
+def yield_no_throw() -> bool:
+    return get_token().yield_no_throw()
+
+
+def _is_ready(x: Any) -> bool:
+    fn = getattr(x, "is_ready", None)
+    if fn is not None:
+        try:
+            return bool(fn())
+        except Exception:
+            return True
+    return True
+
+
+def synchronize(*arrays: Any, poll_interval: float = 1e-5, max_interval: float = 1e-3) -> None:
+    """Interruptibly wait until all *arrays* (jax Arrays / pytrees) are ready.
+
+    Mirrors ``interruptible::synchronize(stream)`` (reference
+    core/interruptible.hpp:78,256): poll readiness, yield between polls so a
+    concurrent :func:`cancel` interrupts the wait.
+    """
+    import jax
+
+    leaves = [l for a in arrays for l in jax.tree_util.tree_leaves(a)]
+    tok = get_token()
+    interval = poll_interval
+    pending = [l for l in leaves if not _is_ready(l)]
+    while pending:
+        tok.yield_()
+        time.sleep(interval)
+        interval = min(interval * 2.0, max_interval)
+        pending = [l for l in pending if not _is_ready(l)]
+    tok.yield_()
+
+
+class interruptible:
+    """Context manager mapping KeyboardInterrupt → cancellation of in-flight
+    device waits, mirroring pylibraft's ``cuda_interruptible``
+    (reference python/pylibraft/common/interruptible.pyx:32-77)."""
+
+    def __init__(self):
+        self._token: Optional[Token] = None
+
+    def __enter__(self):
+        self._token = get_token()
+        return self._token
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is KeyboardInterrupt and self._token is not None:
+            self._token.cancel()
+        # Clear any stale cancellation so the next wait on this thread is clean.
+        if self._token is not None:
+            self._token.yield_no_throw()
+        return False

@@ -1,0 +1,30 @@
+"""Key-value pair used by fused argmin reductions.
+
+Reference: ``raft::KeyValuePair<K,V>`` (cpp/include/raft/core/kvp.hpp:62),
+produced by ``fusedL2NN`` and consumed by k-means.  Registered as a pytree so
+it flows through jit/vmap/scan.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+
+
+class KeyValuePair(NamedTuple):
+    key: Any  # index (int array)
+    value: Any  # payload, e.g. distance (float array)
+
+
+def kvp_min(a: KeyValuePair, b: KeyValuePair) -> KeyValuePair:
+    """Elementwise min by value, tie-broken by smaller key — the reduction
+    used by the fused L2 NN epilogue (reference distance/detail/fused_l2_nn.cuh
+    ``MinAndDistanceReduceOp``)."""
+    import jax.numpy as jnp
+
+    take_b = (b.value < a.value) | ((b.value == a.value) & (b.key < a.key))
+    return KeyValuePair(
+        key=jnp.where(take_b, b.key, a.key),
+        value=jnp.where(take_b, b.value, a.value),
+    )

@@ -1,0 +1,207 @@
+"""Library logger with levels, pattern, and callback sinks.
+
+TPU-native counterpart of the reference's spdlog-backed singleton
+(cpp/include/raft/core/logger.hpp:56,118 — ``raft::logger``, ``RAFT_LOG_*``
+macros, callback sink core/detail/callback_sink.hpp).  Built on the stdlib
+``logging`` module; the spdlog-style ``%v``-pattern is translated to a
+``logging`` format string.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from typing import Callable, Optional
+
+# Level values mirror reference core/logger.hpp:36-46 (RAFT_LEVEL_*).
+OFF = 0
+CRITICAL = 1
+ERROR = 2
+WARN = 3
+INFO = 4
+DEBUG = 5
+TRACE = 6
+
+_LEVEL_TO_PY = {
+    OFF: logging.CRITICAL + 10,
+    CRITICAL: logging.CRITICAL,
+    ERROR: logging.ERROR,
+    WARN: logging.WARNING,
+    INFO: logging.INFO,
+    DEBUG: logging.DEBUG,
+    TRACE: logging.DEBUG - 5,
+}
+
+_DEFAULT_PATTERN = "[%L] [%H:%M:%S.%f] %v"
+
+
+def _spdlog_pattern_to_fmt(pattern: str) -> str:
+    """Translate the (small, commonly used subset of the) spdlog pattern
+    language used by the reference into a ``logging`` format string."""
+    out = pattern
+    for spd, py in (
+        ("%v", "%(message)s"),
+        ("%n", "%(name)s"),
+        ("%L", "%(levelname).1s"),
+        ("%l", "%(levelname)s"),
+        ("%t", "%(thread)d"),
+        ("%P", "%(process)d"),
+    ):
+        out = out.replace(spd, py)
+    # Time specifiers are handled by datefmt; collapse common ones.
+    out = out.replace("%H:%M:%S.%f", "%(asctime)s").replace("%H:%M:%S", "%(asctime)s")
+    return out
+
+
+class _CallbackHandler(logging.Handler):
+    """Callback sink (reference core/detail/callback_sink.hpp): forwards every
+    formatted record to a user callback; optional flush callback."""
+
+    def __init__(self, callback: Callable[[int, str], None], flush: Optional[Callable[[], None]] = None):
+        super().__init__()
+        self._callback = callback
+        self._flush = flush
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self._callback(record.levelno, self.format(record))
+        except Exception:  # pragma: no cover - never raise from logging
+            self.handleError(record)
+
+    def flush(self) -> None:
+        if self._flush is not None:
+            self._flush()
+
+
+class Logger:
+    """Singleton logger (``raft::logger::get()``, reference core/logger.hpp:129)."""
+
+    _instance: Optional["Logger"] = None
+
+    def __init__(self, name: str = "raft_tpu"):
+        self._logger = logging.getLogger(name)
+        self._logger.propagate = False
+        self._level = INFO
+        self._pattern = _DEFAULT_PATTERN
+        self._stream_handler = logging.StreamHandler(sys.stderr)
+        self._logger.addHandler(self._stream_handler)
+        self._callback_handler: Optional[_CallbackHandler] = None
+        self.set_level(INFO)
+        self.set_pattern(_DEFAULT_PATTERN)
+
+    @classmethod
+    def get(cls) -> "Logger":
+        if cls._instance is None:
+            cls._instance = Logger()
+        return cls._instance
+
+    # -- configuration (reference core/logger.hpp:153,166) ------------------
+    def set_level(self, level: int) -> None:
+        expects_level(level)
+        self._level = level
+        self._logger.setLevel(_LEVEL_TO_PY[level])
+
+    def get_level(self) -> int:
+        return self._level
+
+    def should_log_for(self, level: int) -> bool:
+        return level <= self._level and self._level != OFF
+
+    def set_pattern(self, pattern: str) -> None:
+        self._pattern = pattern
+        fmt = logging.Formatter(_spdlog_pattern_to_fmt(pattern), datefmt="%H:%M:%S")
+        self._stream_handler.setFormatter(fmt)
+        if self._callback_handler is not None:
+            self._callback_handler.setFormatter(fmt)
+
+    def get_pattern(self) -> str:
+        return self._pattern
+
+    def set_callback(self, callback: Optional[Callable[[int, str], None]],
+                     flush: Optional[Callable[[], None]] = None) -> None:
+        """Install/remove a callback sink (used by the Python layer to capture
+        logs, mirroring pylibraft's use of the spdlog callback sink)."""
+        if self._callback_handler is not None:
+            self._logger.removeHandler(self._callback_handler)
+            self._callback_handler = None
+        if callback is not None:
+            self._callback_handler = _CallbackHandler(callback, flush)
+            self._callback_handler.setFormatter(self._stream_handler.formatter)
+            self._logger.addHandler(self._callback_handler)
+            self._logger.removeHandler(self._stream_handler)
+        else:
+            if self._stream_handler not in self._logger.handlers:
+                self._logger.addHandler(self._stream_handler)
+
+    def flush(self) -> None:
+        for h in list(self._logger.handlers):
+            h.flush()
+
+    # -- emission (RAFT_LOG_* macros, reference core/logger.hpp:56+) ---------
+    def log(self, level: int, msg: str, *args) -> None:
+        if self.should_log_for(level):
+            self._logger.log(_LEVEL_TO_PY[level], msg % args if args else msg)
+
+
+def expects_level(level: int) -> None:
+    if level not in _LEVEL_TO_PY:
+        raise ValueError(f"invalid log level {level}")
+
+
+def log_trace(msg: str, *args) -> None:
+    Logger.get().log(TRACE, msg, *args)
+
+
+def log_debug(msg: str, *args) -> None:
+    Logger.get().log(DEBUG, msg, *args)
+
+
+def log_info(msg: str, *args) -> None:
+    Logger.get().log(INFO, msg, *args)
+
+
+def log_warn(msg: str, *args) -> None:
+    Logger.get().log(WARN, msg, *args)
+
+
+def log_error(msg: str, *args) -> None:
+    Logger.get().log(ERROR, msg, *args)
+
+
+def log_critical(msg: str, *args) -> None:
+    Logger.get().log(CRITICAL, msg, *args)
+
+
+_PERF_TIMERS: dict = {}
+
+
+class time_range:
+    """Profiler range annotation — counterpart of NVTX ranges
+    (reference core/nvtx.hpp:95 ``common::nvtx::range``).  Emits a
+    ``jax.profiler.TraceAnnotation`` so ranges appear in TPU profiler traces,
+    and optionally logs elapsed wall time at TRACE level."""
+
+    def __init__(self, name: str, log: bool = False):
+        self._name = name
+        self._log = log
+        self._ann = None
+        self._t0 = 0.0
+
+    def __enter__(self):
+        try:
+            import jax.profiler
+
+            self._ann = jax.profiler.TraceAnnotation(self._name)
+            self._ann.__enter__()
+        except Exception:  # pragma: no cover - profiler unavailable
+            self._ann = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._log:
+            log_trace("%s: %.3f ms", self._name, (time.perf_counter() - self._t0) * 1e3)
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        return False

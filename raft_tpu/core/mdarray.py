@@ -1,0 +1,206 @@
+"""Multi-dimensional owning arrays and non-owning views.
+
+TPU-native counterpart of the reference mdspan/mdarray stack
+(cpp/include/raft/core/mdarray.hpp:127, core/device_mdarray.hpp:133-171,
+core/host_mdarray.hpp, core/memory_type.hpp:19, core/span.hpp).  The
+reference vendors 18k LoC of Kokkos mdspan to describe strided views over raw
+memory; on TPU, device buffers are ``jax.Array`` (which already carry
+shape/dtype and are always logically row-major), so these classes are thin:
+they bind an array to a *memory type* and *layout tag* and provide the
+factory/view API shape downstream code expects.
+
+Column-major ("F-contiguous", ``layout_f_contiguous``) data is represented by
+storing the transposed row-major buffer plus a layout flag; ``.view()`` and
+``__array__`` present the logical shape.  This keeps every device buffer in
+XLA's native layout (what the MXU wants) while preserving the reference's
+row/col-major API surface (e.g. pairwise_distance accepts either order).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+from raft_tpu.core.error import LogicError, expects
+
+
+class MemoryType(enum.Enum):
+    """Reference core/memory_type.hpp:19 — where an mdarray's memory lives."""
+
+    HOST = "host"
+    DEVICE = "device"
+    MANAGED = "managed"  # on TPU: host-resident, transferred on demand
+    PINNED = "pinned"
+
+
+class Layout(enum.Enum):
+    """layout_c_contiguous / layout_f_contiguous (reference core/mdspan.hpp)."""
+
+    C = "row_major"
+    F = "col_major"
+
+
+row_major = Layout.C
+col_major = Layout.F
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class MdSpan:
+    """Non-owning view: (array, memory_type, layout).
+
+    The reference's ``mdspan`` is a pointer + extents + strides; here the
+    underlying ``jax.Array``/``np.ndarray`` carries extents, and ``layout``
+    records whether the *logical* array is the buffer or its transpose.
+    """
+
+    __slots__ = ("_array", "memory_type", "layout")
+
+    def __init__(self, array: Any, memory_type: MemoryType = MemoryType.DEVICE,
+                 layout: Layout = Layout.C):
+        self._array = array
+        self.memory_type = memory_type
+        self.layout = layout
+
+    # -- extents -------------------------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        s = tuple(self._array.shape)
+        if self.layout == Layout.F:
+            return tuple(reversed(s))
+        return s
+
+    @property
+    def dtype(self):
+        return self._array.dtype
+
+    @property
+    def ndim(self) -> int:
+        return self._array.ndim
+
+    def extent(self, i: int) -> int:
+        return self.shape[i]
+
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    # -- data access ---------------------------------------------------------
+    @property
+    def data(self) -> Any:
+        """The raw backing buffer (row-major; transposed if layout==F)."""
+        return self._array
+
+    def logical(self) -> Any:
+        """The array in its logical orientation (device array)."""
+        if self.layout == Layout.F:
+            return self._array.T
+        return self._array
+
+    def __array__(self, dtype=None):
+        out = np.asarray(self.logical())
+        return out.astype(dtype) if dtype is not None else out
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (f"{type(self).__name__}(shape={self.shape}, dtype={self.dtype}, "
+                f"{self.memory_type.value}, {self.layout.value})")
+
+
+class MdArray(MdSpan):
+    """Owning array (reference core/mdarray.hpp:127).  Same data model as
+    :class:`MdSpan`; ownership on TPU is the runtime's reference counting, so
+    the distinction is purely an API one."""
+
+    def view(self) -> MdSpan:
+        return MdSpan(self._array, self.memory_type, self.layout)
+
+
+# -- factories (reference core/device_mdarray.hpp:133-171 et al.) ------------
+
+def _zeros(shape, dtype, memory_type: MemoryType, layout: Layout, device=None):
+    buf_shape = tuple(reversed(shape)) if layout == Layout.F else tuple(shape)
+    if memory_type == MemoryType.DEVICE:
+        import jax
+
+        jnp = _jnp()
+        arr = jnp.zeros(buf_shape, dtype=dtype)
+        if device is not None:
+            arr = jax.device_put(arr, device)
+        return arr
+    return np.zeros(buf_shape, dtype=dtype)
+
+
+def make_device_scalar(handle, value, dtype=None) -> MdArray:
+    jnp = _jnp()
+    return MdArray(jnp.asarray(value, dtype=dtype), MemoryType.DEVICE, Layout.C)
+
+
+def make_device_vector(handle, n: int, dtype=np.float32) -> MdArray:
+    return MdArray(_zeros((n,), dtype, MemoryType.DEVICE, Layout.C,
+                          getattr(handle, "device", None)), MemoryType.DEVICE, Layout.C)
+
+
+def make_device_matrix(handle, n_rows: int, n_cols: int, dtype=np.float32,
+                       layout: Layout = Layout.C) -> MdArray:
+    return MdArray(_zeros((n_rows, n_cols), dtype, MemoryType.DEVICE, layout,
+                          getattr(handle, "device", None)), MemoryType.DEVICE, layout)
+
+
+def make_device_mdarray(handle, shape: Sequence[int], dtype=np.float32,
+                        layout: Layout = Layout.C) -> MdArray:
+    return MdArray(_zeros(tuple(shape), dtype, MemoryType.DEVICE, layout,
+                          getattr(handle, "device", None)), MemoryType.DEVICE, layout)
+
+
+def make_host_scalar(value, dtype=None) -> MdArray:
+    return MdArray(np.asarray(value, dtype=dtype), MemoryType.HOST, Layout.C)
+
+
+def make_host_vector(n: int, dtype=np.float32) -> MdArray:
+    return MdArray(np.zeros((n,), dtype=dtype), MemoryType.HOST, Layout.C)
+
+
+def make_host_matrix(n_rows: int, n_cols: int, dtype=np.float32,
+                     layout: Layout = Layout.C) -> MdArray:
+    return MdArray(_zeros((n_rows, n_cols), dtype, MemoryType.HOST, layout),
+                   MemoryType.HOST, layout)
+
+
+# -- input coercion (the pylibraft `__cuda_array_interface__` role) ----------
+
+def as_device_array(x: Any, dtype=None, handle=None):
+    """Coerce *x* (jax array, numpy, anything with ``__array__``/dlpack,
+    MdSpan) to a ``jax.Array``, optionally casting.
+
+    Plays the role of pylibraft's ``__cuda_array_interface__`` input handling
+    (reference python/pylibraft/common/input_validation + cai_wrapper):
+    accept any array-like, check dtype, hand a device buffer to the kernel.
+    """
+    jnp = _jnp()
+    if isinstance(x, MdSpan):
+        x = x.logical()
+    if hasattr(x, "__dlpack__") and not isinstance(x, np.ndarray) and not hasattr(x, "aval"):
+        try:
+            import jax
+
+            x = jax.dlpack.from_dlpack(x)
+        except Exception:
+            x = np.asarray(x)
+    arr = jnp.asarray(x)
+    if dtype is not None and arr.dtype != np.dtype(dtype):
+        arr = arr.astype(dtype)
+    return arr
+
+
+def expect_matrix(x, name: str = "input") -> None:
+    expects(getattr(x, "ndim", None) == 2, f"{name} must be a 2-d array")
+
+
+def expect_same_dtype(*arrays) -> None:
+    dts = {np.dtype(a.dtype) for a in arrays}
+    expects(len(dts) == 1, f"dtype mismatch: {dts}")

@@ -1,0 +1,29 @@
+"""Utility layer: shape/tile math and host helpers.
+
+Counterpart of reference ``raft/util/`` (SURVEY.md §2.2).  Most of the
+reference's device utilities (warp shuffles, vectorized IO, atomics) are
+subsumed by the XLA/Pallas programming model; what survives is integer/tile
+math (``ceildiv``, ``Pow2`` — reference util/pow2_utils.cuh,
+util/integer_utils.hpp), TPU tiling helpers, and small host-side tools
+(``itertools``-style parameter products for tests/bench, a prime sieve).
+"""
+
+from raft_tpu.util.math import (  # noqa: F401
+    Pow2,
+    alignTo,
+    alignDown,
+    ceildiv,
+    is_pow2,
+    next_pow2,
+    round_up_safe,
+)
+from raft_tpu.util.tiling import (  # noqa: F401
+    LANE,
+    SUBLANE,
+    min_tile,
+    pad_dim,
+    pad_to_tile,
+    unpad,
+)
+from raft_tpu.util.itertools import product_of  # noqa: F401
+from raft_tpu.util.seive import Seive  # noqa: F401

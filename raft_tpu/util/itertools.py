@@ -1,0 +1,13 @@
+"""Host-side test/bench parameter helpers (reference util/itertools.hpp)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, Iterable, List
+
+
+def product_of(**axes: Iterable[Any]) -> List[Dict[str, Any]]:
+    """Cartesian product of named axes → list of dicts, like the reference's
+    ``raft::util::itertools::product`` used to build test input grids."""
+    keys = list(axes)
+    return [dict(zip(keys, vals)) for vals in itertools.product(*axes.values())]

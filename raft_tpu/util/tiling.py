@@ -1,0 +1,60 @@
+"""TPU tile-shape helpers.
+
+The VPU is 8x128 and the MXU 128x128; minimum tile shapes depend on dtype
+(see /opt/skills/guides/pallas_guide.md).  Pallas kernels and padded-layout
+data structures (IVF lists, top-k buffers) use these helpers to pick
+hardware-friendly shapes — the role the reference's ``Pow2``/veclen machinery
+plays for CUDA (e.g. neighbors/ivf_flat_types.hpp:30 kIndexGroupSize).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from raft_tpu.util.math import round_up_safe
+
+LANE = 128  # last-dim tile width, all dtypes
+SUBLANE = 8  # second-to-last for f32
+
+_SUBLANES = {
+    4: 8,  # f32/i32
+    2: 16,  # bf16/f16
+    1: 32,  # i8/fp8
+}
+
+
+def min_tile(dtype) -> Tuple[int, int]:
+    """Minimum (sublane, lane) tile for *dtype*."""
+    itemsize = np.dtype(dtype).itemsize
+    return (_SUBLANES.get(itemsize, 8), LANE)
+
+
+def pad_dim(n: int, multiple: int) -> int:
+    return round_up_safe(max(n, 1), multiple)
+
+
+def pad_to_tile(x, row_mult: int = SUBLANE, col_mult: int = LANE, fill=0):
+    """Pad the trailing two dims of *x* up to multiples of (row_mult,
+    col_mult) with *fill*; returns (padded, original_shape)."""
+    import jax.numpy as jnp
+
+    shape = x.shape
+    if x.ndim == 1:
+        n = pad_dim(shape[0], col_mult)
+        if n != shape[0]:
+            x = jnp.pad(x, (0, n - shape[0]), constant_values=fill)
+        return x, shape
+    r, c = shape[-2], shape[-1]
+    rp, cp = pad_dim(r, row_mult), pad_dim(c, col_mult)
+    if (rp, cp) != (r, c):
+        pad = [(0, 0)] * (x.ndim - 2) + [(0, rp - r), (0, cp - c)]
+        x = jnp.pad(x, pad, constant_values=fill)
+    return x, shape
+
+
+def unpad(x, orig_shape):
+    """Slice a padded array back to *orig_shape*."""
+    idx = tuple(slice(0, s) for s in orig_shape)
+    return x[idx]

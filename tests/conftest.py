@@ -1,0 +1,49 @@
+"""Test configuration: run on CPU with 8 virtual devices.
+
+This is the TPU-land analogue of the reference's LocalCUDACluster-based
+distributed tests (SURVEY.md §4): a multi-device single-host environment
+available in CI without real chips, via
+``--xla_force_host_platform_device_count``.
+"""
+
+import os
+
+# Must run before jax is imported anywhere.  Force CPU (the environment may
+# preset JAX_PLATFORMS to a TPU platform; tests always run on the virtual
+# 8-device CPU mesh — bench.py and __graft_entry__.py use the real chip).
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# f64 paths are part of the API surface (reference supports double everywhere).
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize may have registered/selected a TPU PJRT
+# plugin already; force the platform choice at the config level too.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    from jax.sharding import Mesh
+    import numpy as np
+
+    return Mesh(np.array(devices[:8]), ("x",))
+
+
+@pytest.fixture
+def handle():
+    from raft_tpu.core import Handle
+
+    return Handle()

@@ -1,0 +1,221 @@
+"""Core runtime tests — counterpart of reference cpp/test/{handle.cpp,
+interruptible.cu, mdarray.cu, span.cu, pow2_utils.cu, memory_type.cpp}."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import raft_tpu
+from raft_tpu.core import (
+    Handle,
+    KeyValuePair,
+    Layout,
+    LogicError,
+    MemoryType,
+    as_device_array,
+    expects,
+    fail,
+    interruptible,
+    kvp_min,
+    make_device_matrix,
+    make_device_vector,
+    make_host_matrix,
+)
+from raft_tpu.core.logger import Logger, INFO, DEBUG
+from raft_tpu.util import Pow2, Seive, ceildiv, min_tile, pad_to_tile, unpad
+
+
+class TestHandle:
+    def test_default(self):
+        h = Handle()
+        assert h.get_device() is not None
+        assert not h.is_stream_pool_initialized()
+        with pytest.raises(LogicError):
+            h.get_stream_from_stream_pool()
+
+    def test_stream_pool(self):
+        h = Handle(n_streams=4)
+        assert h.stream_pool_size == 4
+        assert h.get_stream_from_stream_pool(6).name == "pool2"
+        assert h.get_next_usable_stream(1).name == "pool1"
+        h.sync_stream_pool()
+        h.wait_stream_pool_on_stream()
+
+    def test_sync_records_work(self):
+        import jax.numpy as jnp
+
+        h = Handle()
+        x = jnp.ones((128, 128)) @ jnp.ones((128, 128))
+        h.get_stream().record(x)
+        h.sync()
+        assert h.get_stream().query()
+
+    def test_comms_slots(self):
+        h = Handle()
+        assert not h.comms_initialized()
+        with pytest.raises(LogicError):
+            h.get_comms()
+        h.set_comms("fake")
+        assert h.get_comms() == "fake"
+        h.set_subcomm("rows", "sub")
+        assert h.get_subcomm("rows") == "sub"
+        with pytest.raises(LogicError):
+            h.get_subcomm("cols")
+
+
+class TestErrors:
+    def test_expects(self):
+        expects(True, "ok")
+        with pytest.raises(LogicError, match="bad thing"):
+            expects(False, "bad thing")
+        with pytest.raises(LogicError):
+            fail("boom")
+
+    def test_hierarchy(self):
+        from raft_tpu.core import RaftError
+
+        assert issubclass(LogicError, RaftError)
+
+
+class TestMdarray:
+    def test_device_matrix(self, handle):
+        m = make_device_matrix(handle, 4, 5)
+        assert m.shape == (4, 5)
+        assert m.memory_type == MemoryType.DEVICE
+        assert np.asarray(m).shape == (4, 5)
+
+    def test_col_major(self, handle):
+        m = make_device_matrix(handle, 4, 6, layout=Layout.F)
+        assert m.shape == (4, 6)
+        assert m.data.shape == (6, 4)  # stored transposed
+        assert m.view().shape == (4, 6)
+
+    def test_host(self):
+        m = make_host_matrix(3, 3, dtype=np.float64)
+        assert m.memory_type == MemoryType.HOST
+        assert m.dtype == np.float64
+
+    def test_vector(self, handle):
+        v = make_device_vector(handle, 7)
+        assert v.shape == (7,)
+        assert v.size() == 7
+
+    def test_as_device_array(self):
+        x = as_device_array(np.arange(6).reshape(2, 3), dtype=np.float32)
+        assert x.dtype == np.float32
+        np.testing.assert_array_equal(np.asarray(x), [[0, 1, 2], [3, 4, 5]])
+
+
+class TestInterruptible:
+    def test_synchronize_completes(self):
+        import jax.numpy as jnp
+
+        x = jnp.arange(1024.0) * 2
+        interruptible.synchronize(x)
+
+    def test_cancel_from_other_thread(self):
+        import jax
+
+        from raft_tpu.core.error import InterruptedError_
+
+        main_tid = threading.get_ident()
+        # Pre-create the token so the canceller never races token creation.
+        interruptible.get_token(main_tid)
+        raised = {}
+
+        def canceller():
+            time.sleep(0.05)
+            interruptible.cancel(main_tid)
+
+        t = threading.Thread(target=canceller)
+        t.start()
+        try:
+            with pytest.raises(InterruptedError_):
+                # Spin in yields until cancelled (no long device op needed).
+                deadline = time.time() + 5
+                while time.time() < deadline:
+                    interruptible.yield_()
+                    time.sleep(0.001)
+                raised["timeout"] = True
+        finally:
+            t.join()
+        assert "timeout" not in raised
+
+    def test_context_manager(self):
+        with interruptible.interruptible():
+            pass  # no KeyboardInterrupt -> nothing happens
+        interruptible.yield_()  # token is clean
+
+
+class TestLogger:
+    def test_levels_and_callback(self):
+        logger = Logger.get()
+        captured = []
+        logger.set_callback(lambda lvl, msg: captured.append(msg))
+        old = logger.get_level()
+        try:
+            logger.set_level(INFO)
+            raft_tpu.core.log_info("hello %d", 42)
+            raft_tpu.core.log_debug("invisible")
+            logger.set_level(DEBUG)
+            raft_tpu.core.log_debug("visible")
+        finally:
+            logger.set_callback(None)
+            logger.set_level(old)
+        assert any("hello 42" in m for m in captured)
+        assert not any("invisible" in m for m in captured)
+        assert any("visible" in m for m in captured)
+
+    def test_time_range(self):
+        from raft_tpu.core import time_range
+
+        with time_range("unit-test-range"):
+            pass
+
+
+class TestKvp:
+    def test_kvp_min(self):
+        import jax.numpy as jnp
+
+        a = KeyValuePair(jnp.array([0, 1, 2]), jnp.array([1.0, 5.0, 3.0]))
+        b = KeyValuePair(jnp.array([3, 0, 2]), jnp.array([2.0, 4.0, 3.0]))
+        m = kvp_min(a, b)
+        np.testing.assert_array_equal(np.asarray(m.key), [0, 0, 2])
+        np.testing.assert_allclose(np.asarray(m.value), [1.0, 4.0, 3.0])
+
+
+class TestUtil:
+    def test_ceildiv(self):
+        assert ceildiv(10, 3) == 4
+        assert ceildiv(9, 3) == 3
+
+    def test_pow2(self):
+        p = Pow2(128)
+        assert p.round_up(129) == 256
+        assert p.round_down(129) == 128
+        assert p.div(256) == 2
+        assert p.mod(130) == 2
+        with pytest.raises(ValueError):
+            Pow2(100)
+
+    def test_tiling(self):
+        import jax.numpy as jnp
+
+        assert min_tile(np.float32) == (8, 128)
+        assert min_tile(np.int8) == (32, 128)
+        x = jnp.ones((5, 100))
+        xp, orig = pad_to_tile(x)
+        assert xp.shape == (8, 128)
+        assert unpad(xp, orig).shape == (5, 100)
+
+    def test_seive(self):
+        s = Seive(50)
+        assert s.is_prime(47)
+        assert not s.is_prime(49)
+        assert list(s.primes()[:5]) == [2, 3, 5, 7, 11]
+
+
+def test_mesh_fixture(mesh8):
+    assert mesh8.devices.size == 8
