@@ -1,0 +1,69 @@
+"""Dense linear algebra (reference raft/linalg/ — SURVEY.md §2.3).
+
+Elementwise ops, reductions, BLAS, matrix-vector broadcasts, and
+factorizations.  The reference's cuBLAS/cuSOLVER wrapper layer disappears:
+XLA lowers dot/eigh/svd/qr natively onto the MXU.
+"""
+
+from raft_tpu.linalg.types import Apply, NormType, axis_for  # noqa: F401
+from raft_tpu.linalg.elementwise import (  # noqa: F401
+    add,
+    add_scalar,
+    binary_op,
+    divide,
+    divide_scalar,
+    map_,
+    map_offset,
+    multiply,
+    multiply_scalar,
+    power,
+    power_scalar,
+    sqrt,
+    subtract,
+    subtract_scalar,
+    ternary_op,
+    unary_op,
+)
+from raft_tpu.linalg.reduce import (  # noqa: F401
+    coalesced_reduction,
+    col_norm,
+    map_reduce,
+    map_then_reduce,
+    mean_squared_error,
+    norm,
+    normalize,
+    reduce,
+    reduce_cols_by_key,
+    reduce_rows_by_key,
+    row_norm,
+    strided_reduction,
+)
+from raft_tpu.linalg.blas import axpy, dot, gemm, gemv, transpose  # noqa: F401
+from raft_tpu.linalg.matrix_vector import (  # noqa: F401
+    binary_add,
+    binary_div,
+    binary_div_skip_zero,
+    binary_mult,
+    binary_sub,
+    matrix_vector_op,
+    matrix_vector_op2,
+)
+from raft_tpu.linalg.decompositions import (  # noqa: F401
+    cholesky_r1_update,
+    eig_dc,
+    eig_jacobi,
+    eig_sel_dc,
+    evaluate_svd_by_reconstruction,
+    lstsq_eig,
+    lstsq_qr,
+    lstsq_svd_jacobi,
+    lstsq_svd_qr,
+    qr_get_q,
+    qr_get_qr,
+    rsvd_fixed_rank,
+    rsvd_perc,
+    svd_eig,
+    svd_jacobi,
+    svd_qr,
+    svd_reconstruction,
+)

@@ -1,0 +1,58 @@
+"""BLAS-level operations.
+
+Counterparts of reference raft/linalg/{gemm,gemv,axpy,dot,transpose}.cuh —
+there these call cuBLAS through linalg/detail/cublas_wrappers.hpp (1035 LoC);
+on TPU every case is a ``jax.lax.dot_general`` the XLA compiler maps onto the
+MXU, so the wrapper layer is tiny.  Matmuls prefer float32 inputs with
+bf16-friendly shapes; ``precision`` exposes XLA's precision knob (the
+tf32-vs-fp32 analogue of cublasMath modes).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+def gemm(a, b, alpha=1.0, beta=0.0, c=None, trans_a: bool = False,
+         trans_b: bool = False, precision=None):
+    """C = alpha·op(A)·op(B) + beta·C (reference linalg/gemm.cuh)."""
+    a = a.T if trans_a else a
+    b = b.T if trans_b else b
+    out = jnp.matmul(a, b, precision=precision)
+    if alpha != 1.0:
+        out = out * alpha
+    if c is not None and beta != 0.0:
+        out = out + beta * c
+    return out
+
+
+def gemv(a, x, alpha=1.0, beta=0.0, y=None, trans_a: bool = False,
+         precision=None):
+    """y = alpha·op(A)·x + beta·y (reference linalg/gemv.cuh)."""
+    a = a.T if trans_a else a
+    out = jnp.matmul(a, x, precision=precision)
+    if alpha != 1.0:
+        out = out * alpha
+    if y is not None and beta != 0.0:
+        out = out + beta * y
+    return out
+
+
+def axpy(alpha, x, y):
+    """y + alpha·x (reference linalg/axpy.cuh)."""
+    return y + alpha * x
+
+
+def dot(x, y):
+    """Inner product (reference linalg/dot.cuh)."""
+    return jnp.dot(x.ravel(), y.ravel())
+
+
+def transpose(a):
+    """Out-of-place transpose (reference linalg/transpose.cuh)."""
+    return a.T

@@ -1,0 +1,184 @@
+"""Dense factorizations: eig, SVD, QR, randomized SVD, least squares,
+Cholesky rank-1 update.
+
+Counterparts of reference raft/linalg/{eig,svd,qr,rsvd,lstsq,
+cholesky_r1_update}.cuh, which call cuSOLVER through the 1422-LoC
+linalg/detail/cusolver_wrappers.hpp.  On TPU the factorizations are XLA's
+native eigh/svd/qr lowerings; the reference's algorithm-selection variants
+(Jacobi vs divide-and-conquer, etc.) are kept as named entry points that
+share one backend, because XLA chooses its own algorithm.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+# -- eig (reference linalg/eig.cuh) ------------------------------------------
+
+def eig_dc(a) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric eigendecomposition, divide-and-conquer flavor
+    (reference ``eigDC``).  Returns (eig_vectors, eig_vals), ascending."""
+    w, v = jnp.linalg.eigh(a)
+    return v, w
+
+
+def eig_jacobi(a, tol: float = 1e-7, sweeps: int = 15):
+    """Jacobi-flavor symmetric eig (reference ``eigJacobi``).  XLA's eigh is
+    itself an (implicitly iterative) one-sided Jacobi on TPU; tol/sweeps are
+    accepted for parity."""
+    return eig_dc(a)
+
+
+def eig_sel_dc(a, n_eig_vals: int, smallest: bool = True):
+    """Select a subset of eigenpairs (reference ``eigSelDC`` with
+    EigVecMemUsage).  Returns (vectors[n, n_eig], vals[n_eig])."""
+    v, w = eig_dc(a)
+    if smallest:
+        return v[:, :n_eig_vals], w[:n_eig_vals]
+    return v[:, -n_eig_vals:], w[-n_eig_vals:]
+
+
+# -- SVD (reference linalg/svd.cuh) ------------------------------------------
+
+def svd_qr(a, gen_left_vec: bool = True, gen_right_vec: bool = True):
+    """SVD via QR-iteration flavor (reference ``svdQR``).
+    Returns (U, S, V) with a = U @ diag(S) @ V.T (V returned, not V.T —
+    matches the reference's output convention)."""
+    u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+    return (u if gen_left_vec else None, s, vt.T if gen_right_vec else None)
+
+
+def svd_eig(a):
+    """SVD via eigendecomposition of the Gram matrix (reference ``svdEig``) —
+    faster for tall-skinny a when only right vectors / values are needed."""
+    n = a.shape[1]
+    gram = a.T @ a
+    v, w = eig_dc(gram)
+    # ascending eigvals → descending singular values
+    w = w[::-1]
+    v = v[:, ::-1]
+    s = jnp.sqrt(jnp.maximum(w, 0))
+    u = (a @ v) / jnp.maximum(s, 1e-30)[None, :]
+    return u, s, v
+
+
+def svd_jacobi(a, gen_left_vec: bool = True, gen_right_vec: bool = True,
+               tol: float = 1e-7, sweeps: int = 15):
+    """Jacobi SVD (reference ``svdJacobi``) — shares XLA's svd backend."""
+    return svd_qr(a, gen_left_vec, gen_right_vec)
+
+
+def svd_reconstruction(u, s, v):
+    """a ≈ U diag(S) Vᵀ (reference ``svdReconstruction``)."""
+    return (u * s[None, :]) @ v.T
+
+
+def evaluate_svd_by_reconstruction(a, u, s, v, tol: float = 1e-4) -> bool:
+    """reference ``evaluateSVDByL2Norm``: relative Frobenius reconstruction
+    error under tol."""
+    rec = svd_reconstruction(u, s, v)
+    err = jnp.linalg.norm(a - rec) / jnp.maximum(jnp.linalg.norm(a), 1e-30)
+    return bool(err < tol)
+
+
+# -- QR (reference linalg/qr.cuh) --------------------------------------------
+
+def qr_get_q(a):
+    """Q factor only (reference ``qrGetQ``)."""
+    q, _ = jnp.linalg.qr(a)
+    return q
+
+
+def qr_get_qr(a):
+    """(Q, R) (reference ``qrGetQR``)."""
+    return jnp.linalg.qr(a)
+
+
+# -- randomized SVD (reference linalg/rsvd.cuh) ------------------------------
+
+def rsvd_fixed_rank(a, k: int, p: int = 10, n_iters: int = 2, key=None,
+                    use_bbt: bool = False):
+    """Randomized SVD with fixed rank k and oversampling p (reference
+    ``rsvdFixedRank``/``rsvdPerc``; Halko et al. range finder + power
+    iterations).  Returns (U[m,k], S[k], V[n,k])."""
+    m, n = a.shape
+    q = min(k + p, min(m, n))
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    omega = jax.random.normal(key, (n, q), dtype=a.dtype)
+    y = a @ omega
+    qmat = qr_get_q(y)
+    for _ in range(n_iters):
+        z = a.T @ qmat
+        z = qr_get_q(z)
+        y = a @ z
+        qmat = qr_get_q(y)
+    b = qmat.T @ a  # q × n
+    ub, s, vbt = jnp.linalg.svd(b, full_matrices=False)
+    u = qmat @ ub
+    return u[:, :k], s[:k], vbt.T[:, :k]
+
+
+def rsvd_perc(a, perc: float, p: int = 10, n_iters: int = 2, key=None):
+    """Rank given as a fraction of min(m,n) (reference ``rsvdPerc``)."""
+    k = max(1, int(perc * min(a.shape)))
+    return rsvd_fixed_rank(a, k, p, n_iters, key)
+
+
+# -- least squares (reference linalg/lstsq.cuh — 4 algorithms) ---------------
+
+def lstsq_svd_qr(a, b):
+    """minimize ‖a·w − b‖ via SVD (reference ``lstsqSvdQR``)."""
+    u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+    s_inv = jnp.where(s > 1e-10 * s[0], 1.0 / s, 0.0)
+    return vt.T @ (s_inv * (u.T @ b))
+
+
+def lstsq_svd_jacobi(a, b):
+    """reference ``lstsqSvdJacobi`` — shares the SVD backend."""
+    return lstsq_svd_qr(a, b)
+
+
+def lstsq_eig(a, b):
+    """Normal-equations path via eigendecomposition of aᵀa
+    (reference ``lstsqEig``)."""
+    g = a.T @ a
+    v, w = eig_dc(g)
+    w_inv = jnp.where(w > 1e-10 * jnp.maximum(w[-1], 1e-30), 1.0 / w, 0.0)
+    return v @ (w_inv * (v.T @ (a.T @ b)))
+
+
+def lstsq_qr(a, b):
+    """QR path (reference ``lstsqQR``)."""
+    q, r = jnp.linalg.qr(a)
+    return jax.scipy.linalg.solve_triangular(r, q.T @ b, lower=False)
+
+
+# -- Cholesky rank-1 update (reference linalg/cholesky_r1_update.cuh) --------
+
+def cholesky_r1_update(l_factor, x, lower: bool = True):
+    """Given L = chol(A) (n×n) and new row/col x (n+1 entries, x[:n] the new
+    off-diagonal block, x[n] the new diagonal entry), return the (n+1)×(n+1)
+    Cholesky factor of the bordered matrix — the incremental-Cholesky
+    used by the reference's sequential solvers
+    (linalg/cholesky_r1_update.cuh ``choleskyRank1Update``)."""
+    n = l_factor.shape[0]
+    expects(x.shape[0] == n + 1, "x must have n+1 entries")
+    if not lower:
+        l_factor = l_factor.T
+    b = x[:n]
+    d = x[n]
+    # Solve L y = b for the new row of the factor.
+    y = jax.scipy.linalg.solve_triangular(l_factor, b, lower=True) if n > 0 else b[:0]
+    diag_new = jnp.sqrt(jnp.maximum(d - jnp.sum(y * y), 0))
+    top = jnp.concatenate([l_factor, jnp.zeros((n, 1), l_factor.dtype)], axis=1)
+    bot = jnp.concatenate([y, diag_new[None]])[None, :]
+    out = jnp.concatenate([top, bot], axis=0)
+    return out if lower else out.T

@@ -1,0 +1,93 @@
+"""Elementwise operations.
+
+Counterparts of reference raft/linalg/{add,subtract,multiply,divide,power,
+sqrt,eltwise,unary_op,binary_op,ternary_op,map}.cuh — there these are custom
+grid-stride CUDA kernels; on TPU they are single XLA HLO ops which the
+compiler fuses into neighbors, so each is a one-liner.  They exist to give
+parity of API surface and a stable place for dtype checks.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+# -- binary (array ⊕ array) — reference linalg/eltwise.cuh + per-op headers --
+
+def add(x, y):
+    return jnp.add(x, y)
+
+
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+def power(x, y):
+    return jnp.power(x, y)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+# -- scalar variants (reference *_scalar in eltwise.cuh) ---------------------
+
+def add_scalar(x, scalar):
+    return x + scalar
+
+
+def subtract_scalar(x, scalar):
+    return x - scalar
+
+
+def multiply_scalar(x, scalar):
+    return x * scalar
+
+
+def divide_scalar(x, scalar):
+    return x / scalar
+
+
+def power_scalar(x, scalar):
+    return jnp.power(x, scalar)
+
+
+# -- generic op application (reference unary_op.cuh, binary_op.cuh,
+#    ternary_op.cuh, map.cuh) ------------------------------------------------
+
+def unary_op(x, op):
+    """Apply ``op(x_i)`` elementwise (reference linalg/unary_op.cuh)."""
+    return op(x)
+
+
+def binary_op(x, y, op):
+    """Apply ``op(x_i, y_i)`` elementwise (reference linalg/binary_op.cuh)."""
+    return op(x, y)
+
+
+def ternary_op(x, y, z, op):
+    """Apply ``op(x_i, y_i, z_i)`` elementwise (reference linalg/ternary_op.cuh)."""
+    return op(x, y, z)
+
+
+def map_(op, *arrays):
+    """N-ary elementwise map (reference linalg/map.cuh ``map``)."""
+    return op(*arrays)
+
+
+def map_offset(shape, op):
+    """Map over flat element offsets (reference linalg/map.cuh ``map_offset``):
+    ``out[i] = op(i)`` for row-major offset i, reshaped to *shape*."""
+    n = 1
+    for s in shape:
+        n *= s
+    idx = jnp.arange(n)
+    return op(idx).reshape(shape)

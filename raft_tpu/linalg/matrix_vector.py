@@ -1,0 +1,60 @@
+"""Broadcast a vector operation across matrix rows or columns.
+
+Counterparts of reference raft/linalg/matrix_vector_op.cuh (generic op) and
+raft/linalg/matrix_vector.cuh (named arithmetic ops), which are backed by the
+vectorized ``matrix::linewise_op`` CUDA kernels — on TPU these are plain
+broadcasting expressions XLA fuses.
+
+Convention (matches the reference): ``bcast_along_rows=True`` means the
+vector has one entry per *column* (it is broadcast along rows, len == n_cols);
+False means one entry per row.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+
+def _shape_vec(vec, bcast_along_rows: bool):
+    return vec[None, :] if bcast_along_rows else vec[:, None]
+
+
+def matrix_vector_op(mat, vec, op: Callable, bcast_along_rows: bool = True):
+    """out[i,j] = op(mat[i,j], vec[j or i]) (reference linalg/matrix_vector_op.cuh)."""
+    return op(mat, _shape_vec(vec, bcast_along_rows))
+
+
+def matrix_vector_op2(mat, vec1, vec2, op: Callable, bcast_along_rows: bool = True):
+    """Two-vector variant (reference matrix_vector_op.cuh overload)."""
+    return op(mat, _shape_vec(vec1, bcast_along_rows), _shape_vec(vec2, bcast_along_rows))
+
+
+def binary_mult(mat, vec, bcast_along_rows: bool = True):
+    return mat * _shape_vec(vec, bcast_along_rows)
+
+
+def binary_div(mat, vec, bcast_along_rows: bool = True):
+    return mat / _shape_vec(vec, bcast_along_rows)
+
+
+def binary_div_skip_zero(mat, vec, bcast_along_rows: bool = True,
+                         return_zero: bool = False):
+    """Divide, leaving entries (or zeroing them) where vec≈0
+    (reference linalg/matrix_vector.cuh ``binary_div_skip_zero``)."""
+    v = _shape_vec(vec, bcast_along_rows)
+    nz = v != 0
+    safe = jnp.where(nz, v, 1)
+    out = mat / safe
+    if return_zero:
+        return jnp.where(nz, out, 0)
+    return jnp.where(nz, out, mat)
+
+
+def binary_add(mat, vec, bcast_along_rows: bool = True):
+    return mat + _shape_vec(vec, bcast_along_rows)
+
+
+def binary_sub(mat, vec, bcast_along_rows: bool = True):
+    return mat - _shape_vec(vec, bcast_along_rows)

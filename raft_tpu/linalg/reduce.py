@@ -1,0 +1,146 @@
+"""Reductions: general reduce, norms, map-reduce, reduce-by-key.
+
+Counterparts of reference raft/linalg/{reduce,coalesced_reduction,
+strided_reduction,map_then_reduce,map_reduce,mean_squared_error,norm,
+reduce_rows_by_key,reduce_cols_by_key,normalize}.cuh.  The reference needs
+distinct kernels for coalesced (reduce along contiguous dim) vs strided
+access; XLA's reduce handles either axis with layout-aware codegen, so both
+names lower to the same implementation here — kept for API parity and for
+callers that encode intent in the name.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.linalg.types import Apply, NormType
+
+
+def _identity(x):
+    return x
+
+
+def reduce(
+    data,
+    apply: Apply = Apply.ALONG_COLUMNS,
+    init=0.0,
+    main_op: Callable = _identity,
+    reduce_op: Callable = jnp.add,
+    final_op: Callable = _identity,
+    inplace_add=None,
+):
+    """General row/col reduction (reference linalg/reduce.cuh:50):
+    ``out = final_op(reduce_op.fold(main_op(x)) ⊕ init)``.
+
+    ALONG_COLUMNS → one output per row; ALONG_ROWS → one per column.
+    """
+    axis = 1 if apply == Apply.ALONG_COLUMNS else 0
+    mapped = main_op(data)
+    if reduce_op is jnp.add:
+        acc = jnp.sum(mapped, axis=axis)
+    elif reduce_op is jnp.minimum:
+        acc = jnp.min(mapped, axis=axis)
+    elif reduce_op is jnp.maximum:
+        acc = jnp.max(mapped, axis=axis)
+    else:
+        # Generic associative fold via lax.reduce on the chosen axis.
+        moved = jnp.moveaxis(mapped, axis, 0)
+        acc = jax.lax.associative_scan(reduce_op, moved, axis=0)[-1]
+    acc = reduce_op(acc, jnp.asarray(init, acc.dtype)) if init is not None else acc
+    out = final_op(acc)
+    if inplace_add is not None:
+        out = out + inplace_add
+    return out
+
+
+def coalesced_reduction(data, init=0.0, main_op=_identity, reduce_op=jnp.add,
+                        final_op=_identity):
+    """Reduce along the contiguous (last) dimension
+    (reference linalg/coalesced_reduction.cuh)."""
+    return reduce(data, Apply.ALONG_COLUMNS, init, main_op, reduce_op, final_op)
+
+
+def strided_reduction(data, init=0.0, main_op=_identity, reduce_op=jnp.add,
+                      final_op=_identity):
+    """Reduce along the strided (first) dimension
+    (reference linalg/strided_reduction.cuh)."""
+    return reduce(data, Apply.ALONG_ROWS, init, main_op, reduce_op, final_op)
+
+
+def map_then_reduce(op: Callable, *arrays, neutral=0.0, reduce_op=jnp.add):
+    """Full map-then-reduce to a scalar (reference linalg/map_then_reduce.cuh
+    ``mapThenReduce``/``mapThenSumReduce``)."""
+    mapped = op(*arrays)
+    if reduce_op is jnp.add:
+        return jnp.sum(mapped)
+    flat = mapped.ravel()
+    return jax.lax.associative_scan(reduce_op, flat)[-1]
+
+
+def map_reduce(op: Callable, reduce_op: Callable, *arrays, neutral=0.0):
+    """reference linalg/map_reduce.cuh."""
+    return map_then_reduce(op, *arrays, neutral=neutral, reduce_op=reduce_op)
+
+
+def mean_squared_error(a, b, weight=1.0):
+    """reference linalg/mean_squared_error.cuh: weighted mean of (a-b)^2."""
+    d = a - b
+    return jnp.mean(d * d) * weight
+
+
+def norm(data, norm_type: NormType = NormType.L2Norm,
+         apply: Apply = Apply.ALONG_COLUMNS, final_op=_identity):
+    """Row/column norms (reference linalg/norm.cuh ``rowNorm``/``colNorm``).
+
+    Note: RAFT's L2 "norm" is the *squared* L2 norm (sum of squares) unless a
+    sqrt final_op is passed — behavior preserved.
+    """
+    axis = 1 if apply == Apply.ALONG_COLUMNS else 0
+    if norm_type == NormType.L1Norm:
+        out = jnp.sum(jnp.abs(data), axis=axis)
+    elif norm_type == NormType.L2Norm:
+        out = jnp.sum(data * data, axis=axis)
+    else:
+        out = jnp.max(jnp.abs(data), axis=axis)
+    return final_op(out)
+
+
+def row_norm(data, norm_type: NormType = NormType.L2Norm, final_op=_identity):
+    return norm(data, norm_type, Apply.ALONG_COLUMNS, final_op)
+
+
+def col_norm(data, norm_type: NormType = NormType.L2Norm, final_op=_identity):
+    return norm(data, norm_type, Apply.ALONG_ROWS, final_op)
+
+
+def reduce_rows_by_key(data, keys, n_unique_keys: int, weights=None):
+    """Sum rows that share a key (reference linalg/reduce_rows_by_key.cuh):
+    ``out[k, :] = Σ_{i: keys[i]==k} w_i · data[i, :]``.
+
+    On TPU this is a segment-sum — XLA lowers it to sorted scatter-adds; this
+    is k-means' M-step workhorse.
+    """
+    vals = data if weights is None else data * weights[:, None]
+    return jax.ops.segment_sum(vals, keys, num_segments=n_unique_keys)
+
+
+def reduce_cols_by_key(data, keys, n_unique_keys: int):
+    """Sum columns that share a key (reference linalg/reduce_cols_by_key.cuh):
+    out[i, k] = Σ_{j: keys[j]==k} data[i, j]."""
+    return jax.ops.segment_sum(data.T, keys, num_segments=n_unique_keys).T
+
+
+def normalize(data, norm_type: NormType = NormType.L2Norm, eps: float = 1e-8,
+              apply: Apply = Apply.ALONG_COLUMNS):
+    """Row-normalize (reference linalg/normalize.cuh ``row_normalize``)."""
+    axis = 1 if apply == Apply.ALONG_COLUMNS else 0
+    if norm_type == NormType.L1Norm:
+        n = jnp.sum(jnp.abs(data), axis=axis, keepdims=True)
+    elif norm_type == NormType.L2Norm:
+        n = jnp.sqrt(jnp.sum(data * data, axis=axis, keepdims=True))
+    else:
+        n = jnp.max(jnp.abs(data), axis=axis, keepdims=True)
+    return jnp.where(n > eps, data / jnp.maximum(n, eps), data)

@@ -1,0 +1,32 @@
+"""Shared linalg types (reference raft/linalg/linalg_types.hpp,
+raft/linalg/norm_types... — nvcc-free POD types in *_types.hpp files)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class Apply(enum.Enum):
+    """Which dimension a row/col-wise operation applies along
+    (reference linalg/linalg_types.hpp ``Apply::ALONG_ROWS/ALONG_COLUMNS``).
+
+    ALONG_ROWS: one result per column (reduce across rows).
+    ALONG_COLUMNS: one result per row (reduce across columns).
+    """
+
+    ALONG_ROWS = "along_rows"
+    ALONG_COLUMNS = "along_columns"
+
+
+class NormType(enum.Enum):
+    """Reference linalg/norm.cuh ``NormType`` {L1Norm, L2Norm, LinfNorm}."""
+
+    L1Norm = "l1"
+    L2Norm = "l2"
+    LinfNorm = "linf"
+
+
+# Axis conventions: RAFT rowNorm produces one value per row (reduce along
+# columns); colNorm one per column.
+def axis_for(apply: Apply) -> int:
+    return 0 if apply == Apply.ALONG_ROWS else 1

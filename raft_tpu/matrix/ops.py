@@ -1,0 +1,193 @@
+"""Matrix manipulation primitives.
+
+Counterparts of reference raft/matrix/{argmax,argmin,col_wise_sort,copy,
+diagonal,gather,init,linewise_op,math,norm,print,reciprocal,reverse,slice,
+sqrt,threshold,triangular}.cuh (impls in matrix/detail/).  CUDA needed CUB
+segmented sorts and bespoke vectorized linewise kernels; on TPU each is one
+XLA op.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+def argmax(mat, axis: int = 1):
+    """Per-row argmax (reference matrix/argmax.cuh)."""
+    return jnp.argmax(mat, axis=axis)
+
+
+def argmin(mat, axis: int = 1):
+    """Per-row argmin (reference matrix/argmin.cuh)."""
+    return jnp.argmin(mat, axis=axis)
+
+
+def col_wise_sort(mat, return_indices: bool = False):
+    """Sort each column (reference matrix/col_wise_sort.cuh, CUB segmented
+    sort there; one XLA sort here)."""
+    if return_indices:
+        idx = jnp.argsort(mat, axis=0)
+        return jnp.take_along_axis(mat, idx, axis=0), idx
+    return jnp.sort(mat, axis=0)
+
+
+def copy(mat):
+    """reference matrix/copy.cuh."""
+    return jnp.array(mat)
+
+
+def truncate_rows(mat, n_rows: int):
+    """Copy the first n_rows (reference ``trunc_zero_origin``)."""
+    return mat[:n_rows]
+
+
+def diagonal(mat):
+    """Extract the main diagonal (reference matrix/diagonal.cuh
+    ``get_diagonal``)."""
+    return jnp.diagonal(mat)
+
+
+def set_diagonal(mat, vec):
+    """Set the main diagonal (reference ``set_diagonal``)."""
+    n = min(mat.shape)
+    vec = jnp.asarray(vec, mat.dtype)
+    return mat.at[jnp.arange(n), jnp.arange(n)].set(vec[:n])
+
+
+def matrix_diagonal_inverse(mat):
+    """Invert diagonal entries in place (reference ``invert_diagonal``)."""
+    n = min(mat.shape)
+    idx = jnp.arange(n)
+    return mat.at[idx, idx].set(1.0 / mat[idx, idx])
+
+
+def eye(n_rows: int, n_cols: Optional[int] = None, dtype=jnp.float32):
+    """Identity init (reference matrix/init.cuh / math.cuh ``setValue``-family)."""
+    return jnp.eye(n_rows, n_cols, dtype=dtype)
+
+
+def fill(shape, value, dtype=jnp.float32):
+    """Constant init (reference matrix/init.cuh ``fill``)."""
+    return jnp.full(shape, value, dtype=dtype)
+
+
+def gather(mat, row_indices):
+    """Gather rows: out[i, :] = mat[map[i], :] (reference matrix/gather.cuh)."""
+    return jnp.take(mat, row_indices, axis=0)
+
+
+def gather_if(mat, row_indices, stencil, pred: Callable, fallback=0.0):
+    """Conditional row gather (reference ``gather_if``): rows whose stencil
+    fails *pred* are filled with *fallback*."""
+    out = jnp.take(mat, row_indices, axis=0)
+    keep = pred(stencil)
+    return jnp.where(keep[:, None], out, jnp.asarray(fallback, out.dtype))
+
+
+def linewise_op(mat, vecs, op: Callable, along_lines: bool = True):
+    """Apply op(mat_element, vec_element...) broadcast along rows or columns
+    (reference matrix/linewise_op.cuh:60 ``linewise_op``).
+
+    along_lines=True: vec[j] is matched to columns (len == n_cols).
+    """
+    if not isinstance(vecs, (tuple, list)):
+        vecs = (vecs,)
+    shaped = [v[None, :] if along_lines else v[:, None] for v in vecs]
+    return op(mat, *shaped)
+
+
+def power(mat, scalar=None):
+    """Element-wise square (×scalar) (reference matrix/math.cuh ``power``)."""
+    out = mat * mat
+    return out if scalar is None else out * scalar
+
+
+def seq_root(mat, scalar=None, set_neg_zero: bool = False):
+    """Element-wise square root (reference matrix/math.cuh ``seqRoot``)."""
+    x = mat if scalar is None else mat * scalar
+    if set_neg_zero:
+        x = jnp.maximum(x, 0)
+    return jnp.sqrt(x)
+
+
+sqrt = seq_root
+
+
+def ratio(mat):
+    """Divide by the global sum (reference matrix/math.cuh ``ratio``)."""
+    return mat / jnp.sum(mat)
+
+
+def weighted_ratio(mat, weights):
+    return mat / jnp.sum(mat * weights)
+
+
+def reciprocal(mat, scalar=1.0, set_zero: bool = True, thres: float = 1e-15):
+    """Element-wise scalar/x with small-value guard
+    (reference matrix/reciprocal.cuh)."""
+    if set_zero:
+        safe = jnp.where(jnp.abs(mat) > thres, mat, 1.0)
+        return jnp.where(jnp.abs(mat) > thres, scalar / safe, 0.0)
+    return scalar / mat
+
+
+def reverse(mat, axis: int = 0):
+    """Reverse rows or columns (reference matrix/reverse.cuh ``col_reverse``/
+    ``row_reverse``)."""
+    return jnp.flip(mat, axis=axis)
+
+
+def sign_flip(mat):
+    """Flip the sign of each column so its max-|value| entry is positive —
+    deterministic eigenvector orientation (reference matrix/math.cuh
+    ``signFlip``)."""
+    idx = jnp.argmax(jnp.abs(mat), axis=0)
+    signs = jnp.sign(mat[idx, jnp.arange(mat.shape[1])])
+    signs = jnp.where(signs == 0, 1.0, signs)
+    return mat * signs[None, :]
+
+
+def slice_matrix(mat, x1: int, y1: int, x2: int, y2: int):
+    """Submatrix [x1:x2, y1:y2] (reference matrix/slice.cuh)."""
+    expects(0 <= x1 < x2 <= mat.shape[0] and 0 <= y1 < y2 <= mat.shape[1],
+            "slice bounds out of range")
+    return mat[x1:x2, y1:y2]
+
+
+def sq_norm(mat):
+    """Frobenius norm squared (reference matrix/norm.cuh ``l2_norm`` —
+    note the reference returns the sum of squares)."""
+    return jnp.sum(mat * mat)
+
+
+def threshold(mat, value: float):
+    """Zero entries below *value* (reference matrix/threshold.cuh
+    ``zero_small_values`` semantics: |x| < thres → 0)."""
+    return jnp.where(jnp.abs(mat) < value, 0.0, mat)
+
+
+zero_small_values = threshold
+
+
+def upper_triangular(mat):
+    """Copy the upper triangle (reference matrix/triangular.cuh)."""
+    return jnp.triu(mat)
+
+
+def print_matrix(mat, name: str = "", h_separator: str = " ",
+                 v_separator: str = "\n") -> str:
+    """Format/print (reference matrix/print.cuh) — returns the string."""
+    import numpy as np
+
+    arr = np.asarray(mat)
+    body = v_separator.join(
+        h_separator.join(f"{v:g}" for v in row) for row in np.atleast_2d(arr)
+    )
+    text = f"{name}\n{body}" if name else body
+    print(text)
+    return text

@@ -1,0 +1,29 @@
+"""RNG + data generators (reference raft/random/ — SURVEY.md §2.6)."""
+
+from raft_tpu.random.rng import (  # noqa: F401
+    GeneratorType,
+    RngState,
+    bernoulli,
+    discrete,
+    exponential,
+    fill,
+    gumbel,
+    laplace,
+    logistic,
+    lognormal,
+    normal,
+    normal_int,
+    normal_table,
+    permute,
+    rayleigh,
+    sample_without_replacement,
+    scaled_bernoulli,
+    uniform,
+    uniform_int,
+)
+from raft_tpu.random.generators import (  # noqa: F401
+    make_blobs,
+    make_regression,
+    multi_variable_gaussian,
+    rmat_rectangular_gen,
+)

@@ -1,0 +1,160 @@
+"""Synthetic data generators.
+
+Counterparts of reference raft/random/{make_blobs,make_regression,
+multi_variable_gaussian,rmat_rectangular_generator}.cuh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.random.rng import RngState, _key_of
+
+
+def make_blobs(
+    rng,
+    n_samples: int,
+    n_features: int,
+    n_clusters: int = 3,
+    cluster_std: float = 1.0,
+    centers=None,
+    center_box: Tuple[float, float] = (-10.0, 10.0),
+    shuffle: bool = True,
+    dtype=jnp.float32,
+):
+    """Isotropic Gaussian blobs (reference random/make_blobs.cuh:63).
+
+    Returns (X[n_samples, n_features], labels[n_samples], centers).
+    """
+    key = _key_of(rng)
+    k_centers, k_labels, k_noise, k_shuffle = jax.random.split(key, 4)
+    if centers is None:
+        lo, hi = center_box
+        centers = jax.random.uniform(k_centers, (n_clusters, n_features),
+                                     dtype=dtype, minval=lo, maxval=hi)
+    else:
+        centers = jnp.asarray(centers, dtype)
+        n_clusters = centers.shape[0]
+    # Balanced labels like the reference's default (proportions=None).
+    labels = jnp.arange(n_samples) % n_clusters
+    if shuffle:
+        labels = jax.random.permutation(k_shuffle, labels)
+    noise = jax.random.normal(k_noise, (n_samples, n_features), dtype=dtype)
+    x = jnp.take(centers, labels, axis=0) + cluster_std * noise
+    return x, labels.astype(jnp.int32), centers
+
+
+def make_regression(
+    rng,
+    n_samples: int,
+    n_features: int,
+    n_informative: Optional[int] = None,
+    n_targets: int = 1,
+    bias: float = 0.0,
+    noise: float = 0.0,
+    effective_rank: Optional[int] = None,
+    tail_strength: float = 0.5,
+    shuffle: bool = True,
+    coef: bool = False,
+    dtype=jnp.float32,
+):
+    """Linear-model regression problem (reference random/make_regression.cuh).
+
+    Returns (X, y[, w]) with y = X·w + bias + N(0, noise).
+    """
+    if n_informative is None:
+        n_informative = n_features
+    n_informative = min(n_informative, n_features)
+    key = _key_of(rng)
+    k_x, k_w, k_noise, k_shuf, k_lr = jax.random.split(key, 5)
+    x = jax.random.normal(k_x, (n_samples, n_features), dtype=dtype)
+    if effective_rank is not None:
+        # Low-rank-plus-tail singular profile (reference uses the same
+        # scheme borrowed from sklearn's make_low_rank_matrix).
+        n = min(n_samples, n_features)
+        sing = jnp.arange(n, dtype=dtype)
+        low = jnp.exp(-(sing / effective_rank) ** 2)
+        tail = jnp.exp(-0.1 * sing / effective_rank)
+        s = (1 - tail_strength) * low + tail_strength * tail
+        u, _, vt = jnp.linalg.svd(x, full_matrices=False)
+        x = (u * s[None, :]) @ vt
+    w = jnp.zeros((n_features, n_targets), dtype=dtype)
+    w_inf = 100.0 * jax.random.uniform(k_w, (n_informative, n_targets), dtype=dtype)
+    w = w.at[:n_informative].set(w_inf)
+    y = x @ w + bias
+    if noise > 0:
+        y = y + noise * jax.random.normal(k_noise, y.shape, dtype=dtype)
+    if shuffle:
+        perm = jax.random.permutation(k_shuf, n_samples)
+        x, y = x[perm], y[perm]
+    y = y.squeeze(-1) if n_targets == 1 else y
+    if coef:
+        return x, y, w.squeeze(-1) if n_targets == 1 else w
+    return x, y
+
+
+def multi_variable_gaussian(rng, mean, cov, n_samples: int = 1,
+                            method: str = "cholesky"):
+    """Sample from N(mean, cov) (reference
+    random/multi_variable_gaussian.cuh — cuSOLVER potrf/eig there, XLA
+    cholesky/eigh here).  Returns [n_samples, dim]."""
+    mean = jnp.asarray(mean)
+    cov = jnp.asarray(cov)
+    dim = mean.shape[0]
+    expects(cov.shape == (dim, dim), "cov must be [dim, dim]")
+    key = _key_of(rng)
+    z = jax.random.normal(key, (n_samples, dim), dtype=cov.dtype)
+    if method == "cholesky":
+        l_factor = jnp.linalg.cholesky(cov)
+        samples = z @ l_factor.T
+    else:  # eigendecomposition path ("jacobi" in the reference)
+        w, v = jnp.linalg.eigh(cov)
+        samples = z @ (v * jnp.sqrt(jnp.maximum(w, 0))[None, :]).T
+    return mean[None, :] + samples
+
+
+def rmat_rectangular_gen(rng, theta, r_scale: int, c_scale: int, n_edges: int,
+                         clip_and_flip: bool = False):
+    """Stochastic Kronecker (R-MAT) graph generator (reference
+    random/rmat_rectangular_generator.cuh:75).
+
+    *theta* is the per-level quadrant distribution, shape
+    [max(r_scale, c_scale), 4] (a, b, c, d per level), or [4] to reuse one
+    distribution for all levels.  Returns (out[n_edges, 2], src, dst) with
+    src ∈ [0, 2^r_scale), dst ∈ [0, 2^c_scale).
+
+    TPU-first design: instead of the reference's per-thread loop over levels,
+    sample all (edge, level) quadrant choices in one [n_edges, max_scale]
+    categorical draw and reduce with bit-shifts — one fused XLA program.
+    """
+    theta = jnp.asarray(theta, jnp.float32)
+    max_scale = max(r_scale, c_scale)
+    if theta.ndim == 1:
+        theta = jnp.broadcast_to(theta[None, :], (max_scale, 4))
+    expects(theta.shape[0] >= max_scale, "theta must cover max(r_scale, c_scale) levels")
+    key = _key_of(rng)
+    logits = jnp.log(jnp.maximum(theta[:max_scale], 1e-37))  # [L, 4]
+    # quad[e, l] ∈ {0,1,2,3} = (row_bit<<1)|col_bit
+    quad = jax.random.categorical(key, logits[None, :, :], axis=-1,
+                                  shape=(n_edges, max_scale))
+    row_bits = (quad >> 1) & 1
+    col_bits = quad & 1
+    # Level l contributes bit (scale-1-l); levels beyond a side's scale
+    # contribute nothing to that side (rectangular adjustment).
+    r_weights = jnp.where(jnp.arange(max_scale) < r_scale,
+                          1 << (jnp.maximum(r_scale - 1 - jnp.arange(max_scale), 0)), 0)
+    c_weights = jnp.where(jnp.arange(max_scale) < c_scale,
+                          1 << (jnp.maximum(c_scale - 1 - jnp.arange(max_scale), 0)), 0)
+    src = jnp.sum(row_bits * r_weights[None, :], axis=1).astype(jnp.int64)
+    dst = jnp.sum(col_bits * c_weights[None, :], axis=1).astype(jnp.int64)
+    if clip_and_flip:
+        # Mirror edges above the diagonal into the lower triangle (square case).
+        lo = jnp.minimum(src, dst)
+        hi = jnp.maximum(src, dst)
+        src, dst = hi, lo
+    out = jnp.stack([src, dst], axis=1)
+    return out, src, dst

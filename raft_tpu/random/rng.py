@@ -1,0 +1,162 @@
+"""Device RNG: counter-based generators and the distribution surface.
+
+Counterpart of reference raft/random/rng.cuh + rng_state.hpp:28-52 —
+``RngState`` {seed, base_subsequence, GeneratorType} with device-side Philox/
+PCG generators (random/detail/rng_device.cuh:438,536).  JAX's RNG is already
+counter-based (threefry2x32 default, or rbg), so :class:`RngState` maps
+directly: seed → PRNGKey, base_subsequence → fold_in counter.  Every call
+advances the subsequence exactly like the reference's
+``rng_state.advance(...)``, so results are reproducible per (seed, call #).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+class GeneratorType(enum.Enum):
+    """reference random/rng_state.hpp:28 — GenPhilox / GenPC."""
+
+    GenPhilox = "philox"  # → threefry (counter-based, same guarantees)
+    GenPC = "pc"  # → rbg
+
+
+class RngState:
+    """Mutable RNG state (reference random/rng_state.hpp:37-52)."""
+
+    def __init__(self, seed: int = 0, base_subsequence: int = 0,
+                 type: GeneratorType = GeneratorType.GenPhilox):
+        self.seed = int(seed)
+        self.base_subsequence = int(base_subsequence)
+        self.type = type
+
+    def advance(self, subsequences: int = 1) -> None:
+        """reference rng_state.hpp ``advance``."""
+        self.base_subsequence += int(subsequences)
+
+    def key(self) -> jax.Array:
+        k = jax.random.PRNGKey(self.seed)
+        return jax.random.fold_in(k, self.base_subsequence)
+
+    def next_key(self) -> jax.Array:
+        k = self.key()
+        self.advance()
+        return k
+
+
+def _key_of(rng) -> jax.Array:
+    if isinstance(rng, RngState):
+        return rng.next_key()
+    return rng  # raw PRNGKey
+
+
+# -- distributions (reference random/rng.cuh) --------------------------------
+
+def uniform(rng, shape, low=0.0, high=1.0, dtype=jnp.float32):
+    return jax.random.uniform(_key_of(rng), shape, dtype=dtype, minval=low, maxval=high)
+
+
+def uniform_int(rng, shape, low, high, dtype=jnp.int32):
+    return jax.random.randint(_key_of(rng), shape, low, high, dtype=dtype)
+
+
+def normal(rng, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return mu + sigma * jax.random.normal(_key_of(rng), shape, dtype=dtype)
+
+
+def normal_int(rng, shape, mu, sigma, dtype=jnp.int32):
+    return jnp.rint(mu + sigma * jax.random.normal(_key_of(rng), shape)).astype(dtype)
+
+
+def normal_table(rng, n_rows, mu_vec, sigma_vec=None, sigma=1.0, dtype=jnp.float32):
+    """Per-column mean/std normal table (reference ``normalTable``)."""
+    mu_vec = jnp.asarray(mu_vec, dtype)
+    n_cols = mu_vec.shape[0]
+    sig = jnp.asarray(sigma_vec, dtype) if sigma_vec is not None else sigma
+    z = jax.random.normal(_key_of(rng), (n_rows, n_cols), dtype=dtype)
+    return mu_vec[None, :] + z * (sig[None, :] if sigma_vec is not None else sig)
+
+
+def lognormal(rng, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return jnp.exp(normal(rng, shape, mu, sigma, dtype))
+
+
+def gumbel(rng, shape, mu=0.0, beta=1.0, dtype=jnp.float32):
+    return mu + beta * jax.random.gumbel(_key_of(rng), shape, dtype=dtype)
+
+
+def logistic(rng, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    return mu + scale * jax.random.logistic(_key_of(rng), shape, dtype=dtype)
+
+
+def exponential(rng, shape, lambda_=1.0, dtype=jnp.float32):
+    return jax.random.exponential(_key_of(rng), shape, dtype=dtype) / lambda_
+
+
+def rayleigh(rng, shape, sigma=1.0, dtype=jnp.float32):
+    u = jax.random.uniform(_key_of(rng), shape, dtype=dtype, minval=1e-12, maxval=1.0)
+    return sigma * jnp.sqrt(-2.0 * jnp.log(u))
+
+
+def laplace(rng, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    return mu + scale * jax.random.laplace(_key_of(rng), shape, dtype=dtype)
+
+
+def bernoulli(rng, shape, prob=0.5):
+    return jax.random.bernoulli(_key_of(rng), prob, shape)
+
+
+def scaled_bernoulli(rng, shape, prob=0.5, scale=1.0, dtype=jnp.float32):
+    """±scale with P(+)=1-prob (reference ``scaled_bernoulli``)."""
+    b = jax.random.bernoulli(_key_of(rng), prob, shape)
+    return jnp.where(b, -scale, scale).astype(dtype)
+
+
+def fill(rng, shape, value, dtype=jnp.float32):
+    """reference ``fill`` (lives in rng.cuh for historical reasons)."""
+    return jnp.full(shape, value, dtype=dtype)
+
+
+def discrete(rng, shape, weights, dtype=jnp.int32):
+    """Sample indices ∝ weights (reference ``discrete``)."""
+    w = jnp.asarray(weights)
+    logits = jnp.log(jnp.maximum(w, 1e-37))
+    return jax.random.categorical(_key_of(rng), logits, shape=shape).astype(dtype)
+
+
+def sample_without_replacement(rng, in_items, n_samples: int, weights=None,
+                               return_indices: bool = False):
+    """Weighted sampling without replacement (reference
+    ``sampleWithoutReplacement``, rng.cuh) — Gumbel-top-k trick: one sort,
+    no rejection loop (TPU-friendly; the reference uses per-thread rejection).
+    """
+    in_items = jnp.asarray(in_items)
+    n = in_items.shape[0]
+    expects(0 < n_samples <= n, "sampledLen must be in (0, len]")
+    key = _key_of(rng)
+    g = jax.random.gumbel(key, (n,))
+    if weights is not None:
+        g = g + jnp.log(jnp.maximum(jnp.asarray(weights), 1e-37))
+    _, idx = jax.lax.top_k(g, n_samples)
+    out = jnp.take(in_items, idx, axis=0)
+    if return_indices:
+        return out, idx
+    return out
+
+
+def permute(rng, in_array=None, n: Optional[int] = None, return_perm: bool = True):
+    """Random permutation of rows (reference random/permute.cuh).  Returns
+    (permuted_rows, perm) like the reference's (out, outPerms)."""
+    if in_array is not None:
+        n = in_array.shape[0]
+    perm = jax.random.permutation(_key_of(rng), n)
+    if in_array is None:
+        return perm
+    out = jnp.take(in_array, perm, axis=0)
+    return (out, perm) if return_perm else out

@@ -23,6 +23,7 @@ import jax  # noqa: E402
 # The environment's sitecustomize may have registered/selected a TPU PJRT
 # plugin already; force the platform choice at the config level too.
 jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402
 

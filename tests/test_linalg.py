@@ -1,0 +1,216 @@
+"""Dense linalg tests — counterpart of reference cpp/test/linalg/* (naive
+host oracles via numpy, reference SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import linalg
+from raft_tpu.linalg import Apply, NormType
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+class TestElementwise:
+    def test_binary(self, rng):
+        x = rng.random((8, 16)).astype(np.float32)
+        y = rng.random((8, 16)).astype(np.float32) + 0.5
+        np.testing.assert_allclose(linalg.add(x, y), x + y, rtol=1e-6)
+        np.testing.assert_allclose(linalg.subtract(x, y), x - y, rtol=1e-6)
+        np.testing.assert_allclose(linalg.multiply(x, y), x * y, rtol=1e-6)
+        np.testing.assert_allclose(linalg.divide(x, y), x / y, rtol=1e-5)
+        np.testing.assert_allclose(linalg.power(jnp.abs(jnp.asarray(x)), 2.0), x**2, rtol=1e-5)
+        np.testing.assert_allclose(linalg.sqrt(x), np.sqrt(x), rtol=1e-6)
+
+    def test_scalar(self, rng):
+        x = rng.random(32).astype(np.float32)
+        np.testing.assert_allclose(linalg.add_scalar(x, 2.0), x + 2, rtol=1e-6)
+        np.testing.assert_allclose(linalg.multiply_scalar(x, 3.0), x * 3, rtol=1e-6)
+
+    def test_ops(self, rng):
+        x = rng.random(16).astype(np.float32)
+        y = rng.random(16).astype(np.float32)
+        z = rng.random(16).astype(np.float32)
+        np.testing.assert_allclose(linalg.unary_op(x, lambda a: a * 2), x * 2, rtol=1e-6)
+        np.testing.assert_allclose(
+            linalg.ternary_op(x, y, z, lambda a, b, c: a + b * c), x + y * z, rtol=1e-6
+        )
+
+    def test_map_offset(self):
+        out = linalg.map_offset((2, 3), lambda i: i * 2)
+        np.testing.assert_array_equal(out, [[0, 2, 4], [6, 8, 10]])
+
+
+class TestReduce:
+    def test_reduce_rows_cols(self, rng):
+        x = rng.random((6, 10)).astype(np.float32)
+        np.testing.assert_allclose(
+            linalg.reduce(x, Apply.ALONG_COLUMNS), x.sum(axis=1), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            linalg.reduce(x, Apply.ALONG_ROWS), x.sum(axis=0), rtol=1e-5
+        )
+
+    def test_reduce_ops(self, rng):
+        x = rng.standard_normal((6, 10)).astype(np.float32)
+        # sum of squares with final sqrt = L2 row norm
+        out = linalg.reduce(x, Apply.ALONG_COLUMNS, main_op=lambda v: v * v,
+                            final_op=jnp.sqrt)
+        np.testing.assert_allclose(out, np.linalg.norm(x, axis=1), rtol=1e-5)
+        out = linalg.reduce(x, Apply.ALONG_COLUMNS, init=np.inf,
+                            reduce_op=jnp.minimum)
+        np.testing.assert_allclose(out, x.min(axis=1), rtol=1e-6)
+
+    def test_norms(self, rng):
+        x = rng.standard_normal((5, 7)).astype(np.float32)
+        np.testing.assert_allclose(
+            linalg.row_norm(x, NormType.L1Norm), np.abs(x).sum(axis=1), rtol=1e-5
+        )
+        # RAFT L2 "norm" is the squared norm
+        np.testing.assert_allclose(
+            linalg.row_norm(x, NormType.L2Norm), (x * x).sum(axis=1), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            linalg.col_norm(x, NormType.LinfNorm), np.abs(x).max(axis=0), rtol=1e-6
+        )
+
+    def test_map_then_reduce(self, rng):
+        x = rng.random((4, 4)).astype(np.float32)
+        out = linalg.map_then_reduce(lambda a: a * a, x)
+        np.testing.assert_allclose(out, (x * x).sum(), rtol=1e-5)
+
+    def test_mse(self, rng):
+        a = rng.random(100).astype(np.float32)
+        b = rng.random(100).astype(np.float32)
+        np.testing.assert_allclose(
+            linalg.mean_squared_error(a, b), ((a - b) ** 2).mean(), rtol=1e-5
+        )
+
+    def test_reduce_rows_by_key(self, rng):
+        x = rng.random((10, 4)).astype(np.float32)
+        keys = np.array([0, 1, 0, 2, 1, 0, 2, 2, 1, 0])
+        out = linalg.reduce_rows_by_key(x, keys, 3)
+        expected = np.stack([x[keys == k].sum(axis=0) for k in range(3)])
+        np.testing.assert_allclose(out, expected, rtol=1e-5)
+        # weighted
+        w = rng.random(10).astype(np.float32)
+        out = linalg.reduce_rows_by_key(x, keys, 3, weights=w)
+        expected = np.stack([(x[keys == k] * w[keys == k, None]).sum(axis=0) for k in range(3)])
+        np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+    def test_reduce_cols_by_key(self, rng):
+        x = rng.random((4, 6)).astype(np.float32)
+        keys = np.array([0, 1, 1, 2, 0, 2])
+        out = linalg.reduce_cols_by_key(x, keys, 3)
+        expected = np.stack([x[:, keys == k].sum(axis=1) for k in range(3)], axis=1)
+        np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+    def test_normalize(self, rng):
+        x = rng.standard_normal((5, 8)).astype(np.float32)
+        out = np.asarray(linalg.normalize(x))
+        np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0, rtol=1e-5)
+
+
+class TestBlas:
+    def test_gemm(self, rng):
+        a = rng.random((5, 7)).astype(np.float32)
+        b = rng.random((7, 3)).astype(np.float32)
+        np.testing.assert_allclose(linalg.gemm(a, b), a @ b, rtol=1e-4)
+        np.testing.assert_allclose(
+            linalg.gemm(a.T, b, trans_a=True), a @ b, rtol=1e-4
+        )
+        c = rng.random((5, 3)).astype(np.float32)
+        np.testing.assert_allclose(
+            linalg.gemm(a, b, alpha=2.0, beta=0.5, c=c), 2 * a @ b + 0.5 * c, rtol=1e-4
+        )
+
+    def test_gemv_axpy_dot(self, rng):
+        a = rng.random((5, 7)).astype(np.float32)
+        x = rng.random(7).astype(np.float32)
+        y = rng.random(5).astype(np.float32)
+        np.testing.assert_allclose(linalg.gemv(a, x), a @ x, rtol=1e-4)
+        np.testing.assert_allclose(linalg.axpy(2.0, y, y), 3 * y, rtol=1e-5)
+        np.testing.assert_allclose(linalg.dot(x, x), (x * x).sum(), rtol=1e-4)
+
+
+class TestMatrixVector:
+    def test_ops(self, rng):
+        m = rng.random((4, 6)).astype(np.float32)
+        v_col = rng.random(6).astype(np.float32) + 0.5
+        v_row = rng.random(4).astype(np.float32) + 0.5
+        np.testing.assert_allclose(
+            linalg.binary_mult(m, v_col, True), m * v_col[None, :], rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            linalg.binary_div(m, v_row, False), m / v_row[:, None], rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            linalg.matrix_vector_op(m, v_col, jnp.add), m + v_col[None, :], rtol=1e-6
+        )
+
+    def test_div_skip_zero(self):
+        m = np.ones((2, 3), np.float32)
+        v = np.array([2.0, 0.0, 4.0], np.float32)
+        out = linalg.binary_div_skip_zero(m, v, True, return_zero=True)
+        np.testing.assert_allclose(out, [[0.5, 0, 0.25]] * 2, rtol=1e-6)
+
+
+class TestDecompositions:
+    def test_eig(self, rng):
+        a = rng.standard_normal((8, 8))
+        a = (a + a.T).astype(np.float64)
+        v, w = linalg.eig_dc(a)
+        np.testing.assert_allclose(np.asarray(v) @ np.diag(w) @ np.asarray(v).T, a, atol=1e-8)
+        v2, w2 = linalg.eig_sel_dc(a, 3, smallest=True)
+        assert v2.shape == (8, 3) and w2.shape == (3,)
+        np.testing.assert_allclose(w2, np.sort(np.linalg.eigvalsh(a))[:3], atol=1e-8)
+
+    def test_svd(self, rng):
+        a = rng.standard_normal((10, 6)).astype(np.float64)
+        u, s, v = linalg.svd_qr(a)
+        np.testing.assert_allclose(linalg.svd_reconstruction(u, s, v), a, atol=1e-8)
+        assert linalg.evaluate_svd_by_reconstruction(a, u, s, v)
+        u2, s2, v2 = linalg.svd_eig(a)
+        np.testing.assert_allclose(s2, s, atol=1e-6)
+        np.testing.assert_allclose(linalg.svd_reconstruction(u2, s2, v2), a, atol=1e-6)
+
+    def test_qr(self, rng):
+        a = rng.standard_normal((8, 5)).astype(np.float64)
+        q, r = linalg.qr_get_qr(a)
+        np.testing.assert_allclose(np.asarray(q) @ np.asarray(r), a, atol=1e-10)
+        np.testing.assert_allclose(np.asarray(q).T @ np.asarray(q), np.eye(5), atol=1e-10)
+
+    def test_rsvd(self, rng):
+        # Low-rank matrix: rsvd should recover it nearly exactly.
+        u0 = rng.standard_normal((50, 5))
+        v0 = rng.standard_normal((5, 30))
+        a = (u0 @ v0).astype(np.float64)
+        u, s, v = linalg.rsvd_fixed_rank(a, k=5, p=5, n_iters=3)
+        np.testing.assert_allclose(linalg.svd_reconstruction(u, s, v), a, atol=1e-6)
+
+    def test_lstsq(self, rng):
+        a = rng.standard_normal((40, 6)).astype(np.float64)
+        w_true = rng.standard_normal(6)
+        b = a @ w_true
+        for fn in (linalg.lstsq_svd_qr, linalg.lstsq_svd_jacobi,
+                   linalg.lstsq_eig, linalg.lstsq_qr):
+            np.testing.assert_allclose(fn(a, b), w_true, atol=1e-8, err_msg=str(fn))
+
+    def test_cholesky_r1_update(self, rng):
+        a = rng.standard_normal((6, 6))
+        a = (a @ a.T + 6 * np.eye(6)).astype(np.float64)
+        l_full = np.linalg.cholesky(a)
+        l_sub = np.linalg.cholesky(a[:5, :5])
+        x = a[:, 5][: 6]  # new column incl. diagonal
+        l_up = linalg.cholesky_r1_update(l_sub, x)
+        np.testing.assert_allclose(l_up, l_full, atol=1e-10)
+
+
+class TestTranspose:
+    def test_transpose(self, rng):
+        a = rng.random((3, 5)).astype(np.float32)
+        np.testing.assert_array_equal(linalg.transpose(a), a.T)

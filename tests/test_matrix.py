@@ -1,0 +1,104 @@
+"""Matrix primitive tests — counterpart of reference cpp/test/matrix/*."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import matrix
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def test_argmax_argmin(rng):
+    m = rng.standard_normal((6, 9)).astype(np.float32)
+    np.testing.assert_array_equal(matrix.argmax(m), m.argmax(axis=1))
+    np.testing.assert_array_equal(matrix.argmin(m), m.argmin(axis=1))
+
+
+def test_col_wise_sort(rng):
+    m = rng.standard_normal((8, 4)).astype(np.float32)
+    np.testing.assert_allclose(matrix.col_wise_sort(m), np.sort(m, axis=0), rtol=1e-6)
+    s, idx = matrix.col_wise_sort(m, return_indices=True)
+    np.testing.assert_allclose(np.take_along_axis(m, np.asarray(idx), axis=0), s, rtol=1e-6)
+
+
+def test_diagonal(rng):
+    m = rng.random((5, 5)).astype(np.float32)
+    np.testing.assert_allclose(matrix.diagonal(m), np.diag(m), rtol=1e-6)
+    out = matrix.set_diagonal(jnp.asarray(m), jnp.zeros(5))
+    assert np.allclose(np.diag(np.asarray(out)), 0)
+    inv = matrix.matrix_diagonal_inverse(jnp.asarray(m))
+    np.testing.assert_allclose(np.diag(np.asarray(inv)), 1 / np.diag(m), rtol=1e-5)
+
+
+def test_gather(rng):
+    m = rng.random((10, 3)).astype(np.float32)
+    idx = np.array([2, 2, 0, 7])
+    np.testing.assert_allclose(matrix.gather(m, idx), m[idx], rtol=1e-6)
+    stencil = np.array([1.0, -1.0, 1.0, -1.0], np.float32)
+    out = matrix.gather_if(m, idx, stencil, lambda s: s > 0, fallback=-5.0)
+    expected = m[idx].copy()
+    expected[1] = -5.0
+    expected[3] = -5.0
+    np.testing.assert_allclose(out, expected, rtol=1e-6)
+
+
+def test_linewise_op(rng):
+    m = rng.random((4, 6)).astype(np.float32)
+    v = rng.random(6).astype(np.float32)
+    np.testing.assert_allclose(
+        matrix.linewise_op(m, v, jnp.add, along_lines=True), m + v[None, :], rtol=1e-6
+    )
+
+
+def test_math(rng):
+    m = rng.random((3, 4)).astype(np.float32) + 0.1
+    np.testing.assert_allclose(matrix.power(m), m * m, rtol=1e-6)
+    np.testing.assert_allclose(matrix.seq_root(m), np.sqrt(m), rtol=1e-6)
+    np.testing.assert_allclose(matrix.ratio(m), m / m.sum(), rtol=1e-5)
+    np.testing.assert_allclose(matrix.reciprocal(m), 1 / m, rtol=1e-5)
+    np.testing.assert_allclose(matrix.sq_norm(m), (m * m).sum(), rtol=1e-5)
+
+
+def test_reciprocal_zero_guard():
+    m = np.array([[2.0, 0.0]], np.float32)
+    out = matrix.reciprocal(m, set_zero=True)
+    np.testing.assert_allclose(out, [[0.5, 0.0]], rtol=1e-6)
+
+
+def test_sign_flip(rng):
+    m = rng.standard_normal((6, 4)).astype(np.float32)
+    out = np.asarray(matrix.sign_flip(m))
+    for j in range(4):
+        i = np.abs(out[:, j]).argmax()
+        assert out[i, j] > 0
+    # Flip preserves column subspace
+    np.testing.assert_allclose(np.abs(out), np.abs(m), rtol=1e-6)
+
+
+def test_reverse_slice_triangular(rng):
+    m = rng.random((6, 6)).astype(np.float32)
+    np.testing.assert_allclose(matrix.reverse(m, axis=0), m[::-1], rtol=1e-6)
+    np.testing.assert_allclose(matrix.slice_matrix(m, 1, 2, 4, 5), m[1:4, 2:5], rtol=1e-6)
+    np.testing.assert_allclose(matrix.upper_triangular(m), np.triu(m), rtol=1e-6)
+    from raft_tpu.core import LogicError
+
+    with pytest.raises(LogicError):
+        matrix.slice_matrix(m, 0, 0, 7, 2)
+
+
+def test_threshold():
+    m = np.array([[0.001, 0.5], [-0.002, -2.0]], np.float32)
+    out = matrix.threshold(m, 0.01)
+    np.testing.assert_allclose(out, [[0, 0.5], [0, -2.0]], rtol=1e-6)
+
+
+def test_init_and_print(capsys):
+    np.testing.assert_array_equal(matrix.eye(3), np.eye(3, dtype=np.float32))
+    np.testing.assert_array_equal(matrix.fill((2, 2), 7.0), np.full((2, 2), 7.0, np.float32))
+    text = matrix.print_matrix(np.array([[1.0, 2.0]]), name="m")
+    assert "1 2" in text

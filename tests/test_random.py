@@ -1,0 +1,141 @@
+"""RNG + generator tests — counterpart of reference cpp/test/random/*
+(moment-matching oracles, as in test/random/rng.cu)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import random as rrandom
+from raft_tpu.random import RngState
+
+
+def test_rng_state_reproducible():
+    a = rrandom.uniform(RngState(123), (1000,))
+    b = rrandom.uniform(RngState(123), (1000,))
+    c = rrandom.uniform(RngState(124), (1000,))
+    np.testing.assert_array_equal(a, b)
+    assert not np.allclose(a, c)
+
+
+def test_rng_state_advances():
+    st = RngState(5)
+    a = rrandom.uniform(st, (100,))
+    b = rrandom.uniform(st, (100,))
+    assert not np.allclose(a, b)
+    assert st.base_subsequence == 2
+
+
+@pytest.mark.parametrize(
+    "fn,kwargs,mean,std,tol",
+    [
+        (rrandom.uniform, dict(low=-1.0, high=3.0), 1.0, 4 / np.sqrt(12), 0.1),
+        (rrandom.normal, dict(mu=2.0, sigma=0.5), 2.0, 0.5, 0.05),
+        (rrandom.lognormal, dict(mu=0.0, sigma=0.25), np.exp(0.03125), None, 0.05),
+        (rrandom.gumbel, dict(mu=0.0, beta=1.0), 0.5772, None, 0.05),
+        (rrandom.logistic, dict(mu=1.0, scale=0.5), 1.0, None, 0.05),
+        (rrandom.exponential, dict(lambda_=2.0), 0.5, None, 0.05),
+        (rrandom.laplace, dict(mu=0.0, scale=1.0), 0.0, None, 0.1),
+        (rrandom.rayleigh, dict(sigma=1.0), np.sqrt(np.pi / 2), None, 0.05),
+    ],
+)
+def test_distribution_moments(fn, kwargs, mean, std, tol):
+    x = np.asarray(fn(RngState(0), (40000,), **kwargs))
+    assert abs(x.mean() - mean) < tol, f"{fn.__name__} mean {x.mean()} != {mean}"
+    if std is not None:
+        assert abs(x.std() - std) < tol
+
+
+def test_uniform_int():
+    x = np.asarray(rrandom.uniform_int(RngState(1), (10000,), 3, 9))
+    assert x.min() == 3 and x.max() == 8
+
+
+def test_bernoulli():
+    x = np.asarray(rrandom.bernoulli(RngState(2), (20000,), prob=0.3))
+    assert abs(x.mean() - 0.3) < 0.02
+    y = np.asarray(rrandom.scaled_bernoulli(RngState(2), (1000,), prob=0.5, scale=2.0))
+    assert set(np.unique(y)) == {-2.0, 2.0}
+
+
+def test_normal_table():
+    mu = np.array([0.0, 10.0, -5.0], np.float32)
+    sig = np.array([1.0, 0.1, 2.0], np.float32)
+    x = np.asarray(rrandom.normal_table(RngState(3), 20000, mu, sig))
+    np.testing.assert_allclose(x.mean(axis=0), mu, atol=0.1)
+    np.testing.assert_allclose(x.std(axis=0), sig, atol=0.1)
+
+
+def test_discrete():
+    w = np.array([0.1, 0.0, 0.6, 0.3])
+    x = np.asarray(rrandom.discrete(RngState(4), (30000,), w))
+    counts = np.bincount(x, minlength=4) / 30000
+    np.testing.assert_allclose(counts, w, atol=0.02)
+    assert counts[1] == 0
+
+
+def test_sample_without_replacement():
+    items = np.arange(100)
+    out, idx = rrandom.sample_without_replacement(
+        RngState(5), items, 30, return_indices=True
+    )
+    assert len(set(np.asarray(idx).tolist())) == 30  # no duplicates
+    np.testing.assert_array_equal(np.asarray(out), items[np.asarray(idx)])
+    # Heavily weighted item should essentially always be included
+    w = np.ones(100)
+    w[17] = 1e6
+    hits = 0
+    for seed in range(20):
+        out = rrandom.sample_without_replacement(RngState(seed), items, 5, weights=w)
+        hits += 17 in np.asarray(out)
+    assert hits == 20
+
+
+def test_permute():
+    x = np.arange(50, dtype=np.float32).reshape(50, 1)
+    out, perm = rrandom.permute(RngState(6), x)
+    assert sorted(np.asarray(perm).tolist()) == list(range(50))
+    np.testing.assert_allclose(np.asarray(out)[:, 0], np.asarray(perm))
+
+
+def test_make_blobs():
+    x, labels, centers = rrandom.make_blobs(
+        RngState(7), 600, 8, n_clusters=4, cluster_std=0.1
+    )
+    assert x.shape == (600, 8) and labels.shape == (600,)
+    # every point lies near its assigned center
+    d = np.linalg.norm(np.asarray(x) - np.asarray(centers)[np.asarray(labels)], axis=1)
+    assert d.max() < 2.0
+    # roughly balanced clusters
+    counts = np.bincount(np.asarray(labels), minlength=4)
+    assert counts.min() >= 140
+
+
+def test_make_regression():
+    x, y, w = rrandom.make_regression(
+        RngState(8), 200, 10, n_informative=5, noise=0.0, coef=True, shuffle=False
+    )
+    np.testing.assert_allclose(np.asarray(x) @ np.asarray(w), np.asarray(y), rtol=1e-3)
+    assert np.allclose(np.asarray(w)[5:], 0)
+
+
+def test_multi_variable_gaussian():
+    mean = np.array([1.0, -2.0])
+    cov = np.array([[2.0, 0.6], [0.6, 1.0]])
+    x = np.asarray(rrandom.multi_variable_gaussian(RngState(9), mean, cov, 50000))
+    np.testing.assert_allclose(x.mean(axis=0), mean, atol=0.05)
+    np.testing.assert_allclose(np.cov(x.T), cov, atol=0.05)
+    y = np.asarray(rrandom.multi_variable_gaussian(RngState(9), mean, cov, 50000,
+                                                   method="eig"))
+    np.testing.assert_allclose(np.cov(y.T), cov, atol=0.05)
+
+
+def test_rmat():
+    theta = np.array([0.57, 0.19, 0.19, 0.05])
+    out, src, dst = rrandom.rmat_rectangular_gen(RngState(10), theta, 10, 8, 5000)
+    src, dst = np.asarray(src), np.asarray(dst)
+    assert out.shape == (5000, 2)
+    assert src.min() >= 0 and src.max() < 2**10
+    assert dst.min() >= 0 and dst.max() < 2**8
+    # skewed distribution: low ids dominate (a=0.57 upper-left)
+    assert (src < 2**9).mean() > 0.65
