@@ -1,0 +1,62 @@
+"""Benchmark driver — prints ONE JSON line for the headline metric.
+
+BASELINE config[0]: pylibraft pairwise_distance, L2SqrtExpanded, 5000×50 f32
+(the reference README's Python example; measured there by
+cpp/bench/distance/distance_exp_l2.cu via the google-benchmark fixture
+cpp/bench/common/benchmark.hpp:108).
+
+Metric: effective GB/s = (bytes_read + bytes_written) / time, i.e.
+(m·k + n·k + m·n) · 4 bytes over the best wall time of repeated synchronized
+runs — matching the reference bench's stream-synchronized timing loop.
+
+The reference publishes no numbers (BASELINE.md); ``A100_BASELINE_GBPS`` is
+an engineering estimate of the reference on A100 for this config (epilogue-
+dominated: ~100 MB output at ~200 µs end-to-end).  vs_baseline is
+value / estimate, where ≥0.8 meets the north-star target.
+"""
+
+import json
+import time
+
+import numpy as np
+
+A100_BASELINE_GBPS = 500.0
+
+M, N, K = 5000, 5000, 50
+
+
+def main():
+    import jax
+
+    from raft_tpu.distance import pairwise_distance
+
+    rng = np.random.default_rng(42)
+    x = jax.device_put(rng.random((M, K), dtype=np.float32))
+    y = jax.device_put(rng.random((N, K), dtype=np.float32))
+
+    def run():
+        return pairwise_distance(x, y, "euclidean")
+
+    # warmup / compile
+    out = run()
+    jax.block_until_ready(out)
+
+    times = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run())
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+
+    nbytes = (M * K + N * K + M * N) * 4
+    gbps = nbytes / best / 1e9
+    print(json.dumps({
+        "metric": "pairwise_distance_l2sqrt_5000x50_f32",
+        "value": round(gbps, 2),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / A100_BASELINE_GBPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

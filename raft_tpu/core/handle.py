@@ -216,12 +216,15 @@ def auto_sync_handle(fn):
     def wrapper(*args, **kwargs):
         if not has_handle:
             return fn(*args, **kwargs)
-        supplied = kwargs.get("handle")
+        # Bind to find the handle whether passed positionally or by keyword.
+        bound = sig.bind_partial(*args, **kwargs)
+        supplied = bound.arguments.get("handle")
         if supplied is None:
-            kwargs["handle"] = default_handle()
-            out = fn(*args, **kwargs)
-            kwargs["handle"].get_stream().record(out)
-            kwargs["handle"].sync_stream()
+            h = default_handle()
+            bound.arguments["handle"] = h
+            out = fn(*bound.args, **bound.kwargs)
+            h.get_stream().record(out)
+            h.sync_stream()
             return out
         return fn(*args, **kwargs)
 

@@ -114,7 +114,13 @@ def synchronize(*arrays: Any, poll_interval: float = 1e-5, max_interval: float =
 class interruptible:
     """Context manager mapping KeyboardInterrupt → cancellation of in-flight
     device waits, mirroring pylibraft's ``cuda_interruptible``
-    (reference python/pylibraft/common/interruptible.pyx:32-77)."""
+    (reference python/pylibraft/common/interruptible.pyx:32-77).
+
+    A KeyboardInterrupt on this thread has already unwound this thread's own
+    wait, so on exit we cancel every *other* registered thread's token — the
+    multi-threaded analogue of the reference cancelling the in-flight CUDA
+    work owned by the context.
+    """
 
     def __init__(self):
         self._token: Optional[Token] = None
@@ -124,8 +130,12 @@ class interruptible:
         return self._token
 
     def __exit__(self, exc_type, exc, tb):
-        if exc_type is KeyboardInterrupt and self._token is not None:
-            self._token.cancel()
+        if exc_type is KeyboardInterrupt:
+            me = threading.get_ident()
+            with _registry_lock:
+                others = [t for tid, t in _registry.items() if tid != me]
+            for t in others:
+                t.cancel()
         # Clear any stale cancellation so the next wait on this thread is clean.
         if self._token is not None:
             self._token.yield_no_throw()

@@ -79,7 +79,20 @@ class Logger:
 
     _instance: Optional["Logger"] = None
 
+    def __new__(cls, name: str = "raft_tpu"):
+        # Singleton per the reference's ``logger::get()``; direct construction
+        # returns the same instance so handlers are never duplicated on the
+        # shared underlying stdlib logger.
+        if cls._instance is None:
+            inst = super().__new__(cls)
+            inst._initialized = False
+            cls._instance = inst
+        return cls._instance
+
     def __init__(self, name: str = "raft_tpu"):
+        if getattr(self, "_initialized", False):
+            return
+        self._initialized = True
         self._logger = logging.getLogger(name)
         self._logger.propagate = False
         self._level = INFO

@@ -1,0 +1,25 @@
+"""Pairwise distances, fused L2 NN, gram kernels
+(reference raft/distance/ — SURVEY.md §2.7)."""
+
+from raft_tpu.distance.distance_types import (  # noqa: F401
+    DISTANCE_TYPES,
+    SUPPORTED_DISTANCES,
+    DistanceType,
+    KernelParams,
+    KernelType,
+)
+from raft_tpu.distance.pairwise import distance, pairwise_distance  # noqa: F401
+from raft_tpu.distance.fused_l2_nn import (  # noqa: F401
+    fused_l2_nn,
+    fused_l2_nn_argmin,
+    fused_l2_nn_min_reduce,
+)
+from raft_tpu.distance.kernels import (  # noqa: F401
+    GramMatrixBase,
+    LinearKernel,
+    PolynomialKernel,
+    RBFKernel,
+    TanhKernel,
+    gram_matrix,
+    kernel_factory,
+)

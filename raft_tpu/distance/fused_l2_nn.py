@@ -1,0 +1,93 @@
+"""Fused L2 nearest neighbor: distance + argmin in one pass.
+
+Counterpart of reference raft/distance/fused_l2_nn.cuh:89,192
+(``fusedL2NN``/``fusedL2NNMinReduce``; kernel distance/detail/
+fused_l2_nn.cuh:132) — k-means' hot kernel.  The CUDA version fuses a GEMM
+tile with per-row atomic KVP argmin and a per-row mutex; TPUs have no global
+atomics, so per SURVEY.md §7 the design is a tiled reduction over the
+n-dimension: ``lax.scan`` over column blocks of y, each step doing an MXU
+matmul (the expanded-L2 trick) and folding a running per-row (min, argmin)
+carry — no m×n matrix ever materializes in HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.kvp import KeyValuePair, kvp_min
+
+_BN = 1024  # column block: y-block (bn × k) + distance block (m × bn) stay in VMEM
+
+# Full-f32 matmul: the default bf16 passes flip ~1% of argmins (see
+# raft_tpu.distance.pairwise.DEFAULT_PRECISION).
+_PRECISION = "highest"
+
+
+@functools.partial(jax.jit, static_argnames=("sqrt", "block_n"))
+def _fused_l2_nn(x, y, x_norms, y_norms, sqrt: bool, block_n: int):
+    m, k = x.shape
+    n = y.shape[0]
+    bn = min(block_n, n)
+    nb = -(-n // bn)
+    n_pad = nb * bn
+    # Pad y with +inf norms so padded columns never win the argmin.
+    y_p = jnp.pad(y, ((0, n_pad - n), (0, 0)))
+    yn_p = jnp.pad(y_norms, (0, n_pad - n), constant_values=jnp.inf)
+    y_blocks = y_p.reshape(nb, bn, k)
+    yn_blocks = yn_p.reshape(nb, bn)
+    idx_dtype = jnp.int32
+
+    def step(carry, blk):
+        yb, ynb, base = blk
+        d = x_norms[:, None] + ynb[None, :] - 2.0 * jnp.matmul(x, yb.T, precision=_PRECISION)
+        d = jnp.maximum(d, 0.0)
+        d = jnp.where(jnp.isfinite(ynb)[None, :], d, jnp.inf)
+        blk_arg = jnp.argmin(d, axis=1)
+        blk_val = jnp.take_along_axis(d, blk_arg[:, None], axis=1)[:, 0]
+        blk_idx = (base + blk_arg).astype(idx_dtype)
+        # min by value, ties → smaller index (reference MinAndDistanceReduceOp)
+        new = kvp_min(carry, KeyValuePair(key=blk_idx, value=blk_val))
+        return new, None
+
+    # Derive the init carry from x (full_like) so its sharding/varying-axes
+    # type matches the step output when running inside shard_map.
+    init = KeyValuePair(
+        key=jnp.full_like(x[:, 0], jnp.iinfo(idx_dtype).max, dtype=idx_dtype),
+        value=jnp.full_like(x[:, 0], jnp.inf),
+    )
+    bases = (jnp.arange(nb) * bn).astype(idx_dtype)
+    best, _ = jax.lax.scan(step, init, (y_blocks, yn_blocks, bases))
+    best_val = jnp.sqrt(best.value) if sqrt else best.value
+    return best_val, best.key
+
+
+def fused_l2_nn(x, y, sqrt: bool = False, x_norms=None, y_norms=None,
+                block_n: int = _BN) -> KeyValuePair:
+    """For each row of x, the nearest row of y by (squared) L2 —
+    returns ``KeyValuePair(key=index, value=distance)`` per row
+    (reference ``fusedL2NN``, fused_l2_nn.cuh:89)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    expects(x.shape[1] == y.shape[1], "x and y must share feature dim")
+    if x_norms is None:
+        x_norms = jnp.sum(x * x, axis=1)
+    if y_norms is None:
+        y_norms = jnp.sum(y * y, axis=1)
+    val, idx = _fused_l2_nn(x, y, x_norms, y_norms, bool(sqrt), int(block_n))
+    return KeyValuePair(key=idx, value=val)
+
+
+def fused_l2_nn_min_reduce(x, y, sqrt: bool = False, **kw) -> KeyValuePair:
+    """Alias matching reference ``fusedL2NNMinReduce`` (fused_l2_nn.cuh:192)."""
+    return fused_l2_nn(x, y, sqrt=sqrt, **kw)
+
+
+def fused_l2_nn_argmin(x, y, sqrt: bool = True):
+    """Argmin-only convenience (pylibraft ``fused_l2_nn_argmin``,
+    distance/fused_l2_nn.pyx:64)."""
+    return fused_l2_nn(x, y, sqrt=sqrt).key

@@ -1,0 +1,83 @@
+"""Gram / kernel matrices for SVM-style kernels.
+
+Counterpart of reference raft/distance/kernels.cuh +
+distance/detail/kernels/{gram_matrix.cuh,kernel_matrices.cuh,
+kernel_factory.cuh}: LINEAR, POLYNOMIAL, RBF, TANH over dense inputs.
+All four ride the MXU (RBF via the expanded-L2 trick).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import LogicError
+from raft_tpu.distance.distance_types import KernelParams, KernelType
+from raft_tpu.distance.pairwise import DEFAULT_PRECISION
+
+
+class GramMatrixBase:
+    """reference detail/kernels/gram_matrix.cuh ``gram_matrix_base``."""
+
+    def __init__(self, params: KernelParams):
+        self.params = params
+
+    def __call__(self, x, y):
+        return self.evaluate(x, y)
+
+    def linear(self, x, y):
+        return jnp.matmul(jnp.asarray(x), jnp.asarray(y).T,
+                          precision=DEFAULT_PRECISION)
+
+    def evaluate(self, x, y):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class LinearKernel(GramMatrixBase):
+    def evaluate(self, x, y):
+        return self.linear(x, y)
+
+
+class PolynomialKernel(GramMatrixBase):
+    def evaluate(self, x, y):
+        p = self.params
+        return jnp.power(p.gamma * self.linear(x, y) + p.coef0, p.degree)
+
+
+class TanhKernel(GramMatrixBase):
+    def evaluate(self, x, y):
+        p = self.params
+        return jnp.tanh(p.gamma * self.linear(x, y) + p.coef0)
+
+
+class RBFKernel(GramMatrixBase):
+    def evaluate(self, x, y):
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        xn = jnp.sum(x * x, axis=1)
+        yn = jnp.sum(y * y, axis=1)
+        sq = jnp.maximum(
+            xn[:, None] + yn[None, :]
+            - 2.0 * jnp.matmul(x, y.T, precision=DEFAULT_PRECISION), 0.0)
+        return jnp.exp(-self.params.gamma * sq)
+
+
+def kernel_factory(params: KernelParams) -> GramMatrixBase:
+    """reference detail/kernels/kernel_factory.cuh ``KernelFactory::create``."""
+    table = {
+        KernelType.LINEAR: LinearKernel,
+        KernelType.POLYNOMIAL: PolynomialKernel,
+        KernelType.RBF: RBFKernel,
+        KernelType.TANH: TanhKernel,
+    }
+    cls = table.get(params.kernel)
+    if cls is None:
+        raise LogicError(f"unsupported kernel {params.kernel}")
+    return cls(params)
+
+
+def gram_matrix(x, y, params: KernelParams):
+    """Evaluate the kernel matrix K(x_i, y_j)."""
+    return kernel_factory(params).evaluate(x, y)

@@ -1,0 +1,291 @@
+"""Pairwise distances — the framework's minimum end-to-end slice.
+
+Counterpart of reference raft/distance/distance.cuh:62-417 (public API,
+runtime metric switch at distance.cuh:305) and the per-metric
+``DistanceImpl`` specializations (distance/detail/distance.cuh:94-522).
+
+TPU-first architecture — two engines instead of one CUDA kernel template:
+
+1. **MXU engine** (``_mxu_metrics``): every metric whose inner loop is an
+   inner product rides ``x @ y.T`` on the 128×128 systolic array, with the
+   per-metric epilogue fused by XLA.  This covers the "expanded" metrics
+   (the reference's dot-product trick: distance/detail/distance.cuh L2/cos/
+   correlation paths) plus Hellinger (⟨√x,√y⟩), RusselRao (⟨x,y⟩) and KL
+   (⟨x, log y⟩) which the reference computes with custom CUDA kernels.
+
+2. **VPU engine** (``_blocked_reduce``): metrics needing a general
+   elementwise accumulation over k (L1, Linf, Canberra, Lp, Hamming,
+   BrayCurtis, JensenShannon, unexpanded L2).  The reference uses the tiled
+   ``PairwiseDistances`` kernel (distance/detail/pairwise_distance_base.cuh:76);
+   here a block-tiled broadcast-reduce with static shapes that XLA fuses in
+   VMEM; the same tiling is reused by the Pallas kernel in
+   :mod:`raft_tpu.distance.pallas_kernels` when available.
+
+Padding rows (to reach block multiples) produce garbage distances that are
+sliced off before returning — same strategy as the reference's grid-stride
+range checks.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import LogicError, expects
+from raft_tpu.distance.distance_types import DISTANCE_TYPES, DistanceType
+
+_BM = 128  # row-block (sublane-friendly)
+_BN = 512  # col-block
+
+# Distance matmuls default to full-f32 MXU passes: the TPU's default
+# (bf16) precision flips ~1% of nearest-neighbor argmins (measured), while
+# at these shapes the exact mode costs <2% extra time.  RAFT computes f32.
+DEFAULT_PRECISION = "highest"
+
+
+def _row_norms(x, squared: bool = True):
+    n = jnp.sum(x * x, axis=1)
+    return n if squared else jnp.sqrt(n)
+
+
+# ---------------------------------------------------------------------------
+# MXU engine: metric = epilogue(x @ f(y).T, row/col statistics)
+# ---------------------------------------------------------------------------
+
+def _l2_expanded(x, y, sqrt: bool, precision=DEFAULT_PRECISION):
+    # reference distance/detail/euclidean.cuh (euclideanAlgo1):
+    # dist = ||x||^2 + ||y||^2 - 2 x·y, rectified at 0.
+    xn = _row_norms(x)
+    yn = _row_norms(y)
+    d = xn[:, None] + yn[None, :] - 2.0 * jnp.matmul(x, y.T, precision=precision)
+    d = jnp.maximum(d, 0.0)
+    return jnp.sqrt(d) if sqrt else d
+
+
+def _cosine(x, y, precision=DEFAULT_PRECISION):
+    # reference distance/detail/cosine.cuh: 1 - x·y / (||x|| ||y||)
+    xn = _row_norms(x, squared=False)
+    yn = _row_norms(y, squared=False)
+    denom = jnp.maximum(xn[:, None] * yn[None, :], 1e-30)
+    return 1.0 - jnp.matmul(x, y.T, precision=precision) / denom
+
+
+def _correlation(x, y, precision=DEFAULT_PRECISION):
+    # reference distance/detail/correlation.cuh:124-128:
+    # 1 - (k·Σxy − Σx·Σy) / sqrt((kΣx²−(Σx)²)(kΣy²−(Σy)²))
+    k = x.shape[1]
+    xs, ys = jnp.sum(x, axis=1), jnp.sum(y, axis=1)
+    x2, y2 = jnp.sum(x * x, axis=1), jnp.sum(y * y, axis=1)
+    numer = k * jnp.matmul(x, y.T, precision=precision) - xs[:, None] * ys[None, :]
+    q = k * x2 - xs * xs
+    r = k * y2 - ys * ys
+    denom = jnp.sqrt(jnp.maximum(q[:, None] * r[None, :], 1e-30))
+    return 1.0 - numer / denom
+
+
+def _inner_product(x, y, precision=DEFAULT_PRECISION):
+    return jnp.matmul(x, y.T, precision=precision)
+
+
+def _hellinger(x, y, precision=DEFAULT_PRECISION):
+    # reference distance/detail/hellinger.cuh: acc = Σ√(x·y); d = √(1−acc),
+    # rectified (inputs are probability-like, assumed non-negative).
+    acc = jnp.matmul(jnp.sqrt(jnp.abs(x)), jnp.sqrt(jnp.abs(y)).T, precision=precision)
+    return jnp.sqrt(jnp.maximum(1.0 - acc, 0.0))
+
+
+def _russelrao(x, y, precision=DEFAULT_PRECISION):
+    # reference distance/detail/russell_rao.cuh:91: (k − Σxy)/k
+    k = x.shape[1]
+    return (k - jnp.matmul(x, y.T, precision=precision)) * (1.0 / k)
+
+
+def _kl_divergence(x, y, precision=DEFAULT_PRECISION):
+    # reference distance/detail/kl_divergence.cuh:27,81-99:
+    # 0.5·Σ x·(log x − log y), with 0·log0 := 0 and log y := 0 where y == 0.
+    x_log = jnp.where(x > 0, jnp.log(jnp.where(x > 0, x, 1.0)), 0.0)
+    y_log = jnp.where(y > 0, jnp.log(jnp.where(y > 0, y, 1.0)), 0.0)
+    row_term = jnp.sum(x * x_log, axis=1)
+    return 0.5 * (row_term[:, None] - jnp.matmul(x, y_log.T, precision=precision))
+
+
+# ---------------------------------------------------------------------------
+# VPU engine: block-tiled elementwise accumulation over k
+# ---------------------------------------------------------------------------
+
+def _blocked_reduce(x, y, tile_fn, bm: int = _BM, bn: int = _BN):
+    """out[i, j] = tile_fn(x[i], y[j]) computed over (bm × bn) tiles.
+
+    tile_fn maps (bm, 1, k), (1, bn, k) → (bm, bn); XLA fuses the broadcast
+    and reduction inside each tile so only bm·bn·k_block VMEM is live —
+    the role of ``Contractions_NT`` smem tiling in the reference
+    (linalg/detail/contractions.cuh:26).
+    """
+    m, k = x.shape
+    n = y.shape[0]
+    bm = min(bm, max(8, m))
+    bn = min(bn, max(128, n))
+    mp = -(-m // bm) * bm
+    np_ = -(-n // bn) * bn
+    xp = jnp.pad(x, ((0, mp - m), (0, 0)))
+    yp = jnp.pad(y, ((0, np_ - n), (0, 0)))
+    xb = xp.reshape(mp // bm, bm, k)
+    yb = yp.reshape(np_ // bn, bn, k)
+
+    def row_block(xi):
+        def col_block(yj):
+            return tile_fn(xi[:, None, :], yj[None, :, :])  # (bm, bn)
+
+        return jax.lax.map(col_block, yb)  # (Nb, bm, bn)
+
+    out = jax.lax.map(row_block, xb)  # (Mb, Nb, bm, bn)
+    out = out.transpose(0, 2, 1, 3).reshape(mp, np_)
+    return out[:m, :n]
+
+
+def _tile_l1(xi, yj):
+    return jnp.sum(jnp.abs(xi - yj), axis=-1)
+
+
+def _tile_l2(xi, yj):
+    d = xi - yj
+    return jnp.sum(d * d, axis=-1)
+
+
+def _tile_linf(xi, yj):
+    return jnp.max(jnp.abs(xi - yj), axis=-1)
+
+
+def _tile_canberra(xi, yj):
+    # reference distance/detail/canberra.cuh: 0/0 → 0
+    num = jnp.abs(xi - yj)
+    den = jnp.abs(xi) + jnp.abs(yj)
+    return jnp.sum(jnp.where(den > 0, num / jnp.where(den > 0, den, 1.0), 0.0), axis=-1)
+
+
+def _tile_lp(p: float):
+    def fn(xi, yj):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(xi - yj), p), axis=-1), 1.0 / p)
+
+    return fn
+
+
+def _tile_hamming(xi, yj):
+    # reference distance/detail/hamming.cuh: mean of (x != y)
+    return jnp.mean((xi != yj).astype(xi.dtype), axis=-1)
+
+
+def _tile_braycurtis(xi, yj):
+    num = jnp.sum(jnp.abs(xi - yj), axis=-1)
+    den = jnp.sum(jnp.abs(xi + yj), axis=-1)
+    return jnp.where(den > 0, num / jnp.where(den > 0, den, 1.0), 0.0)
+
+
+def _tile_jensen_shannon(xi, yj):
+    # reference distance/detail/jensen_shannon.cuh: sqrt(0.5·(KL(x‖m)+KL(y‖m)))
+    m = 0.5 * (xi + yj)
+    safe = m > 0
+
+    def kl_part(a):
+        ok = (a > 0) & safe
+        return jnp.where(ok, a * (jnp.log(jnp.where(a > 0, a, 1.0))
+                                  - jnp.log(jnp.where(safe, m, 1.0))), 0.0)
+
+    acc = jnp.sum(kl_part(xi) + kl_part(yj), axis=-1)
+    return jnp.sqrt(jnp.maximum(0.5 * acc, 0.0))
+
+
+def _haversine(x, y):
+    """Great-circle distance on (lat, lon) radian pairs (reference
+    spatial/knn/detail/haversine_distance.cuh:152)."""
+    expects(x.shape[1] == 2, "haversine requires k=2 (lat, lon)")
+    lat1, lon1 = x[:, 0][:, None], x[:, 1][:, None]
+    lat2, lon2 = y[:, 0][None, :], y[:, 1][None, :]
+    sdlat = jnp.sin(0.5 * (lat2 - lat1))
+    sdlon = jnp.sin(0.5 * (lon2 - lon1))
+    a = sdlat**2 + jnp.cos(lat1) * jnp.cos(lat2) * sdlon**2
+    return 2.0 * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+
+
+# ---------------------------------------------------------------------------
+# dispatch (reference distance.cuh:305 runtime switch)
+# ---------------------------------------------------------------------------
+
+def _dispatch(x, y, metric: DistanceType, metric_arg: float):
+    if metric == DistanceType.L2Expanded:
+        return _l2_expanded(x, y, sqrt=False)
+    if metric == DistanceType.L2SqrtExpanded:
+        return _l2_expanded(x, y, sqrt=True)
+    if metric == DistanceType.CosineExpanded:
+        return _cosine(x, y)
+    if metric == DistanceType.CorrelationExpanded:
+        return _correlation(x, y)
+    if metric == DistanceType.InnerProduct:
+        return _inner_product(x, y)
+    if metric == DistanceType.HellingerExpanded:
+        return _hellinger(x, y)
+    if metric == DistanceType.RusselRaoExpanded:
+        return _russelrao(x, y)
+    if metric == DistanceType.KLDivergence:
+        return _kl_divergence(x, y)
+    if metric == DistanceType.L1:
+        return _blocked_reduce(x, y, _tile_l1)
+    if metric == DistanceType.L2Unexpanded:
+        return _blocked_reduce(x, y, _tile_l2)
+    if metric == DistanceType.L2SqrtUnexpanded:
+        return jnp.sqrt(_blocked_reduce(x, y, _tile_l2))
+    if metric == DistanceType.Linf:
+        return _blocked_reduce(x, y, _tile_linf)
+    if metric == DistanceType.Canberra:
+        return _blocked_reduce(x, y, _tile_canberra)
+    if metric == DistanceType.LpUnexpanded:
+        return _blocked_reduce(x, y, _tile_lp(float(metric_arg)))
+    if metric == DistanceType.HammingUnexpanded:
+        return _blocked_reduce(x, y, _tile_hamming)
+    if metric == DistanceType.BrayCurtis:
+        return _blocked_reduce(x, y, _tile_braycurtis)
+    if metric == DistanceType.JensenShannon:
+        return _blocked_reduce(x, y, _tile_jensen_shannon)
+    if metric == DistanceType.Haversine:
+        return _haversine(x, y)
+    raise LogicError(f"metric {metric.name} is not supported for dense inputs "
+                     "(reference parity: JaccardExpanded/DiceExpanded are "
+                     "sparse-only; Precomputed is a sentinel)")
+
+
+@functools.partial(jax.jit, static_argnames=("metric", "metric_arg"))
+def _distance_jit(x, y, metric: DistanceType, metric_arg: float):
+    return _dispatch(x, y, metric, metric_arg)
+
+
+def distance(x, y, metric: DistanceType, metric_arg: float = 2.0):
+    """Compile-time-metric API (reference templated ``distance<DistanceType>``,
+    distance/distance.cuh:62)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    expects(x.ndim == 2 and y.ndim == 2, "x and y must be 2-d")
+    expects(x.shape[1] == y.shape[1], "x and y must have the same number of columns")
+    return _distance_jit(x, y, DistanceType(metric), float(metric_arg))
+
+
+def pairwise_distance(x, y, metric: Union[str, DistanceType] = "euclidean",
+                      metric_arg: float = 2.0, p: Optional[float] = None):
+    """Runtime-dispatched pairwise distance (reference
+    ``pairwise_distance``, distance/distance.cuh:293; Python surface
+    pylibraft distance/pairwise_distance.pyx:95).
+
+    Parameters mirror pylibraft: *metric* may be any name in
+    ``DISTANCE_TYPES`` or a :class:`DistanceType`; *p* (alias *metric_arg*)
+    is the Minkowski exponent.
+    """
+    if isinstance(metric, str):
+        m = DISTANCE_TYPES.get(metric.lower())
+        if m is None:
+            raise LogicError(f"metric {metric!r} is not supported")
+        metric = m
+    if p is not None:
+        metric_arg = p
+    return distance(x, y, metric, metric_arg)

@@ -138,7 +138,11 @@ def lstsq_svd_qr(a, b):
     """minimize ‖a·w − b‖ via SVD (reference ``lstsqSvdQR``)."""
     u, s, vt = jnp.linalg.svd(a, full_matrices=False)
     s_inv = jnp.where(s > 1e-10 * s[0], 1.0 / s, 0.0)
-    return vt.T @ (s_inv * (u.T @ b))
+    ub = u.T @ b
+    # Scale along the singular-value axis (leading), valid for vector or
+    # matrix right-hand sides.
+    scaled = s_inv[:, None] * ub if ub.ndim == 2 else s_inv * ub
+    return vt.T @ scaled
 
 
 def lstsq_svd_jacobi(a, b):
@@ -152,7 +156,9 @@ def lstsq_eig(a, b):
     g = a.T @ a
     v, w = eig_dc(g)
     w_inv = jnp.where(w > 1e-10 * jnp.maximum(w[-1], 1e-30), 1.0 / w, 0.0)
-    return v @ (w_inv * (v.T @ (a.T @ b)))
+    vtb = v.T @ (a.T @ b)
+    scaled = w_inv[:, None] * vtb if vtb.ndim == 2 else w_inv * vtb
+    return v @ scaled
 
 
 def lstsq_qr(a, b):

@@ -26,7 +26,7 @@ def _identity(x):
 def reduce(
     data,
     apply: Apply = Apply.ALONG_COLUMNS,
-    init=0.0,
+    init=None,
     main_op: Callable = _identity,
     reduce_op: Callable = jnp.add,
     final_op: Callable = _identity,
@@ -36,6 +36,9 @@ def reduce(
     ``out = final_op(reduce_op.fold(main_op(x)) ⊕ init)``.
 
     ALONG_COLUMNS → one output per row; ALONG_ROWS → one per column.
+    *init* is only folded in when given (the reference requires an explicit
+    init for the same reason: an additive-neutral default would silently
+    clamp min/max reductions).
     """
     axis = 1 if apply == Apply.ALONG_COLUMNS else 0
     mapped = main_op(data)
@@ -56,14 +59,14 @@ def reduce(
     return out
 
 
-def coalesced_reduction(data, init=0.0, main_op=_identity, reduce_op=jnp.add,
+def coalesced_reduction(data, init=None, main_op=_identity, reduce_op=jnp.add,
                         final_op=_identity):
     """Reduce along the contiguous (last) dimension
     (reference linalg/coalesced_reduction.cuh)."""
     return reduce(data, Apply.ALONG_COLUMNS, init, main_op, reduce_op, final_op)
 
 
-def strided_reduction(data, init=0.0, main_op=_identity, reduce_op=jnp.add,
+def strided_reduction(data, init=None, main_op=_identity, reduce_op=jnp.add,
                       final_op=_identity):
     """Reduce along the strided (first) dimension
     (reference linalg/strided_reduction.cuh)."""

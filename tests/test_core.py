@@ -219,3 +219,26 @@ class TestUtil:
 
 def test_mesh_fixture(mesh8):
     assert mesh8.devices.size == 8
+
+
+class TestReviewRegressions:
+    """Regression tests for code-review findings."""
+
+    def test_auto_sync_handle_positional(self):
+        from raft_tpu.core import auto_sync_handle, Handle
+
+        @auto_sync_handle
+        def f(x, handle=None):
+            assert handle is not None
+            return x + 1
+
+        assert f(1) == 2                      # default injected + synced
+        assert f(1, Handle()) == 2            # positional handle
+        assert f(1, handle=Handle()) == 2     # keyword handle
+
+    def test_logger_no_duplicate_handlers(self):
+        from raft_tpu.core.logger import Logger
+
+        a, b = Logger(), Logger()
+        assert a is b is Logger.get()
+        assert len(a._logger.handlers) == 1

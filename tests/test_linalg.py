@@ -214,3 +214,22 @@ class TestTranspose:
     def test_transpose(self, rng):
         a = rng.random((3, 5)).astype(np.float32)
         np.testing.assert_array_equal(linalg.transpose(a), a.T)
+
+
+class TestReviewRegressions:
+    """Regression tests for code-review findings."""
+
+    def test_lstsq_matrix_rhs(self, rng):
+        a = rng.standard_normal((40, 6)).astype(np.float64)
+        w_true = rng.standard_normal((6, 3))
+        b = a @ w_true
+        for fn in (linalg.lstsq_svd_qr, linalg.lstsq_eig, linalg.lstsq_qr):
+            np.testing.assert_allclose(fn(a, b), w_true, atol=1e-8, err_msg=str(fn))
+
+    def test_reduce_minmax_no_zero_clamp(self):
+        neg = -np.ones((3, 4), np.float32)
+        out = linalg.reduce(neg, Apply.ALONG_COLUMNS, reduce_op=jnp.maximum)
+        np.testing.assert_allclose(out, [-1, -1, -1])
+        pos = np.ones((3, 4), np.float32) * 5
+        out = linalg.reduce(pos, Apply.ALONG_COLUMNS, reduce_op=jnp.minimum)
+        np.testing.assert_allclose(out, [5, 5, 5])
