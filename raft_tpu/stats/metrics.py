@@ -1,0 +1,293 @@
+"""Model-evaluation and clustering metrics.
+
+Counterparts of reference raft/stats/{accuracy,r2_score,regression_metrics,
+silhouette_score,trustworthiness_score,adjusted_rand_index,rand_index,
+completeness_score,homogeneity_score,v_measure,mutual_info_score,entropy,
+kl_divergence,contingency_matrix,dispersion,information_criterion}.cuh.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.distance import DistanceType, pairwise_distance
+
+
+# -- classification / regression ---------------------------------------------
+
+def accuracy(predictions, ref_predictions):
+    """Fraction of exact matches (reference stats/accuracy.cuh)."""
+    predictions = jnp.asarray(predictions)
+    ref_predictions = jnp.asarray(ref_predictions)
+    return jnp.mean((predictions == ref_predictions).astype(jnp.float32))
+
+
+def r2_score(y, y_hat):
+    """Coefficient of determination (reference stats/r2_score.cuh)."""
+    y = jnp.asarray(y)
+    y_hat = jnp.asarray(y_hat)
+    mu = jnp.mean(y)
+    ss_tot = jnp.sum((y - mu) ** 2)
+    ss_res = jnp.sum((y - y_hat) ** 2)
+    return 1.0 - ss_res / ss_tot
+
+
+def regression_metrics(predictions, ref_predictions):
+    """(mean_abs_error, mean_squared_error, median_abs_error)
+    (reference stats/regression_metrics.cuh)."""
+    predictions = jnp.asarray(predictions)
+    ref_predictions = jnp.asarray(ref_predictions)
+    diff = predictions - ref_predictions
+    return (jnp.mean(jnp.abs(diff)), jnp.mean(diff * diff),
+            jnp.median(jnp.abs(diff)))
+
+
+# -- contingency-table family ------------------------------------------------
+
+def contingency_matrix(y_true, y_pred, n_classes: Optional[int] = None):
+    """Dense contingency matrix [n_true_classes, n_pred_classes]
+    (reference stats/contingency_matrix.cuh; CUB histograms there, a one-hot
+    segment-sum here)."""
+    y_true = jnp.asarray(y_true).astype(jnp.int32)
+    y_pred = jnp.asarray(y_pred).astype(jnp.int32)
+    if n_classes is None:
+        n_classes = int(jnp.maximum(jnp.max(y_true), jnp.max(y_pred))) + 1
+    flat = y_true * n_classes + y_pred
+    counts = jnp.zeros((n_classes * n_classes,), jnp.int32).at[flat].add(1)
+    return counts.reshape(n_classes, n_classes)
+
+
+def entropy(labels, n_classes: Optional[int] = None):
+    """Shannon entropy (nats) of a label vector (reference stats/entropy.cuh)."""
+    labels = jnp.asarray(labels).astype(jnp.int32)
+    if n_classes is None:
+        n_classes = int(jnp.max(labels)) + 1
+    counts = jnp.zeros((n_classes,), jnp.float64).at[labels].add(1.0)
+    p = counts / labels.shape[0]
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.where(p > 0, p, 1.0)), 0.0))
+
+
+def mutual_info_score(y_true, y_pred, n_classes: Optional[int] = None):
+    """Mutual information (nats) between two labelings
+    (reference stats/mutual_info_score.cuh)."""
+    cm = contingency_matrix(y_true, y_pred, n_classes).astype(jnp.float64)
+    n = jnp.sum(cm)
+    pij = cm / n
+    pi = jnp.sum(pij, axis=1, keepdims=True)
+    pj = jnp.sum(pij, axis=0, keepdims=True)
+    denom = pi * pj
+    ok = pij > 0
+    return jnp.sum(jnp.where(ok, pij * (jnp.log(jnp.where(ok, pij, 1.0))
+                                        - jnp.log(jnp.where(ok, denom, 1.0))), 0.0))
+
+
+def homogeneity_score(y_true, y_pred, n_classes: Optional[int] = None):
+    """reference stats/homogeneity_score.cuh: MI / H(true)."""
+    h = entropy(y_true, n_classes)
+    mi = mutual_info_score(y_true, y_pred, n_classes)
+    return jnp.where(h > 0, mi / jnp.maximum(h, 1e-300), 1.0)
+
+
+def completeness_score(y_true, y_pred, n_classes: Optional[int] = None):
+    """reference stats/completeness_score.cuh: MI / H(pred)."""
+    h = entropy(y_pred, n_classes)
+    mi = mutual_info_score(y_true, y_pred, n_classes)
+    return jnp.where(h > 0, mi / jnp.maximum(h, 1e-300), 1.0)
+
+
+def v_measure(y_true, y_pred, n_classes: Optional[int] = None, beta: float = 1.0):
+    """reference stats/v_measure.cuh: weighted harmonic mean of
+    homogeneity and completeness."""
+    h = homogeneity_score(y_true, y_pred, n_classes)
+    c = completeness_score(y_true, y_pred, n_classes)
+    denom = beta * h + c
+    return jnp.where(denom > 0, (1 + beta) * h * c / jnp.maximum(denom, 1e-300), 0.0)
+
+
+def rand_index(y_true, y_pred):
+    """Unadjusted Rand index (reference stats/rand_index.cuh)."""
+    cm = contingency_matrix(y_true, y_pred).astype(jnp.float64)
+    n = jnp.sum(cm)
+    sum_sq = jnp.sum(cm * cm)
+    a_sq = jnp.sum(jnp.sum(cm, axis=1) ** 2)
+    b_sq = jnp.sum(jnp.sum(cm, axis=0) ** 2)
+    # pairs agreeing: same-same + diff-diff
+    tp_fp = (a_sq - n) / 2
+    tp_fn = (b_sq - n) / 2
+    tp = (sum_sq - n) / 2
+    total = n * (n - 1) / 2
+    return (total + 2 * tp - tp_fp - tp_fn) / total
+
+
+def adjusted_rand_index(y_true, y_pred):
+    """ARI (reference stats/adjusted_rand_index.cuh)."""
+    cm = contingency_matrix(y_true, y_pred).astype(jnp.float64)
+    n = jnp.sum(cm)
+
+    def comb2(x):
+        return x * (x - 1) / 2
+
+    sum_comb = jnp.sum(comb2(cm))
+    sum_a = jnp.sum(comb2(jnp.sum(cm, axis=1)))
+    sum_b = jnp.sum(comb2(jnp.sum(cm, axis=0)))
+    total = comb2(n)
+    expected = sum_a * sum_b / total
+    max_index = 0.5 * (sum_a + sum_b)
+    denom = max_index - expected
+    return jnp.where(jnp.abs(denom) > 1e-300, (sum_comb - expected) / denom, 1.0)
+
+
+def kl_divergence(p, q):
+    """Scalar KL divergence between two distributions
+    (reference stats/kl_divergence.cuh: Σ p·log(p/q), 0 where p==0)."""
+    p = jnp.asarray(p)
+    q = jnp.asarray(q)
+    ok = p > 0
+    return jnp.sum(jnp.where(ok, p * (jnp.log(jnp.where(ok, p, 1.0))
+                                      - jnp.log(jnp.where(q > 0, q, 1.0))), 0.0))
+
+
+# -- embedding-quality metrics -----------------------------------------------
+
+def silhouette_score(x, labels, n_clusters: Optional[int] = None,
+                     metric: DistanceType = DistanceType.L2Expanded,
+                     return_samples: bool = False):
+    """Mean silhouette coefficient (reference stats/silhouette_score.cuh:46).
+
+    a(i) = mean intra-cluster distance, b(i) = min mean distance to another
+    cluster; s = (b−a)/max(a,b).  Computed from one pairwise-distance matrix
+    plus a segment-sum over columns by label — no per-pair loop.
+    """
+    x = jnp.asarray(x)
+    labels = jnp.asarray(labels).astype(jnp.int32)
+    n = x.shape[0]
+    if n_clusters is None:
+        n_clusters = int(jnp.max(labels)) + 1
+    d = pairwise_distance(x, x, metric)
+    # per-row sums of distances to each cluster: (n, n_clusters)
+    cluster_sums = jax.ops.segment_sum(d.T, labels, num_segments=n_clusters).T
+    counts = jnp.zeros((n_clusters,), d.dtype).at[labels].add(1.0)
+    own = labels
+    own_count = counts[own]
+    a = jnp.where(own_count > 1,
+                  jnp.take_along_axis(cluster_sums, own[:, None], axis=1)[:, 0]
+                  / jnp.maximum(own_count - 1, 1.0),
+                  0.0)
+    mean_other = cluster_sums / jnp.maximum(counts[None, :], 1.0)
+    mean_other = jnp.where(
+        (jnp.arange(n_clusters)[None, :] == own[:, None]) | (counts[None, :] == 0),
+        jnp.inf, mean_other)
+    b = jnp.min(mean_other, axis=1)
+    s = jnp.where(own_count > 1, (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-300), 0.0)
+    if return_samples:
+        return jnp.mean(s), s
+    return jnp.mean(s)
+
+
+def silhouette_score_batched(x, labels, n_clusters: Optional[int] = None,
+                             metric: DistanceType = DistanceType.L2Expanded,
+                             batch_size: int = 4096, return_samples: bool = False):
+    """Batched silhouette (reference stats/silhouette_score.cuh:62
+    ``silhouette_score_batched``): tiles the pairwise matrix over row chunks
+    so only batch_size×n distances are live."""
+    x = jnp.asarray(x)
+    labels = jnp.asarray(labels).astype(jnp.int32)
+    n = x.shape[0]
+    if n_clusters is None:
+        n_clusters = int(jnp.max(labels)) + 1
+    counts = jnp.zeros((n_clusters,), x.dtype).at[labels].add(1.0)
+    samples = []
+    for start in range(0, n, batch_size):
+        xb = x[start:start + batch_size]
+        lb = labels[start:start + batch_size]
+        d = pairwise_distance(xb, x, metric)
+        cluster_sums = jax.ops.segment_sum(d.T, labels, num_segments=n_clusters).T
+        own_count = counts[lb]
+        a = jnp.where(own_count > 1,
+                      jnp.take_along_axis(cluster_sums, lb[:, None], axis=1)[:, 0]
+                      / jnp.maximum(own_count - 1, 1.0), 0.0)
+        mean_other = cluster_sums / jnp.maximum(counts[None, :], 1.0)
+        mean_other = jnp.where(
+            (jnp.arange(n_clusters)[None, :] == lb[:, None]) | (counts[None, :] == 0),
+            jnp.inf, mean_other)
+        b = jnp.min(mean_other, axis=1)
+        samples.append(jnp.where(own_count > 1,
+                                 (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-300), 0.0))
+    s = jnp.concatenate(samples)
+    if return_samples:
+        return jnp.mean(s), s
+    return jnp.mean(s)
+
+
+def trustworthiness_score(x, x_embedded, n_neighbors: int = 5,
+                          metric: DistanceType = DistanceType.L2SqrtExpanded):
+    """Trustworthiness of a low-dimensional embedding
+    (reference stats/trustworthiness_score.cuh — brute-force kNN there;
+    full argsorted distance ranks here)."""
+    x = jnp.asarray(x)
+    x_embedded = jnp.asarray(x_embedded)
+    n = x.shape[0]
+    expects(n_neighbors < n // 2, "n_neighbors must be < n/2")
+    d_orig = pairwise_distance(x, x, metric)
+    d_emb = pairwise_distance(x_embedded, x_embedded, metric)
+    big = jnp.asarray(jnp.inf, d_orig.dtype)
+    eye = jnp.eye(n, dtype=bool)
+    d_orig = jnp.where(eye, big, d_orig)
+    d_emb = jnp.where(eye, big, d_emb)
+    # rank of j in i's original-space ordering
+    order_orig = jnp.argsort(d_orig, axis=1)
+    ranks = jnp.zeros((n, n), jnp.int32)
+    ranks = jax.vmap(lambda r, o: r.at[o].set(jnp.arange(n, dtype=jnp.int32)))(
+        ranks, order_orig)
+    # k nearest in embedded space
+    _, emb_nn = jax.lax.top_k(-d_emb, n_neighbors)
+    r = jnp.take_along_axis(ranks, emb_nn, axis=1)  # original ranks of embedded nns
+    penalty = jnp.maximum(r - n_neighbors + 1, 0).astype(jnp.float64)
+    t = 1.0 - (2.0 / (n * n_neighbors * (2 * n - 3 * n_neighbors - 1))) * jnp.sum(penalty)
+    return t
+
+
+# -- cluster dispersion / information criterion ------------------------------
+
+def dispersion(centroids, cluster_sizes, global_centroid=None, n_points: Optional[int] = None):
+    """Cluster dispersion Σᵢ sizeᵢ·‖cᵢ − μ‖² (reference
+    stats/detail/dispersion.cuh:31-32; returns sqrt like the reference's
+    final host step)."""
+    centroids = jnp.asarray(centroids)
+    sizes = jnp.asarray(cluster_sizes)
+    if n_points is None:
+        n_points = jnp.sum(sizes)
+    if global_centroid is None:
+        global_centroid = jnp.sum(centroids * sizes[:, None], axis=0) / n_points
+    diff = centroids - global_centroid[None, :]
+    return jnp.sqrt(jnp.sum(diff * diff * sizes[:, None]))
+
+
+class IC_Type(enum.Enum):
+    """reference stats/stats_types.hpp:60 ``IC_Type``."""
+
+    AIC = "aic"
+    AICc = "aicc"
+    BIC = "bic"
+
+
+def information_criterion_batched(loglikelihood, ic_type: IC_Type,
+                                  n_params: int, n_samples: int):
+    """AIC/AICc/BIC per batch element from log-likelihoods
+    (reference stats/detail/batched/information_criterion.cuh:44-69:
+    ic = ic_base − 2·loglike)."""
+    ll = jnp.asarray(loglikelihood)
+    n = float(n_params)
+    t = float(n_samples)
+    if ic_type == IC_Type.AIC:
+        base = 2.0 * n
+    elif ic_type == IC_Type.AICc:
+        base = 2.0 * (n + (n * (n + 1.0)) / (t - n - 1.0))
+    else:
+        base = float(jnp.log(t)) * n
+    return base - 2.0 * ll

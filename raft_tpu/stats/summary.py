@@ -1,0 +1,123 @@
+"""Summary statistics.
+
+Counterparts of reference raft/stats/{mean,mean_center,meanvar,stddev,sum,
+cov,minmax,weighted_mean,histogram}.cuh.  RAFT's convention: statistics are
+per-*column* (the reduction runs down the rows of the n_samples × n_features
+matrix); ``sample=True`` uses the n−1 denominator.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+def mean(data, sample: bool = False):
+    """Column means (reference stats/mean.cuh).  *sample* matches the
+    reference flag (divides by N−1 instead of N — kept for parity although
+    it only matters when composing with stddev)."""
+    n = data.shape[0]
+    denom = (n - 1) if sample else n
+    return jnp.sum(data, axis=0) / denom
+
+
+def mean_center(data, mu=None):
+    """Subtract column means (reference stats/mean_center.cuh ``meanCenter``)."""
+    if mu is None:
+        mu = mean(data)
+    return data - mu[None, :]
+
+
+def mean_add(data, mu):
+    """Inverse of mean_center (reference ``meanAdd``)."""
+    return data + mu[None, :]
+
+
+def meanvar(data, sample: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Column means and variances in one pass (reference stats/meanvar.cuh)."""
+    n = data.shape[0]
+    mu = jnp.mean(data, axis=0)
+    centered = data - mu[None, :]
+    denom = (n - 1) if sample else n
+    var = jnp.sum(centered * centered, axis=0) / denom
+    return mu, var
+
+
+def stddev(data, mu=None, sample: bool = True):
+    """Column standard deviations (reference stats/stddev.cuh)."""
+    if mu is None:
+        mu = jnp.mean(data, axis=0)
+    n = data.shape[0]
+    denom = (n - 1) if sample else n
+    centered = data - mu[None, :]
+    return jnp.sqrt(jnp.sum(centered * centered, axis=0) / denom)
+
+
+def vars_(data, mu=None, sample: bool = True):
+    """Column variances (reference ``vars``)."""
+    s = stddev(data, mu, sample)
+    return s * s
+
+
+def sum_(data):
+    """Column sums (reference stats/sum.cuh)."""
+    return jnp.sum(data, axis=0)
+
+
+def cov(data, mu=None, sample: bool = True, stable: bool = True):
+    """Covariance matrix of the columns (reference stats/cov.cuh — cublas
+    gemm over mean-centered data; here one MXU matmul)."""
+    if mu is None:
+        mu = jnp.mean(data, axis=0)
+    centered = data - mu[None, :]
+    n = data.shape[0]
+    denom = (n - 1) if sample else n
+    return jnp.matmul(centered.T, centered, precision="highest") / denom
+
+
+def minmax(data) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-column (min, max) (reference stats/minmax.cuh)."""
+    return jnp.min(data, axis=0), jnp.max(data, axis=0)
+
+
+def row_weighted_mean(data, weights):
+    """Weighted mean of each row (reference stats/weighted_mean.cuh
+    ``rowWeightedMean``: weights along columns)."""
+    w = jnp.asarray(weights)
+    return jnp.sum(data * w[None, :], axis=1) / jnp.sum(w)
+
+
+def col_weighted_mean(data, weights):
+    """Weighted mean of each column (reference ``colWeightedMean``)."""
+    w = jnp.asarray(weights)
+    return jnp.sum(data * w[:, None], axis=0) / jnp.sum(w)
+
+
+def weighted_mean(data, weights, along_rows: bool = True):
+    """reference ``weightedMean`` dispatcher."""
+    return row_weighted_mean(data, weights) if along_rows else col_weighted_mean(data, weights)
+
+
+def histogram(data, n_bins: int, lower: Optional[float] = None,
+              upper: Optional[float] = None):
+    """Per-column histogram (reference stats/histogram.cuh — the reference
+    ships 8+ CUDA binning strategies (smem/gmem atomics); XLA lowers one
+    one-hot segment-sum instead).
+
+    Values are binned into [lower, upper) with n_bins uniform bins; out-of-
+    range values are clamped into the edge bins (reference binner semantics).
+    Returns int32 [n_bins, n_features].
+    """
+    data = jnp.asarray(data)
+    if data.ndim == 1:
+        data = data[:, None]
+    lo = jnp.min(data) if lower is None else lower
+    hi = jnp.max(data) if upper is None else upper
+    width = (hi - lo) / n_bins
+    idx = jnp.clip(((data - lo) / width).astype(jnp.int32), 0, n_bins - 1)
+    one_hot = jax.nn.one_hot(idx, n_bins, dtype=jnp.int32, axis=0)  # (bins, n, f)
+    return jnp.sum(one_hot, axis=1)

@@ -1,0 +1,189 @@
+"""Stats tests — counterpart of reference cpp/test/stats/* with sklearn/
+numpy oracles (the reference compares against its own naive kernels)."""
+
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from raft_tpu import stats
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+class TestSummary:
+    def test_mean_center(self, rng):
+        x = rng.standard_normal((100, 5))
+        np.testing.assert_allclose(stats.mean(x), x.mean(axis=0), atol=1e-12)
+        c = np.asarray(stats.mean_center(x))
+        np.testing.assert_allclose(c.mean(axis=0), 0, atol=1e-12)
+        np.testing.assert_allclose(stats.mean_add(c, stats.mean(x)), x, atol=1e-12)
+
+    def test_meanvar_stddev(self, rng):
+        x = rng.standard_normal((200, 4))
+        mu, var = stats.meanvar(x, sample=True)
+        np.testing.assert_allclose(mu, x.mean(axis=0), atol=1e-12)
+        np.testing.assert_allclose(var, x.var(axis=0, ddof=1), atol=1e-12)
+        np.testing.assert_allclose(stats.stddev(x), x.std(axis=0, ddof=1), atol=1e-12)
+
+    def test_sum_cov_minmax(self, rng):
+        x = rng.standard_normal((50, 3))
+        np.testing.assert_allclose(stats.sum_(x), x.sum(axis=0), atol=1e-12)
+        np.testing.assert_allclose(stats.cov(x), np.cov(x.T, ddof=1), atol=1e-10)
+        mn, mx = stats.minmax(x)
+        np.testing.assert_allclose(mn, x.min(axis=0))
+        np.testing.assert_allclose(mx, x.max(axis=0))
+
+    def test_weighted_mean(self, rng):
+        x = rng.standard_normal((10, 6))
+        w = rng.random(6)
+        np.testing.assert_allclose(
+            stats.row_weighted_mean(x, w), (x * w).sum(axis=1) / w.sum(), atol=1e-12
+        )
+        w2 = rng.random(10)
+        np.testing.assert_allclose(
+            stats.col_weighted_mean(x, w2), (x * w2[:, None]).sum(axis=0) / w2.sum(),
+            atol=1e-12,
+        )
+
+    def test_histogram(self, rng):
+        x = rng.random((1000, 2)).astype(np.float32)
+        h = np.asarray(stats.histogram(x, 10, 0.0, 1.0))
+        assert h.shape == (10, 2)
+        assert h.sum(axis=0).tolist() == [1000, 1000]
+        expected = np.histogram(x[:, 0], bins=10, range=(0, 1))[0]
+        np.testing.assert_array_equal(h[:, 0], expected)
+
+
+class TestClassification:
+    def test_accuracy(self, rng):
+        a = rng.integers(0, 3, 100)
+        b = a.copy()
+        b[:20] = (b[:20] + 1) % 3
+        np.testing.assert_allclose(stats.accuracy(b, a), 0.8, atol=1e-6)
+
+    def test_r2(self, rng):
+        y = rng.standard_normal(100)
+        yh = y + 0.1 * rng.standard_normal(100)
+        np.testing.assert_allclose(stats.r2_score(y, yh), skm.r2_score(y, yh), atol=1e-6)
+
+    def test_regression_metrics(self, rng):
+        y = rng.standard_normal(100)
+        yh = y + rng.standard_normal(100)
+        mae, mse, medae = stats.regression_metrics(yh, y)
+        np.testing.assert_allclose(mae, skm.mean_absolute_error(y, yh), atol=1e-9)
+        np.testing.assert_allclose(mse, skm.mean_squared_error(y, yh), atol=1e-9)
+        np.testing.assert_allclose(medae, skm.median_absolute_error(y, yh), atol=1e-9)
+
+
+class TestContingency:
+    @pytest.fixture
+    def labels(self, rng):
+        return rng.integers(0, 4, 300), rng.integers(0, 5, 300)
+
+    def test_contingency_matrix(self, labels):
+        a, b = labels
+        cm = np.asarray(stats.contingency_matrix(a, b, n_classes=5))
+        expected = np.zeros((5, 5), int)
+        for i, j in zip(a, b):
+            expected[i, j] += 1
+        np.testing.assert_array_equal(cm, expected)
+
+    def test_entropy(self, labels):
+        a, _ = labels
+        p = np.bincount(a) / len(a)
+        expected = -(p[p > 0] * np.log(p[p > 0])).sum()
+        np.testing.assert_allclose(stats.entropy(a), expected, atol=1e-10)
+
+    def test_mutual_info(self, labels):
+        a, b = labels
+        np.testing.assert_allclose(
+            stats.mutual_info_score(a, b), skm.mutual_info_score(a, b), atol=1e-10
+        )
+
+    def test_homogeneity_completeness_v(self, labels):
+        a, b = labels
+        np.testing.assert_allclose(
+            stats.homogeneity_score(a, b), skm.homogeneity_score(a, b), atol=1e-8
+        )
+        np.testing.assert_allclose(
+            stats.completeness_score(a, b), skm.completeness_score(a, b), atol=1e-8
+        )
+        np.testing.assert_allclose(
+            stats.v_measure(a, b), skm.v_measure_score(a, b), atol=1e-8
+        )
+
+    def test_rand_indices(self, labels):
+        a, b = labels
+        np.testing.assert_allclose(
+            stats.adjusted_rand_index(a, b), skm.adjusted_rand_score(a, b), atol=1e-10
+        )
+        np.testing.assert_allclose(
+            stats.rand_index(a, b), skm.rand_score(a, b), atol=1e-10
+        )
+        # perfect labeling
+        np.testing.assert_allclose(stats.adjusted_rand_index(a, a), 1.0, atol=1e-12)
+
+    def test_kl(self, rng):
+        p = rng.random(20)
+        p /= p.sum()
+        q = rng.random(20)
+        q /= q.sum()
+        expected = (p * np.log(p / q)).sum()
+        np.testing.assert_allclose(stats.kl_divergence(p, q), expected, atol=1e-10)
+
+
+class TestEmbeddingMetrics:
+    def test_silhouette(self, rng):
+        from raft_tpu.random import RngState, make_blobs
+
+        x, labels, _ = make_blobs(RngState(1), 300, 8, n_clusters=3, cluster_std=0.5)
+        x, labels = np.asarray(x, np.float64), np.asarray(labels)
+        got = float(stats.silhouette_score(x, labels))
+        expected = skm.silhouette_score(x, labels, metric="sqeuclidean")
+        np.testing.assert_allclose(got, expected, atol=1e-5)
+
+    def test_silhouette_batched_matches(self, rng):
+        from raft_tpu.random import RngState, make_blobs
+
+        x, labels, _ = make_blobs(RngState(2), 257, 6, n_clusters=4, cluster_std=0.6)
+        x, labels = np.asarray(x, np.float64), np.asarray(labels)
+        full = float(stats.silhouette_score(x, labels))
+        batched = float(stats.silhouette_score_batched(x, labels, batch_size=100))
+        np.testing.assert_allclose(batched, full, atol=1e-10)
+
+    def test_trustworthiness(self, rng):
+        x = rng.standard_normal((120, 10))
+        # identity embedding → trustworthiness 1; noisy projection < 1
+        emb_good = x[:, :10]
+        t_good = float(stats.trustworthiness_score(x, emb_good, n_neighbors=5))
+        np.testing.assert_allclose(t_good, 1.0, atol=1e-9)
+        emb_rand = rng.standard_normal((120, 2))
+        t_rand = float(stats.trustworthiness_score(x, emb_rand, n_neighbors=5))
+        from sklearn.manifold import trustworthiness as sk_trust
+
+        t_sk = sk_trust(x, np.asarray(emb_rand), n_neighbors=5)
+        np.testing.assert_allclose(t_rand, t_sk, atol=1e-6)
+        assert t_rand < t_good
+
+
+class TestDispersionIC:
+    def test_dispersion(self, rng):
+        centroids = rng.standard_normal((4, 3))
+        sizes = np.array([10, 20, 30, 40])
+        mu = (centroids * sizes[:, None]).sum(axis=0) / sizes.sum()
+        expected = np.sqrt((((centroids - mu) ** 2).sum(axis=1) * sizes).sum())
+        np.testing.assert_allclose(
+            stats.dispersion(centroids, sizes), expected, atol=1e-10
+        )
+
+    def test_information_criterion(self):
+        ll = np.array([-100.0, -200.0])
+        aic = np.asarray(stats.information_criterion_batched(ll, stats.IC_Type.AIC, 3, 50))
+        np.testing.assert_allclose(aic, 2 * 3 - 2 * ll)
+        bic = np.asarray(stats.information_criterion_batched(ll, stats.IC_Type.BIC, 3, 50))
+        np.testing.assert_allclose(bic, np.log(50) * 3 - 2 * ll)
+        aicc = np.asarray(stats.information_criterion_batched(ll, stats.IC_Type.AICc, 3, 50))
+        np.testing.assert_allclose(aicc, 2 * (3 + 3 * 4 / (50 - 3 - 1)) - 2 * ll)
