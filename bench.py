@@ -16,16 +16,33 @@ value / estimate, where ≥0.8 meets the north-star target.
 """
 
 import json
+import os
 import time
 
 import numpy as np
 
 A100_BASELINE_GBPS = 500.0
+# Engineering estimate for the reference's k-means on A100 at BASELINE
+# config[1] (100k×128 f32, k=1024): the E-step is a 100k×1024×128 fused GEMM
+# (~26 GFLOP @ ~15 TF/s effective) + M-step; ≈ 300 iter/s.
+A100_BASELINE_KMEANS_ITERS = 300.0
 
 M, N, K = 5000, 5000, 50
 
 
-def main():
+def _time_best(fn, iters=20):
+    import jax
+
+    jax.block_until_ready(fn())  # warmup/compile
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def bench_pairwise():
     import jax
 
     from raft_tpu.distance import pairwise_distance
@@ -33,29 +50,56 @@ def main():
     rng = np.random.default_rng(42)
     x = jax.device_put(rng.random((M, K), dtype=np.float32))
     y = jax.device_put(rng.random((N, K), dtype=np.float32))
-
-    def run():
-        return pairwise_distance(x, y, "euclidean")
-
-    # warmup / compile
-    out = run()
-    jax.block_until_ready(out)
-
-    times = []
-    for _ in range(20):
-        t0 = time.perf_counter()
-        jax.block_until_ready(run())
-        times.append(time.perf_counter() - t0)
-    best = min(times)
-
+    best = _time_best(lambda: pairwise_distance(x, y, "euclidean"))
     nbytes = (M * K + N * K + M * N) * 4
     gbps = nbytes / best / 1e9
-    print(json.dumps({
+    return {
         "metric": "pairwise_distance_l2sqrt_5000x50_f32",
         "value": round(gbps, 2),
         "unit": "GB/s",
         "vs_baseline": round(gbps / A100_BASELINE_GBPS, 3),
-    }))
+    }
+
+
+def bench_kmeans():
+    """BASELINE config[1]: k-means EM iterations/sec, 100k×128 f32, k=1024."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.cluster import min_cluster_and_distance, update_centroids
+
+    rng = np.random.default_rng(0)
+    x = jax.device_put(rng.random((100_000, 128), dtype=np.float32))
+    c = jax.device_put(rng.random((1024, 128), dtype=np.float32))
+
+    @jax.jit
+    def em_iter(c):
+        nn = min_cluster_and_distance(x, c)
+        new, _ = update_centroids(x, nn.key, 1024, old_centroids=c)
+        return new
+
+    # Chained (data-dependent) iterations: repeated identical dispatches can
+    # be elided/cached by the runtime and under-/over-count.
+    jax.block_until_ready(em_iter(c))
+    n_chain = 20
+    t0 = time.perf_counter()
+    cc = c
+    for _ in range(n_chain):
+        cc = em_iter(cc)
+    jax.block_until_ready(cc)
+    ips = n_chain / (time.perf_counter() - t0)
+    return {
+        "metric": "kmeans_iter_100kx128_k1024_f32",
+        "value": round(ips, 2),
+        "unit": "iter/s",
+        "vs_baseline": round(ips / A100_BASELINE_KMEANS_ITERS, 3),
+    }
+
+
+def main():
+    which = os.environ.get("BENCH_METRIC", "pairwise")
+    fn = {"pairwise": bench_pairwise, "kmeans": bench_kmeans}[which]
+    print(json.dumps(fn()))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,31 @@
+"""Clustering: k-means (++/balanced) + single-linkage HAC
+(reference raft/cluster/ — SURVEY.md §2.9)."""
+
+from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams  # noqa: F401
+from raft_tpu.cluster.kmeans import (  # noqa: F401
+    KMeans,
+    KMeansOutput,
+    cluster_cost,
+    fit,
+    fit_predict,
+    init_plus_plus,
+    init_random,
+    kmeans_plus_plus,
+    min_cluster_and_distance,
+    predict,
+    sample_centroids,
+    shuffle_and_gather,
+    transform,
+    update_centroids,
+)
+from raft_tpu.cluster.kmeans_balanced import (  # noqa: F401
+    adjust_centers,
+    build_clusters,
+    build_hierarchical,
+)
+from raft_tpu.cluster.single_linkage import (  # noqa: F401
+    LinkageDistance,
+    SingleLinkageOutput,
+    build_sorted_mst,
+    single_linkage,
+)

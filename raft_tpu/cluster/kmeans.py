@@ -1,0 +1,346 @@
+"""K-means clustering.
+
+Counterpart of reference raft/cluster/kmeans.cuh:85-1046 (public mdspan API)
+with impls mirroring cluster/detail/kmeans.cuh (init via scalable k-means||
+``initKMeansPlusPlus``, main EM loop ``kmeans_fit_main`` :362) and
+cluster/detail/kmeans_common.cuh (``minClusterAndDistanceCompute`` :341,
+``sampleCentroids`` :213, ``shuffleAndGather`` :307).
+
+TPU-first: the E-step rides :func:`raft_tpu.distance.fused_l2_nn` (MXU tile +
+fused argmin, batched over ``batch_samples`` row blocks); the M-step is a
+segment-sum (reduce_rows_by_key); the EM loop is a ``lax.while_loop`` so the
+whole fit is ONE XLA program with no per-iteration host sync (the reference
+syncs inertia to host every iteration — reference kmeans.cuh:470-505).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import replace
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.kvp import KeyValuePair
+from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams
+from raft_tpu.distance import DistanceType, pairwise_distance
+from raft_tpu.distance.fused_l2_nn import _fused_l2_nn
+from raft_tpu.random.rng import RngState
+
+_L2_METRICS = (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+               DistanceType.L2Unexpanded, DistanceType.L2SqrtUnexpanded)
+
+
+# ---------------------------------------------------------------------------
+# building blocks (reference cluster/detail/kmeans_common.cuh)
+# ---------------------------------------------------------------------------
+
+# k-means E-steps default to "high" (bf16x3) matmul precision: measured ~2x
+# faster than full-f32 emulation on v5e with zero argmin flips on k-means-
+# scale data; pass precision="highest" for bit-exact f32.
+@functools.partial(jax.jit, static_argnames=("metric", "batch_samples",
+                                             "batch_centroids", "precision"))
+def min_cluster_and_distance(x, centroids, metric: DistanceType = DistanceType.L2Expanded,
+                             batch_samples: int = 1 << 15, batch_centroids: int = 1024,
+                             precision: str = "high") -> KeyValuePair:
+    """Nearest centroid (index, distance) per sample — the E-step
+    (reference kmeans_common.cuh:341; fusedL2NNMinReduce fast path :416).
+
+    Distances are *squared* L2 for the L2-family metrics (matching the
+    reference, which runs k-means on squared distances), cosine distance for
+    CosineExpanded; batched over (batch_samples × batch_centroids) tiles.
+    """
+    m, dim = x.shape
+    if metric in _L2_METRICS:
+        bs = min(batch_samples, m)
+        nb = -(-m // bs)
+        xp = jnp.pad(x, ((0, nb * bs - m), (0, 0)))
+        y_norms = jnp.sum(centroids * centroids, axis=1)
+
+        def blk(xb):
+            xn = jnp.sum(xb * xb, axis=1)
+            val, idx = _fused_l2_nn(xb, centroids, xn, y_norms, False,
+                                    min(batch_centroids, centroids.shape[0]),
+                                    precision)
+            return val, idx
+
+        vals, idxs = jax.lax.map(blk, xp.reshape(nb, bs, dim))
+        return KeyValuePair(key=idxs.reshape(-1)[:m], value=vals.reshape(-1)[:m])
+    # generic path: row-batched pairwise + argmin (reference else-branch:
+    # pairwise distance tile + cub argmin, same batch_samples bound)
+    from raft_tpu.distance.pairwise import _dispatch
+
+    bs = min(batch_samples, m)
+    nb = -(-m // bs)
+    xp = jnp.pad(x, ((0, nb * bs - m), (0, 0)))
+
+    def blk(xb):
+        d = _dispatch(xb, centroids, metric, 2.0)
+        i = jnp.argmin(d, axis=1).astype(jnp.int32)
+        return jnp.take_along_axis(d, i[:, None], axis=1)[:, 0], i
+
+    vals, idxs = jax.lax.map(blk, xp.reshape(nb, bs, dim))
+    return KeyValuePair(key=idxs.reshape(-1)[:m], value=vals.reshape(-1)[:m])
+
+
+def update_centroids(x, labels, n_clusters: int, sample_weights=None,
+                     old_centroids=None):
+    """M-step: weighted per-cluster means (reference
+    cluster/detail/kmeans.cuh:280 ``update_centroids``; also the MNMG
+    building block pylibraft cluster/kmeans.pyx:71 ``compute_new_centroids``).
+
+    Empty clusters keep their previous centroid (reference fallback).
+    Returns (new_centroids, weight_per_cluster).
+    """
+    x = jnp.asarray(x)
+    labels = jnp.asarray(labels)
+    if sample_weights is None:
+        sample_weights = jnp.ones((x.shape[0],), x.dtype)
+    wx = x * sample_weights[:, None]
+    sums = jax.ops.segment_sum(wx, labels, num_segments=n_clusters)
+    wsum = jax.ops.segment_sum(sample_weights, labels, num_segments=n_clusters)
+    new = sums / jnp.maximum(wsum, 1e-30)[:, None]
+    if old_centroids is not None:
+        new = jnp.where(wsum[:, None] > 0, new, old_centroids)
+    return new, wsum
+
+
+def cluster_cost(min_distances, sample_weights=None):
+    """Total inertia (reference cluster/kmeans.cuh ``cluster_cost``)."""
+    v = min_distances.value if isinstance(min_distances, KeyValuePair) else min_distances
+    if sample_weights is not None:
+        v = v * sample_weights
+    return jnp.sum(v)
+
+
+def sample_centroids(rng: RngState, x, min_distances, n_to_sample: int):
+    """Sample rows with probability ∝ min-distance (reference
+    kmeans_common.cuh:213 ``sampleCentroids``)."""
+    from raft_tpu.random.rng import sample_without_replacement
+
+    d = min_distances.value if isinstance(min_distances, KeyValuePair) else min_distances
+    return sample_without_replacement(rng, x, n_to_sample, weights=d)
+
+
+def shuffle_and_gather(rng: RngState, x, n_samples_to_gather: int):
+    """Random row subset (reference kmeans_common.cuh:307 ``shuffleAndGather``)."""
+    from raft_tpu.random.rng import sample_without_replacement
+
+    return sample_without_replacement(rng, x, n_samples_to_gather)
+
+
+# ---------------------------------------------------------------------------
+# init (reference cluster/detail/kmeans.cuh initRandom / initKMeansPlusPlus)
+# ---------------------------------------------------------------------------
+
+def init_random(rng: RngState, x, n_clusters: int):
+    """Random distinct rows (reference ``initRandom``, detail/kmeans.cuh:60)."""
+    return shuffle_and_gather(rng, x, n_clusters)
+
+
+@functools.partial(jax.jit, static_argnames=("k",), donate_argnums=())
+def _weighted_kmeans_pp(key, candidates, weights, k: int):
+    """Greedy weighted k-means++ over a (small) candidate set — the final
+    step of k-means|| (reference initKMeansPlusPlus's CPU-side selection)."""
+    nc, dim = candidates.shape
+
+    def body(i, state):
+        chosen, min_d = state
+        # zero-weight slots must stay at probability 0 (not NaN/inf)
+        probs = jnp.where(weights > 0, weights * min_d, 0.0)
+        logits = jnp.log(jnp.maximum(probs, 1e-37))
+        idx = jax.random.categorical(jax.random.fold_in(key, i), logits)
+        c = candidates[idx]
+        chosen = chosen.at[i].set(c)
+        d = jnp.sum((candidates - c[None, :]) ** 2, axis=1)
+        return chosen, jnp.minimum(min_d, d)
+
+    # First center ∝ weights alone (classic k-means++ step 1); starting the
+    # loop with an inf/capped min_d would corrupt the d² weighting.
+    idx0 = jax.random.categorical(
+        jax.random.fold_in(key, 0),  # loop body uses fold_in(key, 1..k-1)
+        jnp.log(jnp.maximum(jnp.where(weights > 0, weights, 0.0), 1e-37)))
+    c0 = candidates[idx0]
+    chosen0 = jnp.zeros((k, dim), candidates.dtype).at[0].set(c0)
+    min_d0 = jnp.sum((candidates - c0[None, :]) ** 2, axis=1)
+    chosen, _ = jax.lax.fori_loop(1, k, body, (chosen0, min_d0))
+    return chosen
+
+
+def init_plus_plus(rng: RngState, x, n_clusters: int,
+                   oversampling_factor: float = 2.0, n_rounds: int = 5,
+                   metric: DistanceType = DistanceType.L2Expanded):
+    """Scalable k-means|| init (reference ``initKMeansPlusPlus``,
+    cluster/detail/kmeans.cuh:~520-700; Bahmani et al.):
+
+    1. one uniformly random center;
+    2. ``n_rounds`` rounds sampling ~l = oversampling_factor·k candidates
+       each with probability ∝ d²(x, C);
+    3. weight candidates by assignment counts and run weighted k-means++
+       on the (small) candidate set.
+    """
+    x = jnp.asarray(x)
+    n, dim = x.shape
+    l = max(1, int(oversampling_factor * n_clusters))
+    key0 = rng.next_key()
+    first = x[jax.random.randint(key0, (), 0, n)]
+    # Fixed-capacity candidate buffer (1 + n_rounds·l): ONE compiled shape
+    # for every round instead of a recompile per growing concatenation.
+    # Unfilled slots hold copies of the first center — duplicates cannot
+    # change any point's min distance (argmin ties resolve to the lowest
+    # slot), and they collect zero ownership weight below.
+    cap = 1 + n_rounds * l
+    candidates = jnp.broadcast_to(first[None, :], (cap, dim)).copy()
+    n_filled = 1
+    for r in range(n_rounds):
+        nn = min_cluster_and_distance(x, candidates, metric)
+        probs = jnp.maximum(nn.value, 1e-37)
+        key = rng.next_key()
+        idx = jax.random.categorical(key, jnp.log(probs), shape=(l,))
+        candidates = jax.lax.dynamic_update_slice(candidates, x[idx], (n_filled, 0))
+        n_filled += l
+    # weight candidates by how many points they own (duplicate slots collect
+    # zero: argmin ties go to the first occurrence)
+    nn = min_cluster_and_distance(x, candidates, metric)
+    counts = jnp.zeros((cap,), x.dtype).at[nn.key].add(1.0)
+    return _weighted_kmeans_pp(rng.next_key(), candidates, counts, n_clusters)
+
+
+kmeans_plus_plus = init_plus_plus  # reference kmeans.cuh ``kmeans_plus_plus``
+
+
+# ---------------------------------------------------------------------------
+# fit / predict (reference cluster/detail/kmeans.cuh kmeans_fit_main :362)
+# ---------------------------------------------------------------------------
+
+class KMeansOutput(NamedTuple):
+    centroids: jnp.ndarray
+    inertia: jnp.ndarray
+    n_iter: jnp.ndarray
+    labels: Optional[jnp.ndarray] = None
+
+
+@functools.partial(jax.jit, static_argnames=("metric", "max_iter", "batch_samples",
+                                             "batch_centroids"))
+def _fit_main(x, centroids0, weights, metric: DistanceType, max_iter: int,
+              tol: float, batch_samples: int, batch_centroids: int):
+    k = centroids0.shape[0]
+
+    def cond(state):
+        it, _, _, delta = state
+        return (it < max_iter) & (delta > tol * tol)
+
+    def body(state):
+        it, centroids, _, _ = state
+        nn = min_cluster_and_distance(x, centroids, metric, batch_samples,
+                                      batch_centroids)
+        new, _ = update_centroids(x, nn.key, k, weights, centroids)
+        delta = jnp.sum((new - centroids) ** 2)
+        inertia = cluster_cost(nn, weights)
+        return it + 1, new, inertia, delta
+
+    init = (jnp.asarray(0), centroids0, jnp.asarray(jnp.inf, x.dtype),
+            jnp.asarray(jnp.inf, x.dtype))
+    n_iter, centroids, inertia, _ = jax.lax.while_loop(cond, body, init)
+    # final E-step for the converged inertia (reference recomputes after loop)
+    nn = min_cluster_and_distance(x, centroids, metric, batch_samples, batch_centroids)
+    return centroids, cluster_cost(nn, weights), n_iter
+
+
+def _resolve_batches(params: KMeansParams):
+    bc = params.batch_centroids if params.batch_centroids > 0 else max(
+        1024, params.n_clusters)
+    return params.batch_samples, bc
+
+
+def fit(params: KMeansParams, x, sample_weights=None, centroids=None
+        ) -> KMeansOutput:
+    """Full k-means fit (reference cluster/kmeans.cuh:85 ``fit``):
+    init (++/random/user array) → EM to convergence; best of n_init runs."""
+    x = jnp.asarray(x)
+    expects(x.ndim == 2, "x must be [n_samples, n_features]")
+    expects(params.n_clusters <= x.shape[0], "n_clusters must be <= n_samples")
+    if sample_weights is None:
+        weights = jnp.ones((x.shape[0],), x.dtype)
+    else:
+        # normalize to sum to n_samples (reference detail/kmeans.cuh fit)
+        w = jnp.asarray(sample_weights, x.dtype)
+        weights = w * (x.shape[0] / jnp.sum(w))
+    bs, bc = _resolve_batches(params)
+    rng = RngState(params.seed)
+    best: Optional[KMeansOutput] = None
+    # Array init is deterministic: extra n_init trials would be identical.
+    n_trials = 1 if params.init == InitMethod.Array else max(1, params.n_init)
+    for trial in range(n_trials):
+        if params.init == InitMethod.Array:
+            expects(centroids is not None, "init=Array requires centroids")
+            c0 = jnp.asarray(centroids, x.dtype)
+        elif params.init == InitMethod.Random:
+            c0 = init_random(rng, x, params.n_clusters)
+        else:
+            c0 = init_plus_plus(rng, x, params.n_clusters,
+                                params.oversampling_factor,
+                                metric=params.metric)
+        c, inertia, n_iter = _fit_main(x, c0, weights, params.metric,
+                                       params.max_iter, params.tol, bs, bc)
+        if best is None or float(inertia) < float(best.inertia):
+            best = KMeansOutput(c, inertia, n_iter)
+    return best
+
+
+def predict(params: KMeansParams, x, centroids, sample_weights=None,
+            normalize_weight: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Labels + inertia for fixed centroids (reference kmeans.cuh ``predict``).
+
+    *normalize_weight* matches the reference flag: normalize sample weights
+    to sum to n_samples (as ``fit`` does) before computing inertia.
+    """
+    x = jnp.asarray(x)
+    centroids = jnp.asarray(centroids)
+    if sample_weights is not None and normalize_weight:
+        w = jnp.asarray(sample_weights, x.dtype)
+        sample_weights = w * (x.shape[0] / jnp.sum(w))
+    bs, bc = _resolve_batches(params)
+    nn = min_cluster_and_distance(x, centroids, params.metric, bs, bc)
+    return nn.key, cluster_cost(nn, sample_weights)
+
+
+def fit_predict(params: KMeansParams, x, sample_weights=None, centroids=None
+                ) -> KMeansOutput:
+    """reference kmeans.cuh ``fit_predict``."""
+    out = fit(params, x, sample_weights, centroids)
+    labels, _ = predict(params, x, out.centroids, sample_weights)
+    return KMeansOutput(out.centroids, out.inertia, out.n_iter, labels)
+
+
+def transform(params: KMeansParams, x, centroids):
+    """Distances to every centroid (reference kmeans.cuh ``transform``)."""
+    return pairwise_distance(jnp.asarray(x), jnp.asarray(centroids), params.metric)
+
+
+class KMeans:
+    """Estimator-style convenience wrapper over the functional API."""
+
+    def __init__(self, n_clusters: int = 8, **kwargs):
+        self.params = KMeansParams(n_clusters=n_clusters, **kwargs)
+        self.cluster_centers_ = None
+        self.inertia_ = None
+        self.n_iter_ = None
+        self.labels_ = None
+
+    def fit(self, x, sample_weights=None):
+        out = fit_predict(self.params, x, sample_weights)
+        self.cluster_centers_ = out.centroids
+        self.inertia_ = float(out.inertia)
+        self.n_iter_ = int(out.n_iter)
+        self.labels_ = out.labels
+        return self
+
+    def predict(self, x):
+        labels, _ = predict(self.params, x, self.cluster_centers_)
+        return labels
+
+    def transform(self, x):
+        return transform(self.params, x, self.cluster_centers_)

@@ -1,0 +1,126 @@
+"""Balanced hierarchical k-means — the ANN coarse quantizer trainer.
+
+Counterpart of reference spatial/knn/detail/ann_kmeans_balanced.cuh:
+``build_hierarchical`` (:942 — mesocluster split then per-mesocluster fine
+clustering), ``build_clusters`` (:626) and ``balancing_em_iters`` (:699 —
+EM iterations interleaved with ``adjust_centers`` which re-seeds
+under-populated clusters from over-populated ones).  Used by IVF-Flat /
+IVF-PQ index builds.
+
+TPU notes: EM steps are jitted (fused-L2-NN E-step + segment-sum M-step);
+the mesocluster split runs on host (dynamic subset shapes), padding each
+subset to a power-of-two bucket so XLA compiles O(log n) shapes, not one
+per mesocluster.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.cluster.kmeans import min_cluster_and_distance, update_centroids
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.random.rng import RngState
+
+
+def adjust_centers(centers, counts, x, labels, distances, threshold: float = 0.25):
+    """Re-seed clusters whose size is below ``threshold · average`` with data
+    points drawn from crowded clusters (reference ann_kmeans_balanced.cuh
+    ``adjust_centers`` — there a scalar host loop; here one vectorized pass:
+    the donors are the points with the highest (cluster-size × distance)
+    score, i.e. far-out members of fat clusters)."""
+    k = centers.shape[0]
+    avg = jnp.mean(counts)
+    small = counts < (avg * threshold)
+    n_small = jnp.sum(small.astype(jnp.int32))
+    score = counts[labels] * distances  # crowded-cluster outliers first
+    _, donor_idx = jax.lax.top_k(score, k)  # at most k donors needed
+    # rank small clusters; the i-th small cluster takes the i-th donor
+    small_rank = jnp.cumsum(small.astype(jnp.int32)) - 1
+    donors = x[donor_idx]
+    new_centers = jnp.where(small[:, None], donors[jnp.clip(small_rank, 0, k - 1)],
+                            centers)
+    return new_centers, n_small
+
+
+def build_clusters(rng: RngState, x, n_clusters: int, n_iters: int = 20,
+                   metric: DistanceType = DistanceType.L2Expanded,
+                   adjust_every: int = 2):
+    """Train ``n_clusters`` balanced centers on x (reference
+    ann_kmeans_balanced.cuh:626 ``build_clusters`` + :699
+    ``balancing_em_iters``)."""
+    from raft_tpu.random.rng import sample_without_replacement
+
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    centers = sample_without_replacement(rng, x, min(n_clusters, n))
+    if centers.shape[0] < n_clusters:  # tiny inputs: repeat rows
+        reps = -(-n_clusters // centers.shape[0])
+        centers = jnp.tile(centers, (reps, 1))[:n_clusters]
+    for it in range(n_iters):
+        nn = min_cluster_and_distance(x, centers, metric)
+        centers, counts = update_centroids(x, nn.key, n_clusters,
+                                           old_centroids=centers)
+        if adjust_every and (it % adjust_every == adjust_every - 1):
+            centers, _ = adjust_centers(centers, counts, x, nn.key, nn.value)
+    return centers
+
+
+def _bucket_pad(idx: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+    """Pad an index set to the next power of two by resampling, bounding the
+    number of distinct XLA shapes."""
+    target = 1 << max(3, (len(idx) - 1).bit_length())
+    if len(idx) == target:
+        return idx
+    extra = rng.choice(idx, target - len(idx), replace=True)
+    return np.concatenate([idx, extra])
+
+
+def build_hierarchical(rng: RngState, x, n_clusters: int, n_iters: int = 20,
+                       metric: DistanceType = DistanceType.L2Expanded):
+    """Two-level balanced clustering (reference ann_kmeans_balanced.cuh:942
+    ``build_hierarchical``): ≈√n_clusters mesoclusters, then fine clusters
+    within each mesocluster proportional to its population, then global
+    balancing EM iterations."""
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    if n_clusters <= 32 or n <= 4 * n_clusters:
+        return build_clusters(rng, x, n_clusters, n_iters, metric)
+    n_meso = max(2, int(math.sqrt(n_clusters) + 0.5))
+    meso_centers = build_clusters(rng, x, n_meso, n_iters, metric)
+    meso_labels = np.asarray(min_cluster_and_distance(x, meso_centers, metric).key)
+    sizes = np.bincount(meso_labels, minlength=n_meso)
+    # fine clusters per mesocluster ∝ population (≥1 for non-empty ones,
+    # 0 for empty ones — their quota is redistributed so the concatenated
+    # centers always total exactly n_clusters)
+    quota = np.where(sizes > 0,
+                     np.maximum(1, np.floor(sizes / n * n_clusters).astype(int)), 0)
+    while quota.sum() < n_clusters:
+        quota[np.argmax(np.where(sizes > 0, sizes - quota * (n / n_clusters),
+                                 -np.inf))] += 1
+    while quota.sum() > n_clusters:
+        i = np.argmax(np.where(quota > 1, quota, -1))  # never zero a non-empty meso
+        quota[i] -= 1
+    host_rng = np.random.default_rng(rng.seed + 1000)
+    x_host = np.asarray(x)
+    fine = []
+    for m in range(n_meso):
+        idx = np.nonzero(meso_labels == m)[0]
+        if len(idx) == 0:
+            continue
+        idx = _bucket_pad(idx, host_rng)
+        sub = jnp.asarray(x_host[idx])
+        fine.append(build_clusters(rng, sub, int(quota[m]),
+                                   max(4, n_iters // 2), metric))
+    centers = jnp.concatenate(fine, axis=0)[:n_clusters]
+    # global balancing passes over the full dataset
+    for it in range(max(2, n_iters // 4)):
+        nn = min_cluster_and_distance(x, centers, metric)
+        centers, counts = update_centroids(x, nn.key, n_clusters,
+                                           old_centroids=centers)
+        centers, _ = adjust_centers(centers, counts, x, nn.key, nn.value)
+    return centers

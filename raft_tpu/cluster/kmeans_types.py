@@ -1,0 +1,36 @@
+"""K-means parameter types (reference raft/cluster/kmeans_types.hpp:26-75)."""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from raft_tpu.distance.distance_types import DistanceType
+
+
+class InitMethod(enum.Enum):
+    """reference kmeans_types.hpp:28-37 ``KMeansParams::InitMethod``."""
+
+    KMeansPlusPlus = "kmeans++"
+    Random = "random"
+    Array = "array"
+
+
+@dataclass
+class KMeansParams:
+    """reference kmeans_types.hpp:26-75 — aggregate of all knobs."""
+
+    n_clusters: int = 8
+    init: InitMethod = InitMethod.KMeansPlusPlus
+    max_iter: int = 300
+    tol: float = 1e-4
+    verbosity: int = 4  # raft level INFO
+    seed: int = 0  # rng_state{seed}
+    metric: DistanceType = DistanceType.L2Expanded
+    n_init: int = 1
+    oversampling_factor: float = 2.0
+    # Batching knobs bounding the fused E-step tile (reference
+    # kmeans_types.hpp batch_samples/batch_centroids; 0 → use n_clusters).
+    batch_samples: int = 1 << 15
+    batch_centroids: int = 0
+    inertia_check: bool = False

@@ -1,0 +1,183 @@
+"""Single-linkage hierarchical agglomerative clustering.
+
+Counterpart of reference raft/cluster/single_linkage.cuh:53 and the pipeline
+in cluster/detail/single_linkage.cuh:52-117:
+
+  connectivity graph → sorted MST → host dendrogram (union-find
+  agglomerative labeling, detail/agglomerative.cuh:103
+  ``build_dendrogram_host``) → ``extract_flattened_clusters`` (:239).
+
+TPU-first MST: for the PAIRWISE connectivity mode the graph is dense, and
+Prim's algorithm is the natural fit — n sequential steps of an n-wide
+vector min (VPU), O(n²) total, no sparse frontier data structures.  The
+KNN_GRAPH mode (reference detail/connectivities.cuh:74) builds a kNN graph
+and runs Borůvka + connect_components; that path lands with
+:mod:`raft_tpu.sparse.solver` and is dispatched here when available.
+
+The dendrogram/union-find stage is inherently sequential host work — the
+reference also does it on CPU; here it is numpy (a C++ native version backs
+it when built, see native/).
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.distance import DistanceType, pairwise_distance
+
+
+class LinkageDistance(enum.Enum):
+    """reference cluster/single_linkage_types.hpp:26."""
+
+    PAIRWISE = "pairwise"
+    KNN_GRAPH = "knn_graph"
+
+
+class SingleLinkageOutput(NamedTuple):
+    """reference ``linkage_output`` (single_linkage_types.hpp)."""
+
+    labels: jnp.ndarray  # (n,)
+    children: np.ndarray  # (n-1, 2) scipy-style merge tree
+    deltas: np.ndarray  # (n-1,) merge distances
+    sizes: np.ndarray  # (n-1,) merged cluster sizes
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _prim_mst(d):
+    """Dense-graph Prim: returns (src, dst, weight) of the n−1 MST edges in
+    insertion order.  d must have +inf on the diagonal."""
+    n = d.shape[0]
+    inf = jnp.asarray(jnp.inf, d.dtype)
+
+    def body(i, state):
+        in_tree, best_d, best_src, src, dst, w = state
+        # nearest out-of-tree node
+        cand = jnp.where(in_tree, inf, best_d)
+        u = jnp.argmin(cand).astype(jnp.int32)
+        src = src.at[i].set(best_src[u])
+        dst = dst.at[i].set(u)
+        w = w.at[i].set(cand[u])
+        in_tree = in_tree.at[u].set(True)
+        du = d[u]
+        better = du < best_d
+        best_d = jnp.where(better, du, best_d)
+        best_src = jnp.where(better, u, best_src).astype(jnp.int32)
+        return in_tree, best_d, best_src, src, dst, w
+
+    in_tree = jnp.zeros((n,), bool).at[0].set(True)
+    state = (
+        in_tree,
+        d[0],
+        jnp.zeros((n,), jnp.int32),
+        jnp.zeros((n - 1,), jnp.int32),
+        jnp.zeros((n - 1,), jnp.int32),
+        jnp.zeros((n - 1,), d.dtype),
+    )
+    _, _, _, src, dst, w = jax.lax.fori_loop(0, n - 1, body, state)
+    return src, dst, w
+
+
+def build_sorted_mst(x=None, metric: DistanceType = DistanceType.L2SqrtExpanded,
+                     dist=None):
+    """MST edges sorted by weight (reference cluster/detail/mst.cuh
+    ``build_sorted_mst``)."""
+    if dist is None:
+        x = jnp.asarray(x)
+        dist = pairwise_distance(x, x, metric)
+    n = dist.shape[0]
+    dist = jnp.where(jnp.eye(n, dtype=bool), jnp.inf, dist)
+    src, dst, w = _prim_mst(dist)
+    order = jnp.argsort(w)
+    return src[order], dst[order], w[order]
+
+
+def build_dendrogram_host(src, dst, weights) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Union-find agglomerative labeling on host (reference
+    detail/agglomerative.cuh:103 ``build_dendrogram_host``; union-find
+    :39-70).  Produces scipy-linkage-style (children, deltas, sizes)."""
+    src = np.asarray(src)
+    dst = np.asarray(dst)
+    weights = np.asarray(weights)
+    try:
+        from raft_tpu.native import agglomerative as _native
+
+        return _native.build_dendrogram(src, dst, weights)
+    except Exception:
+        pass
+    n = len(src) + 1
+    parent = np.arange(2 * n - 1)
+    size = np.ones(2 * n - 1, dtype=np.int64)
+
+    def find(a):
+        root = a
+        while parent[root] != root:
+            root = parent[root]
+        while parent[a] != root:  # path compression
+            parent[a], a = root, parent[a]
+        return root
+
+    children = np.zeros((n - 1, 2), dtype=np.int64)
+    sizes = np.zeros(n - 1, dtype=np.int64)
+    for i in range(n - 1):
+        ra, rb = find(src[i]), find(dst[i])
+        new = n + i
+        children[i] = (min(ra, rb), max(ra, rb))
+        size[new] = size[ra] + size[rb]
+        sizes[i] = size[new]
+        parent[ra] = parent[rb] = new
+    return children, weights.copy(), sizes
+
+
+def extract_flattened_clusters(children: np.ndarray, n_clusters: int, n: int
+                               ) -> np.ndarray:
+    """Cut the dendrogram at n_clusters (reference detail/agglomerative.cuh:239
+    ``extract_flattened_clusters``): apply the first n−n_clusters merges and
+    label the resulting forest 0..n_clusters−1."""
+    parent = np.arange(2 * n - 1)
+
+    def find(a):
+        while parent[a] != a:
+            parent[a] = parent[parent[a]]
+            a = parent[a]
+        return a
+
+    for i in range(n - n_clusters):
+        a, b = children[i]
+        new = n + i
+        parent[find(a)] = new
+        parent[find(b)] = new
+    roots = np.array([find(i) for i in range(n)])
+    _, labels = np.unique(roots, return_inverse=True)
+    return labels
+
+
+def single_linkage(x, metric: DistanceType = DistanceType.L2SqrtExpanded,
+                   linkage: LinkageDistance = LinkageDistance.PAIRWISE,
+                   n_clusters: int = 2, c: int = 15) -> SingleLinkageOutput:
+    """Full single-linkage HAC (reference cluster/single_linkage.cuh:53).
+
+    *c* controls kNN-graph density in KNN_GRAPH mode (reference semantics);
+    unused in PAIRWISE mode.
+    """
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    expects(2 <= n_clusters <= n, "n_clusters must be in [2, n]")
+    if linkage == LinkageDistance.KNN_GRAPH:
+        try:
+            from raft_tpu.sparse.neighbors import mst_from_knn_graph
+
+            src, dst, w = mst_from_knn_graph(x, metric, c)
+        except ImportError:
+            src, dst, w = build_sorted_mst(x, metric)
+    else:
+        src, dst, w = build_sorted_mst(x, metric)
+    children, deltas, sizes = build_dendrogram_host(src, dst, w)
+    labels = extract_flattened_clusters(children, n_clusters, n)
+    return SingleLinkageOutput(jnp.asarray(labels), children, deltas, sizes)

@@ -28,8 +28,9 @@ _BN = 1024  # column block: y-block (bn × k) + distance block (m × bn) stay in
 _PRECISION = "highest"
 
 
-@functools.partial(jax.jit, static_argnames=("sqrt", "block_n"))
-def _fused_l2_nn(x, y, x_norms, y_norms, sqrt: bool, block_n: int):
+@functools.partial(jax.jit, static_argnames=("sqrt", "block_n", "precision"))
+def _fused_l2_nn(x, y, x_norms, y_norms, sqrt: bool, block_n: int,
+                 precision: str = _PRECISION):
     m, k = x.shape
     n = y.shape[0]
     bn = min(block_n, n)
@@ -44,7 +45,7 @@ def _fused_l2_nn(x, y, x_norms, y_norms, sqrt: bool, block_n: int):
 
     def step(carry, blk):
         yb, ynb, base = blk
-        d = x_norms[:, None] + ynb[None, :] - 2.0 * jnp.matmul(x, yb.T, precision=_PRECISION)
+        d = x_norms[:, None] + ynb[None, :] - 2.0 * jnp.matmul(x, yb.T, precision=precision)
         d = jnp.maximum(d, 0.0)
         d = jnp.where(jnp.isfinite(ynb)[None, :], d, jnp.inf)
         blk_arg = jnp.argmin(d, axis=1)
@@ -67,7 +68,7 @@ def _fused_l2_nn(x, y, x_norms, y_norms, sqrt: bool, block_n: int):
 
 
 def fused_l2_nn(x, y, sqrt: bool = False, x_norms=None, y_norms=None,
-                block_n: int = _BN) -> KeyValuePair:
+                block_n: int = _BN, precision: str = _PRECISION) -> KeyValuePair:
     """For each row of x, the nearest row of y by (squared) L2 —
     returns ``KeyValuePair(key=index, value=distance)`` per row
     (reference ``fusedL2NN``, fused_l2_nn.cuh:89)."""
@@ -78,7 +79,7 @@ def fused_l2_nn(x, y, sqrt: bool = False, x_norms=None, y_norms=None,
         x_norms = jnp.sum(x * x, axis=1)
     if y_norms is None:
         y_norms = jnp.sum(y * y, axis=1)
-    val, idx = _fused_l2_nn(x, y, x_norms, y_norms, bool(sqrt), int(block_n))
+    val, idx = _fused_l2_nn(x, y, x_norms, y_norms, bool(sqrt), int(block_n), precision)
     return KeyValuePair(key=idx, value=val)
 
 

@@ -1,0 +1,8 @@
+"""Label utilities (reference raft/label/ — SURVEY.md §2.12)."""
+
+from raft_tpu.label.classlabels import (  # noqa: F401
+    get_ovr_labels,
+    get_unique_labels,
+    make_monotonic,
+)
+from raft_tpu.label.merge_labels import merge_labels  # noqa: F401

@@ -1,0 +1,34 @@
+"""Class-label utilities.
+
+Counterpart of reference raft/label/classlabels.cuh:41-116
+(``getUniquelabels``, ``getOvrlabels``, ``make_monotonic``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def get_unique_labels(labels):
+    """Sorted unique labels (reference ``getUniquelabels``).  Host-returning
+    (output size is data-dependent, as in the reference which syncs)."""
+    return jnp.asarray(sorted(set(jnp.asarray(labels).tolist())))
+
+
+def get_ovr_labels(labels, target_label, true_val=1, false_val=0):
+    """One-vs-rest relabel (reference ``getOvrlabels``)."""
+    labels = jnp.asarray(labels)
+    return jnp.where(labels == target_label, true_val, false_val)
+
+
+def make_monotonic(labels, unique_labels=None, zero_based: bool = True):
+    """Map arbitrary label values onto a dense monotonic range
+    (reference ``make_monotonic``: RAFT maps to 1..n by default; pass
+    zero_based=True for 0..n−1).  Jit-safe when unique_labels is given."""
+    labels = jnp.asarray(labels)
+    if unique_labels is None:
+        unique_labels = get_unique_labels(labels)
+    unique_labels = jnp.asarray(unique_labels)
+    idx = jnp.searchsorted(unique_labels, labels)
+    return idx if zero_based else idx + 1

@@ -1,0 +1,44 @@
+"""Top-k / select-k over row-major batches.
+
+Counterpart of reference spatial/knn/detail/topk.cuh:65-80 (``select_topk``
+dispatcher) with its three engines — warp-sort bitonic
+(topk/warpsort_topk.cuh), radix top-k (topk/radix_topk.cuh), and FAISS
+block-select.  TPUs have no warps; ``jax.lax.top_k`` lowers to an efficient
+sort-based selection XLA schedules on the VPU, and the engine distinction
+collapses.  The dispatcher keeps the reference's signature (select_min,
+optional input indices payload).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def select_k(values, k: int, select_min: bool = True, indices=None
+             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Select the k smallest (or largest) elements per row.
+
+    Returns (out_values [..., k], out_indices [..., k]).  If *indices* is
+    given it is a payload gathered alongside (the reference's ``inV``/``inK``
+    pair); otherwise positions are returned.
+    """
+    values = jnp.asarray(values)
+    if select_min:
+        vals, idx = jax.lax.top_k(-values, k)
+        vals = -vals
+    else:
+        vals, idx = jax.lax.top_k(values, k)
+    if indices is not None:
+        idx = jnp.take_along_axis(jnp.asarray(indices), idx, axis=-1)
+    return vals, idx
+
+
+def select_min_k(values, k: int, indices=None):
+    return select_k(values, k, select_min=True, indices=indices)
+
+
+def select_max_k(values, k: int, indices=None):
+    return select_k(values, k, select_min=False, indices=indices)

@@ -1,0 +1,203 @@
+"""Cluster tests — counterpart of reference cpp/test/cluster/*: k-means is
+validated by ARI == 1.0 against make_blobs ground truth
+(reference test/cluster/kmeans.cu:362-369), linkage vs scipy."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import cluster
+from raft_tpu.cluster import InitMethod, KMeansParams
+from raft_tpu.distance import DistanceType
+from raft_tpu.random import RngState, make_blobs
+from raft_tpu.stats import adjusted_rand_index
+
+
+@pytest.fixture
+def blobs():
+    x, labels, centers = make_blobs(RngState(42), 1000, 16, n_clusters=5,
+                                    cluster_std=0.4)
+    return np.asarray(x), np.asarray(labels), np.asarray(centers)
+
+
+class TestBuildingBlocks:
+    def test_min_cluster_and_distance(self, blobs):
+        x, labels, centers = blobs
+        nn = cluster.min_cluster_and_distance(jnp.asarray(x), jnp.asarray(centers))
+        import scipy.spatial.distance as sd
+
+        d = sd.cdist(x, centers, "sqeuclidean")
+        np.testing.assert_array_equal(np.asarray(nn.key), d.argmin(axis=1))
+        np.testing.assert_allclose(np.asarray(nn.value), d.min(axis=1), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_update_centroids(self, blobs):
+        x, labels, centers = blobs
+        new, wsum = cluster.update_centroids(x, labels, 5)
+        for k in range(5):
+            np.testing.assert_allclose(np.asarray(new)[k], x[labels == k].mean(axis=0),
+                                       rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(wsum), np.bincount(labels, minlength=5))
+
+    def test_update_centroids_empty_cluster(self):
+        x = np.random.default_rng(0).random((10, 3)).astype(np.float32)
+        labels = np.zeros(10, np.int32)  # everything in cluster 0
+        old = np.ones((3, 3), np.float32) * 7
+        new, wsum = cluster.update_centroids(x, labels, 3, old_centroids=old)
+        np.testing.assert_allclose(np.asarray(new)[1:], old[1:])  # kept
+        np.testing.assert_allclose(np.asarray(new)[0], x.mean(axis=0), rtol=1e-5)
+
+    def test_cluster_cost(self, blobs):
+        x, _, centers = blobs
+        nn = cluster.min_cluster_and_distance(jnp.asarray(x), jnp.asarray(centers))
+        assert float(cluster.cluster_cost(nn)) > 0
+        w = np.zeros(len(x), np.float32)
+        assert float(cluster.cluster_cost(nn, w)) == 0.0
+
+
+class TestKMeansFit:
+    def test_fit_blobs_ari(self, blobs):
+        x, true_labels, _ = blobs
+        params = KMeansParams(n_clusters=5, init=InitMethod.KMeansPlusPlus,
+                              seed=3, max_iter=100)
+        out = cluster.fit_predict(params, x)
+        ari = float(adjusted_rand_index(np.asarray(out.labels), true_labels))
+        # reference gate: ARI == 1.0 on well-separated blobs (kmeans.cu:362)
+        assert ari > 0.99, f"ARI {ari}"
+        assert int(out.n_iter) <= 100
+
+    def test_fit_random_init_best_of_n(self, blobs):
+        x, true_labels, _ = blobs
+        # Random init lands in local optima on well-separated blobs; n_init
+        # best-of must pick the lowest-inertia run (reference n_init knob).
+        p1 = KMeansParams(n_clusters=5, init=InitMethod.Random, seed=3, n_init=1)
+        p5 = KMeansParams(n_clusters=5, init=InitMethod.Random, seed=3, n_init=5)
+        out1 = cluster.fit(p1, x)
+        out5 = cluster.fit(p5, x)
+        assert float(out5.inertia) <= float(out1.inertia) + 1e-3
+        assert int(out5.n_iter) < 100  # converged, didn't hit max_iter
+
+    def test_fit_init_array(self, blobs):
+        x, true_labels, centers = blobs
+        params = KMeansParams(n_clusters=5, init=InitMethod.Array)
+        out = cluster.fit_predict(params, x, centroids=centers)
+        ari = float(adjusted_rand_index(np.asarray(out.labels), true_labels))
+        assert ari > 0.99
+
+    def test_sample_weights(self, blobs):
+        x, _, _ = blobs
+        w = np.ones(len(x), np.float32)
+        params = KMeansParams(n_clusters=5, seed=1)
+        out_w = cluster.fit(params, x, sample_weights=w)
+        out = cluster.fit(params, x)
+        np.testing.assert_allclose(np.asarray(out_w.centroids),
+                                   np.asarray(out.centroids), rtol=1e-4, atol=1e-5)
+
+    def test_transform(self, blobs):
+        x, _, centers = blobs
+        params = KMeansParams(n_clusters=5)
+        t = cluster.transform(params, x, centers)
+        assert t.shape == (len(x), 5)
+
+    def test_predict_consistency(self, blobs):
+        x, _, _ = blobs
+        params = KMeansParams(n_clusters=5, seed=2)
+        out = cluster.fit(params, x)
+        labels, inertia = cluster.predict(params, x, out.centroids)
+        np.testing.assert_allclose(float(inertia), float(out.inertia), rtol=1e-3)
+
+    def test_estimator_wrapper(self, blobs):
+        x, true_labels, _ = blobs
+        km = cluster.KMeans(n_clusters=5, seed=5).fit(x)
+        assert km.inertia_ > 0
+        ari = float(adjusted_rand_index(np.asarray(km.labels_), true_labels))
+        assert ari > 0.99
+        assert km.predict(x).shape == (len(x),)
+
+
+class TestBalanced:
+    def test_build_clusters_balance(self):
+        x, _, _ = make_blobs(RngState(7), 2000, 8, n_clusters=10, cluster_std=1.0)
+        centers = cluster.build_clusters(RngState(0), x, 16, n_iters=10)
+        assert centers.shape == (16, 8)
+        nn = cluster.min_cluster_and_distance(jnp.asarray(x), centers)
+        counts = np.bincount(np.asarray(nn.key), minlength=16)
+        assert counts.min() > 0  # no empty clusters after balancing
+
+    def test_build_hierarchical(self):
+        x, _, _ = make_blobs(RngState(8), 5000, 8, n_clusters=20, cluster_std=1.0)
+        centers = cluster.build_hierarchical(RngState(0), x, 64, n_iters=8)
+        assert centers.shape == (64, 8)
+        nn = cluster.min_cluster_and_distance(jnp.asarray(x), centers)
+        counts = np.bincount(np.asarray(nn.key), minlength=64)
+        assert (counts > 0).sum() >= 60  # nearly all lists populated
+
+
+class TestSingleLinkage:
+    def test_mst_weight_matches_scipy(self):
+        rng = np.random.default_rng(0)
+        x = rng.random((60, 4))
+        src, dst, w = cluster.build_sorted_mst(x)
+        import scipy.sparse.csgraph as csgraph
+        import scipy.spatial.distance as sd
+
+        d = sd.cdist(x, x)
+        mst = csgraph.minimum_spanning_tree(d)
+        np.testing.assert_allclose(float(np.sum(np.asarray(w))), mst.sum(), rtol=1e-5)
+
+    def test_labels_match_scipy(self):
+        from scipy.cluster.hierarchy import fcluster, linkage
+
+        rng = np.random.default_rng(1)
+        x = np.concatenate([
+            rng.normal(0, 0.3, (40, 5)),
+            rng.normal(5, 0.3, (30, 5)),
+            rng.normal(-5, 0.3, (30, 5)),
+        ]).astype(np.float64)
+        out = cluster.single_linkage(x, n_clusters=3)
+        sp = fcluster(linkage(x, "single"), 3, criterion="maxclust")
+        ari = float(adjusted_rand_index(np.asarray(out.labels), sp - 1))
+        assert ari == 1.0
+        assert out.children.shape == (99, 2)
+        assert out.sizes[-1] == 100
+
+    def test_dendrogram_monotone(self):
+        rng = np.random.default_rng(2)
+        x = rng.random((50, 3))
+        out = cluster.single_linkage(x, n_clusters=2)
+        assert (np.diff(out.deltas) >= -1e-7).all()  # sorted merges
+
+
+class TestReviewRegressions:
+    def test_cosine_metric_threads_through(self):
+        # cosine k-means: init + EM must both use cosine (review finding)
+        rng = np.random.default_rng(0)
+        x = rng.random((500, 16)).astype(np.float32) + 0.1
+        params = KMeansParams(n_clusters=4, metric=DistanceType.CosineExpanded,
+                              seed=0, max_iter=50)
+        out = cluster.fit_predict(params, x)
+        assert out.labels.shape == (500,)
+        assert np.isfinite(float(out.inertia))
+
+    def test_predict_normalize_weight(self, blobs):
+        x, _, centers = blobs
+        params = KMeansParams(n_clusters=5)
+        w = np.full(len(x), 3.0, np.float32)
+        _, i_norm = cluster.predict(params, x, centers, sample_weights=w)
+        _, i_raw = cluster.predict(params, x, centers, sample_weights=w,
+                                   normalize_weight=False)
+        np.testing.assert_allclose(float(i_raw), 3 * float(i_norm), rtol=1e-5)
+
+    def test_array_init_single_trial(self, blobs):
+        x, _, centers = blobs
+        params = KMeansParams(n_clusters=5, init=InitMethod.Array, n_init=10)
+        out = cluster.fit(params, x, centroids=centers)  # must not do 10 fits
+        assert float(out.inertia) > 0
+
+    def test_hierarchical_with_empty_meso(self):
+        # tiny duplicated dataset forces degenerate/empty mesoclusters
+        x = np.tile(np.random.default_rng(0).random((40, 8)).astype(np.float32),
+                    (20, 1))
+        centers = cluster.build_hierarchical(RngState(0), x, 48, n_iters=4)
+        assert centers.shape == (48, 8)

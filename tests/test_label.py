@@ -1,0 +1,57 @@
+"""Label utility tests — counterpart of reference cpp/test/label/*."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from raft_tpu import label
+from raft_tpu.matrix import select_k
+
+
+def test_unique_labels():
+    labels = np.array([5, 2, 5, 9, 2])
+    np.testing.assert_array_equal(label.get_unique_labels(labels), [2, 5, 9])
+
+
+def test_ovr():
+    labels = np.array([0, 1, 2, 1])
+    np.testing.assert_array_equal(label.get_ovr_labels(labels, 1), [0, 1, 0, 1])
+
+
+def test_make_monotonic():
+    labels = np.array([10, 30, 10, 20, 30])
+    np.testing.assert_array_equal(label.make_monotonic(labels), [0, 2, 0, 1, 2])
+    np.testing.assert_array_equal(
+        label.make_monotonic(labels, zero_based=False), [1, 3, 1, 2, 3]
+    )
+
+
+def test_merge_labels():
+    # two chains merged through the mask: {0,1} via a, {1,2} via b
+    labels_a = np.array([0, 0, 2, 3], np.int32)
+    labels_b = np.array([1, 2, 2, 3], np.int32)
+    mask = np.array([False, True, True, False])
+    out = np.asarray(label.merge_labels(labels_a, labels_b, mask))
+    # nodes 0,1 share class a=0; nodes 1,2 share class b=2 → {0,1,2} get 0
+    np.testing.assert_array_equal(out, [0, 0, 0, 3])
+
+
+def test_select_k():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 100)).astype(np.float32)
+    vals, idx = select_k(x, 5, select_min=True)
+    expected = np.sort(x, axis=1)[:, :5]
+    np.testing.assert_allclose(np.asarray(vals), expected, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.take_along_axis(x, np.asarray(idx), axis=1), expected, rtol=1e-6
+    )
+    vals_max, _ = select_k(x, 3, select_min=False)
+    np.testing.assert_allclose(np.asarray(vals_max), -np.sort(-x, axis=1)[:, :3],
+                               rtol=1e-6)
+
+
+def test_select_k_payload():
+    x = np.array([[3.0, 1.0, 2.0]])
+    payload = np.array([[30, 10, 20]])
+    vals, idx = select_k(x, 2, indices=payload)
+    np.testing.assert_array_equal(np.asarray(idx), [[10, 20]])
