@@ -1,0 +1,390 @@
+"""The communicator: the full ``comms_t`` surface over XLA collectives.
+
+Counterpart of reference raft/core/comms.hpp:108-216 (``comms_iface``) and
+:218-648 (typed ``comms_t`` façade), with the NCCL/UCX ``std_comms`` backend
+(comms/detail/std_comms.hpp:55) replaced by XLA collectives over ICI/DCN.
+
+Design (TPU-first, per SURVEY.md §2.13/§5):
+
+* **Device plane** — collectives are *compile-time* ops used inside a
+  ``shard_map`` over a ``jax.sharding.Mesh``: allreduce→psum/pmax/…,
+  allgather→all_gather, reducescatter→psum_scatter, bcast/gather→
+  all_gather+select, device p2p→ppermute.  A :class:`Comms` instance binds
+  (mesh, axis_name, axis_index_groups); ``comm_split`` re-slices the axis
+  into groups — the analogue of NCCL's color/key split (std_comms.hpp:107,
+  reimplemented there by exchanging ncclUniqueIds; here it is a static
+  regrouping, which is what the hardware/ICI topology actually supports).
+* **Host plane** — tagged isend/irecv/waitall for control messages
+  (UCX's role) via a process-local mailbox (single-host) — the DCN path for
+  true multi-host rides the same interface.
+* ``sync_stream`` returns a :class:`Status` and maps device failure →
+  ABORT, mirroring the reference's failure propagation (ncclCommAbort).
+
+Usage:
+
+    comms = Comms(mesh)                    # world communicator
+    def step(x):                           # runs per-shard under shard_map
+        total = comms.allreduce(x)         # psum over ICI
+        ...
+    out = comms.run(step, x_sharded)       # shard_map + jit wrapper
+"""
+
+from __future__ import annotations
+
+import functools
+import queue
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import LogicError, expects
+from raft_tpu.comms.comms_types import ReduceOp, Request, Status
+
+_REDUCERS = {
+    ReduceOp.SUM: jax.lax.psum,
+    ReduceOp.MAX: jax.lax.pmax,
+    ReduceOp.MIN: jax.lax.pmin,
+}
+
+
+class _Mailboxes:
+    """Process-local tagged mailboxes for the host p2p plane."""
+
+    def __init__(self):
+        self._boxes = {}
+        self._lock = threading.Lock()
+
+    def box(self, key):
+        with self._lock:
+            if key not in self._boxes:
+                self._boxes[key] = queue.Queue()
+            return self._boxes[key]
+
+
+_mailboxes = _Mailboxes()
+
+
+class Comms:
+    """``comms_t``-shaped communicator bound to a device mesh axis.
+
+    Parameters
+    ----------
+    mesh: ``jax.sharding.Mesh`` (1-d over the communicator axis).  If None, a
+      mesh over all local devices is built.
+    axis_name: the mesh axis this communicator spans.
+    groups: optional list of rank groups (``axis_index_groups``) — produced
+      by :meth:`comm_split`; collectives then run within each group.
+    """
+
+    def __init__(self, mesh=None, axis_name: str = "world",
+                 groups: Optional[List[List[int]]] = None,
+                 session_id: str = "default", host_rank: int = 0):
+        if mesh is None:
+            devs = jax.devices()
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.array(devs), (axis_name,))
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.groups = groups
+        self.session_id = session_id
+        self._host_rank = host_rank  # used by the host p2p plane
+        self._aborted = False
+        self._run_cache: dict = {}
+        if groups is not None:
+            sizes = {len(g) for g in groups}
+            expects(len(sizes) == 1, "comm_split groups must be equal-sized")
+            self._group_size = sizes.pop()
+            n = mesh.shape[axis_name]
+            ranks = set(r for g in groups for r in g)
+            expects(ranks == set(range(n)), "groups must cover every rank exactly once")
+            # Static per-rank tables (closed over as constants): rank-within-
+            # group, group membership mask, and group member list — jax 0.9's
+            # shard_map has no axis_index_groups, so grouped collectives are
+            # implemented as one full all_gather + a static masked reduction
+            # (still a single ICI collective; XLA fuses the epilogue).
+            rank_table = np.zeros(n, np.int32)
+            mask_table = np.zeros((n, n), bool)
+            members_table = np.zeros((n, self._group_size), np.int32)
+            for g in groups:
+                for pos, r in enumerate(g):
+                    rank_table[r] = pos
+                    mask_table[r, g] = True
+                    members_table[r] = g
+            self._group_rank_table = jnp.asarray(rank_table)
+            self._mask_table = jnp.asarray(mask_table)
+            self._members_table = jnp.asarray(members_table)
+        else:
+            self._group_size = mesh.shape[axis_name]
+            self._group_rank_table = None
+            self._mask_table = None
+            self._members_table = None
+
+    # -- introspection (reference core/comms.hpp:229-237) --------------------
+    def get_size(self) -> int:
+        return self._group_size
+
+    def get_rank(self):
+        """Rank within this communicator.  INSIDE shard_map this is a traced
+        per-shard value; outside it raises (as there is no single rank)."""
+        idx = jax.lax.axis_index(self.axis_name)
+        if self._group_rank_table is not None:
+            return self._group_rank_table[idx]
+        return idx
+
+    def get_global_rank(self):
+        return jax.lax.axis_index(self.axis_name)
+
+    # -- split (reference comm_split, std_comms.hpp:107-171) -----------------
+    def comm_split(self, colors: Sequence[int], keys: Optional[Sequence[int]] = None
+                   ) -> "Comms":
+        """Split into sub-communicators by color; order within each by key.
+
+        NCCL's comm_split takes *this rank's* color at runtime; under SPMD
+        the grouping must be static, so the full color/key vectors (one entry
+        per rank) are passed host-side — the information content is identical.
+        Returns a new :class:`Comms` whose collectives run within each color
+        group (→ ``axis_index_groups``).
+        """
+        n = self.mesh.shape[self.axis_name]
+        colors = list(colors)
+        expects(len(colors) == n, f"need one color per rank ({n})")
+        keys = list(keys) if keys is not None else list(range(n))
+        groups = {}
+        for r, (c, k) in enumerate(zip(colors, keys)):
+            groups.setdefault(c, []).append((k, r))
+        group_list = [[r for _, r in sorted(v)] for _, v in sorted(groups.items())]
+        return Comms(self.mesh, self.axis_name, group_list, self.session_id,
+                     self._host_rank)
+
+    # -- device collectives (used inside shard_map) --------------------------
+    def _gather_all(self, x):
+        """all_gather over the FULL axis (grouped selection is masked on top)."""
+        return jax.lax.all_gather(x, self.axis_name)
+
+    def _my_mask(self):
+        """(n,)-bool membership mask of the calling rank's group."""
+        return self._mask_table[jax.lax.axis_index(self.axis_name)]
+
+    def allreduce(self, x, op: ReduceOp = ReduceOp.SUM):
+        """reference comms_t::allreduce (core/comms.hpp:322)."""
+        if self.groups is None:
+            if op == ReduceOp.PROD:
+                # no pprod primitive: exp∘psum∘log is invalid for ≤0
+                return jnp.prod(self._gather_all(x), axis=0)
+            return _REDUCERS[op](x, self.axis_name)
+        g = self._gather_all(x)  # (n, ...)
+        mask = self._my_mask()
+        mshape = (-1,) + (1,) * (g.ndim - 1)
+        m = mask.reshape(mshape)
+        if op == ReduceOp.SUM:
+            return jnp.sum(jnp.where(m, g, 0), axis=0)
+        if op == ReduceOp.PROD:
+            return jnp.prod(jnp.where(m, g, 1), axis=0)
+        if jnp.issubdtype(g.dtype, jnp.integer):
+            info = jnp.iinfo(g.dtype)
+            lo, hi = info.min, info.max
+        else:
+            lo, hi = -jnp.inf, jnp.inf
+        if op == ReduceOp.MIN:
+            return jnp.min(jnp.where(m, g, jnp.asarray(hi, g.dtype)), axis=0)
+        return jnp.max(jnp.where(m, g, jnp.asarray(lo, g.dtype)), axis=0)
+
+    def bcast(self, x, root: int = 0):
+        """reference comms_t::bcast (core/comms.hpp:340,358): every rank
+        returns its group root's value (*root* is a rank-within-group)."""
+        g = self._gather_all(x)
+        if self.groups is None:
+            return g[root]
+        root_global = self._members_table[jax.lax.axis_index(self.axis_name), root]
+        return jnp.take(g, root_global, axis=0)
+
+    def reduce(self, x, root: int = 0, op: ReduceOp = ReduceOp.SUM):
+        """reference comms_t::reduce (core/comms.hpp:376): non-roots get the
+        reduction too (harmless under SPMD; reference leaves their recvbuff
+        undefined)."""
+        return self.allreduce(x, op)
+
+    def allgather(self, x):
+        """reference comms_t::allgather (core/comms.hpp:395) — concatenated
+        along a new leading axis of size group_size (group members in key
+        order for split communicators)."""
+        g = self._gather_all(x)
+        if self.groups is None:
+            return g
+        members = self._members_table[jax.lax.axis_index(self.axis_name)]
+        return jnp.take(g, members, axis=0)
+
+    def allgatherv(self, x, counts: Sequence[int], pad_to: Optional[int] = None):
+        """reference comms_t::allgatherv (core/comms.hpp:413): variable
+        per-rank counts.  SPMD requires static shapes, so each shard is
+        padded to max(counts); returns (gathered [size, pad, ...], counts)
+        — callers slice with the (static) counts vector, the same
+        information NCCL's displacement vector carries."""
+        counts = list(counts)
+        expects(len(counts) == self.get_size(), "one count per rank")
+        pad = pad_to if pad_to is not None else max(counts)
+        expects(x.shape[0] <= pad, "shard larger than pad_to")
+        xp = jnp.pad(x, [(0, pad - x.shape[0])] + [(0, 0)] * (x.ndim - 1))
+        return self.allgather(xp), counts
+
+    def gather(self, x, root: int = 0):
+        """reference comms_t::gather (core/comms.hpp:437) — under SPMD the
+        gathered value is produced on all ranks; the root distinction is a
+        no-op on TPU (no extra traffic: XLA all-gathers anyway)."""
+        return self.allgather(x)
+
+    def gatherv(self, x, counts: Sequence[int], root: int = 0):
+        return self.allgatherv(x, counts)
+
+    def reducescatter(self, x, op: ReduceOp = ReduceOp.SUM):
+        """reference comms_t::reducescatter (core/comms.hpp:481): reduce then
+        scatter equal chunks; x's leading dim must be divisible by size."""
+        expects(x.shape[0] % self.get_size() == 0,
+                "reducescatter requires leading dim divisible by group size")
+        if op != ReduceOp.SUM or self.groups is not None:
+            g = self.allreduce(x, op)
+            rank = self.get_rank()
+            chunk = x.shape[0] // self.get_size()
+            return jax.lax.dynamic_slice_in_dim(g, rank * chunk, chunk, 0)
+        return jax.lax.psum_scatter(x, self.axis_name, tiled=True)
+
+    # -- device p2p (reference core/comms.hpp:498-648) -----------------------
+    def device_send(self, x, dst: int):
+        """Paired send: must be matched by the symmetric device_recv on every
+        rank (SPMD) — implemented with the dst/src pair as a ppermute."""
+        raise LogicError("device_send/device_recv are fused on TPU: use "
+                         "device_sendrecv(x, dst, src) — XLA collectives are "
+                         "matched per-program, not per-rank")
+
+    device_recv = device_send
+
+    def device_sendrecv(self, x, perm: Sequence[Tuple[int, int]]):
+        """reference comms_t::device_sendrecv (core/comms.hpp:602): exchange
+        with explicit (src, dst) pairs → ``ppermute``.  Ranks not in *perm*
+        receive zeros (XLA semantics)."""
+        return jax.lax.ppermute(x, self.axis_name, perm)
+
+    def device_multicast_sendrecv(self, x, dsts: Sequence[int], srcs: Sequence[int]):
+        """reference comms_t::device_multicast_sendrecv (core/comms.hpp:628):
+        send to several ranks / receive from several — returns the stacked
+        gathered values from *srcs* (all_gather + select keeps it one
+        collective on ICI)."""
+        g = self._gather_all(x)
+        return jnp.stack([g[s] for s in srcs])
+
+    def barrier(self):
+        """reference comms_t::barrier (core/comms.hpp:255): inside shard_map
+        → a psum fence; outside → device sync."""
+        try:
+            return jax.lax.psum(jnp.ones(()), self.axis_name)
+        except NameError:  # outside a mapped context
+            for d in self.mesh.devices.flat:
+                jax.device_put(0.0, d).block_until_ready()
+            return None
+
+    # -- host p2p plane (UCX's role; reference isend/irecv/waitall) ----------
+    def isend(self, obj, dst: int, tag: int = 0) -> Request:
+        box = _mailboxes.box((self.session_id, self._host_rank, dst, tag))
+        box.put(obj)
+        return Request("send", dst, tag, obj, done=True)
+
+    def irecv(self, src: int, tag: int = 0) -> Request:
+        return Request("recv", src, tag)
+
+    def waitall(self, requests: Sequence[Request], timeout: float = 60.0):
+        for r in requests:
+            if r.kind == "recv" and not r.done:
+                box = _mailboxes.box((self.session_id, r.peer, self._host_rank, r.tag))
+                try:
+                    r.payload = box.get(timeout=timeout)
+                except queue.Empty:
+                    self._aborted = True
+                    raise LogicError(
+                        f"comms waitall: timed out after {timeout}s waiting for "
+                        f"recv from rank {r.peer} tag {r.tag} "
+                        f"(session {self.session_id})") from None
+                r.done = True
+        return [r.payload for r in requests if r.kind == "recv"]
+
+    # -- group semantics + sync (reference group_start/end, sync_stream) -----
+    class _Group:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    def group_start(self):
+        """reference group_start (core/comms.hpp:270): XLA fuses adjacent
+        collectives itself; kept as a no-op context for API parity."""
+        return Comms._Group()
+
+    def group_end(self):
+        pass
+
+    def sync_stream(self, *arrays, stream=None) -> Status:
+        """Wait for outstanding device work; ABORT on device failure
+        (reference comms_t::sync_stream → status_t, std_comms sync_stream
+        polling cudaStreamQuery + ncclCommGetAsyncError)."""
+        if self._aborted:
+            return Status.ABORT
+        try:
+            from raft_tpu.core import interruptible
+
+            interruptible.synchronize(*arrays)
+            if stream is not None:
+                stream.synchronize()
+            return Status.SUCCESS
+        except KeyboardInterrupt:
+            raise
+        except Exception as e:  # device failure → abort the clique
+            from raft_tpu.core.logger import log_error
+
+            log_error("comms sync failed, aborting: %s", e)
+            self._aborted = True
+            return Status.ABORT
+
+    def abort(self):
+        """reference ncclCommAbort path."""
+        self._aborted = True
+
+    # -- execution helper ----------------------------------------------------
+    def run(self, fn: Callable, *args, in_specs=None, out_specs=None, **shard_kw):
+        """Run *fn* under ``shard_map`` over this communicator's mesh.
+
+        Default: every arg sharded along its leading axis; every output
+        replicated.  This is the OPG execution model (one shard per device).
+        """
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+
+        if in_specs is None:
+            in_specs = tuple(P(self.axis_name) for _ in args)
+        if out_specs is None:
+            out_specs = P()
+        # check_vma=False: grouped collectives are all_gather + masked
+        # reductions, which ARE replicated per-group but not provably so to
+        # the static varying-axes checker.
+        shard_kw.setdefault("check_vma", False)
+        # Cache the jitted wrapper: jit caches are keyed by callable identity,
+        # so rebuilding shard_map(fn) per call would retrace every time.
+        cache_key = (fn, str(in_specs), str(out_specs), str(sorted(shard_kw.items())))
+        jitted = self._run_cache.get(cache_key)
+        if jitted is None:
+            mapped = shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                               out_specs=out_specs, **shard_kw)
+            jitted = jax.jit(mapped)
+            self._run_cache[cache_key] = jitted
+        return jitted(*args)
+
+
+def build_comms(mesh=None, axis_name: str = "world", session_id: str = "default"
+                ) -> Comms:
+    """Construct a world communicator (reference ``build_comms_nccl_only``,
+    comms/std_comms.hpp:42 — no NCCL uid rendezvous needed: the mesh IS the
+    clique)."""
+    return Comms(mesh, axis_name, session_id=session_id)

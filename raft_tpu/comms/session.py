@@ -1,0 +1,119 @@
+"""Session-scoped MNMG bootstrap — the raft-dask equivalent.
+
+Counterpart of reference python/raft-dask/raft_dask/common/comms.py:37-245
+(``Comms`` session class), :247-326 (per-worker session state +
+``local_handle``), and the handle-injection path
+(common/comms_utils.pyx:240,270 → C++ ``build_comms_nccl_only``).
+
+On TPU the NCCL-uid rendezvous (comms.py:83,136) collapses: a pod's devices
+are already a clique.  The part worth preserving — and preserved here — is
+the *session pattern*: an opaque sessionId registered process-wide, workers/
+callers fetching a pre-injected :class:`raft_tpu.core.Handle` via
+``local_handle(session_id)``, and explicit ``init``/``destroy`` lifecycle.
+Multi-host bootstrap calls ``jax.distributed.initialize`` (PjRt's DCN
+control plane — the role NCCL uid broadcast + UCX endpoint mesh play in the
+reference).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Dict, Optional
+
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.handle import Handle
+from raft_tpu.comms.comms import Comms, build_comms
+
+_state_lock = threading.Lock()
+_session_state: Dict[str, dict] = {}
+
+
+def get_comms_state(session_id: str) -> dict:
+    """Per-process session state dict (reference
+    ``get_raft_comm_state(sessionId)``, comms.py:247)."""
+    with _state_lock:
+        if session_id not in _session_state:
+            _session_state[session_id] = {}
+        return _session_state[session_id]
+
+
+def local_handle(session_id: str) -> Optional[Handle]:
+    """The session's injected handle (reference ``local_handle``, comms.py:247)."""
+    return get_comms_state(session_id).get("handle")
+
+
+class CommsSession:
+    """Session bootstrap (reference raft-dask ``Comms`` class, comms.py:37).
+
+    Parameters
+    ----------
+    n_devices: use the first n local devices (None → all).
+    multihost: call ``jax.distributed.initialize(**multihost)`` first
+      (coordinator_address/num_processes/process_id), then build the mesh
+      over global devices.
+    """
+
+    def __init__(self, n_devices: Optional[int] = None, multihost: Optional[dict] = None,
+                 axis_name: str = "world"):
+        self.session_id = uuid.uuid4().hex  # reference comms.py sessionId
+        self.axis_name = axis_name
+        self._n_devices = n_devices
+        self._multihost = multihost
+        self.comms: Optional[Comms] = None
+        self.initialized = False
+
+    def init(self) -> "CommsSession":
+        """Bring up the communicator and inject it into a session handle on
+        every worker (reference ``Comms.init(workers)`` → ``_func_init_all``,
+        comms.py:171-218,414-459)."""
+        import jax
+        from jax.sharding import Mesh
+
+        if self._multihost:
+            jax.distributed.initialize(**self._multihost)
+        devs = jax.devices()
+        if self._n_devices is not None:
+            expects(self._n_devices <= len(devs),
+                    f"requested {self._n_devices} devices, have {len(devs)}")
+            devs = devs[: self._n_devices]
+        mesh = Mesh(np.array(devs), (self.axis_name,))
+        self.comms = build_comms(mesh, self.axis_name, self.session_id)
+        handle = Handle(mesh=mesh)
+        handle.set_comms(self.comms)  # reference handle.set_comms (handle.hpp:239)
+        st = get_comms_state(self.session_id)
+        st["handle"] = handle
+        st["comms"] = self.comms
+        st["nranks"] = len(devs)
+        self.initialized = True
+        return self
+
+    def worker_info(self) -> dict:
+        """reference ``Comms.worker_info`` (comms.py:154): rank map."""
+        expects(self.initialized, "session not initialized")
+        return {i: {"rank": i, "device": str(d)}
+                for i, d in enumerate(self.comms.mesh.devices.flat)}
+
+    def destroy(self):
+        """Tear down session state (reference ``Comms.destroy``, comms.py:220);
+        shuts down the jax.distributed control plane if this session started it."""
+        with _state_lock:
+            _session_state.pop(self.session_id, None)
+        if self._multihost and self.initialized:
+            import jax
+
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+        self.comms = None
+        self.initialized = False
+
+    def __enter__(self):
+        return self.init()
+
+    def __exit__(self, *exc):
+        self.destroy()
+        return False

@@ -1,0 +1,87 @@
+"""MNMG k-means tests — BASELINE config[4] path (distributed EM over a mesh),
+validated against the single-device implementation."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import cluster
+from raft_tpu.cluster import KMeansParams, InitMethod
+from raft_tpu.cluster import kmeans_mnmg
+from raft_tpu.comms import build_comms
+from raft_tpu.random import RngState, make_blobs
+from raft_tpu.stats import adjusted_rand_index
+
+
+@pytest.fixture(scope="module")
+def comms():
+    return build_comms()
+
+
+@pytest.fixture
+def blobs():
+    x, labels, centers = make_blobs(RngState(11), 1600, 12, n_clusters=4,
+                                    cluster_std=0.4)
+    return np.asarray(x), np.asarray(labels), np.asarray(centers)
+
+
+def test_distributed_matches_single_device(comms, blobs):
+    x, true_labels, centers = blobs
+    params = KMeansParams(n_clusters=4, init=InitMethod.Array, max_iter=50)
+    out_single = cluster.fit(params, x, centroids=centers)
+    out_dist = kmeans_mnmg.fit(params, comms, x, centroids=centers)
+    # identical init + deterministic EM → identical result up to fp reduction order
+    np.testing.assert_allclose(np.asarray(out_dist.centroids),
+                               np.asarray(out_single.centroids), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(float(out_dist.inertia), float(out_single.inertia),
+                               rtol=1e-4)
+
+
+def test_distributed_ari(comms, blobs):
+    x, true_labels, _ = blobs
+    params = KMeansParams(n_clusters=4, max_iter=100, seed=0)
+    out = kmeans_mnmg.fit(params, comms, x)
+    labels, inertia = kmeans_mnmg.predict(params, comms, x, out.centroids)
+    ari = float(adjusted_rand_index(np.asarray(labels), true_labels))
+    assert ari > 0.99, f"ARI {ari}"
+    assert float(inertia) > 0
+
+
+def test_compute_new_centroids_building_block(comms, blobs):
+    """The pylibraft compute_new_centroids equivalent: one E+M step."""
+    x, _, centers = blobs
+
+    def fn(x_shard, c):
+        new, wsum, inertia = kmeans_mnmg.compute_new_centroids(x_shard, c, comms)
+        return new, wsum, inertia
+
+    from jax.sharding import PartitionSpec as P
+    import jax
+
+    x_sharded = jax.device_put(
+        jnp.asarray(x),
+        jax.sharding.NamedSharding(comms.mesh, P(comms.axis_name, None)))
+    new, wsum, inertia = comms.run(
+        fn, x_sharded, jnp.asarray(centers),
+        in_specs=(P(comms.axis_name, None), P(None, None)),
+        out_specs=(P(None, None), P(None), P()),
+    )
+    # oracle: single-device one EM step
+    nn = cluster.min_cluster_and_distance(jnp.asarray(x), jnp.asarray(centers))
+    expected, wsum_exp = cluster.update_centroids(x, nn.key, 4,
+                                                  old_centroids=jnp.asarray(centers))
+    np.testing.assert_allclose(np.asarray(new), np.asarray(expected), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(wsum), np.asarray(wsum_exp), rtol=1e-6)
+    np.testing.assert_allclose(float(inertia), float(cluster.cluster_cost(nn)),
+                               rtol=1e-4)
+
+
+def test_uneven_shards_rejected(comms):
+    from raft_tpu.core import LogicError
+
+    x = np.random.default_rng(0).random((1001, 4)).astype(np.float32)
+    with pytest.raises(LogicError):
+        kmeans_mnmg.fit(KMeansParams(n_clusters=2), comms, x)
