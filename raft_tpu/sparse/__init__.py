@@ -1,0 +1,44 @@
+"""Sparse primitives (reference raft/sparse/ — SURVEY.md §2.10).
+
+COO/CSR fixed-capacity containers, conversions, structural ops, sparse
+linear algebra, sparse pairwise distances, sparse neighbors, and the MST /
+Lanczos solvers.
+
+TPU-first design: XLA wants static shapes, so every container is a
+fixed-capacity buffer + padding convention (reference pre-allocates outputs
+for the same reason — SURVEY.md §7 "dynamic shapes").  Padded COO entries
+carry ``row == n_rows, col == 0, val == 0``: segment reductions with
+``num_segments == n_rows`` drop them, gathers stay in-bounds, and sums are
+unaffected.  CSR keeps ``indptr[-1] == nnz`` with tail padding beyond nnz.
+"""
+
+from raft_tpu.sparse.types import COO, CSR  # noqa: F401
+from raft_tpu.sparse import convert, linalg, op  # noqa: F401
+from raft_tpu.sparse.convert import (  # noqa: F401
+    adj_to_csr,
+    coo_to_csr,
+    coo_to_dense,
+    csr_to_coo,
+    csr_to_dense,
+    dense_to_coo,
+    dense_to_csr,
+)
+from raft_tpu.sparse.op import (  # noqa: F401
+    coo_max_duplicates,
+    coo_remove_scalar,
+    coo_remove_zeros,
+    coo_sort,
+    coo_sum_duplicates,
+    csr_row_slice,
+    csr_row_op,
+)
+from raft_tpu.sparse.linalg import (  # noqa: F401
+    csr_add,
+    csr_degree,
+    csr_transpose,
+    laplacian,
+    row_normalize,
+    spmm,
+    spmv,
+    symmetrize,
+)

@@ -1,0 +1,92 @@
+"""Format conversions: coo↔csr↔dense, adjacency→csr.
+
+Counterpart of reference ``sparse/convert/`` (``coo.cuh``, ``csr.cuh``,
+``dense.cuh``, ``detail/adj_to_csr.cuh``).  All conversions are jittable
+with static capacities; the dense→sparse direction takes an explicit
+``capacity`` (the reference preallocates the output and counts first —
+here count-first is a host-side convenience, see :func:`dense_to_csr`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.sparse.types import COO, CSR
+
+
+def coo_to_csr(coo: COO) -> CSR:
+    """COO (row-sorted) → CSR.  Reference sparse/convert/csr.cuh
+    ``sorted_coo_to_csr``: the input must be sorted by row (use
+    :func:`raft_tpu.sparse.op.coo_sort` first)."""
+    n_rows = coo.shape[0]
+    live = coo.mask()
+    # Padded rows are n_rows → fall outside [0, n_rows) bincount range.
+    counts = jnp.bincount(
+        jnp.where(live, coo.rows, n_rows), length=n_rows + 1
+    )[:n_rows]
+    indptr = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                              jnp.cumsum(counts).astype(jnp.int32)])
+    indices = jnp.where(live, coo.cols, 0)
+    data = jnp.where(live, coo.vals, jnp.zeros((), coo.vals.dtype))
+    return CSR(indptr, indices, data, coo.shape)
+
+
+def csr_to_coo(csr: CSR) -> COO:
+    """CSR → COO.  Reference sparse/convert/coo.cuh ``csr_to_coo``."""
+    rows = csr.row_ids()
+    live = csr.mask()
+    return COO(jnp.where(live, rows, csr.shape[0]),
+               jnp.where(live, csr.indices, 0),
+               jnp.where(live, csr.data, jnp.zeros((), csr.data.dtype)),
+               csr.shape, nnz=csr.nnz)
+
+
+def coo_to_dense(coo: COO) -> jnp.ndarray:
+    """COO → dense.  Padding (row == n_rows) is dropped by the scatter."""
+    out = jnp.zeros(coo.shape, coo.vals.dtype)
+    return out.at[coo.rows, coo.cols].add(coo.vals, mode="drop")
+
+
+def csr_to_dense(csr: CSR) -> jnp.ndarray:
+    """CSR → dense (reference sparse/convert/dense.cuh ``csr_to_dense``)."""
+    return coo_to_dense(csr_to_coo(csr))
+
+
+def dense_to_coo(x, capacity: Optional[int] = None) -> COO:
+    """Dense → COO.  ``capacity`` defaults to m*n (fully dense worst case);
+    pass the known nnz bound to keep buffers small.  Entries are produced in
+    row-major (row-sorted) order; zeros are compacted out."""
+    x = jnp.asarray(x)
+    m, n = x.shape
+    cap = min(int(capacity), m * n) if capacity is not None else m * n
+    flat = x.ravel()
+    nonzero = flat != 0
+    # Entries past the caller's capacity are truncated (matches the
+    # reference's preallocated-output contract); nnz reports what survived.
+    nnz = jnp.minimum(jnp.sum(nonzero, dtype=jnp.int32), cap)
+    # Stable compaction: order live entries first, keeping row-major order.
+    order = jnp.argsort(~nonzero, stable=True)[:cap]
+    live = jnp.arange(cap) < nnz
+    rows = jnp.where(live, (order // n).astype(jnp.int32), m)
+    cols = jnp.where(live, (order % n).astype(jnp.int32), 0)
+    vals = jnp.where(live, flat[order], jnp.zeros((), x.dtype))
+    return COO(rows, cols, vals, (m, n), nnz=nnz)
+
+
+def dense_to_csr(x, capacity: Optional[int] = None) -> CSR:
+    """Dense → CSR (reference sparse/convert/csr.cuh ``dense_to_csr``)."""
+    return coo_to_csr(dense_to_coo(x, capacity))
+
+
+def adj_to_csr(adj, capacity: Optional[int] = None) -> CSR:
+    """Boolean adjacency matrix → CSR with unit weights.
+
+    Reference sparse/convert/detail/adj_to_csr.cuh (``adj_to_csr``).
+    """
+    adj = jnp.asarray(adj)
+    expects(adj.dtype == jnp.bool_ or jnp.issubdtype(adj.dtype, jnp.integer),
+            "adj_to_csr expects a boolean/integer adjacency matrix")
+    return dense_to_csr(adj.astype(jnp.float32), capacity)

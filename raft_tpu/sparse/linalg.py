@@ -1,0 +1,141 @@
+"""Sparse linear algebra: SpMV/SpMM, add, transpose, symmetrize, norms.
+
+Counterpart of reference ``sparse/linalg/`` (``add.cuh``, ``degree.cuh``,
+``norm.cuh``, ``symmetrize.cuh``, ``transpose.cuh``) — the cusparse calls
+become segment reductions + gathers that XLA lowers to TPU scatter/gather
+HLOs; SpMM rides a gather + segment-sum which XLA fuses (the Pallas
+alternative only pays off for very large nnz).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.sparse.types import COO, CSR
+from raft_tpu.sparse.convert import coo_to_csr, csr_to_coo
+from raft_tpu.sparse.op import _coo_combine_duplicates, coo_sort, coo_sum_duplicates
+
+
+def spmv(csr: CSR, x) -> jnp.ndarray:
+    """y = A @ x for CSR A, dense x (n_cols,).
+
+    The reference uses cusparse SpMV (sparse/detail/cusparse_wrappers.h);
+    here: gather x at column indices, multiply, segment-sum by row.  Padding
+    rows (id n_rows) are dropped by ``num_segments``.
+    """
+    x = jnp.asarray(x)
+    expects(x.shape[0] == csr.shape[1], "spmv: dimension mismatch")
+    prod = csr.data * x[csr.indices]
+    return jax.ops.segment_sum(prod, csr.row_ids(), num_segments=csr.shape[0])
+
+
+def spmm(csr: CSR, b) -> jnp.ndarray:
+    """C = A @ B for CSR A (m×k), dense B (k×n)."""
+    b = jnp.asarray(b)
+    expects(b.shape[0] == csr.shape[1], "spmm: dimension mismatch")
+    prod = csr.data[:, None] * b[csr.indices, :]
+    return jax.ops.segment_sum(prod, csr.row_ids(), num_segments=csr.shape[0])
+
+
+def csr_degree(csr: CSR) -> jnp.ndarray:
+    """Number of live entries per row (reference sparse/linalg/degree.cuh
+    ``coo_degree``)."""
+    return jnp.diff(csr.indptr)
+
+
+def coo_degree(coo: COO) -> jnp.ndarray:
+    ids = jnp.where(coo.mask(), coo.rows, coo.shape[0])
+    return jnp.bincount(ids, length=coo.shape[0] + 1)[:coo.shape[0]]
+
+
+def row_normalize(csr: CSR, norm: str = "l1") -> CSR:
+    """Normalize each row by its L1 norm or max (reference
+    sparse/linalg/norm.cuh ``csr_row_normalize_l1`` / ``_max``)."""
+    rows = csr.row_ids()
+    if norm == "l1":
+        denom = jax.ops.segment_sum(jnp.abs(csr.data), rows,
+                                    num_segments=csr.shape[0])
+    elif norm == "max":
+        denom = jax.ops.segment_max(csr.data, rows,
+                                    num_segments=csr.shape[0])
+    else:
+        raise ValueError(f"unknown norm {norm!r}")
+    denom = jnp.where(denom != 0, denom, 1)
+    safe_rows = jnp.clip(rows, 0, csr.shape[0] - 1)
+    data = csr.data / denom[safe_rows]
+    data = jnp.where(csr.mask(), data, jnp.zeros((), data.dtype))
+    return CSR(csr.indptr, csr.indices, data, csr.shape)
+
+
+def csr_transpose(csr: CSR) -> CSR:
+    """Aᵀ (reference sparse/linalg/transpose.h, cusparse csr2csc)."""
+    coo = csr_to_coo(csr)
+    live = coo.mask()
+    t = COO(jnp.where(live, coo.cols, csr.shape[1]),
+            jnp.where(live, coo.rows, 0),
+            coo.vals, (csr.shape[1], csr.shape[0]), nnz=coo.nnz)
+    return coo_to_csr(coo_sort(t))
+
+
+def csr_add(a: CSR, b: CSR) -> CSR:
+    """A + B with duplicate coalescing (reference sparse/linalg/add.cuh
+    ``csr_add_calc_inds``/``csr_add_finalize``).  Output capacity is
+    ``a.capacity + b.capacity`` (the exact union size is data-dependent)."""
+    expects(a.shape == b.shape, "csr_add: shape mismatch")
+    ca, cb = csr_to_coo(a), csr_to_coo(b)
+    merged = COO(jnp.concatenate([ca.rows, cb.rows]),
+                 jnp.concatenate([ca.cols, cb.cols]),
+                 jnp.concatenate([ca.vals, jnp.asarray(cb.vals, ca.vals.dtype)]),
+                 a.shape, nnz=ca.nnz + cb.nnz)
+    return coo_to_csr(coo_sum_duplicates(merged))
+
+
+def symmetrize(coo_or_csr, combine: str = "sum"):
+    """A ← A + Aᵀ handling duplicates (reference sparse/linalg/symmetrize.cuh
+    ``coo_symmetrize`` builds the union with a custom reduction; kNN-graph
+    symmetrization uses max semantics).  Returns the same container kind."""
+    is_csr = isinstance(coo_or_csr, CSR)
+    coo = csr_to_coo(coo_or_csr) if is_csr else coo_or_csr
+    expects(coo.shape[0] == coo.shape[1], "symmetrize: matrix must be square")
+    live = coo.mask()
+    n = coo.shape[0]
+    both = COO(jnp.concatenate([coo.rows, jnp.where(live, coo.cols, n)]),
+               jnp.concatenate([coo.cols, jnp.where(live, coo.rows, 0)]),
+               jnp.concatenate([coo.vals,
+                                jnp.where(live, coo.vals,
+                                          jnp.zeros((), coo.vals.dtype))]),
+               coo.shape, nnz=2 * coo.nnz)
+    out = _coo_combine_duplicates(both, combine)
+    return coo_to_csr(out) if is_csr else out
+
+
+def laplacian(adj: CSR, normalized: bool = False) -> CSR:
+    """Graph Laplacian L = D − A (or I − D^-1/2 A D^-1/2).
+
+    Reference spectral/matrix_wrappers.hpp ``laplacian_matrix_t`` represents
+    L implicitly (SpMV = D·x − A·x); this materializes it for reuse by the
+    Lanczos solver, with capacity nnz + n for the diagonal.
+    """
+    n = adj.shape[0]
+    expects(adj.shape[0] == adj.shape[1], "laplacian: matrix must be square")
+    deg = jax.ops.segment_sum(adj.data, adj.row_ids(), num_segments=n)
+    ca = csr_to_coo(adj)
+    live = ca.mask()
+    if normalized:
+        inv_sqrt = jnp.where(deg > 0, 1.0 / jnp.sqrt(jnp.maximum(deg, 1e-30)), 0.0)
+        safe_r = jnp.clip(ca.rows, 0, n - 1)
+        safe_c = jnp.clip(ca.cols, 0, n - 1)
+        off = jnp.where(live, -ca.vals * inv_sqrt[safe_r] * inv_sqrt[safe_c],
+                        jnp.zeros((), ca.vals.dtype))
+        diag = jnp.where(deg > 0, 1.0, 0.0).astype(ca.vals.dtype)
+    else:
+        off = jnp.where(live, -ca.vals, jnp.zeros((), ca.vals.dtype))
+        diag = deg.astype(ca.vals.dtype)
+    merged = COO(
+        jnp.concatenate([jnp.where(live, ca.rows, n), jnp.arange(n, dtype=jnp.int32)]),
+        jnp.concatenate([jnp.where(live, ca.cols, 0), jnp.arange(n, dtype=jnp.int32)]),
+        jnp.concatenate([off, diag]),
+        adj.shape, nnz=ca.nnz + n)
+    return coo_to_csr(coo_sum_duplicates(merged))

@@ -1,0 +1,124 @@
+"""Structural sparse ops: sort, filter, dedupe, slice, row op.
+
+Counterpart of reference ``sparse/op/`` (``sort.h``, ``filter.hpp``,
+``reduce.cuh``, ``slice.hpp``, ``row_op.cuh``).  Everything is jittable:
+filters compact in place within the fixed capacity and update ``nnz``
+instead of shrinking buffers (the reference similarly pre-counts and
+allocates, SURVEY.md §7 "dynamic shapes").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.sparse.types import COO, CSR
+
+
+def _compact(coo: COO, keep) -> COO:
+    """Stable-compact entries where ``keep`` holds; repad the tail."""
+    keep = keep & coo.mask()
+    nnz = jnp.sum(keep, dtype=jnp.int32)
+    order = jnp.argsort(~keep, stable=True)
+    live = jnp.arange(coo.capacity) < nnz
+    return COO(jnp.where(live, coo.rows[order], coo.shape[0]),
+               jnp.where(live, coo.cols[order], 0),
+               jnp.where(live, coo.vals[order], jnp.zeros((), coo.vals.dtype)),
+               coo.shape, nnz=nnz)
+
+
+def coo_sort(coo: COO) -> COO:
+    """Sort entries by (row, col).  Reference sparse/op/sort.h ``coo_sort``.
+    Padding (row == n_rows) sorts to the tail automatically.
+
+    Two-pass stable sort (cols then rows) instead of a fused int64 key —
+    TPUs compute in int32 and a fused key overflows past 2³¹ entries.
+    """
+    order = jnp.argsort(coo.cols, stable=True)
+    order = order[jnp.argsort(coo.rows[order], stable=True)]
+    return COO(coo.rows[order], coo.cols[order], coo.vals[order],
+               coo.shape, nnz=coo.nnz)
+
+
+def coo_remove_scalar(coo: COO, scalar) -> COO:
+    """Drop entries equal to *scalar* (reference sparse/op/filter.hpp
+    ``coo_remove_scalar``)."""
+    return _compact(coo, coo.vals != scalar)
+
+
+def coo_remove_zeros(coo: COO) -> COO:
+    """Drop explicit zeros (reference ``coo_remove_zeros``)."""
+    return coo_remove_scalar(coo, 0)
+
+
+def coo_sum_duplicates(coo: COO) -> COO:
+    """Sum duplicate (row, col) entries; output is sorted by (row, col).
+
+    Reference sparse/op/reduce.cuh ``max_duplicates``-family dedupe (the
+    reference keeps max; RAFT's symmetrize uses sum semantics — both are
+    exposed, see *combine*).
+    """
+    return _coo_combine_duplicates(coo, "sum")
+
+
+def coo_max_duplicates(coo: COO) -> COO:
+    """Keep the max over duplicate coordinates (reference
+    sparse/op/reduce.cuh ``max_duplicates``)."""
+    return _coo_combine_duplicates(coo, "max")
+
+
+def _coo_combine_duplicates(coo: COO, combine: str) -> COO:
+    s = coo_sort(coo)
+    live = s.mask()
+    is_new = jnp.concatenate([jnp.ones((1,), bool),
+                              (s.rows[1:] != s.rows[:-1])
+                              | (s.cols[1:] != s.cols[:-1])]) & live
+    group = jnp.cumsum(is_new) - 1  # group id per entry; padding → last group
+    group = jnp.where(live, group, s.capacity)
+    n_groups = jnp.sum(is_new, dtype=jnp.int32)
+    if combine == "sum":
+        vals = jax.ops.segment_sum(s.vals, group, num_segments=s.capacity)
+    elif combine == "max":
+        # segment_max's -inf fill in empty tail slots is cleared by the
+        # out_live mask at the return site.
+        vals = jax.ops.segment_max(s.vals, group, num_segments=s.capacity)
+    else:  # pragma: no cover
+        raise ValueError(combine)
+    # First-occurrence coordinates per group (all duplicates share them).
+    rows = jnp.full((s.capacity,), s.shape[0], jnp.int32).at[group].min(
+        s.rows, mode="drop")
+    cols = jax.ops.segment_min(s.cols, group, num_segments=s.capacity)
+    out_live = jnp.arange(s.capacity) < n_groups
+    return COO(jnp.where(out_live, rows, s.shape[0]),
+               jnp.where(out_live, cols, 0),
+               jnp.where(out_live, vals, jnp.zeros((), s.vals.dtype)),
+               s.shape, nnz=n_groups)
+
+
+def csr_row_slice(csr: CSR, start: int, stop: int) -> CSR:
+    """Extract rows [start, stop) as a new CSR (reference
+    sparse/op/slice.hpp ``csr_row_slice_indptr``/``_populate``).
+
+    *start*/*stop* must be static Python ints (the output row count is a
+    shape).  Capacity is preserved; entries are shifted to the front.
+    """
+    start, stop = int(start), int(stop)
+    lo, hi = csr.indptr[start], csr.indptr[stop]
+    nnz = hi - lo
+    idx = jnp.arange(csr.capacity)
+    src = jnp.clip(idx + lo, 0, csr.capacity - 1)
+    live = idx < nnz
+    indptr = jnp.clip(csr.indptr[start:stop + 1] - lo, 0, nnz)
+    return CSR(indptr,
+               jnp.where(live, csr.indices[src], 0),
+               jnp.where(live, csr.data[src], jnp.zeros((), csr.data.dtype)),
+               (stop - start, csr.shape[1]))
+
+
+def csr_row_op(csr: CSR, fn) -> CSR:
+    """Apply ``fn(row_id, values) -> values`` elementwise with the row id
+    available (reference sparse/op/row_op.cuh ``csr_row_op`` hands each row's
+    extent to a device lambda)."""
+    new = fn(csr.row_ids(), csr.data)
+    new = jnp.where(csr.mask(), new, jnp.zeros((), new.dtype))
+    return CSR(csr.indptr, csr.indices, new, csr.shape)

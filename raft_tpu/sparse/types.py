@@ -1,0 +1,120 @@
+"""Sparse containers: fixed-capacity COO and CSR.
+
+Counterpart of reference ``sparse/coo.hpp`` (``COO`` with preallocated
+device buffers + ``setSize``) and ``sparse/csr.hpp``.  Registered as JAX
+pytrees so they flow through ``jit``/``vmap``/``shard_map``; the matrix
+shape is static aux data, the buffers are leaves.
+
+Padding convention (module doc of :mod:`raft_tpu.sparse`): entries at
+positions ``>= nnz`` hold ``row == n_rows, col == 0, val == 0``.  ``nnz``
+is carried as a traced scalar so structural ops (filter, dedupe) stay
+jittable; capacity (buffer length) is static.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+@jax.tree_util.register_pytree_node_class
+class COO:
+    """Coordinate-format sparse matrix with fixed capacity.
+
+    Attributes:
+      rows, cols: int32 (capacity,) coordinate buffers.
+      vals: (capacity,) values.
+      nnz: traced int32 scalar — number of live entries (<= capacity).
+      shape: static (n_rows, n_cols).
+    """
+
+    def __init__(self, rows, cols, vals, shape: Tuple[int, int], nnz=None):
+        self.rows = jnp.asarray(rows, jnp.int32)
+        self.cols = jnp.asarray(cols, jnp.int32)
+        self.vals = jnp.asarray(vals)
+        self.shape = (int(shape[0]), int(shape[1]))
+        self.nnz = jnp.asarray(self.rows.shape[0] if nnz is None else nnz, jnp.int32)
+
+    @property
+    def capacity(self) -> int:
+        return self.rows.shape[0]
+
+    @property
+    def dtype(self):
+        return self.vals.dtype
+
+    def mask(self):
+        """Boolean (capacity,) mask of live entries."""
+        return jnp.arange(self.capacity) < self.nnz
+
+    def tree_flatten(self):
+        return (self.rows, self.cols, self.vals, self.nnz), self.shape
+
+    @classmethod
+    def tree_unflatten(cls, shape, leaves):
+        rows, cols, vals, nnz = leaves
+        obj = object.__new__(cls)
+        obj.rows, obj.cols, obj.vals, obj.nnz = rows, cols, vals, nnz
+        obj.shape = shape
+        return obj
+
+    def __repr__(self):
+        return (f"COO(shape={self.shape}, capacity={self.capacity}, "
+                f"dtype={self.vals.dtype})")
+
+
+@jax.tree_util.register_pytree_node_class
+class CSR:
+    """Compressed-sparse-row matrix with fixed capacity.
+
+    ``indptr`` is (n_rows+1,) with ``indptr[-1] == nnz``; ``indices``/
+    ``data`` have static length ``capacity >= nnz`` with zero tail padding.
+    """
+
+    def __init__(self, indptr, indices, data, shape: Tuple[int, int]):
+        self.indptr = jnp.asarray(indptr, jnp.int32)
+        self.indices = jnp.asarray(indices, jnp.int32)
+        self.data = jnp.asarray(data)
+        self.shape = (int(shape[0]), int(shape[1]))
+        expects(self.indptr.shape[0] == self.shape[0] + 1,
+                "CSR indptr must have n_rows+1 entries")
+
+    @property
+    def capacity(self) -> int:
+        return self.indices.shape[0]
+
+    @property
+    def nnz(self):
+        return self.indptr[-1]
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def row_ids(self):
+        """int32 (capacity,) row index per entry; padding maps to n_rows
+        (dropped by segment ops with num_segments == n_rows)."""
+        return jnp.searchsorted(
+            self.indptr, jnp.arange(self.capacity, dtype=jnp.int32), side="right"
+        ).astype(jnp.int32) - 1
+
+    def mask(self):
+        return jnp.arange(self.capacity) < self.nnz
+
+    def tree_flatten(self):
+        return (self.indptr, self.indices, self.data), self.shape
+
+    @classmethod
+    def tree_unflatten(cls, shape, leaves):
+        obj = object.__new__(cls)
+        obj.indptr, obj.indices, obj.data = leaves
+        obj.shape = shape
+        return obj
+
+    def __repr__(self):
+        return (f"CSR(shape={self.shape}, capacity={self.capacity}, "
+                f"dtype={self.data.dtype})")

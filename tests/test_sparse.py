@@ -1,0 +1,169 @@
+"""Sparse containers/convert/op/linalg vs scipy.sparse oracles
+(reference test strategy SURVEY.md §4: naive-oracle comparisons)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from raft_tpu.sparse import (
+    COO,
+    CSR,
+    adj_to_csr,
+    coo_remove_zeros,
+    coo_sort,
+    coo_sum_duplicates,
+    coo_to_csr,
+    coo_to_dense,
+    csr_add,
+    csr_degree,
+    csr_row_slice,
+    csr_to_coo,
+    csr_to_dense,
+    csr_transpose,
+    dense_to_coo,
+    dense_to_csr,
+    laplacian,
+    row_normalize,
+    spmm,
+    spmv,
+    symmetrize,
+)
+
+
+def random_csr(m, n, density=0.3, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    s = sp.random(m, n, density=density, random_state=rng, format="csr",
+                  dtype=dtype)
+    return s
+
+
+def to_raft(s: sp.csr_matrix, extra_capacity=0) -> CSR:
+    pad = extra_capacity
+    indices = np.concatenate([s.indices, np.zeros(pad, np.int32)])
+    data = np.concatenate([s.data, np.zeros(pad, s.data.dtype)])
+    return CSR(s.indptr, indices, data, s.shape)
+
+
+@pytest.mark.parametrize("m,n", [(7, 5), (16, 16), (33, 9)])
+@pytest.mark.parametrize("pad", [0, 13])
+def test_roundtrip_dense_csr(m, n, pad):
+    s = random_csr(m, n, seed=m * n)
+    csr = to_raft(s, pad)
+    np.testing.assert_allclose(csr_to_dense(csr), s.toarray(), rtol=1e-6)
+    # dense → csr → dense
+    back = dense_to_csr(s.toarray())
+    np.testing.assert_allclose(csr_to_dense(back), s.toarray(), rtol=1e-6)
+    assert int(back.nnz) == s.nnz
+
+
+def test_coo_roundtrip_and_sort():
+    s = random_csr(10, 8, seed=3)
+    coo = csr_to_coo(to_raft(s, 5))
+    np.testing.assert_allclose(coo_to_dense(coo), s.toarray(), rtol=1e-6)
+    # scramble then sort
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(coo.capacity)
+    scrambled = COO(np.array(coo.rows)[perm], np.array(coo.cols)[perm],
+                    np.array(coo.vals)[perm], coo.shape, nnz=coo.nnz)
+    srt = coo_sort(scrambled)
+    np.testing.assert_allclose(coo_to_dense(srt), s.toarray(), rtol=1e-6)
+    rows = np.array(srt.rows)[: s.nnz]
+    assert (np.diff(rows) >= 0).all()
+
+
+def test_coo_remove_zeros():
+    rows = np.array([0, 0, 1, 2, 3], np.int32)
+    cols = np.array([1, 2, 0, 2, 3], np.int32)
+    vals = np.array([1.0, 0.0, 2.0, 0.0, 3.0], np.float32)
+    coo = COO(rows, cols, vals, (4, 4))
+    out = coo_remove_zeros(coo)
+    assert int(out.nnz) == 3
+    dense = np.zeros((4, 4), np.float32)
+    dense[0, 1], dense[1, 0], dense[3, 3] = 1, 2, 3
+    np.testing.assert_allclose(coo_to_dense(out), dense)
+
+
+def test_coo_sum_duplicates():
+    rows = np.array([2, 0, 0, 2, 1], np.int32)
+    cols = np.array([1, 3, 3, 1, 0], np.int32)
+    vals = np.array([1.0, 2.0, 5.0, 4.0, 3.0], np.float32)
+    coo = COO(rows, cols, vals, (3, 4))
+    out = coo_sum_duplicates(coo)
+    assert int(out.nnz) == 3
+    dense = np.zeros((3, 4), np.float32)
+    dense[2, 1], dense[0, 3], dense[1, 0] = 5, 7, 3
+    np.testing.assert_allclose(coo_to_dense(out), dense)
+
+
+@pytest.mark.parametrize("m,n,k", [(9, 7, 4), (16, 16, 16)])
+def test_spmv_spmm(m, n, k):
+    s = random_csr(m, n, seed=5)
+    csr = to_raft(s, 7)
+    rng = np.random.default_rng(1)
+    x = rng.random(n).astype(np.float32)
+    b = rng.random((n, k)).astype(np.float32)
+    np.testing.assert_allclose(spmv(csr, x), s @ x, rtol=2e-5)
+    np.testing.assert_allclose(spmm(csr, b), s @ b, rtol=2e-5)
+
+
+def test_degree_and_row_normalize():
+    s = random_csr(12, 6, seed=7)
+    csr = to_raft(s, 3)
+    deg = np.diff(s.indptr)
+    np.testing.assert_array_equal(csr_degree(csr), deg)
+    rn = row_normalize(csr, "l1")
+    dense = csr_to_dense(rn)
+    expected = s.toarray()
+    sums = np.abs(expected).sum(1, keepdims=True)
+    sums[sums == 0] = 1
+    np.testing.assert_allclose(dense, expected / sums, rtol=1e-5)
+
+
+def test_transpose_add():
+    a = random_csr(8, 11, seed=11)
+    b = random_csr(8, 11, seed=13)
+    np.testing.assert_allclose(
+        csr_to_dense(csr_transpose(to_raft(a, 4))), a.toarray().T, rtol=1e-6)
+    out = csr_add(to_raft(a), to_raft(b))
+    np.testing.assert_allclose(csr_to_dense(out), (a + b).toarray(), rtol=1e-5)
+
+
+def test_symmetrize():
+    a = random_csr(9, 9, seed=17)
+    out = symmetrize(to_raft(a, 6))
+    np.testing.assert_allclose(csr_to_dense(out), (a + a.T).toarray(),
+                               rtol=1e-5)
+
+
+@pytest.mark.parametrize("normalized", [False, True])
+def test_laplacian(normalized):
+    rng = np.random.default_rng(23)
+    n = 10
+    dense = (rng.random((n, n)) < 0.3).astype(np.float32)
+    dense = np.maximum(dense, dense.T)
+    np.fill_diagonal(dense, 0)
+    s = sp.csr_matrix(dense)
+    lap = laplacian(to_raft(s, 8), normalized=normalized)
+    deg = dense.sum(1)
+    if normalized:
+        with np.errstate(divide="ignore"):
+            isq = np.where(deg > 0, 1 / np.sqrt(deg), 0)
+        expected = np.where(deg > 0, 1.0, 0.0) * np.eye(n) - isq[:, None] * dense * isq[None, :]
+    else:
+        expected = np.diag(deg) - dense
+    np.testing.assert_allclose(csr_to_dense(lap), expected, atol=1e-5)
+
+
+def test_csr_row_slice():
+    s = random_csr(12, 7, seed=29)
+    out = csr_row_slice(to_raft(s, 9), 3, 9)
+    np.testing.assert_allclose(csr_to_dense(out), s.toarray()[3:9], rtol=1e-6)
+
+
+def test_adj_to_csr():
+    rng = np.random.default_rng(31)
+    adj = rng.random((6, 9)) < 0.4
+    out = adj_to_csr(adj)
+    np.testing.assert_allclose(csr_to_dense(out), adj.astype(np.float32))
