@@ -14,6 +14,7 @@ unaffected.  CSR keeps ``indptr[-1] == nnz`` with tail padding beyond nnz.
 
 from raft_tpu.sparse.types import COO, CSR  # noqa: F401
 from raft_tpu.sparse import convert, linalg, op  # noqa: F401
+from raft_tpu.sparse import distance, neighbors  # noqa: F401
 from raft_tpu.sparse.convert import (  # noqa: F401
     adj_to_csr,
     coo_to_csr,
@@ -33,12 +34,21 @@ from raft_tpu.sparse.op import (  # noqa: F401
     csr_row_op,
 )
 from raft_tpu.sparse.linalg import (  # noqa: F401
+    coo_degree,
     csr_add,
     csr_degree,
     csr_transpose,
+    fit_embedding,
     laplacian,
     row_normalize,
     spmm,
     spmv,
     symmetrize,
+    weak_cc,
+)
+from raft_tpu.sparse.solver import (  # noqa: F401
+    MSTResult,
+    boruvka_mst,
+    lanczos_largest,
+    lanczos_smallest,
 )

@@ -111,6 +111,64 @@ def symmetrize(coo_or_csr, combine: str = "sum"):
     return coo_to_csr(out) if is_csr else out
 
 
+def weak_cc(g: CSR) -> jnp.ndarray:
+    """Weakly-connected component labels via min-label propagation.
+
+    Counterpart of reference ``sparse/csr.hpp`` ``weak_cc`` (per-vertex
+    frontier kernel); here a ``lax.while_loop`` of whole-graph segment-min
+    passes — each pass halves label diameter via a pointer-jumping step, so
+    convergence is fast in practice.  Labels are the minimum vertex id
+    reachable; relabel with :mod:`raft_tpu.label` if compaction is needed.
+    """
+    n = g.shape[0]
+    expects(g.shape[0] == g.shape[1], "weak_cc: graph must be square")
+    rows = g.row_ids()
+    rows_safe = jnp.clip(rows, 0, n - 1)
+    cols_safe = jnp.clip(g.indices, 0, n - 1)
+
+    def cond(state):
+        return state[1]
+
+    def body(state):
+        color, _ = state
+        # Weak connectivity ignores direction: propagate the min label both
+        # ways along every edge...
+        pulled = jax.ops.segment_min(
+            jnp.where(g.mask(), color[cols_safe], n), rows, num_segments=n)
+        pushed = jax.ops.segment_min(
+            jnp.where(g.mask(), color[rows_safe], n),
+            jnp.where(g.mask(), g.indices, n), num_segments=n)
+        new = jnp.minimum(color, jnp.minimum(pulled, pushed))
+        # ...then pointer-jump through the current labels.
+        new = new[jnp.clip(new, 0, n - 1)]
+        return (new, jnp.any(new != color))
+
+    color, _ = jax.lax.while_loop(
+        cond, body, (jnp.arange(n, dtype=jnp.int32), jnp.asarray(True)))
+    return color
+
+
+def fit_embedding(adj: CSR, n_components: int, *, seed: int = 0,
+                  tol: float = 1e-6) -> jnp.ndarray:
+    """Spectral embedding of a graph: smallest non-trivial Laplacian
+    eigenvectors, row-scaled.
+
+    Counterpart of reference ``sparse/linalg/detail/spectral.cuh:34-80``
+    (``fit_embedding``): Laplacian + Lanczos smallest n_components+1 +
+    scaling, dropping the trivial constant eigenvector.
+    Returns (n, n_components).
+    """
+    from raft_tpu.sparse.solver import lanczos_smallest
+
+    lap = laplacian(adj)
+    _, vecs = lanczos_smallest(lap, n_components + 1, seed=seed, tol=tol)
+    emb = vecs[:, 1:]
+    # Scale each component to unit std (the reference scales the embedding
+    # before handing it to k-means).
+    std = jnp.maximum(jnp.std(emb, axis=0), 1e-12)
+    return emb / std
+
+
 def laplacian(adj: CSR, normalized: bool = False) -> CSR:
     """Graph Laplacian L = D − A (or I − D^-1/2 A D^-1/2).
 

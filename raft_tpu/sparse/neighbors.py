@@ -1,0 +1,188 @@
+"""Sparse neighbors: batched sparse brute-force kNN, kNN-graph builder,
+connect_components MST fix-up.
+
+Counterpart of reference ``sparse/neighbors/`` — ``detail/knn.cuh``
+(batched sparse bf-kNN), ``knn_graph.cuh`` (dense input → COO kNN graph),
+``detail/connect_components.cuh`` (cross-component 1-NN used to turn a
+spanning forest into a spanning tree).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.distance import DistanceType, pairwise_distance as dense_pairwise
+from raft_tpu.matrix import select_k
+from raft_tpu.sparse.distance import pairwise_distance as sparse_pairwise
+from raft_tpu.sparse.types import COO, CSR
+from raft_tpu.sparse.op import csr_row_slice
+from raft_tpu.sparse.solver import boruvka_mst
+from raft_tpu.sparse.solver.mst import sorted_mst_edges
+
+
+def brute_force_knn(index: CSR, query: CSR, k: int,
+                    metric: DistanceType = DistanceType.L2Expanded,
+                    batch_size_index: int = 16384,
+                    batch_size_query: int = 4096
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched sparse brute-force kNN (reference
+    sparse/neighbors/detail/knn.cuh ``brute_force_knn``): tiles over both
+    index and query, merging per-tile top-k like ``knn_merge_parts``.
+
+    Returns (distances [nq, k], indices [nq, k]).
+    """
+    nq, ni = query.shape[0], index.shape[0]
+    expects(1 <= k <= ni, "brute_force_knn: need 1 <= k <= n_index")
+    bq = min(batch_size_query, nq)
+    bi = min(batch_size_index, ni)
+
+    out_d, out_i = [], []
+    for q0 in range(0, nq, bq):
+        q1 = min(q0 + bq, nq)
+        qs = csr_row_slice(query, q0, q1)
+        best_d = best_i = None
+        for i0 in range(0, ni, bi):
+            i1 = min(i0 + bi, ni)
+            d = sparse_pairwise(qs, csr_row_slice(index, i0, i1), metric)
+            kk = min(k, i1 - i0)
+            vals, idx = select_k(d, kk, select_min=True)
+            idx = idx + i0
+            if best_d is None:
+                best_d, best_i = vals, idx
+            else:
+                # merge parts: top-k of the union of running + new candidates
+                cat_d = jnp.concatenate([best_d, vals], axis=1)
+                cat_i = jnp.concatenate([best_i, idx], axis=1)
+                best_d, best_i = select_k(cat_d, min(k, cat_d.shape[1]),
+                                          select_min=True, indices=cat_i)
+        # pad if fewer than k candidates total (ni < k handled by expects)
+        out_d.append(best_d)
+        out_i.append(best_i)
+    return (out_d[0] if len(out_d) == 1 else jnp.concatenate(out_d, axis=0),
+            out_i[0] if len(out_i) == 1 else jnp.concatenate(out_i, axis=0))
+
+
+def build_k(n_samples: int, c: int) -> int:
+    """k heuristic for kNN-graph connectivity (reference
+    sparse/neighbors/detail/knn_graph.cuh:56, from "kNN-MST-Agglomerative"):
+    min(n, max(2, ⌊log2 n⌋ + c))."""
+    return int(min(n_samples, max(2, math.floor(math.log2(max(n_samples, 2))) + c)))
+
+
+def knn_graph(x, metric: DistanceType = DistanceType.L2SqrtExpanded,
+              c: int = 15, k: Optional[int] = None,
+              batch_size: int = 4096) -> COO:
+    """Directed kNN graph of dense points as COO (reference
+    sparse/neighbors/knn_graph.cuh:— dense input, sparse output).
+
+    Self-edges are excluded; edge (i, j) carries the metric distance.
+    """
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    kk = int(k) if k is not None else build_k(n, c)
+    kk = min(kk, n - 1)
+    rows_list, cols_list, vals_list = [], [], []
+    for i0 in range(0, n, batch_size):
+        i1 = min(i0 + batch_size, n)
+        d = dense_pairwise(x[i0:i1], x, metric)
+        # exclude self by +inf on the diagonal entries of this block
+        r = jnp.arange(i0, i1)
+        d = d.at[jnp.arange(i1 - i0), r].set(jnp.inf)
+        vals, idx = select_k(d, kk, select_min=True)
+        rows_list.append(jnp.repeat(r, kk).astype(jnp.int32))
+        cols_list.append(idx.reshape(-1).astype(jnp.int32))
+        vals_list.append(vals.reshape(-1))
+    return COO(jnp.concatenate(rows_list), jnp.concatenate(cols_list),
+               jnp.concatenate(vals_list), (n, n))
+
+
+def connect_components(x, colors,
+                       metric: DistanceType = DistanceType.L2SqrtExpanded,
+                       batch_size: int = 4096) -> COO:
+    """Cross-component nearest-neighbor edges (reference
+    sparse/neighbors/detail/connect_components.cuh): for each point the
+    nearest point in a *different* component, reduced to the minimum edge
+    per (component) color pair endpoint, symmetrized.
+
+    Returns a COO edge set (n, n) with one edge per source color minimum —
+    enough to strictly reduce the number of components when merged with a
+    spanning forest (``min_components_by_color`` in the reference).
+    """
+    x = jnp.asarray(x)
+    colors = jnp.asarray(colors, jnp.int32)
+    n = x.shape[0]
+    nn_dist_list, nn_idx_list = [], []
+    for i0 in range(0, n, batch_size):
+        i1 = min(i0 + batch_size, n)
+        d = dense_pairwise(x[i0:i1], x, metric)
+        same = colors[i0:i1, None] == colors[None, :]
+        d = jnp.where(same, jnp.inf, d)
+        nn_idx_list.append(jnp.argmin(d, axis=1).astype(jnp.int32))
+        nn_dist_list.append(jnp.min(d, axis=1))
+    nn_idx = jnp.concatenate(nn_idx_list)
+    nn_dist = jnp.concatenate(nn_dist_list)
+
+    # Per-color minimum outgoing edge (min_components_by_color): the point
+    # with the smallest cross-component distance within each color.
+    best_dist = jax.ops.segment_min(nn_dist, colors, num_segments=n)
+    is_best = (nn_dist == best_dist[jnp.clip(colors, 0, n - 1)]) & jnp.isfinite(nn_dist)
+    # deterministic pick: smallest point index among equals per color
+    cand = jnp.where(is_best, jnp.arange(n, dtype=jnp.int32), n)
+    best_pt = jax.ops.segment_min(cand, colors, num_segments=n)
+    has = best_pt < n
+    src = jnp.where(has, best_pt, n).astype(jnp.int32)
+    src_safe = jnp.clip(src, 0, n - 1)
+    dst = jnp.where(has, nn_idx[src_safe], 0).astype(jnp.int32)
+    w = jnp.where(has, nn_dist[src_safe], 0.0)
+    # symmetrize: emit both directions
+    rows = jnp.concatenate([src, jnp.where(has, dst, n)])
+    cols = jnp.concatenate([dst, jnp.where(has, src_safe, 0).astype(jnp.int32)])
+    vals = jnp.concatenate([w, w])
+    return COO(rows, cols, vals, (n, n), nnz=2 * jnp.sum(has, dtype=jnp.int32))
+
+
+def mst_from_knn_graph(x, metric: DistanceType = DistanceType.L2SqrtExpanded,
+                       c: int = 15, max_fixup_iter: int = 32):
+    """Sorted MST edges of the kNN-graph connectivity (reference
+    cluster/detail/connectivities.cuh + detail/mst.cuh ``build_sorted_mst``
+    with ``connect_components`` fix-up for disconnected kNN graphs).
+
+    Returns (src, dst, weight) sorted ascending by weight with exactly
+    n−1 live edges.
+    """
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    knn = knn_graph(x, metric, c)
+    # symmetrize by emitting reverse edges (duplicates are harmless for MST)
+    live = knn.mask()
+    g = COO(jnp.concatenate([knn.rows, jnp.where(live, knn.cols, n)]),
+            jnp.concatenate([knn.cols, jnp.where(live, knn.rows, 0)]),
+            jnp.concatenate([knn.vals, knn.vals]), (n, n), nnz=2 * knn.nnz)
+    res = boruvka_mst(g)
+    for _ in range(max_fixup_iter):
+        n_comp = len(jnp.unique(jax.device_get(res.color)))
+        if n_comp == 1:
+            break
+        fix = connect_components(x, res.color, metric)
+        # merge forest edges + fix-up edges and re-run Borůvka (reference
+        # merges MST(msf) with MST(cross edges); rerunning on the union is
+        # the same tree by cut optimality)
+        fsrc, fdst, fw = res.src, res.dst, res.weight
+        flive = jnp.arange(fsrc.shape[0]) < res.n_edges
+        rows = jnp.concatenate([jnp.where(flive, fsrc, n),
+                                jnp.where(flive, fdst, n), fix.rows])
+        cols = jnp.concatenate([jnp.where(flive, fdst, 0),
+                                jnp.where(flive, fsrc, 0), fix.cols])
+        vals = jnp.concatenate([jnp.where(flive, fw, 0.0),
+                                jnp.where(flive, fw, 0.0), fix.vals])
+        g = COO(rows, cols, vals, (n, n),
+                nnz=2 * res.n_edges + fix.nnz)
+        res = boruvka_mst(g)
+    expects(int(res.n_edges) == n - 1,
+            "mst_from_knn_graph: could not connect the kNN graph")
+    return sorted_mst_edges(res)

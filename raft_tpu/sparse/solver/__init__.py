@@ -1,0 +1,8 @@
+"""Sparse solvers: restarted Lanczos eigensolver + Borůvka MST
+(reference raft/sparse/solver/ — SURVEY.md §2.10)."""
+
+from raft_tpu.sparse.solver.lanczos import (  # noqa: F401
+    lanczos_largest,
+    lanczos_smallest,
+)
+from raft_tpu.sparse.solver.mst import MSTResult, boruvka_mst  # noqa: F401

@@ -1,0 +1,145 @@
+"""Minimum spanning tree/forest — parallel Borůvka.
+
+Counterpart of reference ``sparse/solver/mst_solver.cuh:40`` (``MST_solver``,
+kernels ``solver/detail/mst_kernels.cuh``, alterated-weight tie-breaking
+``mst_utils.cuh``).
+
+TPU-first redesign: the reference's per-vertex CUDA kernels (min-edge-
+per-supervertex, cycle removal, pointer-jumping label merge) become
+whole-array XLA ops inside one ``lax.while_loop`` — segment reductions via
+stable sorts, scatter for per-color winners, and pointer jumping as an
+inner ``while_loop``.  Tie-breaking uses lexicographic (weight, min(u,v),
+max(u,v)) via chained stable argsorts instead of the reference's epsilon
+"alteration" of weights — a strict total order on undirected edges, so the
+per-color minimum-edge choice is consistent across both directed copies
+and the selected edge set is a forest (plus 2-cycles, removed explicitly,
+same as the reference's cycle-elimination kernel).
+
+Everything is static-shape: edge capacity E, MST capacity n−1 with a live
+count, colors as an (n,) labeling — a spanning *forest* falls out naturally
+for disconnected graphs (reference returns n−1−n_components edges likewise).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.sparse.types import COO, CSR
+from raft_tpu.sparse.convert import csr_to_coo
+
+
+class MSTResult(NamedTuple):
+    """Spanning forest edges (capacity n−1, live entries first) + labels."""
+
+    src: jnp.ndarray      # (n-1,) int32; padding = n
+    dst: jnp.ndarray      # (n-1,) int32; padding = n
+    weight: jnp.ndarray   # (n-1,); padding = 0
+    n_edges: jnp.ndarray  # int32 scalar — live edge count
+    color: jnp.ndarray    # (n,) int32 component label per vertex
+
+
+def boruvka_mst(g: Union[COO, CSR]) -> MSTResult:
+    """MST/MSF of a symmetric weighted graph (both directed copies present,
+    as the reference requires — mst_solver.cuh:40 takes a symmetrized CSR).
+    """
+    coo = csr_to_coo(g) if isinstance(g, CSR) else g
+    expects(coo.shape[0] == coo.shape[1], "boruvka_mst: graph must be square")
+    n = coo.shape[0]
+    e = coo.capacity
+    u, v, w = coo.rows, coo.cols, coo.vals
+    # Robust to non-compacted inputs (merged edge lists): an entry is live
+    # iff its endpoints are in range — padding carries the row==n sentinel.
+    live = (u >= 0) & (u < n) & (v >= 0) & (v < n)
+    # Canonical undirected identity for tie-breaking.
+    minuv = jnp.minimum(u, v)
+    maxuv = jnp.maximum(u, v)
+    inf = jnp.asarray(jnp.inf, w.dtype)
+
+    def round_body(state):
+        color, msrc, mdst, mw, count, _changed = state
+        cu = color[jnp.clip(u, 0, n - 1)]
+        cv = color[jnp.clip(v, 0, n - 1)]
+        cross = live & (cu != cv)
+
+        # Sort edges by (color; weight; canonical id) — least-significant
+        # keys first, each pass stable.
+        order = jnp.argsort(maxuv, stable=True)
+        order = order[jnp.argsort(minuv[order], stable=True)]
+        wk = jnp.where(cross, w, inf)
+        order = order[jnp.argsort(wk[order], stable=True)]
+        ck = jnp.where(cross, cu, n)
+        order = order[jnp.argsort(ck[order], stable=True)]
+
+        ck_s = ck[order]
+        first = jnp.concatenate([jnp.ones((1,), bool), ck_s[1:] != ck_s[:-1]])
+        first &= ck_s < n
+        # Per-color winning edge (original index); colors without a cross
+        # edge keep sentinel E.
+        sel = jnp.full((n,), e, jnp.int32).at[
+            jnp.where(first, ck_s, n)].set(order.astype(jnp.int32), mode="drop")
+        any_cross = jnp.any(sel < e)
+
+        # parent[c] = color at the other end of c's winning edge.
+        has = sel < e
+        sel_safe = jnp.clip(sel, 0, e - 1)
+        other = jnp.where(has, cv[sel_safe], jnp.arange(n, dtype=jnp.int32))
+        parent = other
+        # Remove 2-cycles (mutual minimum pairs): smaller color becomes root
+        # (reference mst_kernels.cuh cycle elimination).
+        gp = parent[jnp.clip(parent, 0, n - 1)]
+        iota = jnp.arange(n, dtype=jnp.int32)
+        is_cycle = (gp == iota) & (iota < parent)
+        parent = jnp.where(is_cycle, iota, parent)
+
+        # Pointer-jump to roots.
+        def pj_cond(p):
+            return jnp.any(p[jnp.clip(p, 0, n - 1)] != p)
+
+        def pj_body(p):
+            return p[jnp.clip(p, 0, n - 1)]
+
+        roots = jax.lax.while_loop(pj_cond, pj_body, parent)
+
+        # Accepted edges: the distinct winners.  With a strict total order a
+        # mutual (2-cycle) pair necessarily picks the same undirected edge
+        # through its two directed copies — dropping the root side's mark
+        # adds it exactly once.
+        mark = has & ~is_cycle
+        chosen = jnp.zeros((e,), bool).at[sel_safe].set(mark, mode="drop")
+        chosen &= live
+        # Compact accepted edges to positions count..count+k-1 of the MST.
+        pos = count + jnp.cumsum(chosen.astype(jnp.int32)) - 1
+        pos = jnp.where(chosen, pos, n)  # out-of-range → dropped by scatter
+        msrc = msrc.at[pos].set(u.astype(jnp.int32), mode="drop")
+        mdst = mdst.at[pos].set(v.astype(jnp.int32), mode="drop")
+        mw = mw.at[pos].set(w, mode="drop")
+        count = count + jnp.sum(chosen, dtype=jnp.int32)
+
+        new_color = roots[jnp.clip(color, 0, n - 1)]
+        return new_color, msrc, mdst, mw, count, any_cross
+
+    def cond(state):
+        return state[5]
+
+    init = (jnp.arange(n, dtype=jnp.int32),
+            jnp.full((n - 1,), n, jnp.int32),
+            jnp.full((n - 1,), n, jnp.int32),
+            jnp.zeros((n - 1,), w.dtype),
+            jnp.zeros((), jnp.int32),
+            jnp.asarray(True))
+    color, msrc, mdst, mw, count, _ = jax.lax.while_loop(cond, round_body, init)
+    return MSTResult(msrc, mdst, mw, count, color)
+
+
+def sorted_mst_edges(result: MSTResult):
+    """MST edges sorted ascending by weight (reference
+    cluster/detail/mst.cuh ``build_sorted_mst`` sorts before the dendrogram
+    stage).  Padding (weight 0 at src == n) is pushed to the tail."""
+    wk = jnp.where(jnp.arange(result.src.shape[0]) < result.n_edges,
+                   result.weight, jnp.inf)
+    order = jnp.argsort(wk, stable=True)
+    return result.src[order], result.dst[order], result.weight[order]

@@ -1,0 +1,149 @@
+"""Sparse distances / sparse kNN / kNN-graph MST / single-linkage KNN mode."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+from scipy.spatial.distance import cdist
+
+from raft_tpu.distance import DistanceType
+from raft_tpu.sparse import CSR
+from raft_tpu.sparse.distance import SUPPORTED_SPARSE_DISTANCES, pairwise_distance
+from raft_tpu.sparse.neighbors import (
+    brute_force_knn,
+    build_k,
+    connect_components,
+    knn_graph,
+    mst_from_knn_graph,
+)
+
+SCIPY_NAMES = {
+    DistanceType.L2Expanded: "sqeuclidean",
+    DistanceType.L2SqrtExpanded: "euclidean",
+    DistanceType.CosineExpanded: "cosine",
+    DistanceType.L1: "cityblock",
+    DistanceType.Linf: "chebyshev",
+    DistanceType.Canberra: "canberra",
+}
+
+
+def to_raft(s: sp.csr_matrix, pad=0) -> CSR:
+    indices = np.concatenate([s.indices, np.zeros(pad, np.int32)])
+    data = np.concatenate([s.data, np.zeros(pad, s.data.dtype)])
+    return CSR(s.indptr, indices, data, s.shape)
+
+
+def random_csr(m, n, density=0.4, seed=0):
+    rng = np.random.default_rng(seed)
+    return sp.random(m, n, density=density, random_state=rng, format="csr",
+                     dtype=np.float32)
+
+
+@pytest.mark.parametrize("metric", list(SCIPY_NAMES))
+def test_sparse_pairwise_vs_scipy(metric):
+    a = random_csr(33, 20, seed=1)
+    b = random_csr(27, 20, seed=2)
+    d = np.asarray(pairwise_distance(to_raft(a, 5), to_raft(b, 3), metric))
+    ref = cdist(a.toarray(), b.toarray(), SCIPY_NAMES[metric])
+    np.testing.assert_allclose(d, ref, rtol=1e-3, atol=1e-5)
+
+
+def test_sparse_pairwise_batched_matches_unbatched():
+    a = random_csr(50, 16, seed=3)
+    b = random_csr(40, 16, seed=4)
+    full = np.asarray(pairwise_distance(to_raft(a), to_raft(b),
+                                        DistanceType.L2SqrtExpanded))
+    tiled = np.asarray(pairwise_distance(to_raft(a), to_raft(b),
+                                         DistanceType.L2SqrtExpanded,
+                                         batch_size_x=16, batch_size_y=17))
+    np.testing.assert_allclose(tiled, full, rtol=1e-5)
+
+
+@pytest.mark.parametrize("batch", [(16384, 4096), (13, 11)])
+def test_sparse_brute_force_knn(batch):
+    bi, bq = batch
+    index = random_csr(60, 12, seed=5)
+    query = random_csr(25, 12, seed=6)
+    d, i = brute_force_knn(to_raft(index), to_raft(query), k=5,
+                           batch_size_index=bi, batch_size_query=bq)
+    ref = cdist(query.toarray(), index.toarray(), "sqeuclidean")
+    ref_i = np.argsort(ref, axis=1, kind="stable")[:, :5]
+    ref_d = np.take_along_axis(ref, ref_i, axis=1)
+    np.testing.assert_allclose(np.asarray(d), ref_d, rtol=1e-3, atol=1e-5)
+    # indices may differ on ties; distances must match
+
+
+def test_build_k():
+    assert build_k(1024, 5) == 15
+    assert build_k(4, 1) == 3
+    assert build_k(2, 50) == 2
+
+
+def test_knn_graph():
+    rng = np.random.default_rng(8)
+    x = rng.random((30, 4)).astype(np.float32)
+    g = knn_graph(x, DistanceType.L2SqrtExpanded, k=3)
+    rows = np.asarray(g.rows)
+    cols = np.asarray(g.cols)
+    vals = np.asarray(g.vals)
+    assert rows.shape[0] == 30 * 3
+    ref = cdist(x, x)
+    np.fill_diagonal(ref, np.inf)
+    for i in range(30):
+        mine = set(cols[rows == i])
+        theirs = set(np.argsort(ref[i])[:3])
+        assert mine == theirs
+        np.testing.assert_allclose(np.sort(vals[rows == i]),
+                                   np.sort(ref[i, list(theirs)]), rtol=1e-4)
+
+
+def test_connect_components_reduces():
+    rng = np.random.default_rng(9)
+    x = np.concatenate([rng.random((10, 3)), rng.random((10, 3)) + 10]).astype(np.float32)
+    colors = np.array([0] * 10 + [1] * 10, np.int32)
+    edges = connect_components(x, colors)
+    rows = np.asarray(edges.rows)
+    cols = np.asarray(edges.cols)
+    live = rows < 20
+    assert live.sum() >= 2  # at least one edge + its reverse
+    crosses = colors[rows[live]] != colors[cols[live]]
+    assert crosses.all()
+
+
+def test_mst_from_knn_graph_connects():
+    rng = np.random.default_rng(10)
+    # three far-apart blobs — kNN graph (small k) is disconnected, fix-up
+    # must stitch it into a single tree
+    x = np.concatenate([rng.random((15, 2)),
+                        rng.random((15, 2)) + 50,
+                        rng.random((15, 2)) + 100]).astype(np.float32)
+    src, dst, w = mst_from_knn_graph(x, c=2)
+    n = 45
+    src, dst, w = np.asarray(src)[: n - 1], np.asarray(dst)[: n - 1], np.asarray(w)[: n - 1]
+    # forms a spanning tree
+    parent = list(range(n))
+
+    def find(a):
+        while parent[a] != a:
+            parent[a] = parent[parent[a]]
+            a = parent[a]
+        return a
+
+    for s, t in zip(src, dst):
+        rs, rt = find(int(s)), find(int(t))
+        assert rs != rt
+        parent[rs] = rt
+    assert len({find(i) for i in range(n)}) == 1
+    assert (np.diff(w) >= 0).all()
+
+
+def test_single_linkage_knn_graph_mode():
+    from raft_tpu.cluster import LinkageDistance, single_linkage
+
+    rng = np.random.default_rng(11)
+    x = np.concatenate([rng.random((20, 2)),
+                        rng.random((20, 2)) + 10]).astype(np.float32)
+    out = single_linkage(x, linkage=LinkageDistance.KNN_GRAPH, n_clusters=2)
+    labels = np.asarray(out.labels)
+    assert len(np.unique(labels[:20])) == 1
+    assert len(np.unique(labels[20:])) == 1
+    assert labels[0] != labels[20]

@@ -1,0 +1,168 @@
+"""Lanczos / Borůvka MST / weak_cc / fit_embedding vs scipy oracles."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+import scipy.sparse.csgraph as csgraph
+
+from raft_tpu.sparse import (
+    CSR,
+    boruvka_mst,
+    dense_to_csr,
+    fit_embedding,
+    lanczos_largest,
+    lanczos_smallest,
+    laplacian,
+    weak_cc,
+)
+from raft_tpu.sparse.solver.mst import sorted_mst_edges
+
+
+def to_raft(s: sp.csr_matrix, pad=0) -> CSR:
+    indices = np.concatenate([s.indices, np.zeros(pad, np.int32)])
+    data = np.concatenate([s.data, np.zeros(pad, s.data.dtype)])
+    return CSR(s.indptr, indices, data, s.shape)
+
+
+def random_sym_graph(n, density=0.2, seed=0, connected=False):
+    rng = np.random.default_rng(seed)
+    d = rng.random((n, n)).astype(np.float32)
+    mask = rng.random((n, n)) < density
+    d = d * mask
+    d = np.triu(d, 1)
+    if connected:
+        # ring to guarantee connectivity
+        for i in range(n):
+            d[min(i, (i + 1) % n), max(i, (i + 1) % n)] = rng.random() + 0.1
+    d = d + d.T
+    return d
+
+
+@pytest.mark.parametrize("n,k", [(40, 3), (80, 5)])
+def test_lanczos_smallest_vs_numpy(n, k):
+    d = random_sym_graph(n, 0.3, seed=n, connected=True)
+    lap = laplacian(dense_to_csr(d))
+    evals, evecs = lanczos_smallest(lap, k, tol=1e-8)
+    dense_lap = np.diag(d.sum(1)) - d
+    ref = np.linalg.eigvalsh(dense_lap)[:k]
+    np.testing.assert_allclose(np.sort(np.array(evals)), ref, atol=1e-3)
+    # Residual check ||A v - λ v||
+    for i in range(k):
+        v = np.array(evecs[:, i])
+        r = dense_lap @ v - float(evals[i]) * v
+        assert np.linalg.norm(r) < 1e-2
+
+
+def test_lanczos_largest_vs_numpy():
+    n, k = 60, 4
+    d = random_sym_graph(n, 0.3, seed=9, connected=True)
+    csr = dense_to_csr(d)
+    evals, evecs = lanczos_largest(csr, k, tol=1e-8)
+    ref = np.linalg.eigvalsh(d)[::-1][:k]
+    np.testing.assert_allclose(np.array(evals), ref, atol=1e-3)
+
+
+@pytest.mark.parametrize("n,seed", [(30, 0), (64, 1), (100, 2)])
+def test_boruvka_mst_matches_scipy(n, seed):
+    d = random_sym_graph(n, 0.25, seed=seed, connected=True)
+    res = boruvka_mst(dense_to_csr(d))
+    assert int(res.n_edges) == n - 1
+    total = float(np.sum(np.array(res.weight)[: n - 1]))
+    ref = csgraph.minimum_spanning_tree(sp.csr_matrix(d)).sum()
+    np.testing.assert_allclose(total, ref, rtol=1e-5)
+    # single component
+    assert len(np.unique(np.array(res.color))) == 1
+    # sorted edges ascending
+    src, dst, w = sorted_mst_edges(res)
+    ws = np.array(w)[: n - 1]
+    assert (np.diff(ws) >= 0).all()
+
+
+def test_boruvka_forest_disconnected():
+    # two cliques, no cross edges
+    rng = np.random.default_rng(5)
+    n = 20
+    d = np.zeros((n, n), np.float32)
+    for block in (slice(0, 10), slice(10, 20)):
+        b = rng.random((10, 10)).astype(np.float32)
+        b = np.triu(b, 1)
+        d[block, block] = b + b.T
+    res = boruvka_mst(dense_to_csr(d))
+    assert int(res.n_edges) == n - 2
+    colors = np.array(res.color)
+    assert len(np.unique(colors)) == 2
+    assert len(np.unique(colors[:10])) == 1 and len(np.unique(colors[10:])) == 1
+    ref = csgraph.minimum_spanning_tree(sp.csr_matrix(d)).sum()
+    total = float(np.sum(np.array(res.weight)[: n - 2]))
+    np.testing.assert_allclose(total, ref, rtol=1e-5)
+
+
+def test_boruvka_ties():
+    # all weights equal → any spanning tree has the same cost; must not
+    # produce cycles or duplicates.
+    n = 16
+    d = np.ones((n, n), np.float32) - np.eye(n, dtype=np.float32)
+    res = boruvka_mst(dense_to_csr(d))
+    assert int(res.n_edges) == n - 1
+    np.testing.assert_allclose(float(np.sum(np.array(res.weight)[: n - 1])),
+                               n - 1)
+    # edges must form a tree: union-find check
+    parent = list(range(n))
+
+    def find(a):
+        while parent[a] != a:
+            parent[a] = parent[parent[a]]
+            a = parent[a]
+        return a
+
+    for s, t in zip(np.array(res.src)[: n - 1], np.array(res.dst)[: n - 1]):
+        rs, rt = find(int(s)), find(int(t))
+        assert rs != rt, "cycle in MST output"
+        parent[rs] = rt
+
+
+def test_weak_cc_directed():
+    # weak connectivity ignores edge direction
+    d = np.zeros((3, 3), np.float32)
+    d[0, 1] = 1.0
+    labels = np.array(weak_cc(dense_to_csr(d)))
+    assert labels[0] == labels[1] != labels[2]
+
+
+def test_coo_degree():
+    from raft_tpu.sparse import coo_degree, csr_to_coo
+
+    d = np.zeros((4, 4), np.float32)
+    d[0, 1] = d[0, 2] = d[2, 3] = 1.0
+    deg = np.array(coo_degree(csr_to_coo(dense_to_csr(d))))
+    np.testing.assert_array_equal(deg, [2, 0, 1, 0])
+
+
+def test_weak_cc():
+    d = np.zeros((9, 9), np.float32)
+    for a, b in [(0, 1), (1, 2), (3, 4), (5, 6), (6, 7), (7, 8)]:
+        d[a, b] = d[b, a] = 1.0
+    labels = np.array(weak_cc(dense_to_csr(d)))
+    assert len(np.unique(labels)) == 3
+    assert labels[0] == labels[1] == labels[2]
+    assert labels[3] == labels[4]
+    assert labels[5] == labels[6] == labels[7] == labels[8]
+
+
+def test_fit_embedding_separates_blocks():
+    # two dense blocks weakly joined: the Fiedler vector separates them
+    rng = np.random.default_rng(7)
+    n = 40
+    d = np.zeros((n, n), np.float32)
+    for block in (slice(0, 20), slice(20, 40)):
+        b = (rng.random((20, 20)) < 0.7).astype(np.float32)
+        b = np.triu(b, 1)
+        d[block, block] = b + b.T
+    d[0, 20] = d[20, 0] = 0.01
+    emb = np.array(fit_embedding(dense_to_csr(d), 2, tol=1e-8))
+    assert emb.shape == (n, 2)
+    side = emb[:, 0] > np.median(emb[:, 0])
+    # all of block 1 on one side, block 2 on the other
+    assert len(np.unique(side[:20])) == 1
+    assert len(np.unique(side[20:])) == 1
+    assert side[0] != side[20]
