@@ -69,15 +69,17 @@ def pairwise_distance(x: CSR, y: CSR, metric: DistanceType = DistanceType.L2Expa
     bx = min(batch_size_x, m)
     by = min(batch_size_y or max(batch_size_x, 4096), n)
 
-    y_blocks = []
-    for j0 in range(0, n, by):
-        j1 = min(j0 + by, n)
-        y_blocks.append(csr_to_dense(csr_row_slice(y, j0, j1)))
-
     out_rows = []
     for i0 in range(0, m, bx):
         i1 = min(i0 + bx, m)
         xd = csr_to_dense(csr_row_slice(x, i0, i1))
-        row = [_dense.pairwise_distance(xd, yd, metric, p=p) for yd in y_blocks]
+        row = []
+        # Densify each y block inside the loop so at most one (bx, dim) and
+        # one (by, dim) dense tile are live at a time — the batch knobs must
+        # bound the densified footprint (reference batch_size_index/query).
+        for j0 in range(0, n, by):
+            j1 = min(j0 + by, n)
+            yd = csr_to_dense(csr_row_slice(y, j0, j1))
+            row.append(_dense.pairwise_distance(xd, yd, metric, p=p))
         out_rows.append(row[0] if len(row) == 1 else jnp.concatenate(row, axis=1))
     return out_rows[0] if len(out_rows) == 1 else jnp.concatenate(out_rows, axis=0)

@@ -142,7 +142,14 @@ def connect_components(x, colors,
     # symmetrize: emit both directions
     rows = jnp.concatenate([src, jnp.where(has, dst, n)])
     cols = jnp.concatenate([dst, jnp.where(has, src_safe, 0).astype(jnp.int32)])
-    vals = jnp.concatenate([w, w])
+    vals = jnp.concatenate([w, jnp.where(has, w, 0.0)])
+    # Compact live entries to the front so the COO honors the module's
+    # padding convention (types.py: positions >= nnz hold row == n_rows).
+    pad = rows >= n
+    order = jnp.argsort(pad, stable=True)
+    rows = rows[order]
+    cols = jnp.where(pad, 0, cols)[order]
+    vals = jnp.where(pad, 0.0, vals)[order]
     return COO(rows, cols, vals, (n, n), nnz=2 * jnp.sum(has, dtype=jnp.int32))
 
 

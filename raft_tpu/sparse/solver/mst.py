@@ -109,7 +109,11 @@ def boruvka_mst(g: Union[COO, CSR]) -> MSTResult:
         # through its two directed copies — dropping the root side's mark
         # adds it exactly once.
         mark = has & ~is_cycle
-        chosen = jnp.zeros((e,), bool).at[sel_safe].set(mark, mode="drop")
+        # Scatter True only at winning edges (index e for non-winners →
+        # dropped); writing `mark` at clipped indices would let a False from
+        # a cross-edge-less color clobber a real winner at buffer slot e-1.
+        chosen = jnp.zeros((e,), bool).at[
+            jnp.where(mark, sel, e)].set(True, mode="drop")
         chosen &= live
         # Compact accepted edges to positions count..count+k-1 of the MST.
         pos = count + jnp.cumsum(chosen.astype(jnp.int32)) - 1

@@ -1,0 +1,39 @@
+"""raft_tpu.spectral — spectral graph partitioning and modularity clustering.
+
+Counterpart of reference ``raft/spectral/`` (SURVEY.md §2.11):
+pluggable eigen/cluster solvers (``spectral/eigen_solvers.cuh:45``,
+``cluster_solvers.cuh:43``), ``partition()``
+(``spectral/detail/partition.hpp:65-107``), ``modularity_maximization()``
+(``spectral/modularity_maximization.cuh:47-77``) and the partition quality
+metrics ``analyze_partition`` / ``analyze_modularity``.
+"""
+
+from raft_tpu.spectral.matrix import (
+    laplacian_matvec,
+    modularity_matvec,
+)
+from raft_tpu.spectral.solvers import (
+    EigenSolverConfig,
+    LanczosEigenSolver,
+    ClusterSolverConfig,
+    KMeansClusterSolver,
+)
+from raft_tpu.spectral.partition import (
+    partition,
+    modularity_maximization,
+    analyze_partition,
+    analyze_modularity,
+)
+
+__all__ = [
+    "laplacian_matvec",
+    "modularity_matvec",
+    "EigenSolverConfig",
+    "LanczosEigenSolver",
+    "ClusterSolverConfig",
+    "KMeansClusterSolver",
+    "partition",
+    "modularity_maximization",
+    "analyze_partition",
+    "analyze_modularity",
+]

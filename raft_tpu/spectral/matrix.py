@@ -1,0 +1,59 @@
+"""Implicit spectral operators over a CSR adjacency matrix.
+
+Counterpart of reference ``spectral/matrix_wrappers.hpp:41-45``
+(``sparse_matrix_t`` / ``laplacian_matrix_t`` / ``modularity_matrix_t``):
+the reference wraps cusparse SpMV and overrides ``mv`` so the Lanczos solver
+sees ``L·x`` or ``B·x`` without materializing L or B.  TPU-first the same
+idea is a closure over :func:`raft_tpu.sparse.linalg.spmv` — XLA fuses the
+rank-1/diagonal corrections into the surrounding computation, and the
+Lanczos solver already accepts a bare ``matvec``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.sparse.types import CSR
+from raft_tpu.sparse.linalg import spmv
+
+
+def _degrees(adj: CSR) -> jnp.ndarray:
+    """Weighted degree vector d_i = Σ_j a_ij."""
+    return jax.ops.segment_sum(adj.data, adj.row_ids(),
+                               num_segments=adj.shape[0])
+
+
+def laplacian_matvec(adj: CSR) -> Tuple[Callable, jnp.ndarray]:
+    """Implicit Laplacian operator: ``L·x = D·x − A·x``.
+
+    Returns (matvec, degrees).  Reference ``laplacian_matrix_t::mv``
+    computes the same two-term SpMV (spectral/matrix_wrappers.hpp).
+    """
+    expects(adj.shape[0] == adj.shape[1], "laplacian: matrix must be square")
+    deg = _degrees(adj)
+
+    def mv(x):
+        return deg * x - spmv(adj, x)
+
+    return mv, deg
+
+
+def modularity_matvec(adj: CSR) -> Tuple[Callable, jnp.ndarray, jnp.ndarray]:
+    """Implicit modularity operator ``B·x = A·x − d (dᵀx) / (2m)``.
+
+    Returns (matvec, degrees, edge_sum) where ``edge_sum = Σ_ij a_ij = 2m``.
+    Reference ``modularity_matrix_t::mv`` (spectral/matrix_wrappers.hpp).
+    """
+    expects(adj.shape[0] == adj.shape[1], "modularity: matrix must be square")
+    deg = _degrees(adj)
+    edge_sum = jnp.sum(deg)  # 2m for an undirected (symmetric) graph
+
+    def mv(x):
+        scale = jnp.dot(deg, x) / jnp.maximum(edge_sum, 1e-30)
+        return spmv(adj, x) - deg * scale
+
+    return mv, deg, edge_sum

@@ -1,0 +1,93 @@
+"""Pluggable eigen/cluster solvers for the spectral pipelines.
+
+Counterparts of reference ``spectral/eigen_solvers.cuh:45``
+(``lanczos_solver_t`` + ``eigen_solver_config_t``) and
+``spectral/cluster_solvers.cuh:43`` (``kmeans_solver_t`` +
+``cluster_solver_config_t``).  The configs keep the reference's field names
+so downstream callers translate one-to-one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Tuple
+
+import jax.numpy as jnp
+
+from raft_tpu.cluster import KMeansParams, InitMethod, fit_predict
+
+
+@dataclasses.dataclass
+class EigenSolverConfig:
+    """Reference ``eigen_solver_config_t`` (spectral/eigen_solvers.cuh:28)."""
+
+    n_eigVecs: int
+    maxIter: int = 15          # restart rounds (reference: maxIter_lanczos)
+    restartIter: int = 0       # Krylov size m (0 → auto, like reference's 2k+16)
+    tol: float = 1e-6
+    reorthogonalize: bool = True  # always on in the TPU build (MXU-cheap)
+    seed: int = 1234567
+
+
+class LanczosEigenSolver:
+    """Reference ``lanczos_solver_t`` (spectral/eigen_solvers.cuh:45).
+
+    ``solve_smallest_eigenvectors`` / ``solve_largest_eigenvectors`` accept
+    either a :class:`~raft_tpu.sparse.types.CSR` or a bare ``matvec``
+    callable (the implicit Laplacian/modularity operators).
+    """
+
+    def __init__(self, config: EigenSolverConfig):
+        self.config = config
+
+    def _kwargs(self):
+        c = self.config
+        return dict(
+            ncv=(c.restartIter or None),
+            max_restarts=c.maxIter,
+            tol=c.tol,
+            seed=c.seed,
+        )
+
+    def solve_smallest_eigenvectors(self, a, n: Optional[int] = None
+                                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        from raft_tpu.sparse.solver import lanczos_smallest
+
+        return lanczos_smallest(a, self.config.n_eigVecs, n=n, **self._kwargs())
+
+    def solve_largest_eigenvectors(self, a, n: Optional[int] = None
+                                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        from raft_tpu.sparse.solver import lanczos_largest
+
+        return lanczos_largest(a, self.config.n_eigVecs, n=n, **self._kwargs())
+
+
+@dataclasses.dataclass
+class ClusterSolverConfig:
+    """Reference ``cluster_solver_config_t`` (spectral/cluster_solvers.cuh:30)."""
+
+    n_clusters: int
+    maxIter: int = 100
+    tol: float = 1e-4
+    seed: int = 123456
+
+
+class KMeansClusterSolver:
+    """Reference ``kmeans_solver_t`` (spectral/cluster_solvers.cuh:43):
+    k-means on the (n, n_eigVecs) spectral embedding."""
+
+    def __init__(self, config: ClusterSolverConfig):
+        self.config = config
+
+    def solve(self, embedding) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Returns (labels [n], inertia scalar)."""
+        c = self.config
+        params = KMeansParams(
+            n_clusters=c.n_clusters,
+            max_iter=c.maxIter,
+            tol=c.tol,
+            seed=c.seed,
+            init=InitMethod.KMeansPlusPlus,
+        )
+        out = fit_predict(params, jnp.asarray(embedding))
+        return out.labels, out.inertia

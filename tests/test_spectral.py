@@ -1,0 +1,113 @@
+"""Spectral partition / modularity maximization on planted-community graphs.
+
+Oracle style mirrors reference test/cluster_solvers.cu / test/eigen_solvers.cu
+plus property checks: a planted two-block graph must be recovered exactly,
+and quality metrics must match hand-computed values.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.sparse import dense_to_csr
+from raft_tpu.spectral import (
+    ClusterSolverConfig,
+    EigenSolverConfig,
+    KMeansClusterSolver,
+    LanczosEigenSolver,
+    analyze_modularity,
+    analyze_partition,
+    modularity_maximization,
+    partition,
+)
+
+
+def planted_blocks(sizes, p_in=0.8, p_out=0.02, seed=0):
+    """Symmetric unweighted block-community adjacency + ground-truth labels."""
+    rng = np.random.default_rng(seed)
+    n = sum(sizes)
+    labels = np.repeat(np.arange(len(sizes)), sizes)
+    prob = np.where(labels[:, None] == labels[None, :], p_in, p_out)
+    a = (rng.random((n, n)) < prob).astype(np.float32)
+    a = np.triu(a, 1)
+    # guarantee intra-block connectivity via a path inside each block
+    start = 0
+    for s in sizes:
+        for i in range(start, start + s - 1):
+            a[i, i + 1] = 1.0
+        start += s
+    # one bridge between consecutive blocks so the graph is connected
+    start = 0
+    for s in sizes[:-1]:
+        a[start + s - 1, start + s] = 1.0
+        start += s
+    a = a + a.T
+    return a, labels
+
+
+def _agree(pred, truth):
+    """Fraction of pairs on which two labelings agree (label-permutation
+    invariant)."""
+    pred, truth = np.asarray(pred), np.asarray(truth)
+    same_p = pred[:, None] == pred[None, :]
+    same_t = truth[:, None] == truth[None, :]
+    return (same_p == same_t).mean()
+
+
+@pytest.mark.parametrize("sizes", [(30, 30), (25, 25, 25)])
+def test_partition_recovers_planted_blocks(sizes):
+    a, truth = planted_blocks(sizes, seed=len(sizes))
+    k = len(sizes)
+    adj = dense_to_csr(a)
+    eig = LanczosEigenSolver(EigenSolverConfig(n_eigVecs=k, tol=1e-7))
+    km = KMeansClusterSolver(ClusterSolverConfig(n_clusters=k))
+    labels, eig_vals, eig_vecs, _ = partition(adj, eig, km)
+    assert _agree(labels, truth) > 0.95
+    assert eig_vecs.shape == (a.shape[0], k)
+    # Laplacian eigenvalues are nonnegative; smallest ~0 (connected graph)
+    assert float(eig_vals[0]) < 1e-3
+    assert np.all(np.array(eig_vals) > -1e-4)
+
+
+def test_analyze_partition_matches_dense_oracle():
+    a, truth = planted_blocks((20, 20), seed=7)
+    adj = dense_to_csr(a)
+    edge_cut, cost = analyze_partition(adj, 2, truth)
+    # dense oracle
+    lap = np.diag(a.sum(1)) - a
+    cut = []
+    for i in range(2):
+        u = (truth == i).astype(np.float64)
+        cut.append(u @ lap @ u)
+    np.testing.assert_allclose(float(edge_cut), sum(cut) / 2, rtol=1e-5)
+    np.testing.assert_allclose(
+        float(cost), sum(c / (truth == i).sum() for i, c in enumerate(cut)),
+        rtol=1e-5)
+    # the planted partition should beat a random one
+    rng = np.random.default_rng(0)
+    rand_cut, _ = analyze_partition(adj, 2, rng.integers(0, 2, truth.shape[0]))
+    assert float(edge_cut) < float(rand_cut)
+
+
+def test_modularity_maximization_and_analyze():
+    a, truth = planted_blocks((30, 30), p_in=0.7, p_out=0.02, seed=3)
+    adj = dense_to_csr(a)
+    k = 2
+    eig = LanczosEigenSolver(EigenSolverConfig(n_eigVecs=k, tol=1e-7))
+    km = KMeansClusterSolver(ClusterSolverConfig(n_clusters=k))
+    labels, _, _, _ = modularity_maximization(adj, eig, km)
+    assert _agree(labels, truth) > 0.95
+
+    q_truth = float(analyze_modularity(adj, 2, truth))
+    # dense modularity oracle: Q = (1/2m) Σ_ij (a_ij − d_i d_j / 2m) δ(c_i,c_j)
+    d = a.sum(1)
+    two_m = d.sum()
+    b = a - np.outer(d, d) / two_m
+    delta = (truth[:, None] == truth[None, :]).astype(np.float64)
+    q_ref = (b * delta).sum() / two_m
+    np.testing.assert_allclose(q_truth, q_ref, rtol=1e-5)
+    # good community structure → clearly positive modularity
+    assert q_truth > 0.3
+    # random labels → near-zero modularity
+    rng = np.random.default_rng(1)
+    q_rand = float(analyze_modularity(adj, 2, rng.integers(0, 2, truth.shape[0])))
+    assert q_rand < q_truth / 2
