@@ -131,7 +131,7 @@ def _lanczos(matvec_or_csr, n: int, k: int, *, largest: bool,
 def lanczos_smallest(a: Union[CSR, Callable], n_components: int, *,
                      n: Optional[int] = None, ncv: Optional[int] = None,
                      max_restarts: int = 15, tol: float = 1e-6,
-                     seed: int = 0, v0=None):
+                     seed: int = 0, v0=None, dtype=jnp.float32):
     """Smallest eigenpairs of a symmetric operator.
 
     Reference ``computeSmallestEigenvectors`` (sparse/solver/lanczos.cuh:68).
@@ -153,14 +153,14 @@ def lanczos_smallest(a: Union[CSR, Callable], n_components: int, *,
     neg = lambda v: -a(v)  # noqa: E731
     evals, vecs = _lanczos(neg, n, n_components, largest=True, ncv=ncv,
                            max_restarts=max_restarts, tol=tol, seed=seed,
-                           v0=v0)
+                           dtype=dtype, v0=v0)
     return -evals, vecs
 
 
 def lanczos_largest(a: Union[CSR, Callable], n_components: int, *,
                     n: Optional[int] = None, ncv: Optional[int] = None,
                     max_restarts: int = 15, tol: float = 1e-6,
-                    seed: int = 0, v0=None):
+                    seed: int = 0, v0=None, dtype=jnp.float32):
     """Largest eigenpairs (reference ``computeLargestEigenvectors``,
     sparse/solver/lanczos.cuh:132).  Returns (eigenvalues [k] descending,
     eigenvectors [n, k])."""
@@ -171,7 +171,7 @@ def lanczos_largest(a: Union[CSR, Callable], n_components: int, *,
         dtype = a.data.dtype
     else:
         expects(n is not None, "lanczos with a matvec callable needs n")
-        matvec, dtype = a, jnp.float32
+        matvec = a
     return _lanczos(matvec, n, n_components, largest=True, ncv=ncv,
                     max_restarts=max_restarts, tol=tol, seed=seed,
                     dtype=dtype, v0=v0)

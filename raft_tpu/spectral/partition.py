@@ -51,7 +51,8 @@ def partition(adj: CSR, eigen_solver: LanczosEigenSolver,
     expects(adj.shape[0] == adj.shape[1], "partition: adjacency must be square")
     n = adj.shape[0]
     mv, _ = laplacian_matvec(adj)
-    eig_vals, eig_vecs = eigen_solver.solve_smallest_eigenvectors(mv, n=n)
+    eig_vals, eig_vecs = eigen_solver.solve_smallest_eigenvectors(
+        mv, n=n, dtype=adj.data.dtype)
     emb = _transform_eigen_matrix(eig_vecs)
     labels, inertia = cluster_solver.solve(emb)
     return labels, eig_vals, eig_vecs, inertia
@@ -72,7 +73,8 @@ def modularity_maximization(adj: CSR, eigen_solver: LanczosEigenSolver,
             "modularity_maximization: adjacency must be square")
     n = adj.shape[0]
     mv, _, _ = modularity_matvec(adj)
-    eig_vals, eig_vecs = eigen_solver.solve_largest_eigenvectors(mv, n=n)
+    eig_vals, eig_vecs = eigen_solver.solve_largest_eigenvectors(
+        mv, n=n, dtype=adj.data.dtype)
     emb = _transform_eigen_matrix(eig_vecs)
     # scale_obs: normalize each observation (row) to unit norm before
     # k-means (reference modularity_maximization.hpp ``scale_obs``).

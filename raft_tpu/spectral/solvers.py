@@ -49,17 +49,21 @@ class LanczosEigenSolver:
             seed=c.seed,
         )
 
-    def solve_smallest_eigenvectors(self, a, n: Optional[int] = None
+    def solve_smallest_eigenvectors(self, a, n: Optional[int] = None,
+                                    dtype=jnp.float32
                                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         from raft_tpu.sparse.solver import lanczos_smallest
 
-        return lanczos_smallest(a, self.config.n_eigVecs, n=n, **self._kwargs())
+        return lanczos_smallest(a, self.config.n_eigVecs, n=n, dtype=dtype,
+                                **self._kwargs())
 
-    def solve_largest_eigenvectors(self, a, n: Optional[int] = None
+    def solve_largest_eigenvectors(self, a, n: Optional[int] = None,
+                                   dtype=jnp.float32
                                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         from raft_tpu.sparse.solver import lanczos_largest
 
-        return lanczos_largest(a, self.config.n_eigVecs, n=n, **self._kwargs())
+        return lanczos_largest(a, self.config.n_eigVecs, n=n, dtype=dtype,
+                               **self._kwargs())
 
 
 @dataclasses.dataclass
