@@ -1,0 +1,12 @@
+"""raft_tpu.solver — combinatorial solvers.
+
+Counterpart of reference ``raft/solver/`` (SURVEY.md §2.12):
+``LinearAssignmentProblem`` (solver/linear_assignment.cuh:53).
+"""
+
+from raft_tpu.solver.linear_assignment import (
+    LinearAssignmentProblem,
+    solve_lap,
+)
+
+__all__ = ["LinearAssignmentProblem", "solve_lap"]

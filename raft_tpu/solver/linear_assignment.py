@@ -1,0 +1,210 @@
+"""Batched linear assignment (LAP) solver.
+
+Counterpart of reference ``solver/linear_assignment.cuh:53``
+(``LinearAssignmentProblem`` — the Date-Nagi GPU alternating-tree Hungarian
+algorithm, kernels in ``solver/detail/lap_kernels.cuh``), which solves a
+batch of n×n min-cost assignment problems and exposes row/col assignments,
+row/col duals, and primal/dual objective values.
+
+TPU-first redesign: the Hungarian alternating-tree search is a
+frontier-expansion algorithm with data-dependent serial augmenting paths —
+a poor fit for SPMD/XLA.  Instead this uses **Bertsekas' auction algorithm
+with ε-scaling**: every phase is dense row-parallel work (per-row top-2
+reduction over the cost matrix → bids → per-column argmax over bidders),
+which vectorizes perfectly over the VPU/MXU and batches with ``vmap``.
+ε-scaling from a coarse ε down to ``final_eps`` keeps the number of
+bidding rounds near O(n) per phase; with integer-valued costs and
+``final_eps < 1/n`` the result is provably optimal, and for float costs it
+is ε-optimal (|primal − dual| ≤ n·ε), exactly the guarantee the reference's
+``epsilon_`` tolerance encodes.
+
+All control flow is ``lax.while_loop`` on device — one compiled
+computation per (n, batch) shape, no host round-trips per round.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+class LAPResult(NamedTuple):
+    """Solution of a batch of assignment problems."""
+
+    row_assignment: jnp.ndarray   # (batch, n) int32: col assigned to each row
+    col_assignment: jnp.ndarray   # (batch, n) int32: row assigned to each col
+    objective: jnp.ndarray        # (batch,) primal objective Σ cost[i, σ(i)]
+    row_duals: jnp.ndarray        # (batch, n) dual u_i
+    col_duals: jnp.ndarray        # (batch, n) dual v_j (auction prices)
+
+
+def _auction_phase(benefit, prices, eps, max_rounds):
+    """One ε-phase of the forward auction on a single (n, n) benefit matrix.
+
+    Jacobi parallel bidding: all unassigned persons bid simultaneously;
+    each object goes to its highest bidder, evicting the previous owner.
+    """
+    n = benefit.shape[0]
+    inf = jnp.asarray(jnp.finfo(benefit.dtype).max, benefit.dtype)
+
+    def cond(state):
+        row_to_col, _, _, rounds = state
+        return jnp.any(row_to_col < 0) & (rounds < max_rounds)
+
+    def body(state):
+        row_to_col, col_to_row, prices, rounds = state
+        unassigned = row_to_col < 0                       # (n,)
+        value = benefit - prices[None, :]                  # (n, n)
+        # per-row best and second-best values
+        top2, top2_idx = jax.lax.top_k(value, 2)
+        best_j = top2_idx[:, 0]
+        bid_amount = prices[best_j] + (top2[:, 0] - top2[:, 1]) + eps
+        # Each column takes the highest bid among unassigned bidders.
+        bid = jnp.where(unassigned[:, None] &
+                        (jnp.arange(n)[None, :] == best_j[:, None]),
+                        bid_amount[:, None], -inf)         # (n_rows, n_cols)
+        best_bid = jnp.max(bid, axis=0)                    # (n_cols,)
+        winner = jnp.argmax(bid, axis=0).astype(jnp.int32)
+        got_bid = best_bid > -inf
+        # Evict previous owners of re-auctioned columns, then award to the
+        # winners.  Winners are unassigned rows and owners are assigned
+        # rows, so the two scatters touch disjoint rows.
+        prev_owner = jnp.where(got_bid & (col_to_row >= 0), col_to_row, n)
+        row_to_col = row_to_col.at[prev_owner].set(-1, mode="drop")
+        col_to_row = jnp.where(got_bid, winner, col_to_row)
+        row_to_col = row_to_col.at[
+            jnp.where(got_bid, winner, n)].set(
+                jnp.arange(n, dtype=jnp.int32), mode="drop")
+        prices = jnp.where(got_bid, best_bid, prices)
+        return row_to_col, col_to_row, prices, rounds + 1
+
+    init = (jnp.full((n,), -1, jnp.int32), jnp.full((n,), -1, jnp.int32),
+            prices, jnp.zeros((), jnp.int32))
+    row_to_col, col_to_row, prices, _ = jax.lax.while_loop(cond, body, init)
+    return row_to_col, col_to_row, prices
+
+
+def _solve_single(cost, final_eps: float, scaling_factor: float,
+                  max_rounds_per_phase: int):
+    """ε-scaled auction for one (n, n) cost matrix → LAP fields."""
+    n = cost.shape[0]
+    benefit = -cost                     # min-cost ↔ max-benefit
+    spread = jnp.maximum(jnp.max(cost) - jnp.min(cost),
+                         jnp.asarray(1.0, cost.dtype))
+    # phase schedule: eps_0 = spread/2, shrink by scaling_factor until
+    # <= final_eps.  The count must be static for while_loop-free scan.
+    def phase(carry, _):
+        prices, eps, done = carry
+        _, _, new_prices = _auction_phase(benefit, prices, eps,
+                                          max_rounds_per_phase)
+        prices = jnp.where(done, prices, new_prices)
+        next_eps = jnp.maximum(eps / scaling_factor,
+                               jnp.asarray(final_eps, cost.dtype))
+        new_done = done | (eps <= final_eps)
+        return (prices, next_eps, new_done), None
+
+    # number of phases needed: log_{sf}(spread/(2*final_eps)) + 1; bound it
+    # statically by assuming spread/final_eps <= 1e9.
+    import math
+    n_phases = max(1, int(math.ceil(math.log(1e9) / math.log(scaling_factor))))
+    eps0 = spread / 2
+    (prices, _, _), _ = jax.lax.scan(
+        phase, (jnp.zeros((n,), cost.dtype), eps0,
+                jnp.asarray(False)), None, length=n_phases)
+    # Final phase at final_eps with the settled prices — its assignment is
+    # ε-optimal (|primal − dual| ≤ n·ε).
+    r2c, c2r, prices = _auction_phase(benefit, prices,
+                                      jnp.asarray(final_eps, cost.dtype),
+                                      max_rounds_per_phase)
+    safe = jnp.clip(r2c, 0, n - 1)
+    objective = jnp.sum(jnp.take_along_axis(cost, safe[:, None], axis=1)[:, 0])
+    # duals: v = prices, u_i = max_j (benefit_ij − v_j) (complementary
+    # slackness in the max-benefit form; reference exposes row/col duals
+    # via getRowDualVector/getColDualVector).
+    u = jnp.max(benefit - prices[None, :], axis=1)
+    return r2c, c2r, objective, -u, -prices  # negate back to min-cost form
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _solve_batched(costs, final_eps, scaling_factor, max_rounds_per_phase):
+    return jax.vmap(lambda c: _solve_single(
+        c, final_eps, scaling_factor, max_rounds_per_phase))(costs)
+
+
+def solve_lap(costs, epsilon: float = 1e-6, scaling_factor: float = 8.0,
+              max_rounds_per_phase: int = 0) -> LAPResult:
+    """Solve a batch of n×n min-cost assignment problems.
+
+    *costs* is (batch, n, n) or (n, n).  *epsilon* is the optimality
+    tolerance (reference ctor's ``epsilon``): the returned assignment's
+    objective is within ``n·epsilon`` of optimal; for integer costs pass
+    ``epsilon < 1/n`` to get the exact optimum.
+    """
+    costs = jnp.asarray(costs)
+    squeeze = costs.ndim == 2
+    if squeeze:
+        costs = costs[None]
+    expects(costs.ndim == 3 and costs.shape[1] == costs.shape[2],
+            "solve_lap: costs must be (batch, n, n) square")
+    n = costs.shape[1]
+    if max_rounds_per_phase <= 0:
+        max_rounds_per_phase = 16 * n + 256
+    r2c, c2r, obj, u, v = _solve_batched(
+        costs.astype(jnp.promote_types(costs.dtype, jnp.float32)),
+        float(epsilon), float(scaling_factor), int(max_rounds_per_phase))
+    res = LAPResult(r2c, c2r, obj, u, v)
+    if squeeze:
+        res = LAPResult(*(a[0] for a in res))
+    return res
+
+
+class LinearAssignmentProblem:
+    """Reference-parity class surface (solver/linear_assignment.cuh:53).
+
+    ``solve(cost_matrices)`` → stores assignments/duals/objectives, exposed
+    through the same getters the reference has.
+    """
+
+    def __init__(self, size: int, batchsize: int = 1, epsilon: float = 1e-6):
+        self.size = int(size)
+        self.batchsize = int(batchsize)
+        self.epsilon = float(epsilon)
+        self._result: LAPResult | None = None
+
+    def solve(self, cost_matrices) -> LAPResult:
+        costs = jnp.asarray(cost_matrices)
+        if costs.ndim == 2:
+            costs = costs[None]
+        expects(costs.shape == (self.batchsize, self.size, self.size),
+                f"expected ({self.batchsize}, {self.size}, {self.size}) costs")
+        self._result = solve_lap(costs, self.epsilon)
+        return self._result
+
+    def _res(self) -> LAPResult:
+        expects(self._result is not None, "call solve() first")
+        return self._result
+
+    # Reference getters (linear_assignment.cuh:118-170)
+    def get_row_assignments(self):
+        return self._res().row_assignment
+
+    def get_col_assignments(self):
+        return self._res().col_assignment
+
+    def get_primal_objective_value(self, batch: int = 0):
+        return self._res().objective[batch]
+
+    def get_dual_objective_value(self, batch: int = 0):
+        r = self._res()
+        return jnp.sum(r.row_duals[batch]) + jnp.sum(r.col_duals[batch])
+
+    def get_row_dual_vector(self, batch: int = 0):
+        return self._res().row_duals[batch]
+
+    def get_col_dual_vector(self, batch: int = 0):
+        return self._res().col_duals[batch]

@@ -1,0 +1,80 @@
+"""LAP auction solver vs scipy.optimize.linear_sum_assignment oracle."""
+
+import numpy as np
+import pytest
+from scipy.optimize import linear_sum_assignment
+
+from raft_tpu.solver import LinearAssignmentProblem, solve_lap
+
+
+def scipy_objective(cost):
+    r, c = linear_sum_assignment(cost)
+    return cost[r, c].sum()
+
+
+@pytest.mark.parametrize("n,seed", [(8, 0), (32, 1), (64, 2)])
+def test_lap_float_optimal(n, seed):
+    rng = np.random.default_rng(seed)
+    cost = rng.random((n, n)).astype(np.float32)
+    res = solve_lap(cost, epsilon=1e-7)
+    r2c = np.array(res.row_assignment)
+    # valid permutation
+    assert sorted(r2c.tolist()) == list(range(n))
+    # col_assignment is the inverse permutation
+    c2r = np.array(res.col_assignment)
+    assert np.array_equal(c2r[r2c], np.arange(n))
+    # within n·eps of scipy's optimum
+    ref = scipy_objective(cost.astype(np.float64))
+    assert float(res.objective) <= ref + n * 1e-5
+    np.testing.assert_allclose(float(res.objective),
+                               cost[np.arange(n), r2c].sum(), rtol=1e-5)
+
+
+def test_lap_integer_exact():
+    rng = np.random.default_rng(3)
+    n = 24
+    cost = rng.integers(0, 100, (n, n)).astype(np.float32)
+    res = solve_lap(cost, epsilon=1.0 / (2 * n))
+    r2c = np.array(res.row_assignment)
+    assert sorted(r2c.tolist()) == list(range(n))
+    # integer costs + eps < 1/n → provably exact optimum
+    assert float(res.objective) == scipy_objective(cost.astype(np.int64))
+
+
+def test_lap_batched():
+    rng = np.random.default_rng(4)
+    b, n = 5, 16
+    costs = rng.random((b, n, n)).astype(np.float32)
+    res = solve_lap(costs, epsilon=1e-7)
+    assert res.row_assignment.shape == (b, n)
+    for i in range(b):
+        ref = scipy_objective(costs[i].astype(np.float64))
+        assert float(res.objective[i]) <= ref + n * 1e-5
+
+
+def test_lap_class_surface_and_duality():
+    rng = np.random.default_rng(5)
+    n, b = 20, 3
+    costs = rng.random((b, n, n)).astype(np.float32)
+    lap = LinearAssignmentProblem(size=n, batchsize=b, epsilon=1e-7)
+    lap.solve(costs)
+    for i in range(b):
+        primal = float(lap.get_primal_objective_value(i))
+        dual = float(lap.get_dual_objective_value(i))
+        # weak duality (dual <= primal) and ε-complementary slackness
+        assert dual <= primal + 1e-4
+        assert primal - dual <= n * 1e-4
+        # feasibility of duals: u_i + v_j <= c_ij (+ tolerance)
+        u = np.array(lap.get_row_dual_vector(i))
+        v = np.array(lap.get_col_dual_vector(i))
+        assert np.all(u[:, None] + v[None, :] <= costs[i] + 1e-4)
+
+
+def test_lap_diag_structure():
+    # cost with an obvious optimal diagonal
+    n = 12
+    cost = np.full((n, n), 10.0, np.float32)
+    np.fill_diagonal(cost, 0.0)
+    res = solve_lap(cost, epsilon=1e-6)
+    assert np.array_equal(np.array(res.row_assignment), np.arange(n))
+    assert float(res.objective) == 0.0
