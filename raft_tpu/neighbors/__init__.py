@@ -1,0 +1,39 @@
+"""raft_tpu.neighbors — nearest-neighbor search (exact and approximate).
+
+Counterpart of reference ``raft/neighbors/`` + ``raft/spatial/knn/``
+(SURVEY.md §2.8): brute-force kNN (tiled, no FAISS), fused L2 kNN,
+``knn_merge_parts``, epsilon neighborhood, haversine kNN, and the ANN
+indexes (IVF-Flat, IVF-PQ, random ball cover).
+"""
+
+from raft_tpu.neighbors.brute_force import (
+    knn,
+    brute_force_knn,
+    fused_l2_knn,
+    knn_merge_parts,
+)
+from raft_tpu.neighbors.epsilon_neighborhood import (
+    eps_neighbors,
+    eps_neighbors_l2sq,
+)
+from raft_tpu.neighbors.haversine import haversine_knn
+
+__all__ = [
+    "knn",
+    "brute_force_knn",
+    "fused_l2_knn",
+    "knn_merge_parts",
+    "eps_neighbors",
+    "eps_neighbors_l2sq",
+    "haversine_knn",
+]
+
+
+def __getattr__(name):
+    # Lazy submodule access for the ANN index families (ivf_flat, ivf_pq,
+    # ball_cover) so importing the light exact-kNN surface stays cheap.
+    if name in ("ivf_flat", "ivf_pq", "ball_cover"):
+        import importlib
+
+        return importlib.import_module(f"raft_tpu.neighbors.{name}")
+    raise AttributeError(f"module 'raft_tpu.neighbors' has no attribute {name!r}")

@@ -1,0 +1,56 @@
+"""Epsilon neighborhood: boolean adjacency within a radius.
+
+Counterpart of reference ``neighbors/epsilon_neighborhood.cuh:48``
+(``epsUnexpL2SqNeighborhood``): for each (x_i, y_j) pair, adjacency
+``‖x_i − y_j‖² ≤ eps`` plus per-row vertex degrees — the DBSCAN building
+block.  The reference fuses the unexpanded L2 into the tiled contraction
+kernel; on TPU the expanded form rides the MXU and XLA fuses the
+threshold + popcount epilogue.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.distance.pairwise import distance as _pairwise
+
+
+@functools.partial(jax.jit, static_argnums=())
+def _eps_tile(x, y, eps):
+    d = _pairwise(x, y, DistanceType.L2Expanded, 2.0)
+    adj = d <= eps
+    return adj, jnp.sum(adj, axis=1, dtype=jnp.int32)
+
+
+def eps_neighbors_l2sq(x, y, eps: float, *, batch_size: int = 8192
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Adjacency of squared-L2 balls: ``adj[i, j] = ‖x_i − y_j‖² ≤ eps``.
+
+    Returns (adj [m, n] bool, vd [m] int32 row degrees).  *eps* is the
+    squared radius, as in the reference.
+    """
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    expects(x.ndim == 2 and y.ndim == 2, "inputs must be 2-d")
+    expects(x.shape[1] == y.shape[1], "feature dim mismatch")
+    eps = jnp.asarray(eps, x.dtype)
+    adj_rows, vd_rows = [], []
+    for i0 in range(0, x.shape[0], batch_size):
+        i1 = min(i0 + batch_size, x.shape[0])
+        adj, vd = _eps_tile(x[i0:i1], y, eps)
+        adj_rows.append(adj)
+        vd_rows.append(vd)
+    adj = adj_rows[0] if len(adj_rows) == 1 else jnp.concatenate(adj_rows, 0)
+    vd = vd_rows[0] if len(vd_rows) == 1 else jnp.concatenate(vd_rows, 0)
+    return adj, vd
+
+
+def eps_neighbors(x, y, eps: float, **kw):
+    """Radius (not squared) convenience wrapper."""
+    return eps_neighbors_l2sq(x, y, float(eps) ** 2, **kw)

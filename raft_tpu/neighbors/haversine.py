@@ -1,0 +1,29 @@
+"""Haversine (great-circle) k-nearest-neighbors.
+
+Counterpart of reference ``spatial/knn/detail/haversine_distance.cuh``
+(``haversine_knn``): brute-force kNN under the haversine metric over
+(latitude, longitude) pairs in radians.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.neighbors.brute_force import knn
+
+
+def haversine_knn(index, queries, k: int, **kw
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """kNN in great-circle distance; rows are (lat, lon) in radians.
+
+    Returns (distances [nq, k], indices [nq, k]).
+    """
+    index = jnp.asarray(index)
+    queries = jnp.asarray(queries)
+    expects(index.shape[1] == 2 and queries.shape[1] == 2,
+            "haversine inputs must be (n, 2) lat/lon radians")
+    return knn(index, queries, k, DistanceType.Haversine, **kw)

@@ -1,0 +1,117 @@
+"""Brute-force kNN / merge-parts / eps-neighborhood / haversine vs oracles.
+
+Oracle style mirrors reference test/neighbors/*: exact methods are checked
+for exact agreement with a trivially-correct host computation.
+"""
+
+import numpy as np
+import pytest
+from scipy.spatial.distance import cdist
+
+from raft_tpu.neighbors import (
+    eps_neighbors_l2sq,
+    fused_l2_knn,
+    haversine_knn,
+    knn,
+    knn_merge_parts,
+)
+
+
+def ref_knn(index, queries, k, metric="euclidean", **kw):
+    d = cdist(queries, index, metric, **kw)
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(d, idx, axis=1), idx
+
+
+@pytest.mark.parametrize("metric,scipy_metric", [
+    ("euclidean", "euclidean"),
+    ("sqeuclidean", "sqeuclidean"),
+    ("cityblock", "cityblock"),
+    ("cosine", "cosine"),
+    ("chebyshev", "chebyshev"),
+])
+def test_knn_matches_scipy(metric, scipy_metric):
+    rng = np.random.default_rng(0)
+    index = rng.random((500, 16)).astype(np.float32)
+    queries = rng.random((60, 16)).astype(np.float32)
+    k = 10
+    d, i = knn(index, queries, k, metric)
+    rd, ri = ref_knn(index.astype(np.float64), queries.astype(np.float64), k,
+                     scipy_metric)
+    # distances must match; indices may differ only on ties
+    np.testing.assert_allclose(np.array(d), rd, atol=1e-4)
+    same = (np.array(i) == ri).mean()
+    assert same > 0.99
+
+
+def test_knn_tiling_invariance():
+    rng = np.random.default_rng(1)
+    index = rng.random((300, 8)).astype(np.float32)
+    queries = rng.random((40, 8)).astype(np.float32)
+    d1, i1 = knn(index, queries, 5)
+    d2, i2 = knn(index, queries, 5, batch_size_index=64, batch_size_query=16)
+    np.testing.assert_allclose(np.array(d1), np.array(d2), atol=1e-5)
+    np.testing.assert_array_equal(np.array(i1), np.array(i2))
+
+
+def test_fused_l2_knn():
+    rng = np.random.default_rng(2)
+    index = rng.random((200, 12)).astype(np.float32)
+    queries = rng.random((30, 12)).astype(np.float32)
+    d, i = fused_l2_knn(index, queries, 4, sqrt=True)
+    rd, ri = ref_knn(index.astype(np.float64), queries.astype(np.float64), 4)
+    np.testing.assert_allclose(np.array(d), rd, atol=1e-4)
+
+
+def test_knn_merge_parts_equals_global():
+    rng = np.random.default_rng(3)
+    parts = [rng.random((150, 8)).astype(np.float32) for _ in range(3)]
+    queries = rng.random((25, 8)).astype(np.float32)
+    k = 7
+    pd, pi = [], []
+    for p in parts:
+        d, i = knn(p, queries, k)
+        pd.append(d)
+        pi.append(i)
+    offsets = np.cumsum([0] + [p.shape[0] for p in parts[:-1]])
+    md, mi = knn_merge_parts(np.stack(pd), np.stack(pi), k,
+                             translations=offsets.tolist())
+    full = np.concatenate(parts, axis=0)
+    fd, fi = knn(full, queries, k)
+    np.testing.assert_allclose(np.array(md), np.array(fd), atol=1e-5)
+    np.testing.assert_array_equal(np.array(mi), np.array(fi))
+
+
+def test_eps_neighbors():
+    rng = np.random.default_rng(4)
+    x = rng.random((80, 5)).astype(np.float32)
+    y = rng.random((120, 5)).astype(np.float32)
+    eps_sq = 0.3
+    adj, vd = eps_neighbors_l2sq(x, y, eps_sq, batch_size=32)
+    ref = cdist(x, y, "sqeuclidean") <= eps_sq
+    np.testing.assert_array_equal(np.array(adj), ref)
+    np.testing.assert_array_equal(np.array(vd), ref.sum(1))
+
+
+def test_haversine_knn():
+    rng = np.random.default_rng(5)
+    lat = rng.uniform(-np.pi / 2, np.pi / 2, 100)
+    lon = rng.uniform(-np.pi, np.pi, 100)
+    pts = np.stack([lat, lon], axis=1).astype(np.float32)
+    q = pts[:10] + 0.01
+    d, i = haversine_knn(pts, q, 3)
+
+    def hav(a, b):
+        dlat = a[:, None, 0] - b[None, :, 0]
+        dlon = a[:, None, 1] - b[None, :, 1]
+        h = (np.sin(dlat / 2) ** 2 +
+             np.cos(a[:, None, 0]) * np.cos(b[None, :, 0]) *
+             np.sin(dlon / 2) ** 2)
+        return 2 * np.arcsin(np.sqrt(np.clip(h, 0, 1)))
+
+    full = hav(q.astype(np.float64), pts.astype(np.float64))
+    ridx = np.argsort(full, axis=1, kind="stable")[:, :3]
+    rd = np.take_along_axis(full, ridx, axis=1)
+    np.testing.assert_allclose(np.array(d), rd, atol=1e-4)
+    # nearest neighbor of a barely-perturbed point is the point itself
+    assert np.array_equal(np.array(i)[:, 0], np.arange(10))
