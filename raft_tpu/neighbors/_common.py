@@ -1,0 +1,59 @@
+"""Shared helpers for the inverted-list ANN indexes.
+
+The padded-list packing (rank-within-label scatter into static
+(n_lists, capacity) blocks) and host-side trainset subsampling are shared
+by IVF-Flat, IVF-PQ and ball cover — one implementation so a packing fix
+lands everywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def pack_lists(payload, ids, labels, n_lists: int,
+               capacity: Optional[int] = None
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, int]:
+    """Scatter rows into (n_lists, capacity, …) padded blocks.
+
+    *payload* is (n, …) of any dtype; *ids* (n,) int32; *labels* (n,) int32.
+    Returns (data (n_lists, capacity, …), idx (n_lists, capacity) with -1
+    padding, counts (n_lists,) int32, capacity).  Capacity is rounded up to
+    a multiple of 8 (TPU sublane) when derived from the data.
+    """
+    n = payload.shape[0]
+    counts = jnp.bincount(labels, length=n_lists)
+    if capacity is None:
+        capacity = max(8, -(-int(jnp.max(counts)) // 8) * 8)
+    order = jnp.argsort(labels, stable=True)
+    sorted_labels = labels[order]
+    start = jnp.searchsorted(sorted_labels, jnp.arange(n_lists))
+    rank_sorted = jnp.arange(n) - start[sorted_labels]
+    rank = jnp.zeros((n,), jnp.int32).at[order].set(
+        rank_sorted.astype(jnp.int32))
+    flat_pos = labels * capacity + rank
+    tail = payload.shape[1:]
+    data = jnp.zeros((n_lists * capacity,) + tail, payload.dtype
+                     ).at[flat_pos].set(payload)
+    data = data.reshape((n_lists, capacity) + tail)
+    idx = jnp.full((n_lists * capacity,), -1, jnp.int32
+                   ).at[flat_pos].set(jnp.asarray(ids, jnp.int32)
+                                      ).reshape(n_lists, capacity)
+    return data, idx, counts.astype(jnp.int32), capacity
+
+
+def subsample_trainset(x, fraction: float, n_lists: int, seed: int):
+    """Host-side uniform trainset subsample (reference
+    kmeans_trainset_fraction semantics, ivf_flat_build/ivf_pq_build)."""
+    n = x.shape[0]
+    if fraction >= 1.0 or n <= 1024:
+        return x
+    n_train = max(n_lists * 4, int(n * fraction))
+    if n_train >= n:
+        return x
+    sel = np.sort(np.random.default_rng(seed).choice(
+        n, size=n_train, replace=False))
+    return x[jnp.asarray(sel)]

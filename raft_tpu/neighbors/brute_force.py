@@ -38,9 +38,9 @@ def _resolve_metric(metric) -> DistanceType:
     return DistanceType(metric)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
 def _knn_scan(index, queries, k: int, metric: DistanceType,
-              metric_arg: float, tile: int):
+              metric_arg: float, tile: int, select_min: bool):
     """Running top-k over index tiles: never materializes (m, n)."""
     n = index.shape[0]
     n_tiles = max(1, -(-n // tile))
@@ -52,21 +52,21 @@ def _knn_scan(index, queries, k: int, metric: DistanceType,
     bases = (jnp.arange(n_tiles) * tile).astype(jnp.int32)
 
     nq = queries.shape[0]
-    inf = jnp.asarray(jnp.inf, queries.dtype)
+    sentinel = jnp.asarray(jnp.inf if select_min else -jnp.inf, queries.dtype)
 
     def step(carry, xs):
         best_d, best_i = carry
         tile_x, tile_valid, base = xs
         d = _pairwise(queries, tile_x, metric, metric_arg)
-        d = jnp.where(tile_valid[None, :], d, inf)
+        d = jnp.where(tile_valid[None, :], d, sentinel)
         ids = (base + jnp.arange(tile, dtype=jnp.int32))[None, :].repeat(nq, 0)
         merged_d = jnp.concatenate([best_d, d], axis=1)
         merged_i = jnp.concatenate([best_i, ids], axis=1)
-        best_d, best_i = select_k(merged_d, k, select_min=True,
+        best_d, best_i = select_k(merged_d, k, select_min=select_min,
                                   indices=merged_i)
         return (best_d, best_i), None
 
-    init = (jnp.full((nq, k), inf, queries.dtype),
+    init = (jnp.full((nq, k), sentinel, queries.dtype),
             jnp.full((nq, k), -1, jnp.int32))
     (best_d, best_i), _ = jax.lax.scan(step, init, (tiles, vtiles, bases))
     return best_d, best_i
@@ -94,11 +94,14 @@ def knn(index, queries, k: int,
     expects(1 <= k <= index.shape[0],
             f"k={k} must be in [1, n_index={index.shape[0]}]")
     tile = min(batch_size_index, index.shape[0])
+    # InnerProduct is a similarity: kNN selects the LARGEST values
+    # (reference knn_brute_force_faiss.cuh: IP uses a max-selection heap).
+    select_min = metric != DistanceType.InnerProduct
     out_d, out_i = [], []
     for q0 in range(0, queries.shape[0], batch_size_query):
         q1 = min(q0 + batch_size_query, queries.shape[0])
         d, i = _knn_scan(index, queries[q0:q1], int(k), metric,
-                         float(metric_arg), int(tile))
+                         float(metric_arg), int(tile), select_min)
         out_d.append(d)
         out_i.append(i)
     d = out_d[0] if len(out_d) == 1 else jnp.concatenate(out_d, axis=0)
@@ -124,7 +127,8 @@ def fused_l2_knn(index, queries, k: int, sqrt: bool = True,
 
 
 def knn_merge_parts(part_distances, part_indices, k: Optional[int] = None,
-                    translations: Optional[Sequence[int]] = None
+                    translations: Optional[Sequence[int]] = None,
+                    metric: Union[str, DistanceType] = DistanceType.L2SqrtExpanded
                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Merge per-part top-k results into a global top-k.
 
@@ -132,7 +136,10 @@ def knn_merge_parts(part_distances, part_indices, k: Optional[int] = None,
     block-select merge in knn_brute_force_faiss.cuh:66-139): parts are
     (n_parts, n_queries, k) stacked results from sharded indexes;
     *translations* offsets each part's local ids into the global id space.
+    *metric* must match the per-part searches: InnerProduct results are
+    similarities and merge with max-selection.
     """
+    select_min = _resolve_metric(metric) != DistanceType.InnerProduct
     d = jnp.asarray(part_distances)
     i = jnp.asarray(part_indices)
     expects(d.ndim == 3 and i.shape == d.shape,
@@ -148,4 +155,4 @@ def knn_merge_parts(part_distances, part_indices, k: Optional[int] = None,
         i = i + t
     merged_d = jnp.moveaxis(d, 0, 1).reshape(nq, n_parts * in_k)
     merged_i = jnp.moveaxis(i, 0, 1).reshape(nq, n_parts * in_k)
-    return select_k(merged_d, int(k), select_min=True, indices=merged_i)
+    return select_k(merged_d, int(k), select_min=select_min, indices=merged_i)

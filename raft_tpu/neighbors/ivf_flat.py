@@ -1,0 +1,324 @@
+"""IVF-Flat approximate nearest-neighbor index.
+
+Counterpart of reference ``neighbors/ivf_flat.cuh`` +
+``spatial/knn/detail/ivf_flat_{build,search}.cuh`` (SURVEY.md §2.8):
+coarse k-means quantizer (balanced hierarchical, ann_kmeans_balanced.cuh:942)
+→ inverted lists of raw vectors → search = coarse GEMM + top-n_probes +
+masked list scan + final top-k.
+
+TPU-first redesign of the storage layout: the reference packs each list in
+interleaved groups of ``kIndexGroupSize = 32·veclen`` rows tuned for warp
+coalescing (ivf_flat_types.hpp:58-109) — a CUDA-ism.  Here every list is a
+row-block of one dense (n_lists, list_capacity, dim) array padded to a
+lane-friendly capacity (multiple of 8): each (query, probe) scan step is a
+(capacity × dim)·(dim) contraction the MXU tiles natively, and padding is
+masked with +inf distances.  Ragged lists become static shapes — the XLA
+requirement SURVEY.md §7 calls out — at the cost of measured padding waste
+(`Index.padding_fraction`).
+
+Supported dtypes mirror the reference (f32 + int8/uint8 storage with f32
+compute); supported metrics: L2Expanded/L2SqrtExpanded/InnerProduct/
+CosineExpanded (cosine = IP on normalized vectors, as in the reference
+search prologue ivf_flat_search.cuh:1120).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.cluster import build_hierarchical, min_cluster_and_distance
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.matrix.select_k import select_k
+from raft_tpu.neighbors._common import pack_lists, subsample_trainset
+from raft_tpu.random.rng import RngState
+
+_SUPPORTED = (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+              DistanceType.InnerProduct, DistanceType.CosineExpanded)
+
+
+@dataclasses.dataclass
+class IndexParams:
+    """Reference ``ivf_flat::index_params`` (ivf_flat_types.hpp:30)."""
+
+    n_lists: int = 1024
+    metric: DistanceType = DistanceType.L2Expanded
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    adaptive_centers: bool = False
+    add_data_on_build: bool = True
+    seed: int = 1234
+
+
+@dataclasses.dataclass
+class SearchParams:
+    """Reference ``ivf_flat::search_params`` (ivf_flat_types.hpp:118)."""
+
+    n_probes: int = 20
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Index:
+    """IVF-Flat index: padded dense inverted lists.
+
+    ``list_data``    (n_lists, capacity, dim) — stored vectors (storage dtype)
+    ``list_indices`` (n_lists, capacity) int32 — source ids, -1 at padding
+    ``list_sizes``   (n_lists,) int32
+    ``centers``      (n_lists, dim) f32 coarse centroids
+    """
+
+    centers: jnp.ndarray
+    list_data: jnp.ndarray
+    list_indices: jnp.ndarray
+    list_sizes: jnp.ndarray
+    metric: DistanceType
+    adaptive_centers: bool = False
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def capacity(self) -> int:
+        return self.list_data.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(jnp.sum(self.list_sizes))
+
+    @property
+    def padding_fraction(self) -> float:
+        """Fraction of allocated list slots that are padding — the metric
+        SURVEY.md §7 says to measure for the padded-list design."""
+        total = self.n_lists * self.capacity
+        return 1.0 - self.size / max(total, 1)
+
+    def tree_flatten(self):
+        leaves = (self.centers, self.list_data, self.list_indices,
+                  self.list_sizes)
+        return leaves, (self.metric, self.adaptive_centers)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, metric=aux[0], adaptive_centers=aux[1])
+
+
+def _normalize_rows(x):
+    n = jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-30)
+    return x / n
+
+
+def _compute_dtype(x):
+    return jnp.float32 if x.dtype in (jnp.int8, jnp.uint8) else x.dtype
+
+
+def _assign_lists(q, centers, metric: DistanceType) -> jnp.ndarray:
+    """Assign vectors to lists consistently with how search ranks probes:
+    max-dot for InnerProduct/Cosine (q pre-normalized for cosine), else
+    min-L2 via the fused path."""
+    if metric in (DistanceType.InnerProduct, DistanceType.CosineExpanded):
+        c = _normalize_rows(centers) if metric == DistanceType.CosineExpanded \
+            else centers
+        return jnp.argmax(q @ c.T.astype(q.dtype), axis=1).astype(jnp.int32)
+    return min_cluster_and_distance(q, centers).key.astype(jnp.int32)
+
+
+def build(params: IndexParams, dataset, ids=None) -> Index:
+    """Train + populate an IVF-Flat index (reference ``ivf_flat::build``,
+    neighbors/ivf_flat.cuh:64 → ivf_flat_build.cuh:228)."""
+    x = jnp.asarray(dataset)
+    expects(x.ndim == 2, "dataset must be (n, dim)")
+    expects(params.metric in _SUPPORTED,
+            f"ivf_flat: unsupported metric {params.metric}")
+    n = x.shape[0]
+    n_lists = min(params.n_lists, n)
+    xf = x.astype(_compute_dtype(x))
+    train = subsample_trainset(xf, params.kmeans_trainset_fraction, n_lists,
+                               params.seed)
+    cx = _normalize_rows(train) if params.metric == DistanceType.CosineExpanded else train
+    centers = build_hierarchical(RngState(params.seed), cx, n_lists,
+                                 params.kmeans_n_iters)
+    index = Index(centers=centers,
+                  list_data=jnp.zeros((n_lists, 8, x.shape[1]), x.dtype),
+                  list_indices=jnp.full((n_lists, 8), -1, jnp.int32),
+                  list_sizes=jnp.zeros((n_lists,), jnp.int32),
+                  metric=params.metric,
+                  adaptive_centers=params.adaptive_centers)
+    if params.add_data_on_build:
+        index = extend(index, x, ids)
+    return index
+
+
+def extend(index: Index, new_vectors, new_ids=None) -> Index:
+    """Add vectors to an existing index (reference ``ivf_flat::extend``,
+    ivf_flat_build.cuh:108).  Functional: returns a new Index (repacks the
+    padded lists; the reference reallocates lists likewise)."""
+    xa = jnp.asarray(new_vectors)
+    expects(xa.ndim == 2 and xa.shape[1] == index.dim, "dim mismatch")
+    n_new = xa.shape[0]
+    base = index.size
+    if new_ids is None:
+        new_ids = jnp.arange(base, base + n_new, dtype=jnp.int32)
+    else:
+        new_ids = jnp.asarray(new_ids, jnp.int32)
+        expects(new_ids.shape == (n_new,), "ids must be (n_new,)")
+
+    xf = xa.astype(_compute_dtype(xa))
+    q = _normalize_rows(xf) if index.metric == DistanceType.CosineExpanded else xf
+    labels = _assign_lists(q, index.centers, index.metric)
+
+    # merge with existing live rows
+    if base:
+        old_mask = index.list_indices.reshape(-1) >= 0
+        old_flat_data = index.list_data.reshape(-1, index.dim)[old_mask]
+        old_flat_ids = index.list_indices.reshape(-1)[old_mask]
+        old_labels = jnp.repeat(jnp.arange(index.n_lists, dtype=jnp.int32),
+                                index.capacity)[old_mask]
+        all_data = jnp.concatenate(
+            [old_flat_data, xa.astype(old_flat_data.dtype)], axis=0)
+        all_ids = jnp.concatenate([old_flat_ids, new_ids])
+        all_labels = jnp.concatenate([old_labels, labels])
+    else:
+        all_data, all_ids, all_labels = xa, new_ids, labels
+
+    data, idx, sizes, _ = pack_lists(all_data, all_ids, all_labels,
+                                     index.n_lists)
+    centers = index.centers
+    if index.adaptive_centers:
+        # drift centers toward the mean of their members (reference
+        # ivf_flat_build.cuh extend with adaptive_centers=true)
+        sums = jax.ops.segment_sum(
+            all_data.astype(centers.dtype), all_labels,
+            num_segments=index.n_lists)
+        cnt = jnp.maximum(sizes.astype(centers.dtype), 1)[:, None]
+        centers = jnp.where(sizes[:, None] > 0, sums / cnt, centers)
+    return Index(centers=centers, list_data=data, list_indices=idx,
+                 list_sizes=sizes, metric=index.metric,
+                 adaptive_centers=index.adaptive_centers)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def _scan_probes(queries, probe_ids, index_leaves, metric_val: int, k: int,
+                 sqrt: bool):
+    """Score all probed lists for a query batch and select top-k.
+
+    One `lax.scan` step per probe rank: gathers each query's p-th probed
+    list (nq, capacity, dim) and contracts it against the queries — the
+    TPU analogue of the reference's per-(query, probe) interleaved scan
+    blocks (ivf_flat_search.cuh:658-782), with the running top-k merge
+    playing the role of the in-kernel warp-sort queues.
+    """
+    centers, list_data, list_indices, list_sizes = index_leaves
+    nq = queries.shape[0]
+    cap = list_data.shape[1]
+    is_ip = metric_val == int(DistanceType.InnerProduct)
+    is_cos = metric_val == int(DistanceType.CosineExpanded)
+    select_min = not is_ip  # IP is a similarity: select largest
+    sentinel = jnp.asarray(jnp.inf if select_min else -jnp.inf, queries.dtype)
+
+    def step(carry, probe_col):
+        best_d, best_i = carry
+        lists = probe_col                                   # (nq,) list ids
+        data = list_data[lists].astype(queries.dtype)       # (nq, cap, dim)
+        ids = list_indices[lists]                           # (nq, cap)
+        sizes = list_sizes[lists]                           # (nq,)
+        dots = jnp.einsum("qd,qcd->qc", queries, data,
+                          preferred_element_type=queries.dtype)
+        if is_ip:
+            d = dots
+        elif is_cos:
+            # queries are pre-normalized; normalize stored vectors here
+            xn = jnp.sqrt(jnp.maximum(jnp.sum(data ** 2, axis=-1), 1e-30))
+            d = 1.0 - dots / xn
+        else:
+            xn = jnp.sum(data ** 2, axis=-1)
+            qn = jnp.sum(queries ** 2, axis=-1, keepdims=True)
+            d = qn + xn - 2.0 * dots
+        live = jnp.arange(cap)[None, :] < sizes[:, None]
+        d = jnp.where(live, d, sentinel)
+        merged_d = jnp.concatenate([best_d, d], axis=1)
+        merged_i = jnp.concatenate([best_i, ids], axis=1)
+        best_d, best_i = select_k(merged_d, k, select_min=select_min,
+                                  indices=merged_i)
+        return (best_d, best_i), None
+
+    init = (jnp.full((nq, k), sentinel, queries.dtype),
+            jnp.full((nq, k), -1, jnp.int32))
+    (best_d, best_i), _ = jax.lax.scan(step, init,
+                                       jnp.swapaxes(probe_ids, 0, 1))
+    if sqrt:
+        best_d = jnp.sqrt(jnp.maximum(best_d, 0))
+    return best_d, best_i
+
+
+def search(params: SearchParams, index: Index, queries, k: int,
+           *, batch_size_query: int = 1024
+           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Search the index (reference ``ivf_flat::search``,
+    neighbors/ivf_flat.cuh:325 → ivf_flat_search.cuh:1057):
+    coarse GEMM → top-n_probes lists → masked list scans → final top-k.
+
+    Returns (distances [nq, k], indices [nq, k]).
+    """
+    q = jnp.asarray(queries)
+    expects(q.ndim == 2 and q.shape[1] == index.dim, "query dim mismatch")
+    n_probes = min(params.n_probes, index.n_lists)
+    expects(k >= 1, "k must be >= 1")
+    qf = q.astype(_compute_dtype(q))
+    if index.metric == DistanceType.CosineExpanded:
+        qf = _normalize_rows(qf)
+    sqrt = index.metric == DistanceType.L2SqrtExpanded
+    leaves = (index.centers, index.list_data, index.list_indices,
+              index.list_sizes)
+    out_d, out_i = [], []
+    for q0 in range(0, qf.shape[0], batch_size_query):
+        q1 = min(q0 + batch_size_query, qf.shape[0])
+        qb = qf[q0:q1]
+        # coarse ranking against centroids (reference :1120 linalg::gemm)
+        cd = _coarse_distances(qb, index.centers, index.metric)
+        _, probes = select_k(cd, n_probes, select_min=True)
+        d, i = _scan_probes(qb, probes.astype(jnp.int32), leaves,
+                            int(index.metric), int(k), sqrt)
+        out_d.append(d)
+        out_i.append(i)
+    d = out_d[0] if len(out_d) == 1 else jnp.concatenate(out_d, axis=0)
+    i = out_i[0] if len(out_i) == 1 else jnp.concatenate(out_i, axis=0)
+    return d, i
+
+
+@jax.jit
+def _coarse_l2(q, centers):
+    qn = jnp.sum(q ** 2, axis=1, keepdims=True)
+    cn = jnp.sum(centers ** 2, axis=1)
+    return qn + cn[None, :] - 2.0 * (q @ centers.T)
+
+
+def _coarse_distances(q, centers, metric: DistanceType):
+    centers = centers.astype(q.dtype)
+    if metric == DistanceType.CosineExpanded:
+        centers = _normalize_rows(centers)
+        return -(q @ centers.T)
+    if metric == DistanceType.InnerProduct:
+        return -(q @ centers.T)
+    return _coarse_l2(q, centers)
+
+
+def build_and_search(dataset, queries, k: int,
+                     index_params: Optional[IndexParams] = None,
+                     search_params: Optional[SearchParams] = None):
+    """Convenience one-shot (used by tests/benchmarks)."""
+    ip = index_params or IndexParams()
+    sp = search_params or SearchParams()
+    idx = build(ip, dataset)
+    return search(sp, idx, queries, k)

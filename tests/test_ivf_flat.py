@@ -1,0 +1,101 @@
+"""IVF-Flat: recall-threshold tests vs brute force (the reference's ANN
+test pattern — test/neighbors/ann_ivf_pq.cuh min_recall gates)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.neighbors import knn
+from raft_tpu.neighbors.ivf_flat import (
+    Index,
+    IndexParams,
+    SearchParams,
+    build,
+    extend,
+    search,
+)
+
+
+def make_data(n=3000, dim=24, n_queries=64, seed=0, clusters=40):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 5, (clusters, dim))
+    assign = rng.integers(0, clusters, n)
+    x = (centers[assign] + rng.normal(0, 1, (n, dim))).astype(np.float32)
+    q = (centers[rng.integers(0, clusters, n_queries)] +
+         rng.normal(0, 1, (n_queries, dim))).astype(np.float32)
+    return x, q
+
+
+def recall(found, truth):
+    hits = 0
+    for f, t in zip(np.asarray(found), np.asarray(truth)):
+        hits += len(set(f.tolist()) & set(t.tolist()))
+    return hits / truth.size
+
+
+@pytest.mark.parametrize("metric,min_recall", [
+    (DistanceType.L2Expanded, 0.95),
+    (DistanceType.L2SqrtExpanded, 0.95),
+    (DistanceType.InnerProduct, 0.9),
+    (DistanceType.CosineExpanded, 0.9),
+])
+def test_ivf_flat_recall(metric, min_recall):
+    x, q = make_data()
+    k = 10
+    params = IndexParams(n_lists=64, metric=metric, seed=3)
+    idx = build(params, x)
+    assert idx.size == x.shape[0]
+    d, i = search(SearchParams(n_probes=16), idx, q, k)
+    if metric == DistanceType.InnerProduct:
+        bf_metric = DistanceType.InnerProduct
+    elif metric == DistanceType.CosineExpanded:
+        bf_metric = DistanceType.CosineExpanded
+    else:
+        bf_metric = DistanceType.L2Expanded
+    _, ti = knn(x, q, k, bf_metric)
+    assert recall(i, np.array(ti)) >= min_recall
+
+
+def test_ivf_flat_full_probes_is_exact():
+    x, q = make_data(n=1200, dim=16, n_queries=32)
+    k = 8
+    idx = build(IndexParams(n_lists=32, metric=DistanceType.L2Expanded), x)
+    d, i = search(SearchParams(n_probes=32), idx, q, k)  # probe everything
+    td, ti = knn(x, q, k, DistanceType.L2Expanded)
+    assert recall(i, np.array(ti)) == 1.0
+    np.testing.assert_allclose(np.sort(np.array(d), 1),
+                               np.sort(np.array(td), 1), rtol=1e-3, atol=1e-3)
+
+
+def test_ivf_flat_extend():
+    x, q = make_data(n=2000, dim=16)
+    half = 1000
+    params = IndexParams(n_lists=32, metric=DistanceType.L2Expanded,
+                         add_data_on_build=False)
+    idx = build(params, x)
+    assert idx.size == 0
+    idx = extend(idx, x[:half])
+    assert idx.size == half
+    idx = extend(idx, x[half:], new_ids=np.arange(half, 2000, dtype=np.int32))
+    assert idx.size == 2000
+    d, i = search(SearchParams(n_probes=32), idx, q, 5)
+    _, ti = knn(x, q, 5, DistanceType.L2Expanded)
+    assert recall(i, np.array(ti)) == 1.0
+
+
+def test_ivf_flat_int8_storage():
+    rng = np.random.default_rng(7)
+    x = rng.integers(-100, 100, (800, 16)).astype(np.int8)
+    q = x[:20]
+    idx = build(IndexParams(n_lists=16, metric=DistanceType.L2Expanded), x)
+    assert idx.list_data.dtype == np.int8
+    d, i = search(SearchParams(n_probes=16), idx, q, 1)
+    # each query is its own nearest neighbor at distance 0
+    np.testing.assert_array_equal(np.array(i)[:, 0], np.arange(20))
+    np.testing.assert_allclose(np.array(d)[:, 0], 0.0, atol=1e-3)
+
+
+def test_ivf_flat_padding_metric():
+    x, _ = make_data(n=1000, dim=8)
+    idx = build(IndexParams(n_lists=16), x)
+    assert 0.0 <= idx.padding_fraction < 0.95
