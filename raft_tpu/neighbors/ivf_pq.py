@@ -1,0 +1,421 @@
+"""IVF-PQ approximate nearest-neighbor index.
+
+Counterpart of reference ``neighbors/ivf_pq.cuh`` +
+``spatial/knn/detail/ivf_pq_{build,search}.cuh`` (SURVEY.md §2.8):
+two-level quantization ``y ≈ Q1(y) + Q2(y − Q1(y))`` — coarse k-means
+centers + product-quantized residuals — with search-time per-query lookup
+tables.
+
+Parameter surface mirrors ``ivf_pq_types.hpp:30-120``: ``pq_bits`` 4–8,
+``pq_dim`` (0 → heuristic), ``codebook_kind`` PER_SUBSPACE/PER_CLUSTER,
+``force_random_rotation``; search: ``n_probes``, ``lut_dtype``
+(f32/bf16/f16), ``internal_distance_dtype``.
+
+TPU-first redesign:
+- The reference stores codes in a bit-packed interleaved layout and scores
+  them with 15 precompiled CUDA kernel variants holding the LUT in shared
+  memory (ivf_pq_search.cuh:594-738).  Here codes live in padded dense
+  (n_lists, capacity, pq_dim) uint8 blocks; the LUT is a per-(query-batch)
+  (nq, pq_dim, 2^bits) array resident in VMEM during the scoring gather,
+  and scoring is ``Σ_m LUT[q, m, code[q, c, m]]`` — a take_along_axis XLA
+  fuses with the running top-k merge.
+- Codebook training is Lloyd k-means ``vmap``-ed over subspaces (or over
+  clusters for PER_CLUSTER) — all codebooks train simultaneously on the
+  MXU instead of the reference's sequential per-subspace loop.
+- The random rotation is a QR-orthonormalized Gaussian (dim, rot_dim)
+  matrix, applied as one GEMM (the reference multiplies by the same kind
+  of matrix in ivf_pq_build).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.cluster import build_hierarchical, min_cluster_and_distance
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.matrix.select_k import select_k
+from raft_tpu.neighbors._common import pack_lists, subsample_trainset
+from raft_tpu.random.rng import RngState
+
+_SUPPORTED = (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+              DistanceType.InnerProduct)
+
+_LUT_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+               "float16": jnp.float16}
+
+
+class CodebookKind(enum.IntEnum):
+    """Reference ``codebook_gen`` (ivf_pq_types.hpp:31)."""
+
+    PER_SUBSPACE = 0
+    PER_CLUSTER = 1
+
+
+@dataclasses.dataclass
+class IndexParams:
+    """Reference ``ivf_pq::index_params`` (ivf_pq_types.hpp:36)."""
+
+    n_lists: int = 1024
+    metric: DistanceType = DistanceType.L2Expanded
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    pq_bits: int = 8
+    pq_dim: int = 0          # 0 → heuristic (ivf_pq_build calc_pq_dim)
+    codebook_kind: CodebookKind = CodebookKind.PER_SUBSPACE
+    force_random_rotation: bool = False
+    seed: int = 1234
+
+
+@dataclasses.dataclass
+class SearchParams:
+    """Reference ``ivf_pq::search_params`` (ivf_pq_types.hpp:88)."""
+
+    n_probes: int = 20
+    lut_dtype: str = "float32"              # float32 | bfloat16 | float16
+    internal_distance_dtype: str = "float32"  # float32 | float16
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Index:
+    """IVF-PQ index.
+
+    ``centers``   (n_lists, dim) f32 coarse centroids (original space)
+    ``rotation``  (dim, rot_dim) orthonormal transform
+    ``codebooks`` PER_SUBSPACE: (pq_dim, 2^bits, ds); PER_CLUSTER:
+                  (n_lists, 2^bits, ds) — ds = rot_dim // pq_dim
+    ``list_codes``   (n_lists, capacity, pq_dim) uint8
+    ``list_indices`` (n_lists, capacity) int32, -1 padding
+    ``list_sizes``   (n_lists,) int32
+    """
+
+    centers: jnp.ndarray
+    rotation: jnp.ndarray
+    codebooks: jnp.ndarray
+    list_codes: jnp.ndarray
+    list_indices: jnp.ndarray
+    list_sizes: jnp.ndarray
+    metric: DistanceType
+    codebook_kind: CodebookKind
+    pq_bits: int
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def rot_dim(self) -> int:
+        return self.rotation.shape[1]
+
+    @property
+    def pq_dim(self) -> int:
+        return self.list_codes.shape[2]
+
+    @property
+    def pq_len(self) -> int:
+        return self.codebooks.shape[2]
+
+    @property
+    def capacity(self) -> int:
+        return self.list_codes.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(jnp.sum(self.list_sizes))
+
+    def tree_flatten(self):
+        leaves = (self.centers, self.rotation, self.codebooks,
+                  self.list_codes, self.list_indices, self.list_sizes)
+        return leaves, (self.metric, self.codebook_kind, self.pq_bits)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, metric=aux[0], codebook_kind=aux[1],
+                   pq_bits=aux[2])
+
+
+def _calc_pq_dim(dim: int) -> int:
+    """Heuristic for pq_dim when 0 (reference ivf_pq_build ``calc_pq_dim``:
+    roughly dim/2 rounded to a power-of-two-friendly multiple of 8)."""
+    d = max(1, dim // 2)
+    if d >= 8:
+        d = -(-d // 8) * 8
+    return d
+
+
+def _make_rotation(key, dim: int, rot_dim: int, random: bool) -> jnp.ndarray:
+    if not random and dim == rot_dim:
+        return jnp.eye(dim, dtype=jnp.float32)
+    g = jax.random.normal(key, (max(dim, rot_dim), max(dim, rot_dim)),
+                          jnp.float32)
+    q, _ = jnp.linalg.qr(g)
+    return q[:dim, :rot_dim]
+
+
+def _lloyd_kmeans(key, data, k: int, iters: int):
+    """Plain Lloyd k-means for codebook training (vmappable).
+
+    data: (n, d) → centers (k, d).  The reference trains PQ codebooks with
+    the same balanced-kmeans machinery; plain Lloyd on residual subvectors
+    converges equally well here and vmaps cleanly over codebooks.
+    """
+    n = data.shape[0]
+    sel = jax.random.choice(key, n, (k,), replace=n < k)
+    centers = data[sel]
+
+    def step(centers, _):
+        d = (jnp.sum(data ** 2, 1, keepdims=True)
+             + jnp.sum(centers ** 2, 1)[None, :]
+             - 2.0 * data @ centers.T)
+        labels = jnp.argmin(d, axis=1)
+        sums = jax.ops.segment_sum(data, labels, num_segments=k)
+        cnt = jnp.bincount(labels, length=k).astype(data.dtype)
+        new = jnp.where(cnt[:, None] > 0, sums / jnp.maximum(cnt, 1)[:, None],
+                        centers)
+        return new, None
+
+    centers, _ = jax.lax.scan(step, centers, None, length=iters)
+    return centers
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _train_codebooks_subspace(key, residuals, pq_dim: int, k: int,
+                              iters: int):
+    """PER_SUBSPACE: one codebook per subspace (pq_dim, k, ds)."""
+    n, rot_dim = residuals.shape
+    ds = rot_dim // pq_dim
+    sub = residuals.reshape(n, pq_dim, ds).swapaxes(0, 1)  # (pq_dim, n, ds)
+    keys = jax.random.split(key, pq_dim)
+    return jax.vmap(lambda kk, d: _lloyd_kmeans(kk, d, k, iters))(keys, sub)
+
+
+def _train_codebooks_cluster_host(key, residuals_np, labels_np,
+                                  n_lists: int, pq_dim: int, k: int,
+                                  iters: int):
+    """PER_CLUSTER training driven from host: groups are ragged, so build
+    fixed-size per-cluster sample matrices host-side, then one vmapped
+    Lloyd over clusters on device."""
+    n, rot_dim = residuals_np.shape
+    ds = rot_dim // pq_dim
+    sub = residuals_np.reshape(n, pq_dim, ds)
+    cap = max(k * 4, 256)
+    rng = np.random.default_rng(int(jax.random.randint(key, (), 0, 2**31 - 1)))
+    batches = np.zeros((n_lists, cap, ds), np.float32)
+    for c in range(n_lists):
+        rows = np.nonzero(labels_np == c)[0]
+        if rows.size == 0:
+            continue
+        pool = sub[rows].reshape(-1, ds)
+        take = rng.choice(pool.shape[0], size=cap,
+                          replace=pool.shape[0] < cap)
+        batches[c] = pool[take]
+    keys = jax.random.split(key, n_lists)
+    return jax.jit(jax.vmap(
+        lambda kk, d: _lloyd_kmeans(kk, d, k, iters)))(keys,
+                                                       jnp.asarray(batches))
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _encode(residuals, codebooks, labels, per_cluster: bool):
+    """PQ-encode rotated residuals → (n, pq_dim) uint8."""
+    n, rot_dim = residuals.shape
+    if per_cluster:
+        k = codebooks.shape[1]
+        ds = codebooks.shape[2]
+        pq_dim = rot_dim // ds
+        sub = residuals.reshape(n, pq_dim, ds)
+        cb = codebooks[labels]                          # (n, k, ds)
+        d = (jnp.sum(sub ** 2, -1)[:, :, None]
+             + jnp.sum(cb ** 2, -1)[:, None, :]
+             - 2.0 * jnp.einsum("nmd,nkd->nmk", sub, cb))
+        return jnp.argmin(d, axis=-1).astype(jnp.uint8)
+    pq_dim, k, ds = codebooks.shape
+    sub = residuals.reshape(n, pq_dim, ds)
+    d = (jnp.sum(sub ** 2, -1)[:, :, None]
+         + jnp.sum(codebooks ** 2, -1)[None, :, :]
+         - 2.0 * jnp.einsum("nmd,mkd->nmk", sub, codebooks))
+    return jnp.argmin(d, axis=-1).astype(jnp.uint8)
+
+
+def build(params: IndexParams, dataset, ids=None) -> Index:
+    """Train + populate (reference ``ivf_pq::build``, ivf_pq_build.cuh)."""
+    x = jnp.asarray(dataset, jnp.float32)
+    expects(x.ndim == 2, "dataset must be (n, dim)")
+    expects(params.metric in _SUPPORTED,
+            f"ivf_pq: unsupported metric {params.metric}")
+    expects(4 <= params.pq_bits <= 8,
+            "pq_bits must be in [4, 8] (ivf_pq_types.hpp:52)")
+    n, dim = x.shape
+    n_lists = min(params.n_lists, n)
+    pq_dim = params.pq_dim or _calc_pq_dim(dim)
+    rot_dim = -(-dim // pq_dim) * pq_dim
+    k = 1 << params.pq_bits
+    key = jax.random.PRNGKey(params.seed)
+    k_rot, k_cb = jax.random.split(key)
+
+    # 1) coarse quantizer
+    train = subsample_trainset(x, params.kmeans_trainset_fraction, n_lists,
+                               params.seed)
+    centers = build_hierarchical(RngState(params.seed), train, n_lists,
+                                 params.kmeans_n_iters)
+
+    # 2) rotation
+    rotation = _make_rotation(k_rot, dim, rot_dim,
+                              params.force_random_rotation or rot_dim != dim)
+
+    # 3) residuals in rotated space.  Assignment must agree with how
+    # search ranks probe lists: max-dot for InnerProduct, else min-L2.
+    if params.metric == DistanceType.InnerProduct:
+        labels = jnp.argmax(x @ centers.T, axis=1).astype(jnp.int32)
+    else:
+        labels = min_cluster_and_distance(x, centers).key.astype(jnp.int32)
+    resid = (x - centers[labels]) @ rotation          # (n, rot_dim)
+
+    # 4) codebooks
+    if params.codebook_kind == CodebookKind.PER_CLUSTER:
+        codebooks = _train_codebooks_cluster_host(
+            k_cb, np.asarray(resid), np.asarray(labels), n_lists, pq_dim,
+            k, params.kmeans_n_iters)
+    else:
+        codebooks = _train_codebooks_subspace(k_cb, resid, pq_dim, k,
+                                              params.kmeans_n_iters)
+
+    # 5) encode + pack
+    codes = _encode(resid, codebooks, labels,
+                    params.codebook_kind == CodebookKind.PER_CLUSTER)
+    if ids is None:
+        ids = jnp.arange(n, dtype=jnp.int32)
+    else:
+        ids = jnp.asarray(ids, jnp.int32)
+    list_codes, list_indices, list_sizes, _ = pack_lists(
+        codes, ids, labels, n_lists)
+    return Index(centers=centers, rotation=rotation, codebooks=codebooks,
+                 list_codes=list_codes, list_indices=list_indices,
+                 list_sizes=list_sizes, metric=params.metric,
+                 codebook_kind=params.codebook_kind, pq_bits=params.pq_bits)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7))
+def _search_batch(q, probe_ids, leaves, metric_val: int, k: int,
+                  per_cluster: bool, lut_dtype_name: str, int_dtype_name: str):
+    """Score probed lists via per-query LUTs (reference similarity kernels
+    ivf_pq_search.cuh:594-738) with a running top-k merge."""
+    centers, rotation, codebooks, list_codes, list_indices, list_sizes = leaves
+    nq = q.shape[0]
+    cap = list_codes.shape[1]
+    is_ip = metric_val == int(DistanceType.InnerProduct)
+    lut_dtype = _LUT_DTYPES[lut_dtype_name]
+    acc_dtype = _LUT_DTYPES.get(int_dtype_name, jnp.float32)
+    select_min = not is_ip
+    sentinel = jnp.asarray(jnp.inf if select_min else -jnp.inf, jnp.float32)
+
+    rot_q = q @ rotation                                  # (nq, rot_dim)
+    rot_centers = centers @ rotation                      # (n_lists, rot_dim)
+    if per_cluster:
+        kcb, ds = codebooks.shape[1], codebooks.shape[2]
+        pq_dim = rot_q.shape[1] // ds
+    else:
+        pq_dim, kcb, ds = codebooks.shape
+
+    def step(carry, probe_col):
+        best_d, best_i = carry
+        lists = probe_col                                  # (nq,)
+        c_rot = rot_centers[lists]                         # (nq, rot_dim)
+        r = (rot_q - c_rot).reshape(nq, pq_dim, ds)        # query residual
+        cb = (codebooks[lists] if per_cluster else codebooks)
+        if is_ip:
+            # score = q·(c + code) = q·c + Σ_m q_m·cb  → LUT of dots
+            if per_cluster:
+                lut = jnp.einsum("qmd,qkd->qmk", rot_q.reshape(nq, pq_dim, ds),
+                                 cb)
+            else:
+                lut = jnp.einsum("qmd,mkd->qmk", rot_q.reshape(nq, pq_dim, ds),
+                                 cb)
+            base = jnp.sum(q * centers[lists], axis=-1)    # (nq,)
+        else:
+            # score = ||r − code||² summed over subspaces
+            if per_cluster:
+                lut = (jnp.sum(r ** 2, -1)[:, :, None]
+                       + jnp.sum(cb ** 2, -1)[:, None, :]
+                       - 2.0 * jnp.einsum("qmd,qkd->qmk", r, cb))
+            else:
+                lut = (jnp.sum(r ** 2, -1)[:, :, None]
+                       + jnp.sum(cb ** 2, -1)[None, :, :]
+                       - 2.0 * jnp.einsum("qmd,mkd->qmk", r, cb))
+            base = jnp.zeros((nq,), jnp.float32)
+        lut = lut.astype(lut_dtype)                        # (nq, pq_dim, kcb)
+        codes = list_codes[lists].astype(jnp.int32)        # (nq, cap, pq_dim)
+        ids = list_indices[lists]
+        sizes = list_sizes[lists]
+        # gather-sum: out[q, c] = Σ_m lut[q, m, codes[q, c, m]]
+        g = jnp.take_along_axis(
+            lut[:, None, :, :].astype(acc_dtype),
+            codes[:, :, :, None], axis=3)[..., 0]          # (nq, cap, pq_dim)
+        d = jnp.sum(g, axis=-1).astype(jnp.float32) + base[:, None]
+        live = jnp.arange(cap)[None, :] < sizes[:, None]
+        d = jnp.where(live, d, sentinel)
+        merged_d = jnp.concatenate([best_d, d], axis=1)
+        merged_i = jnp.concatenate([best_i, ids], axis=1)
+        best_d, best_i = select_k(merged_d, k, select_min=select_min,
+                                  indices=merged_i)
+        return (best_d, best_i), None
+
+    init = (jnp.full((nq, k), sentinel, jnp.float32),
+            jnp.full((nq, k), -1, jnp.int32))
+    (best_d, best_i), _ = jax.lax.scan(step, init,
+                                       jnp.swapaxes(probe_ids, 0, 1))
+    if metric_val == int(DistanceType.L2SqrtExpanded):
+        best_d = jnp.sqrt(jnp.maximum(best_d, 0))
+    return best_d, best_i
+
+
+def search(params: SearchParams, index: Index, queries, k: int,
+           *, batch_size_query: int = 1024
+           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Search (reference ``ivf_pq::search``, ivf_pq_search.cuh:780):
+    coarse top-n_probes → per-probe LUT scoring → top-k.
+
+    Returns (distances [nq, k], indices [nq, k]).  Distances are
+    PQ-approximate, as in the reference.
+    """
+    q = jnp.asarray(queries, jnp.float32)
+    expects(q.ndim == 2 and q.shape[1] == index.dim, "query dim mismatch")
+    expects(params.lut_dtype in _LUT_DTYPES,
+            f"lut_dtype must be one of {list(_LUT_DTYPES)}")
+    n_probes = min(params.n_probes, index.n_lists)
+    is_ip = index.metric == DistanceType.InnerProduct
+    leaves = (index.centers, index.rotation, index.codebooks,
+              index.list_codes, index.list_indices, index.list_sizes)
+    out_d, out_i = [], []
+    for q0 in range(0, q.shape[0], batch_size_query):
+        q1 = min(q0 + batch_size_query, q.shape[0])
+        qb = q[q0:q1]
+        if is_ip:
+            coarse = -(qb @ index.centers.T)
+        else:
+            coarse = (jnp.sum(qb ** 2, 1, keepdims=True)
+                      + jnp.sum(index.centers ** 2, 1)[None, :]
+                      - 2.0 * qb @ index.centers.T)
+        _, probes = select_k(coarse, n_probes, select_min=True)
+        d, i = _search_batch(qb, probes.astype(jnp.int32), leaves,
+                             int(index.metric), int(k),
+                             index.codebook_kind == CodebookKind.PER_CLUSTER,
+                             params.lut_dtype,
+                             params.internal_distance_dtype)
+        out_d.append(d)
+        out_i.append(i)
+    d = out_d[0] if len(out_d) == 1 else jnp.concatenate(out_d, axis=0)
+    i = out_i[0] if len(out_i) == 1 else jnp.concatenate(out_i, axis=0)
+    return d, i

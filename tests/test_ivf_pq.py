@@ -1,0 +1,106 @@
+"""IVF-PQ: recall-threshold tests vs brute force (reference pattern
+test/neighbors/ann_ivf_pq.cuh per-config min_recall gates)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.neighbors import knn
+from raft_tpu.neighbors.ivf_pq import (
+    CodebookKind,
+    IndexParams,
+    SearchParams,
+    build,
+    search,
+)
+
+
+def make_data(n=4000, dim=32, n_queries=64, seed=0, clusters=50):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 5, (clusters, dim))
+    assign = rng.integers(0, clusters, n)
+    x = (centers[assign] + rng.normal(0, 1, (n, dim))).astype(np.float32)
+    q = (centers[rng.integers(0, clusters, n_queries)] +
+         rng.normal(0, 1, (n_queries, dim))).astype(np.float32)
+    return x, q
+
+
+def recall(found, truth):
+    hits = 0
+    for f, t in zip(np.asarray(found), np.asarray(truth)):
+        hits += len(set(f.tolist()) & set(t.tolist()))
+    return hits / truth.size
+
+
+@pytest.mark.parametrize("pq_bits,min_recall", [(8, 0.85), (6, 0.75),
+                                                (4, 0.55)])
+def test_ivf_pq_recall_pq_bits(pq_bits, min_recall):
+    x, q = make_data()
+    k = 10
+    idx = build(IndexParams(n_lists=50, pq_bits=pq_bits, pq_dim=16,
+                            seed=5), x)
+    d, i = search(SearchParams(n_probes=20), idx, q, k)
+    _, ti = knn(x, q, k, DistanceType.L2Expanded)
+    r = recall(i, np.array(ti))
+    assert r >= min_recall, f"recall {r} < {min_recall} at pq_bits={pq_bits}"
+
+
+def test_ivf_pq_per_cluster_codebooks():
+    x, q = make_data(n=3000, dim=24)
+    idx = build(IndexParams(n_lists=40, pq_bits=8, pq_dim=12,
+                            codebook_kind=CodebookKind.PER_CLUSTER, seed=2), x)
+    assert idx.codebooks.shape == (40, 256, 2)
+    d, i = search(SearchParams(n_probes=16), idx, q, 10)
+    _, ti = knn(x, q, 10, DistanceType.L2Expanded)
+    assert recall(i, np.array(ti)) >= 0.8
+
+
+def test_ivf_pq_rotation_non_divisible():
+    # dim not a multiple of pq_dim → random rotation into rot_dim
+    x, q = make_data(n=2000, dim=30)
+    idx = build(IndexParams(n_lists=32, pq_bits=8, pq_dim=8, seed=4), x)
+    assert idx.rot_dim == 32 and idx.rot_dim % idx.pq_dim == 0
+    # rotation rows orthonormal: R Rᵀ = I
+    rrt = np.array(idx.rotation) @ np.array(idx.rotation).T
+    np.testing.assert_allclose(rrt, np.eye(30), atol=1e-4)
+    d, i = search(SearchParams(n_probes=24), idx, q, 10)
+    _, ti = knn(x, q, 10, DistanceType.L2Expanded)
+    # coarser gate: 8 codes over 30 rotated dims is a low-resolution config
+    assert recall(i, np.array(ti)) >= 0.6
+
+
+def test_ivf_pq_low_precision_lut():
+    x, q = make_data(n=2500, dim=32)
+    idx = build(IndexParams(n_lists=32, pq_bits=8, pq_dim=16, seed=6), x)
+    d32, i32 = search(SearchParams(n_probes=16, lut_dtype="float32"),
+                      idx, q, 10)
+    dbf, ibf = search(SearchParams(n_probes=16, lut_dtype="bfloat16"),
+                      idx, q, 10)
+    _, ti = knn(x, q, 10, DistanceType.L2Expanded)
+    r32 = recall(i32, np.array(ti))
+    rbf = recall(ibf, np.array(ti))
+    assert r32 >= 0.85
+    # low-precision LUT degrades recall only slightly (reference doc note)
+    assert rbf >= r32 - 0.1
+
+
+def test_ivf_pq_inner_product():
+    x, q = make_data(n=2500, dim=32, seed=9)
+    idx = build(IndexParams(n_lists=32, pq_bits=8, pq_dim=16,
+                            metric=DistanceType.InnerProduct, seed=7), x)
+    d, i = search(SearchParams(n_probes=16), idx, q, 10)
+    _, ti = knn(x, q, 10, DistanceType.InnerProduct)
+    assert recall(i, np.array(ti)) >= 0.75
+    # IP scores descend
+    d = np.array(d)
+    assert np.all(np.diff(d, axis=1) <= 1e-3)
+
+
+def test_ivf_pq_approx_distance_quality():
+    x, q = make_data(n=2000, dim=32)
+    idx = build(IndexParams(n_lists=32, pq_bits=8, pq_dim=16, seed=8), x)
+    d, i = search(SearchParams(n_probes=32), idx, q, 5)
+    td, ti = knn(x, q, 5, DistanceType.L2Expanded)
+    # PQ distances approximate true distances within the quantization error
+    rel = np.abs(np.array(d) - np.array(td)) / np.maximum(np.array(td), 1.0)
+    assert np.median(rel) < 0.25
