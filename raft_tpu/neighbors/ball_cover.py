@@ -1,0 +1,255 @@
+"""Random ball cover: landmark-based exact kNN.
+
+Counterpart of reference ``neighbors/ball_cover.cuh:63-336``
+(``build_index`` / ``all_knn_query`` / ``knn_query`` / ``eps_nn``; impl
+``spatial/knn/detail/ball_cover.cuh:70,122`` — Cayton's random ball cover):
+sample landmarks, group points by nearest landmark, prune scans with the
+triangle inequality ``d(q, x) ≥ d(q, L) − radius(L)``.
+
+TPU-first redesign: the reference's register-tuned 2D/3D pass kernels
+(detail/ball_cover/registers.cuh) become the same padded-list scan used by
+IVF-Flat, and the *dynamic* per-query pruning becomes a two-pass scheme
+with a **certificate of exactness** that keeps all shapes static:
+
+1. probe the P nearest landmarks per query (static P), keeping running
+   top-k;
+2. check per query that every unprobed landmark's lower bound
+   ``d(q, L) − radius(L)`` exceeds the current k-th distance;
+3. if any query fails the certificate, double P and rerun (host loop —
+   each attempt is one compiled computation).
+
+Step 3 terminates at P = n_landmarks, where the scan is exhaustive, so the
+result is always exact — same guarantee as the reference, with the
+data-dependent work expressed as shape-bucketed retries instead of
+divergent warps.
+
+Supported metrics: L2 (sqrt/squared) and Haversine, as in the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.distance.pairwise import distance as _pairwise
+from raft_tpu.matrix.select_k import select_k
+from raft_tpu.neighbors._common import pack_lists
+
+_SUPPORTED = (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded,
+              DistanceType.Haversine)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class BallCoverIndex:
+    """Reference ``BallCoverIndex`` (neighbors/ball_cover_types.hpp):
+    landmarks + per-landmark padded point blocks + radii."""
+
+    landmarks: jnp.ndarray      # (n_landmarks, dim)
+    radii: jnp.ndarray          # (n_landmarks,) max dist to members
+    list_data: jnp.ndarray      # (n_landmarks, capacity, dim)
+    list_indices: jnp.ndarray   # (n_landmarks, capacity) int32, -1 pad
+    list_sizes: jnp.ndarray     # (n_landmarks,) int32
+    metric: DistanceType
+
+    @property
+    def n_landmarks(self) -> int:
+        return self.landmarks.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.landmarks.shape[1]
+
+    @property
+    def capacity(self) -> int:
+        return self.list_data.shape[1]
+
+    def tree_flatten(self):
+        return ((self.landmarks, self.radii, self.list_data,
+                 self.list_indices, self.list_sizes), (self.metric,))
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, metric=aux[0])
+
+
+def _tile_distance(q, data, metric: DistanceType):
+    """Distances from queries (nq, dim) to gathered tiles (nq, cap, dim)."""
+    if metric == DistanceType.Haversine:
+        dlat = q[:, None, 0] - data[:, :, 0]
+        dlon = q[:, None, 1] - data[:, :, 1]
+        h = (jnp.sin(dlat / 2) ** 2 +
+             jnp.cos(q[:, None, 0]) * jnp.cos(data[:, :, 0]) *
+             jnp.sin(dlon / 2) ** 2)
+        return 2.0 * jnp.arcsin(jnp.sqrt(jnp.clip(h, 0.0, 1.0)))
+    dots = jnp.einsum("qd,qcd->qc", q, data)
+    qn = jnp.sum(q ** 2, axis=-1, keepdims=True)
+    xn = jnp.sum(data ** 2, axis=-1)
+    return jnp.sqrt(jnp.maximum(qn + xn - 2.0 * dots, 0.0))
+
+
+def build_index(x, metric: DistanceType = DistanceType.L2SqrtExpanded,
+                n_landmarks: Optional[int] = None, seed: int = 0
+                ) -> BallCoverIndex:
+    """Sample ≈√n landmarks, group points by nearest landmark, record
+    per-landmark radii (reference ``build_index``, ball_cover.cuh:63;
+    ``sample_landmarks`` + ``construct_landmark_1nn``,
+    detail/ball_cover.cuh:70,122)."""
+    x = jnp.asarray(x)
+    expects(x.ndim == 2, "x must be (n, dim)")
+    metric = DistanceType(metric)
+    expects(metric in _SUPPORTED, f"ball_cover: unsupported metric {metric}")
+    if metric == DistanceType.Haversine:
+        expects(x.shape[1] == 2, "haversine needs (lat, lon) columns")
+    n = x.shape[0]
+    if n_landmarks is None:
+        n_landmarks = max(1, int(math.isqrt(n)))
+    n_landmarks = min(n_landmarks, n)
+    sel = np.sort(np.random.default_rng(seed).choice(
+        n, size=n_landmarks, replace=False))
+    landmarks = x[jnp.asarray(sel)]
+    # 1-NN of every point among landmarks
+    d = _pairwise(x, landmarks, metric, 2.0)
+    labels = jnp.argmin(d, axis=1).astype(jnp.int32)
+    dist = jnp.min(d, axis=1)
+    radii = jax.ops.segment_max(dist, labels, num_segments=n_landmarks)
+
+    data, idx, counts, _ = pack_lists(x, jnp.arange(n, dtype=jnp.int32),
+                                      labels, n_landmarks)
+    return BallCoverIndex(landmarks=landmarks, radii=radii, list_data=data,
+                          list_indices=idx, list_sizes=counts, metric=metric)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _probe_pass(index_leaves, queries, k: int, n_probe: int, metric_val: int):
+    """Scan each query's n_probe nearest landmarks; return top-k plus the
+    exactness certificate (no unprobed landmark can beat the k-th dist)."""
+    landmarks, radii, list_data, list_indices, list_sizes = index_leaves
+    metric = DistanceType(int(metric_val))
+    nq = queries.shape[0]
+    cap = list_data.shape[1]
+    nl = landmarks.shape[0]
+    inf = jnp.asarray(jnp.inf, queries.dtype)
+
+    ql = _pairwise(queries, landmarks, metric, 2.0)        # (nq, nl)
+    _, probe_order = jax.lax.top_k(-ql, n_probe)           # nearest first
+
+    def step(carry, probe_col):
+        best_d, best_i = carry
+        lists = probe_col
+        data = list_data[lists]
+        ids = list_indices[lists]
+        sizes = list_sizes[lists]
+        d = _tile_distance(queries, data, metric)
+        live = jnp.arange(cap)[None, :] < sizes[:, None]
+        d = jnp.where(live, d, inf)
+        md = jnp.concatenate([best_d, d], axis=1)
+        mi = jnp.concatenate([best_i, ids], axis=1)
+        return select_k(md, k, select_min=True, indices=mi), None
+
+    init = (jnp.full((nq, k), inf, queries.dtype),
+            jnp.full((nq, k), -1, jnp.int32))
+    (best_d, best_i), _ = jax.lax.scan(step, init,
+                                       jnp.swapaxes(probe_order, 0, 1))
+    # certificate: lower bound of every unprobed landmark vs k-th distance
+    probed = jnp.zeros((nq, nl), bool).at[
+        jnp.arange(nq)[:, None], probe_order].set(True)
+    lb = jnp.maximum(ql - radii[None, :], 0.0)
+    kth = best_d[:, -1]
+    exact = jnp.all(probed | (lb > kth[:, None]), axis=1)
+    return best_d, best_i, exact
+
+
+def knn_query(index: BallCoverIndex, queries, k: int,
+              *, initial_probes: Optional[int] = None,
+              batch_size_query: int = 4096
+              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact kNN against the indexed points (reference ``knn_query``,
+    ball_cover.cuh:225).  Returns (distances [nq, k], indices [nq, k])."""
+    q = jnp.asarray(queries)
+    expects(q.ndim == 2 and q.shape[1] == index.dim, "query dim mismatch")
+    expects(k >= 1, "k must be >= 1")
+    nl = index.n_landmarks
+    leaves = (index.landmarks, index.radii, index.list_data,
+              index.list_indices, index.list_sizes)
+    out_d, out_i = [], []
+    for q0 in range(0, q.shape[0], batch_size_query):
+        q1 = min(q0 + batch_size_query, q.shape[0])
+        qb = q[q0:q1]
+        p = min(nl, initial_probes) if initial_probes else \
+            min(nl, max(4, int(math.isqrt(nl)) * 2))
+        while True:
+            d, i, exact = _probe_pass(leaves, qb, int(k), int(p),
+                                      int(index.metric))
+            if bool(jnp.all(exact)) or p >= nl:
+                break
+            p = min(nl, p * 2)
+        out_d.append(d)
+        out_i.append(i)
+    d = out_d[0] if len(out_d) == 1 else jnp.concatenate(out_d, axis=0)
+    i = out_i[0] if len(out_i) == 1 else jnp.concatenate(out_i, axis=0)
+    return d, i
+
+
+def all_knn_query(index: BallCoverIndex, k: int, **kw
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """kNN of the indexed points among themselves (reference
+    ``all_knn_query``, ball_cover.cuh:112): self-query over the packed
+    lists in source order."""
+    live = index.list_indices.reshape(-1) >= 0
+    flat = index.list_data.reshape(-1, index.dim)[live]
+    ids = index.list_indices.reshape(-1)[live]
+    order = jnp.argsort(ids)
+    return knn_query(index, flat[order], k, **kw)
+
+
+def eps_nn(index: BallCoverIndex, queries, eps: float,
+           *, batch_size_query: int = 4096
+           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """All neighbors within *eps* (reference ``eps_nn``,
+    ball_cover.cuh:291): boolean adjacency (nq, n_indexed) in source-id
+    order + per-query degree.  The reference's landmark pruning
+    ``d(q, L) − radius(L) > eps`` is subsumed here: pruned lists cannot
+    contain hits, and on TPU the dense masked scan is the fast path."""
+    q = jnp.asarray(queries)
+    expects(q.ndim == 2 and q.shape[1] == index.dim, "query dim mismatch")
+    n_total = int(jnp.sum(index.list_sizes))
+    leaves = (index.landmarks, index.radii, index.list_data,
+              index.list_indices, index.list_sizes)
+    out = []
+    for q0 in range(0, q.shape[0], batch_size_query):
+        q1 = min(q0 + batch_size_query, q.shape[0])
+        out.append(_eps_pass(leaves, q[q0:q1], float(eps),
+                             int(index.metric), n_total))
+    adj = out[0] if len(out) == 1 else jnp.concatenate(out, axis=0)
+    return adj, jnp.sum(adj, axis=1, dtype=jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _eps_pass(index_leaves, queries, eps: float, metric_val: int,
+              n_total: int):
+    landmarks, radii, list_data, list_indices, list_sizes = index_leaves
+    metric = DistanceType(metric_val)
+    nq = queries.shape[0]
+    nl, cap, dim = list_data.shape
+
+    adj = jnp.zeros((nq, n_total), bool)
+
+    def step(li, adj):
+        data = list_data[li]
+        ids = list_indices[li]
+        d = _pairwise(queries, data, metric, 2.0)          # (nq, cap)
+        live = (jnp.arange(cap) < list_sizes[li])[None, :]
+        hit = (d <= eps) & live
+        return adj.at[:, jnp.where(ids >= 0, ids, n_total)].max(
+            hit, mode="drop")
+
+    return jax.lax.fori_loop(0, nl, step, adj)

@@ -1,0 +1,64 @@
+"""Random ball cover: exactness tests vs brute force (the reference checks
+ball cover against brute-force ground truth, test/neighbors/ball_cover.cu)."""
+
+import numpy as np
+import pytest
+from scipy.spatial.distance import cdist
+
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.neighbors.ball_cover import (
+    all_knn_query,
+    build_index,
+    eps_nn,
+    knn_query,
+)
+
+
+@pytest.mark.parametrize("n,dim,k", [(1500, 3, 7), (2000, 8, 11)])
+def test_ball_cover_knn_exact(n, dim, k):
+    rng = np.random.default_rng(n)
+    x = rng.random((n, dim)).astype(np.float32)
+    q = rng.random((100, dim)).astype(np.float32)
+    index = build_index(x)
+    d, i = knn_query(index, q, k)
+    ref = cdist(q.astype(np.float64), x.astype(np.float64))
+    ridx = np.argsort(ref, axis=1, kind="stable")[:, :k]
+    rd = np.take_along_axis(ref, ridx, axis=1)
+    np.testing.assert_allclose(np.sort(np.array(d), 1), rd, atol=1e-3)
+    # exactness: distance multisets agree ⇒ same neighbor sets up to ties
+    hits = sum(len(set(a.tolist()) & set(b.tolist()))
+               for a, b in zip(np.array(i), ridx))
+    assert hits / ridx.size > 0.999
+
+
+def test_ball_cover_all_knn():
+    rng = np.random.default_rng(0)
+    x = rng.random((900, 4)).astype(np.float32)
+    index = build_index(x)
+    d, i = all_knn_query(index, 5)
+    # each point's own nearest neighbor is itself at distance 0
+    np.testing.assert_array_equal(np.array(i)[:, 0], np.arange(900))
+    np.testing.assert_allclose(np.array(d)[:, 0], 0.0, atol=1e-4)
+
+
+def test_ball_cover_haversine():
+    rng = np.random.default_rng(1)
+    lat = rng.uniform(-1.2, 1.2, 800)
+    lon = rng.uniform(-3.0, 3.0, 800)
+    x = np.stack([lat, lon], 1).astype(np.float32)
+    q = x[:50] + 0.001
+    index = build_index(x, DistanceType.Haversine)
+    d, i = knn_query(index, q, 3)
+    assert np.array_equal(np.array(i)[:, 0], np.arange(50))
+
+
+def test_ball_cover_eps_nn():
+    rng = np.random.default_rng(2)
+    x = rng.random((600, 4)).astype(np.float32)
+    q = rng.random((80, 4)).astype(np.float32)
+    eps = 0.35
+    index = build_index(x)
+    adj, vd = eps_nn(index, q, eps)
+    ref = cdist(q, x) <= eps
+    np.testing.assert_array_equal(np.array(adj), ref)
+    np.testing.assert_array_equal(np.array(vd), ref.sum(1))
