@@ -1,0 +1,141 @@
+// raft_tpu native host runtime.
+//
+// C++ implementations of the reference's host-side native components
+// (SURVEY.md §2.14 layer role): the sequential union-find stages of
+// single-linkage HAC (reference cluster/detail/agglomerative.cuh:39-239 —
+// build_dendrogram_host / extract_flattened_clusters, host C++ there too),
+// host label utilities (label/classlabels.cuh make_monotonic), and host COO
+// canonicalization (sparse/op sort+dedupe, the host path).
+//
+// Exposed as a plain C ABI consumed from Python via ctypes — the
+// pybind-free equivalent of pylibraft's Cython-over-C++ runtime layer.
+//
+// Build: `make -C native` or CMake; raft_tpu.native auto-builds on first
+// import when a toolchain is present and falls back to numpy otherwise.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <vector>
+
+namespace {
+
+// Union-find with path halving; label space [0, 2n-1) as in the reference
+// agglomerative labeling (cluster index n+i after the i-th merge).
+struct UnionFind {
+  std::vector<int64_t> parent;
+  explicit UnionFind(int64_t n) : parent(n) {
+    std::iota(parent.begin(), parent.end(), int64_t{0});
+  }
+  int64_t find(int64_t a) {
+    while (parent[a] != a) {
+      parent[a] = parent[parent[a]];
+      a = parent[a];
+    }
+    return a;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Agglomerative labeling from weight-sorted MST edges.
+// children: (n_edges, 2) int64 out; sizes: (n_edges,) int64 out.
+// Returns 0 on success.
+int rt_build_dendrogram(const int32_t* src, const int32_t* dst,
+                        int64_t n_edges, int64_t* children, int64_t* sizes) {
+  const int64_t n = n_edges + 1;
+  UnionFind uf(2 * n - 1);
+  std::vector<int64_t> size(2 * n - 1, 1);
+  for (int64_t i = 0; i < n_edges; ++i) {
+    const int64_t ra = uf.find(src[i]);
+    const int64_t rb = uf.find(dst[i]);
+    if (ra == rb) return 1;  // not a forest: sorted-MST invariant broken
+    const int64_t merged = n + i;
+    children[2 * i] = std::min(ra, rb);
+    children[2 * i + 1] = std::max(ra, rb);
+    size[merged] = size[ra] + size[rb];
+    sizes[i] = size[merged];
+    uf.parent[ra] = merged;
+    uf.parent[rb] = merged;
+  }
+  return 0;
+}
+
+// Cut the dendrogram at n_clusters: apply the first n - n_clusters merges,
+// then densely label the forest roots 0..n_clusters-1 in first-seen order.
+int rt_extract_flattened_clusters(const int64_t* children, int64_t n,
+                                  int64_t n_clusters, int32_t* labels) {
+  if (n_clusters < 1 || n_clusters > n) return 1;
+  UnionFind uf(2 * n - 1);
+  for (int64_t i = 0; i < n - n_clusters; ++i) {
+    const int64_t merged = n + i;
+    uf.parent[uf.find(children[2 * i])] = merged;
+    uf.parent[uf.find(children[2 * i + 1])] = merged;
+  }
+  // monotonic labels by smallest member (matches np.unique(return_inverse)
+  // on roots because the root id of a set is >= every member yet unique):
+  // map root -> dense id ordered by root value.
+  std::vector<int64_t> roots(n);
+  for (int64_t i = 0; i < n; ++i) roots[i] = uf.find(i);
+  std::vector<int64_t> uniq(roots);
+  std::sort(uniq.begin(), uniq.end());
+  uniq.erase(std::unique(uniq.begin(), uniq.end()), uniq.end());
+  for (int64_t i = 0; i < n; ++i) {
+    labels[i] = static_cast<int32_t>(
+        std::lower_bound(uniq.begin(), uniq.end(), roots[i]) - uniq.begin());
+  }
+  return 0;
+}
+
+// Dense monotonic relabeling (reference label/classlabels.cuh:41-116
+// make_monotonic host path). out[i] in [base, base+k); returns k.
+int64_t rt_make_monotonic(const int32_t* labels, int64_t n, int32_t base,
+                          int32_t* out) {
+  std::vector<int32_t> uniq(labels, labels + n);
+  std::sort(uniq.begin(), uniq.end());
+  uniq.erase(std::unique(uniq.begin(), uniq.end()), uniq.end());
+  for (int64_t i = 0; i < n; ++i) {
+    out[i] = base + static_cast<int32_t>(
+        std::lower_bound(uniq.begin(), uniq.end(), labels[i]) - uniq.begin());
+  }
+  return static_cast<int64_t>(uniq.size());
+}
+
+// Canonicalize COO on host: sort by (row, col), merge duplicates by
+// summation, drop explicit zeros if drop_zeros. Returns new nnz.
+// rows/cols/vals are modified in place (first nnz_out entries valid).
+int64_t rt_coo_canonicalize(int32_t* rows, int32_t* cols, double* vals,
+                            int64_t nnz, int drop_zeros) {
+  std::vector<int64_t> order(nnz);
+  std::iota(order.begin(), order.end(), int64_t{0});
+  std::sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
+    if (rows[a] != rows[b]) return rows[a] < rows[b];
+    return cols[a] < cols[b];
+  });
+  std::vector<int32_t> r(nnz), c(nnz);
+  std::vector<double> v(nnz);
+  for (int64_t i = 0; i < nnz; ++i) {
+    r[i] = rows[order[i]];
+    c[i] = cols[order[i]];
+    v[i] = vals[order[i]];
+  }
+  int64_t out = 0;
+  for (int64_t i = 0; i < nnz;) {
+    double acc = 0.0;
+    int64_t j = i;
+    while (j < nnz && r[j] == r[i] && c[j] == c[i]) acc += v[j++];
+    if (!(drop_zeros && acc == 0.0)) {
+      rows[out] = r[i];
+      cols[out] = c[i];
+      vals[out] = acc;
+      ++out;
+    }
+    i = j;
+  }
+  return out;
+}
+
+}  // extern "C"

@@ -109,8 +109,8 @@ def build_dendrogram_host(src, dst, weights) -> Tuple[np.ndarray, np.ndarray, np
         from raft_tpu.native import agglomerative as _native
 
         return _native.build_dendrogram(src, dst, weights)
-    except Exception:
-        pass
+    except (ImportError, RuntimeError):
+        pass  # native runtime unavailable → numpy path; real errors surface
     n = len(src) + 1
     parent = np.arange(2 * n - 1)
     size = np.ones(2 * n - 1, dtype=np.int64)
@@ -140,6 +140,12 @@ def extract_flattened_clusters(children: np.ndarray, n_clusters: int, n: int
     """Cut the dendrogram at n_clusters (reference detail/agglomerative.cuh:239
     ``extract_flattened_clusters``): apply the first n−n_clusters merges and
     label the resulting forest 0..n_clusters−1."""
+    try:
+        from raft_tpu.native import agglomerative as _native
+
+        return _native.extract_flattened_clusters(children, n_clusters, n)
+    except (ImportError, RuntimeError):
+        pass  # native runtime unavailable → numpy path; real errors surface
     parent = np.arange(2 * n - 1)
 
     def find(a):

@@ -1,0 +1,122 @@
+"""AOT executable cache.
+
+Counterpart of the reference's precompiled template-instantiation libraries
+``libraft-distance`` / ``libraft-nn`` (SURVEY.md §2.14): those exist to
+kill per-process compile latency for the known-hot (op, dtype) combinations.
+The idiomatic XLA mechanism is ahead-of-time lowering + a persistent
+compilation cache:
+
+- :func:`aot` wraps a function so each (shape-bucket, dtype) signature is
+  lowered and compiled ONCE and then dispatched via the cached executable —
+  the in-process analogue of linking against libraft-distance.
+- :func:`enable_persistent_cache` points JAX's compilation cache at a
+  directory so executables survive process restarts — the on-disk analogue
+  of shipping the precompiled libs.
+
+Shape bucketing: pass ``bucket=True`` to round the leading (batch) dim up
+to the next power of two and pad, the standard trick to bound the number
+of distinct executables for ragged workloads.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def enable_persistent_cache(path: Optional[str] = None) -> str:
+    """Enable JAX's on-disk compilation cache (idempotent).  Returns the
+    cache directory."""
+    path = path or os.environ.get(
+        "RAFT_TPU_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "raft_tpu", "xla"))
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    return path
+
+
+def _bucket_dim(n: int) -> int:
+    if n <= 8:
+        return 8
+    return 1 << (int(n - 1).bit_length())
+
+
+class AotFunction:
+    """A function with a per-signature compiled-executable cache."""
+
+    def __init__(self, fn: Callable, static_argnums: Tuple[int, ...] = (),
+                 bucket: bool = False):
+        self._fn = fn
+        self._static = tuple(static_argnums)
+        self._bucket = bucket
+        self._cache: Dict[Any, Any] = {}
+        functools.update_wrapper(self, fn)
+
+    def _signature(self, args):
+        sig = []
+        for i, a in enumerate(args):
+            if i in self._static:
+                sig.append(("static", a))
+            else:
+                a = jnp.asarray(a)
+                shape = a.shape
+                if self._bucket and a.ndim >= 1:
+                    shape = (_bucket_dim(shape[0]),) + shape[1:]
+                sig.append((shape, str(a.dtype)))
+        return tuple(sig)
+
+    def compiled(self, *args):
+        """Return the compiled executable for this signature (compiling on
+        miss) without running it."""
+        sig = self._signature(args)
+        entry = self._cache.get(sig)
+        if entry is None:
+            jitted = jax.jit(self._fn, static_argnums=self._static)
+            lower_args = []
+            for i, a in enumerate(args):
+                if i in self._static:
+                    lower_args.append(a)
+                else:
+                    a = jnp.asarray(a)
+                    shape, dtype = sig[i]
+                    lower_args.append(jax.ShapeDtypeStruct(shape, a.dtype))
+            entry = jitted.lower(*lower_args).compile()
+            self._cache[sig] = entry
+        return entry
+
+    def __call__(self, *args):
+        exe = self.compiled(*args)
+        call_args = []
+        for i, a in enumerate(args):
+            if i in self._static:
+                continue  # static args are baked into the executable
+            a = jnp.asarray(a)
+            if self._bucket and a.ndim >= 1:
+                b = _bucket_dim(a.shape[0])
+                if b != a.shape[0]:
+                    pad = [(0, b - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+                    a = jnp.pad(a, pad)
+            call_args.append(a)
+        return exe(*call_args)
+
+    @property
+    def cache_size(self) -> int:
+        return len(self._cache)
+
+
+def aot(fn: Optional[Callable] = None, *, static_argnums: Tuple[int, ...] = (),
+        bucket: bool = False):
+    """Decorator: AOT-compile *fn* per (shape-bucket, dtype) signature.
+
+    NB with ``bucket=True`` the caller must treat rows beyond the original
+    leading dim as padding in the result.
+    """
+    if fn is None:
+        return lambda f: AotFunction(f, static_argnums, bucket)
+    return AotFunction(fn, static_argnums, bucket)

@@ -1,0 +1,161 @@
+"""ctypes bindings to the native host runtime (native/raft_runtime.cpp).
+
+Role of pylibraft's Cython-over-C++ runtime layer (SURVEY.md §2.15) without
+pybind: a plain C ABI loaded via ctypes.  The shared library is built on
+first import (g++, cached beside the sources); every binding has a numpy
+fallback at its call site, so the package works without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
+_LIB_NAME = "libraft_tpu_runtime.so"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> Optional[Path]:
+    src = _NATIVE_DIR / "raft_runtime.cpp"
+    out = _NATIVE_DIR / _LIB_NAME
+    if not src.exists():
+        return None
+    if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
+        return out
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-fPIC", "-shared",
+             "-o", str(out), str(src)],
+            check=True, capture_output=True, timeout=120)
+        return out
+    except Exception:
+        return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("RAFT_TPU_DISABLE_NATIVE"):
+            return None
+        path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(str(path))
+        except OSError:
+            return None
+        lib.rt_build_dendrogram.restype = ctypes.c_int
+        lib.rt_build_dendrogram.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.rt_extract_flattened_clusters.restype = ctypes.c_int
+        lib.rt_extract_flattened_clusters.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.rt_make_monotonic.restype = ctypes.c_int64
+        lib.rt_make_monotonic.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.rt_coo_canonicalize.restype = ctypes.c_int64
+        lib.rt_coo_canonicalize.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+def is_available() -> bool:
+    return _load() is not None
+
+
+def _i32(a):
+    return np.ascontiguousarray(np.asarray(a), dtype=np.int32)
+
+
+class agglomerative:
+    """Native union-find dendrogram stages (reference
+    cluster/detail/agglomerative.cuh:103,239)."""
+
+    @staticmethod
+    def build_dendrogram(src, dst, weights
+                         ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        src = _i32(src)
+        dst = _i32(dst)
+        weights = np.asarray(weights)
+        n_edges = src.shape[0]
+        children = np.empty((n_edges, 2), np.int64)
+        sizes = np.empty((n_edges,), np.int64)
+        rc = lib.rt_build_dendrogram(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n_edges,
+            children.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if rc != 0:
+            raise ValueError("build_dendrogram: edges do not form a forest")
+        return children, np.array(weights, copy=True), sizes
+
+    @staticmethod
+    def extract_flattened_clusters(children, n_clusters: int, n: int
+                                   ) -> np.ndarray:
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        children = np.ascontiguousarray(np.asarray(children), dtype=np.int64)
+        labels = np.empty((n,), np.int32)
+        rc = lib.rt_extract_flattened_clusters(
+            children.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, int(n_clusters),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rc != 0:
+            raise ValueError("extract_flattened_clusters: bad n_clusters")
+        return labels
+
+
+def make_monotonic_host(labels, zero_based: bool = True
+                        ) -> Tuple[np.ndarray, int]:
+    """Native dense relabeling; returns (out, n_unique)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native runtime unavailable")
+    labels = _i32(labels)
+    out = np.empty_like(labels)
+    k = lib.rt_make_monotonic(
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        labels.shape[0], 0 if zero_based else 1,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return out, int(k)
+
+
+def coo_canonicalize_host(rows, cols, vals, drop_zeros: bool = True
+                          ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Native COO sort + duplicate-sum (+ zero drop); returns compacted
+    (rows, cols, vals)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native runtime unavailable")
+    rows = _i32(rows).copy()
+    cols = _i32(cols).copy()
+    vals = np.ascontiguousarray(np.asarray(vals), dtype=np.float64).copy()
+    nnz = lib.rt_coo_canonicalize(
+        rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        rows.shape[0], 1 if drop_zeros else 0)
+    return rows[:nnz], cols[:nnz], vals[:nnz]

@@ -8,10 +8,13 @@ lands everywhere.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Callable, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
+
+from raft_tpu.matrix.select_k import select_k
 
 
 def pack_lists(payload, ids, labels, n_lists: int,
@@ -43,6 +46,45 @@ def pack_lists(payload, ids, labels, n_lists: int,
                    ).at[flat_pos].set(jnp.asarray(ids, jnp.int32)
                                       ).reshape(n_lists, capacity)
     return data, idx, counts.astype(jnp.int32), capacity
+
+
+def scan_probe_lists(probe_ids, score_tile: Callable, list_indices,
+                     list_sizes, k: int, select_min: bool, dtype
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Running top-k over per-query probed lists — the shared inner loop of
+    IVF-Flat, IVF-PQ and ball-cover search.
+
+    *probe_ids* (nq, n_probes) int32; ``score_tile(lists) -> (nq, cap)``
+    distances/similarities for each query's gathered list; padding slots
+    (position ≥ list size) are masked to the sentinel here.  Returns
+    (best_d (nq, k), best_i (nq, k) int32, -1 for empty slots).
+    """
+    nq = probe_ids.shape[0]
+    cap = list_indices.shape[1]
+    sentinel = jnp.asarray(jnp.inf if select_min else -jnp.inf, dtype)
+
+    def step(carry, probe_col):
+        best_d, best_i = carry
+        d = score_tile(probe_col).astype(dtype)
+        ids = list_indices[probe_col]
+        sizes = list_sizes[probe_col]
+        live = jnp.arange(cap)[None, :] < sizes[:, None]
+        d = jnp.where(live, d, sentinel)
+        merged_d = jnp.concatenate([best_d, d], axis=1)
+        merged_i = jnp.concatenate([best_i, ids], axis=1)
+        return select_k(merged_d, k, select_min=select_min,
+                        indices=merged_i), None
+
+    init = (jnp.full((nq, k), sentinel, dtype),
+            jnp.full((nq, k), -1, jnp.int32))
+    (best_d, best_i), _ = jax.lax.scan(step, init,
+                                       jnp.swapaxes(probe_ids, 0, 1))
+    return best_d, best_i
+
+
+def empty_result(nq: int, k: int, dtype):
+    """(0-or-nq, k) empty search output for zero-query batches."""
+    return (jnp.zeros((nq, k), dtype), jnp.full((nq, k), -1, jnp.int32))
 
 
 def subsample_trainset(x, fraction: float, n_lists: int, seed: int):

@@ -41,7 +41,11 @@ from raft_tpu.core.error import expects
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.distance.pairwise import distance as _pairwise
 from raft_tpu.matrix.select_k import select_k
-from raft_tpu.neighbors._common import pack_lists
+from raft_tpu.neighbors._common import (
+    empty_result,
+    pack_lists,
+    scan_probe_lists,
+)
 
 _SUPPORTED = (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded,
               DistanceType.Haversine)
@@ -90,7 +94,10 @@ def _tile_distance(q, data, metric: DistanceType):
              jnp.cos(q[:, None, 0]) * jnp.cos(data[:, :, 0]) *
              jnp.sin(dlon / 2) ** 2)
         return 2.0 * jnp.arcsin(jnp.sqrt(jnp.clip(h, 0.0, 1.0)))
-    dots = jnp.einsum("qd,qcd->qc", q, data)
+    # precision='highest': this module promises EXACT results and its
+    # certificate compares these values against full-precision landmark
+    # bounds — TPU bf16-default matmuls would silently break exactness.
+    dots = jnp.einsum("qd,qcd->qc", q, data, precision="highest")
     qn = jnp.sum(q ** 2, axis=-1, keepdims=True)
     xn = jnp.sum(data ** 2, axis=-1)
     return jnp.sqrt(jnp.maximum(qn + xn - 2.0 * dots, 0.0))
@@ -135,30 +142,18 @@ def _probe_pass(index_leaves, queries, k: int, n_probe: int, metric_val: int):
     landmarks, radii, list_data, list_indices, list_sizes = index_leaves
     metric = DistanceType(int(metric_val))
     nq = queries.shape[0]
-    cap = list_data.shape[1]
     nl = landmarks.shape[0]
-    inf = jnp.asarray(jnp.inf, queries.dtype)
 
     ql = _pairwise(queries, landmarks, metric, 2.0)        # (nq, nl)
     _, probe_order = jax.lax.top_k(-ql, n_probe)           # nearest first
 
-    def step(carry, probe_col):
-        best_d, best_i = carry
-        lists = probe_col
-        data = list_data[lists]
-        ids = list_indices[lists]
-        sizes = list_sizes[lists]
-        d = _tile_distance(queries, data, metric)
-        live = jnp.arange(cap)[None, :] < sizes[:, None]
-        d = jnp.where(live, d, inf)
-        md = jnp.concatenate([best_d, d], axis=1)
-        mi = jnp.concatenate([best_i, ids], axis=1)
-        return select_k(md, k, select_min=True, indices=mi), None
+    def score_tile(lists):
+        return _tile_distance(queries, list_data[lists], metric)
 
-    init = (jnp.full((nq, k), inf, queries.dtype),
-            jnp.full((nq, k), -1, jnp.int32))
-    (best_d, best_i), _ = jax.lax.scan(step, init,
-                                       jnp.swapaxes(probe_order, 0, 1))
+    best_d, best_i = scan_probe_lists(probe_order.astype(jnp.int32),
+                                      score_tile, list_indices, list_sizes,
+                                      k, select_min=True,
+                                      dtype=queries.dtype)
     # certificate: lower bound of every unprobed landmark vs k-th distance
     probed = jnp.zeros((nq, nl), bool).at[
         jnp.arange(nq)[:, None], probe_order].set(True)
@@ -177,6 +172,8 @@ def knn_query(index: BallCoverIndex, queries, k: int,
     q = jnp.asarray(queries)
     expects(q.ndim == 2 and q.shape[1] == index.dim, "query dim mismatch")
     expects(k >= 1, "k must be >= 1")
+    if q.shape[0] == 0:
+        return empty_result(0, int(k), q.dtype)
     nl = index.n_landmarks
     leaves = (index.landmarks, index.radii, index.list_data,
               index.list_indices, index.list_sizes)

@@ -36,7 +36,12 @@ from raft_tpu.core.error import expects
 from raft_tpu.cluster import build_hierarchical, min_cluster_and_distance
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.matrix.select_k import select_k
-from raft_tpu.neighbors._common import pack_lists, subsample_trainset
+from raft_tpu.neighbors._common import (
+    empty_result,
+    pack_lists,
+    scan_probe_lists,
+    subsample_trainset,
+)
 from raft_tpu.random.rng import RngState
 
 _SUPPORTED = (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
@@ -220,43 +225,26 @@ def _scan_probes(queries, probe_ids, index_leaves, metric_val: int, k: int,
     playing the role of the in-kernel warp-sort queues.
     """
     centers, list_data, list_indices, list_sizes = index_leaves
-    nq = queries.shape[0]
-    cap = list_data.shape[1]
     is_ip = metric_val == int(DistanceType.InnerProduct)
     is_cos = metric_val == int(DistanceType.CosineExpanded)
-    select_min = not is_ip  # IP is a similarity: select largest
-    sentinel = jnp.asarray(jnp.inf if select_min else -jnp.inf, queries.dtype)
 
-    def step(carry, probe_col):
-        best_d, best_i = carry
-        lists = probe_col                                   # (nq,) list ids
+    def score_tile(lists):
         data = list_data[lists].astype(queries.dtype)       # (nq, cap, dim)
-        ids = list_indices[lists]                           # (nq, cap)
-        sizes = list_sizes[lists]                           # (nq,)
         dots = jnp.einsum("qd,qcd->qc", queries, data,
                           preferred_element_type=queries.dtype)
         if is_ip:
-            d = dots
-        elif is_cos:
+            return dots
+        if is_cos:
             # queries are pre-normalized; normalize stored vectors here
             xn = jnp.sqrt(jnp.maximum(jnp.sum(data ** 2, axis=-1), 1e-30))
-            d = 1.0 - dots / xn
-        else:
-            xn = jnp.sum(data ** 2, axis=-1)
-            qn = jnp.sum(queries ** 2, axis=-1, keepdims=True)
-            d = qn + xn - 2.0 * dots
-        live = jnp.arange(cap)[None, :] < sizes[:, None]
-        d = jnp.where(live, d, sentinel)
-        merged_d = jnp.concatenate([best_d, d], axis=1)
-        merged_i = jnp.concatenate([best_i, ids], axis=1)
-        best_d, best_i = select_k(merged_d, k, select_min=select_min,
-                                  indices=merged_i)
-        return (best_d, best_i), None
+            return 1.0 - dots / xn
+        xn = jnp.sum(data ** 2, axis=-1)
+        qn = jnp.sum(queries ** 2, axis=-1, keepdims=True)
+        return qn + xn - 2.0 * dots
 
-    init = (jnp.full((nq, k), sentinel, queries.dtype),
-            jnp.full((nq, k), -1, jnp.int32))
-    (best_d, best_i), _ = jax.lax.scan(step, init,
-                                       jnp.swapaxes(probe_ids, 0, 1))
+    best_d, best_i = scan_probe_lists(probe_ids, score_tile, list_indices,
+                                      list_sizes, k, select_min=not is_ip,
+                                      dtype=queries.dtype)
     if sqrt:
         best_d = jnp.sqrt(jnp.maximum(best_d, 0))
     return best_d, best_i
@@ -276,6 +264,8 @@ def search(params: SearchParams, index: Index, queries, k: int,
     n_probes = min(params.n_probes, index.n_lists)
     expects(k >= 1, "k must be >= 1")
     qf = q.astype(_compute_dtype(q))
+    if qf.shape[0] == 0:
+        return empty_result(0, int(k), qf.dtype)
     if index.metric == DistanceType.CosineExpanded:
         qf = _normalize_rows(qf)
     sqrt = index.metric == DistanceType.L2SqrtExpanded

@@ -42,7 +42,12 @@ from raft_tpu.core.error import expects
 from raft_tpu.cluster import build_hierarchical, min_cluster_and_distance
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.matrix.select_k import select_k
-from raft_tpu.neighbors._common import pack_lists, subsample_trainset
+from raft_tpu.neighbors._common import (
+    empty_result,
+    pack_lists,
+    scan_probe_lists,
+    subsample_trainset,
+)
 from raft_tpu.random.rng import RngState
 
 _SUPPORTED = (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
@@ -314,12 +319,9 @@ def _search_batch(q, probe_ids, leaves, metric_val: int, k: int,
     ivf_pq_search.cuh:594-738) with a running top-k merge."""
     centers, rotation, codebooks, list_codes, list_indices, list_sizes = leaves
     nq = q.shape[0]
-    cap = list_codes.shape[1]
     is_ip = metric_val == int(DistanceType.InnerProduct)
     lut_dtype = _LUT_DTYPES[lut_dtype_name]
     acc_dtype = _LUT_DTYPES.get(int_dtype_name, jnp.float32)
-    select_min = not is_ip
-    sentinel = jnp.asarray(jnp.inf if select_min else -jnp.inf, jnp.float32)
 
     rot_q = q @ rotation                                  # (nq, rot_dim)
     rot_centers = centers @ rotation                      # (n_lists, rot_dim)
@@ -329,9 +331,7 @@ def _search_batch(q, probe_ids, leaves, metric_val: int, k: int,
     else:
         pq_dim, kcb, ds = codebooks.shape
 
-    def step(carry, probe_col):
-        best_d, best_i = carry
-        lists = probe_col                                  # (nq,)
+    def score_tile(lists):
         c_rot = rot_centers[lists]                         # (nq, rot_dim)
         r = (rot_q - c_rot).reshape(nq, pq_dim, ds)        # query residual
         cb = (codebooks[lists] if per_cluster else codebooks)
@@ -357,25 +357,15 @@ def _search_batch(q, probe_ids, leaves, metric_val: int, k: int,
             base = jnp.zeros((nq,), jnp.float32)
         lut = lut.astype(lut_dtype)                        # (nq, pq_dim, kcb)
         codes = list_codes[lists].astype(jnp.int32)        # (nq, cap, pq_dim)
-        ids = list_indices[lists]
-        sizes = list_sizes[lists]
         # gather-sum: out[q, c] = Σ_m lut[q, m, codes[q, c, m]]
         g = jnp.take_along_axis(
             lut[:, None, :, :].astype(acc_dtype),
             codes[:, :, :, None], axis=3)[..., 0]          # (nq, cap, pq_dim)
-        d = jnp.sum(g, axis=-1).astype(jnp.float32) + base[:, None]
-        live = jnp.arange(cap)[None, :] < sizes[:, None]
-        d = jnp.where(live, d, sentinel)
-        merged_d = jnp.concatenate([best_d, d], axis=1)
-        merged_i = jnp.concatenate([best_i, ids], axis=1)
-        best_d, best_i = select_k(merged_d, k, select_min=select_min,
-                                  indices=merged_i)
-        return (best_d, best_i), None
+        return jnp.sum(g, axis=-1).astype(jnp.float32) + base[:, None]
 
-    init = (jnp.full((nq, k), sentinel, jnp.float32),
-            jnp.full((nq, k), -1, jnp.int32))
-    (best_d, best_i), _ = jax.lax.scan(step, init,
-                                       jnp.swapaxes(probe_ids, 0, 1))
+    best_d, best_i = scan_probe_lists(probe_ids, score_tile, list_indices,
+                                      list_sizes, k, select_min=not is_ip,
+                                      dtype=jnp.float32)
     if metric_val == int(DistanceType.L2SqrtExpanded):
         best_d = jnp.sqrt(jnp.maximum(best_d, 0))
     return best_d, best_i
@@ -394,6 +384,8 @@ def search(params: SearchParams, index: Index, queries, k: int,
     expects(q.ndim == 2 and q.shape[1] == index.dim, "query dim mismatch")
     expects(params.lut_dtype in _LUT_DTYPES,
             f"lut_dtype must be one of {list(_LUT_DTYPES)}")
+    if q.shape[0] == 0:
+        return empty_result(0, int(k), jnp.float32)
     n_probes = min(params.n_probes, index.n_lists)
     is_ip = index.metric == DistanceType.InnerProduct
     leaves = (index.centers, index.rotation, index.codebooks,

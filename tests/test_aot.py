@@ -1,0 +1,57 @@
+"""AOT executable cache (the precompiled-libs equivalent, SURVEY.md §2.14)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.aot import AotFunction, aot, enable_persistent_cache
+
+
+def test_aot_caches_per_signature():
+    calls = {"n": 0}
+
+    @aot
+    def f(x):
+        calls["n"] += 1  # traced once per signature
+        return x * 2.0
+
+    a = np.ones((16, 4), np.float32)
+    r1 = f(a)
+    r2 = f(a + 1)
+    np.testing.assert_allclose(np.array(r2), 4.0)
+    assert calls["n"] == 1
+    assert f.cache_size == 1
+    f(np.ones((32, 4), np.float32))  # new shape → new executable
+    assert f.cache_size == 2
+    f(np.ones((16, 4), np.float64))  # new dtype → new executable
+    assert f.cache_size == 3
+
+
+def test_aot_bucketing_bounds_executables():
+    @aot(bucket=True)
+    def f(x):
+        return jnp.sum(x, axis=1)
+
+    for n in (9, 11, 13, 16):
+        out = f(np.ones((n, 3), np.float32))
+        assert out.shape[0] == 16  # bucketed to next pow2
+        np.testing.assert_allclose(np.array(out)[:n], 3.0)
+    assert f.cache_size == 1
+
+
+def test_aot_static_args():
+    @aot(static_argnums=(1,))
+    def f(x, k):
+        return x[:, :k]
+
+    out = f(np.ones((4, 8), np.float32), 3)
+    assert out.shape == (4, 3)
+    assert f.cache_size == 1
+    f(np.ones((4, 8), np.float32), 5)
+    assert f.cache_size == 2
+
+
+def test_persistent_cache_dir(tmp_path):
+    d = enable_persistent_cache(str(tmp_path / "xla"))
+    import os
+
+    assert os.path.isdir(d)
