@@ -161,7 +161,7 @@ def extract_flattened_clusters(children: np.ndarray, n_clusters: int, n: int
         parent[find(b)] = new
     roots = np.array([find(i) for i in range(n)])
     _, labels = np.unique(roots, return_inverse=True)
-    return labels
+    return labels.astype(np.int32)  # same dtype as the native path
 
 
 def single_linkage(x, metric: DistanceType = DistanceType.L2SqrtExpanded,

@@ -214,7 +214,32 @@ def _haversine(x, y):
 # dispatch (reference distance.cuh:305 runtime switch)
 # ---------------------------------------------------------------------------
 
+_PALLAS_OPS = {
+    DistanceType.L1: ("l1", None),
+    DistanceType.L2Unexpanded: ("l2", None),
+    DistanceType.L2SqrtUnexpanded: ("l2", jnp.sqrt),
+    DistanceType.Linf: ("linf", None),
+    DistanceType.Canberra: ("canberra", None),
+}
+
+
+def _try_pallas(x, y, metric: DistanceType):
+    """Opt-in Pallas engine for the VPU metrics (see pallas_kernels)."""
+    entry = _PALLAS_OPS.get(metric)
+    if entry is None:
+        return None
+    from raft_tpu.distance import pallas_kernels as pk
+
+    if not pk.is_enabled(x.shape[1]):
+        return None
+    acc = pk.pairwise_accumulate(x, y, entry[0])
+    return entry[1](acc) if entry[1] is not None else acc
+
+
 def _dispatch(x, y, metric: DistanceType, metric_arg: float):
+    pallas_out = _try_pallas(x, y, metric)
+    if pallas_out is not None:
+        return pallas_out
     if metric == DistanceType.L2Expanded:
         return _l2_expanded(x, y, sqrt=False)
     if metric == DistanceType.L2SqrtExpanded:

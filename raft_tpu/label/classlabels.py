@@ -25,7 +25,22 @@ def get_ovr_labels(labels, target_label, true_val=1, false_val=0):
 def make_monotonic(labels, unique_labels=None, zero_based: bool = True):
     """Map arbitrary label values onto a dense monotonic range
     (reference ``make_monotonic``: RAFT maps to 1..n by default; pass
-    zero_based=True for 0..n−1).  Jit-safe when unique_labels is given."""
+    zero_based=True for 0..n−1).  Jit-safe when unique_labels is given.
+
+    Host numpy inputs take the native C++ fast path when built
+    (native/raft_runtime.cpp ``rt_make_monotonic``)."""
+    import numpy as np
+
+    if unique_labels is None and isinstance(labels, np.ndarray):
+        try:
+            from raft_tpu import native
+
+            if native.is_available():
+                out, _ = native.make_monotonic_host(
+                    labels, zero_based=zero_based)
+                return jnp.asarray(out)
+        except (ImportError, RuntimeError):
+            pass
     labels = jnp.asarray(labels)
     if unique_labels is None:
         unique_labels = get_unique_labels(labels)

@@ -40,7 +40,6 @@ import numpy as np
 from raft_tpu.core.error import expects
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.distance.pairwise import distance as _pairwise
-from raft_tpu.matrix.select_k import select_k
 from raft_tpu.neighbors._common import (
     empty_result,
     pack_lists,

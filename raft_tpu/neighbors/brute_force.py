@@ -94,8 +94,9 @@ def knn(index, queries, k: int,
     expects(1 <= k <= index.shape[0],
             f"k={k} must be in [1, n_index={index.shape[0]}]")
     if queries.shape[0] == 0:
-        return (jnp.zeros((0, k), queries.dtype),
-                jnp.full((0, k), -1, jnp.int32))
+        from raft_tpu.neighbors._common import empty_result
+
+        return empty_result(0, int(k), queries.dtype)
     tile = min(batch_size_index, index.shape[0])
     # InnerProduct is a similarity: kNN selects the LARGEST values
     # (reference knn_brute_force_faiss.cuh: IP uses a max-selection heap).

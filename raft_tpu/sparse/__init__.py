@@ -23,6 +23,7 @@ from raft_tpu.sparse.convert import (  # noqa: F401
     csr_to_dense,
     dense_to_coo,
     dense_to_csr,
+    from_triplets,
 )
 from raft_tpu.sparse.op import (  # noqa: F401
     coo_max_duplicates,

@@ -17,6 +17,47 @@ from raft_tpu.core.error import expects
 from raft_tpu.sparse.types import COO, CSR
 
 
+def from_triplets(rows, cols, vals, shape) -> CSR:
+    """Build a CSR from raw host (row, col, value) triplets: sort by
+    (row, col), sum duplicates, drop explicit zeros, then convert.
+
+    The canonicalization runs in the native C++ runtime when built
+    (native/raft_runtime.cpp ``rt_coo_canonicalize``) — the host ingest
+    path of the reference's ``sparse/op`` sort+dedupe — with a numpy
+    fallback otherwise.
+    """
+    import numpy as np
+
+    rows = np.asarray(rows)
+    cols = np.asarray(cols)
+    vals = np.asarray(vals)
+    expects(rows.shape == cols.shape == vals.shape,
+            "from_triplets: rows/cols/vals must be the same length")
+    try:
+        from raft_tpu import native
+
+        if native.is_available():
+            r, c, v = native.coo_canonicalize_host(rows, cols, vals)
+            v = v.astype(vals.dtype if np.issubdtype(vals.dtype, np.floating)
+                         else np.float64)
+        else:
+            raise RuntimeError
+    except (ImportError, RuntimeError):
+        order = np.lexsort((cols, rows))
+        r, c, v0 = rows[order], cols[order], vals[order]
+        key = r.astype(np.int64) * shape[1] + c
+        uniq, inv = np.unique(key, return_inverse=True)
+        v = np.zeros(len(uniq), vals.dtype)
+        np.add.at(v, inv, v0)
+        r = (uniq // shape[1]).astype(np.int32)
+        c = (uniq % shape[1]).astype(np.int32)
+        keep = v != 0
+        r, c, v = r[keep], c[keep], v[keep]
+    coo = COO(jnp.asarray(r, jnp.int32), jnp.asarray(c, jnp.int32),
+              jnp.asarray(v), tuple(shape))
+    return coo_to_csr(coo)
+
+
 def coo_to_csr(coo: COO) -> CSR:
     """COO (row-sorted) → CSR.  Reference sparse/convert/csr.cuh
     ``sorted_coo_to_csr``: the input must be sorted by row (use

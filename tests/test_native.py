@@ -47,28 +47,21 @@ def test_flatten_matches_python():
     children, _, _ = build_dendrogram_host(src, dst, w)
     for k in (2, 5, 10):
         nat = native.agglomerative.extract_flattened_clusters(children, k, 80)
-        # python fallback
-        import os
+        # independent pure-python union-find oracle
+        parent = np.arange(2 * 80 - 1)
 
-        os.environ["RAFT_TPU_DISABLE_NATIVE"] = "1"
-        try:
-            # force fallback by calling the pure-python body directly
-            parent = np.arange(2 * 80 - 1)
+        def find(a):
+            while parent[a] != a:
+                parent[a] = parent[parent[a]]
+                a = parent[a]
+            return a
 
-            def find(a):
-                while parent[a] != a:
-                    parent[a] = parent[parent[a]]
-                    a = parent[a]
-                return a
-
-            for i in range(80 - k):
-                a, b = children[i]
-                parent[find(a)] = 80 + i
-                parent[find(b)] = 80 + i
-            roots = np.array([find(i) for i in range(80)])
-            _, py = np.unique(roots, return_inverse=True)
-        finally:
-            del os.environ["RAFT_TPU_DISABLE_NATIVE"]
+        for i in range(80 - k):
+            a, b = children[i]
+            parent[find(a)] = 80 + i
+            parent[find(b)] = 80 + i
+        roots = np.array([find(i) for i in range(80)])
+        _, py = np.unique(roots, return_inverse=True)
         np.testing.assert_array_equal(nat, py)
         assert len(np.unique(nat)) == k
 
@@ -106,3 +99,28 @@ def test_single_linkage_uses_native_transparently():
     assert len(np.unique(labels)) == 2
     assert len(np.unique(labels[:40])) == 1
     assert len(np.unique(labels[40:])) == 1
+
+
+def test_from_triplets_canonicalizes():
+    import scipy.sparse as sp
+
+    from raft_tpu.sparse import from_triplets
+
+    rows = np.array([3, 0, 3, 1, 0, 2], np.int32)
+    cols = np.array([1, 2, 1, 0, 2, 2], np.int32)
+    vals = np.array([1.5, 2.0, -1.5, 4.0, 1.0, 0.0], np.float64)
+    csr = from_triplets(rows, cols, vals, (4, 4))
+    ref = sp.coo_matrix((vals, (rows, cols)), shape=(4, 4)).tocsr()
+    ref.sum_duplicates()
+    ref.eliminate_zeros()
+    got = sp.csr_matrix((np.array(csr.data), np.array(csr.indices),
+                         np.array(csr.indptr)), shape=(4, 4))
+    assert (got != ref).nnz == 0
+
+
+def test_make_monotonic_native_path():
+    from raft_tpu.label import make_monotonic
+
+    labels = np.array([30, 10, 30, 20], np.int32)
+    out = np.array(make_monotonic(labels))
+    np.testing.assert_array_equal(out, [2, 0, 2, 1])
