@@ -96,9 +96,66 @@ def bench_kmeans():
     }
 
 
+def bench_ivf_pq():
+    """BASELINE config[2] (scaled): IVF-PQ QPS at recall gate, 200k×128."""
+    import jax
+
+    from raft_tpu.neighbors import ivf_pq, knn
+
+    rng = np.random.default_rng(0)
+    n, dim, nq, k = 200_000, 128, 1024, 10
+    centers = rng.normal(0, 5, (1000, dim))
+    x = (centers[rng.integers(0, 1000, n)]
+         + rng.normal(0, 1, (n, dim))).astype(np.float32)
+    q = (centers[rng.integers(0, 1000, nq)]
+         + rng.normal(0, 1, (nq, dim))).astype(np.float32)
+    index = ivf_pq.build(ivf_pq.IndexParams(n_lists=1000, pq_dim=32,
+                                            pq_bits=8, seed=1), x)
+    sp = ivf_pq.SearchParams(n_probes=20)
+    best = _time_best(lambda: ivf_pq.search(sp, index, q, k)[0], iters=5)
+    qps = nq / best
+    _, i = ivf_pq.search(sp, index, q, k)
+    _, ti = knn(x, q, k)
+    i, ti = np.array(i), np.array(ti)
+    recall = sum(len(set(a.tolist()) & set(b.tolist()))
+                 for a, b in zip(i, ti)) / ti.size
+    # A100 reference ballpark for this config ~50k QPS at recall ~0.9
+    return {
+        "metric": f"ivf_pq_qps_200kx128_recall{recall:.2f}",
+        "value": round(qps, 1),
+        "unit": "qps",
+        "vs_baseline": round(qps / 50_000.0, 3),
+    }
+
+
+def bench_lanczos():
+    """BASELINE config[3]: Lanczos smallest-eigenpairs on a sparse graph."""
+    import scipy.sparse as sp
+
+    from raft_tpu.sparse import CSR, laplacian, lanczos_smallest
+
+    rng = np.random.default_rng(0)
+    n = 20_000
+    g = sp.random(n, n, density=2e-3, format="csr", dtype=np.float32,
+                  random_state=1)
+    g = g + g.T
+    adj = CSR(g.indptr, g.indices, g.data, g.shape)
+    lap = laplacian(adj)
+    best = _time_best(lambda: lanczos_smallest(lap, 8, tol=1e-6)[0], iters=3)
+    solves = 1.0 / best
+    # A100 ballpark: ~2 solves/s for this size via cusparse+steqr
+    return {
+        "metric": "lanczos_smallest8_20k_2e-3",
+        "value": round(solves, 2),
+        "unit": "solves/s",
+        "vs_baseline": round(solves / 2.0, 3),
+    }
+
+
 def main():
     which = os.environ.get("BENCH_METRIC", "pairwise")
-    fn = {"pairwise": bench_pairwise, "kmeans": bench_kmeans}[which]
+    fn = {"pairwise": bench_pairwise, "kmeans": bench_kmeans,
+          "ivf_pq": bench_ivf_pq, "lanczos": bench_lanczos}[which]
     print(json.dumps(fn()))
 
 

@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# raft-tpu build driver — parity with the reference's build.sh
+# (reference build.sh:21-55: libraft pylibraft raft-dask docs tests bench).
+#
+# Targets:
+#   native   build the C++ host runtime (native/libraft_tpu_runtime.so)
+#   tests    run the pytest suite on the 8-device virtual CPU mesh
+#   bench    run the headline benchmark (real accelerator if present)
+#   checks   run the CI gate (ci/checks.sh)
+#   clean    remove build artifacts
+#
+# Default (no args): native + tests.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+targets=("$@")
+[ ${#targets[@]} -eq 0 ] && targets=(native tests)
+
+for t in "${targets[@]}"; do
+  case "$t" in
+    native)
+      make -C native
+      ;;
+    tests)
+      python -m pytest tests/ -q
+      ;;
+    bench)
+      python bench.py
+      ;;
+    checks)
+      bash ci/checks.sh
+      ;;
+    clean)
+      make -C native clean || true
+      rm -rf native/build .pytest_cache
+      find . -name __pycache__ -type d -prune -exec rm -rf {} +
+      ;;
+    *)
+      echo "unknown target: $t (native|tests|bench|checks|clean)" >&2
+      exit 1
+      ;;
+  esac
+done

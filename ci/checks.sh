@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# CI gate — parity with the reference's ci/checks/ style + test jobs
+# (reference ci/checks/style.sh, ci/gpu/build.sh:106-121).
+#
+# 1. bytecode-compile every source file (syntax gate)
+# 2. forbidden-pattern blacklist: no CUDA, no torch in the library
+#    (the reference bans sync CUDA calls the same way, black_lists.sh:22)
+# 3. import gate: the full public surface imports cleanly
+# 4. pytest on the 8-device virtual CPU mesh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== compile =="
+python -m compileall -q raft_tpu tests bench.py __graft_entry__.py
+
+echo "== blacklist =="
+# only real imports/usages count — docstrings cite reference CUDA symbols
+if grep -rnE '^\s*(import|from)\s+(torch|cupy|pycuda|numba)' \
+    raft_tpu/ --include="*.py"; then
+  echo "forbidden import found (torch/cupy/pycuda/numba in library code)" >&2
+  exit 1
+fi
+
+echo "== import =="
+python - <<'EOF'
+import importlib
+
+mods = [
+    "raft_tpu", "raft_tpu.core", "raft_tpu.core.aot", "raft_tpu.linalg",
+    "raft_tpu.matrix", "raft_tpu.stats", "raft_tpu.random",
+    "raft_tpu.distance", "raft_tpu.distance.pallas_kernels",
+    "raft_tpu.cluster", "raft_tpu.label", "raft_tpu.sparse",
+    "raft_tpu.spectral", "raft_tpu.solver", "raft_tpu.comms",
+    "raft_tpu.neighbors", "raft_tpu.neighbors.ivf_flat",
+    "raft_tpu.neighbors.ivf_pq", "raft_tpu.neighbors.ball_cover",
+    "raft_tpu.native",
+]
+for m in mods:
+    importlib.import_module(m)
+print(f"{len(mods)} modules import cleanly")
+EOF
+
+echo "== tests =="
+python -m pytest tests/ -q
+
+echo "CI checks passed"

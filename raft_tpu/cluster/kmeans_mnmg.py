@@ -33,7 +33,7 @@ from raft_tpu.distance.distance_types import DistanceType
 
 def compute_new_centroids(x_shard, centroids, comms: Comms,
                           sample_weights=None, metric=DistanceType.L2Expanded,
-                          batch_samples: int = 1 << 15, batch_centroids: int = 1024):
+                          batch_samples: int = 2048, batch_centroids: int = 1024):
     """One distributed E+M step on this rank's shard — the MNMG-composable
     building block (pylibraft ``compute_new_centroids``).
 

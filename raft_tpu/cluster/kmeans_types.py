@@ -31,6 +31,6 @@ class KMeansParams:
     oversampling_factor: float = 2.0
     # Batching knobs bounding the fused E-step tile (reference
     # kmeans_types.hpp batch_samples/batch_centroids; 0 → use n_clusters).
-    batch_samples: int = 1 << 15
+    batch_samples: int = 2048
     batch_centroids: int = 0
     inertia_check: bool = False
