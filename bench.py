@@ -13,10 +13,20 @@ The reference publishes no numbers (BASELINE.md); ``A100_BASELINE_GBPS`` is
 an engineering estimate of the reference on A100 for this config (epilogue-
 dominated: ~100 MB output at ~200 µs end-to-end).  vs_baseline is
 value / estimate, where ≥0.8 meets the north-star target.
+
+Select a metric with BENCH_METRIC=pairwise|kmeans|kmeans_mnmg|ivf_pq|lanczos.
+
+Robust bring-up (the round-1 failure was an unguarded TPU backend init):
+the measurement runs in a *child* process under a watchdog.  The parent
+retries the configured platform with backoff, then falls back to a scrubbed
+CPU environment so a number is always recorded; the JSON carries a
+"platform" field saying which backend actually ran.
 """
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -64,7 +74,6 @@ def bench_pairwise():
 def bench_kmeans():
     """BASELINE config[1]: k-means EM iterations/sec, 100k×128 f32, k=1024."""
     import jax
-    import jax.numpy as jnp
 
     from raft_tpu.cluster import min_cluster_and_distance, update_centroids
 
@@ -90,6 +99,43 @@ def bench_kmeans():
     ips = n_chain / (time.perf_counter() - t0)
     return {
         "metric": "kmeans_iter_100kx128_k1024_f32",
+        "value": round(ips, 2),
+        "unit": "iter/s",
+        "vs_baseline": round(ips / A100_BASELINE_KMEANS_ITERS, 3),
+    }
+
+
+def bench_kmeans_mnmg():
+    """BASELINE config[4]: distributed k-means EM iter/s over all local
+    devices (OPG row sharding + psum, the raft-dask MNMG pattern).
+
+    On the single-chip bench host this exercises the full shard_map/comms
+    path on a 1-device mesh; on a pod it scales with the mesh.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    from raft_tpu.cluster import KMeansParams, InitMethod, kmeans_mnmg
+    from raft_tpu.comms import build_comms
+
+    ndev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()), ("world",))
+    comms = build_comms(mesh)
+    n, dim, k = 100_000 // ndev * ndev, 128, 1024
+    rng = np.random.default_rng(0)
+    x = rng.random((n, dim), dtype=np.float32)
+    c0 = rng.random((k, dim), dtype=np.float32)
+    n_iter = 10
+    params = KMeansParams(n_clusters=k, init=InitMethod.Array, max_iter=n_iter,
+                          tol=0.0)
+    out = kmeans_mnmg.fit(params, comms, x, centroids=c0)  # warmup/compile
+    jax.block_until_ready(out.centroids)
+    t0 = time.perf_counter()
+    out = kmeans_mnmg.fit(params, comms, x, centroids=c0)
+    jax.block_until_ready(out.centroids)
+    ips = int(out.n_iter) / (time.perf_counter() - t0)
+    return {
+        "metric": f"kmeans_mnmg_iter_100kx128_k1024_f32_{ndev}dev",
         "value": round(ips, 2),
         "unit": "iter/s",
         "vs_baseline": round(ips / A100_BASELINE_KMEANS_ITERS, 3),
@@ -152,11 +198,84 @@ def bench_lanczos():
     }
 
 
+_METRICS = {"pairwise": bench_pairwise, "kmeans": bench_kmeans,
+            "kmeans_mnmg": bench_kmeans_mnmg, "ivf_pq": bench_ivf_pq,
+            "lanczos": bench_lanczos}
+
+
+def _child_main():
+    """Run one metric and print its JSON line (runs under the watchdog)."""
+    import jax
+
+    result = _METRICS[os.environ.get("BENCH_METRIC", "pairwise")]()
+    result["platform"] = jax.default_backend()
+    print(json.dumps(result), flush=True)
+
+
+def _cpu_env() -> dict:
+    """Scrubbed environment forcing the CPU backend in a fresh process.
+
+    Clearing PALLAS_AXON_POOL_IPS disables sitecustomize TPU-plugin
+    registration (which overrides JAX_PLATFORMS at jax.config level and can
+    block indefinitely on remote backend bring-up).
+    """
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    return env
+
+
+def _attempt(env, timeout_s, label):
+    """One watchdog-guarded child run; returns the JSON line or None."""
+    cmd = [sys.executable, os.path.abspath(__file__)]
+    env = dict(env)
+    env["_BENCH_CHILD"] = "1"
+    try:
+        proc = subprocess.run(cmd, env=env, stdout=subprocess.PIPE,
+                              stderr=sys.stderr, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(f"bench: {label}: timed out after {timeout_s}s "
+              f"(backend bring-up or compile hang)", file=sys.stderr)
+        return None
+    out = proc.stdout.decode(errors="replace")
+    if proc.returncode != 0:
+        print(f"bench: {label}: child exited rc={proc.returncode}",
+              file=sys.stderr)
+        return None
+    for line in reversed(out.strip().splitlines()):
+        if line.startswith("{"):
+            try:
+                json.loads(line)
+                return line
+            except json.JSONDecodeError:
+                continue
+    print(f"bench: {label}: no JSON line in child output", file=sys.stderr)
+    return None
+
+
 def main():
-    which = os.environ.get("BENCH_METRIC", "pairwise")
-    fn = {"pairwise": bench_pairwise, "kmeans": bench_kmeans,
-          "ivf_pq": bench_ivf_pq, "lanczos": bench_lanczos}[which]
-    print(json.dumps(fn()))
+    if os.environ.get("_BENCH_CHILD") == "1":
+        _child_main()
+        return
+    platform = os.environ.get("JAX_PLATFORMS") or "default"
+    t1 = int(os.environ.get("BENCH_TIMEOUT_S", "600"))
+    # Primary platform (TPU under the driver), with one retry after backoff:
+    # transient Unavailable from remote TPU bring-up was round 1's failure.
+    for attempt, timeout_s in ((1, t1), (2, t1 // 2)):
+        line = _attempt(dict(os.environ), timeout_s,
+                        f"platform '{platform}' attempt {attempt}")
+        if line is not None:
+            print(line)
+            return
+        time.sleep(10)
+    print(f"bench: platform '{platform}' failed twice; falling back to CPU",
+          file=sys.stderr)
+    line = _attempt(_cpu_env(), 1200, "cpu fallback")
+    if line is None:
+        print("bench: all platforms failed (tried "
+              f"'{platform}' x2, cpu)", file=sys.stderr)
+        sys.exit(1)
+    print(line)
 
 
 if __name__ == "__main__":

@@ -113,11 +113,14 @@ def _weighted_cluster_sums(x, labels, w, n_clusters: int):
 
     TPUs have no fast scatter-add, so for moderate k the segment-sum is
     recast as a chunked one-hot matmul riding the MXU (measured ~5× over
-    the scatter lowering on v5e at 100k×128, k=1024); large k falls back
-    to segment_sum where the one-hot would dominate memory.
+    the scatter lowering on v5e at 100k×128, k=1024; bench/bench_kmeans.py
+    ``mstep`` entry reproduces); large k falls back to segment_sum where the
+    one-hot would dominate memory.  CPU has no MXU and a fine scatter-add,
+    so it always takes the segment-sum path (measured ~4× over one-hot at
+    the same config on the CI host).
     """
     n, d = x.shape
-    if n_clusters > 4096 or n < _SUM_CHUNK:
+    if jax.default_backend() == "cpu" or n_clusters > 4096 or n < _SUM_CHUNK:
         wx = x * w[:, None]
         sums = jax.ops.segment_sum(wx, labels, num_segments=n_clusters)
         wsum = jax.ops.segment_sum(w, labels, num_segments=n_clusters)

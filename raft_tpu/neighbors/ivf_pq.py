@@ -14,11 +14,14 @@ Parameter surface mirrors ``ivf_pq_types.hpp:30-120``: ``pq_bits`` 4–8,
 TPU-first redesign:
 - The reference stores codes in a bit-packed interleaved layout and scores
   them with 15 precompiled CUDA kernel variants holding the LUT in shared
-  memory (ivf_pq_search.cuh:594-738).  Here codes live in padded dense
-  (n_lists, capacity, pq_dim) uint8 blocks; the LUT is a per-(query-batch)
+  memory (ivf_pq_search.cuh:594-738).  Here codes live **bit-packed** in
+  padded dense (n_lists, capacity, ⌈pq_dim·pq_bits/8⌉) uint8 blocks
+  (reference packing contract ivf_pq_types.hpp:56-65 — a pq_bits=4 index
+  costs half the bytes of pq_bits=8); search unpacks each gathered probe
+  tile with VPU shift/mask ops.  The LUT is a per-(query-batch)
   (nq, pq_dim, 2^bits) array resident in VMEM during the scoring gather,
-  and scoring is ``Σ_m LUT[q, m, code[q, c, m]]`` — a take_along_axis XLA
-  fuses with the running top-k merge.
+  and scoring is ``Σ_m LUT[q, m, code[q, c, m]]`` — a one-hot contraction
+  XLA fuses with the running top-k merge.
 - Codebook training is Lloyd k-means ``vmap``-ed over subspaces (or over
   clusters for PER_CLUSTER) — all codebooks train simultaneously on the
   MXU instead of the reference's sequential per-subspace loop.
@@ -54,7 +57,11 @@ _SUPPORTED = (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
               DistanceType.InnerProduct)
 
 _LUT_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
-               "float16": jnp.float16}
+               "float16": jnp.float16, "float8_e4m3": jnp.float8_e4m3fn}
+# fp8 e4m3 max finite is 448; quantize LUTs to a per-query [0, 440] range
+# (reference lut_dtype CUDA_R_8U plays the same compressed-LUT role,
+# ivf_pq_types.hpp:94-100).
+_FP8_PEAK = 440.0
 
 
 class CodebookKind(enum.IntEnum):
@@ -84,7 +91,9 @@ class SearchParams:
     """Reference ``ivf_pq::search_params`` (ivf_pq_types.hpp:88)."""
 
     n_probes: int = 20
-    lut_dtype: str = "float32"              # float32 | bfloat16 | float16
+    # float32 | bfloat16 | float16 | float8_e4m3 (reference lut_dtype incl.
+    # CUDA_R_8U, ivf_pq_types.hpp:94-100)
+    lut_dtype: str = "float32"
     internal_distance_dtype: str = "float32"  # float32 | float16
 
 
@@ -97,7 +106,8 @@ class Index:
     ``rotation``  (dim, rot_dim) orthonormal transform
     ``codebooks`` PER_SUBSPACE: (pq_dim, 2^bits, ds); PER_CLUSTER:
                   (n_lists, 2^bits, ds) — ds = rot_dim // pq_dim
-    ``list_codes``   (n_lists, capacity, pq_dim) uint8
+    ``list_codes``   (n_lists, capacity, ⌈pq_dim·pq_bits/8⌉) uint8,
+                     bit-packed (LSB-first bitstream of pq_bits codes)
     ``list_indices`` (n_lists, capacity) int32, -1 padding
     ``list_sizes``   (n_lists,) int32
     """
@@ -126,7 +136,9 @@ class Index:
 
     @property
     def pq_dim(self) -> int:
-        return self.list_codes.shape[2]
+        if self.codebook_kind == CodebookKind.PER_CLUSTER:
+            return self.rot_dim // self.codebooks.shape[2]
+        return self.codebooks.shape[0]
 
     @property
     def pq_len(self) -> int:
@@ -149,6 +161,41 @@ class Index:
     def tree_unflatten(cls, aux, leaves):
         return cls(*leaves, metric=aux[0], codebook_kind=aux[1],
                    pq_bits=aux[2])
+
+
+def _code_bytes(pq_dim: int, pq_bits: int) -> int:
+    return -(-pq_dim * pq_bits // 8)
+
+
+def _pack_codes(codes, pq_bits: int) -> jnp.ndarray:
+    """Bit-pack (n, pq_dim) sub-quantizer indices into (n, ⌈pq_dim·bits/8⌉)
+    uint8 — LSB-first bitstream (reference packed-codes contract,
+    ivf_pq_types.hpp:56-65).  pq_bits=8 is the identity."""
+    if pq_bits == 8:
+        return codes.astype(jnp.uint8)
+    n, pq_dim = codes.shape
+    total = pq_dim * pq_bits
+    nbytes = _code_bytes(pq_dim, pq_bits)
+    bits = (codes.astype(jnp.int32)[:, :, None]
+            >> jnp.arange(pq_bits)) & 1                 # (n, pq_dim, bits)
+    bits = bits.reshape(n, total)
+    if nbytes * 8 != total:
+        bits = jnp.pad(bits, ((0, 0), (0, nbytes * 8 - total)))
+    byte = jnp.sum(bits.reshape(n, nbytes, 8) << jnp.arange(8), axis=-1)
+    return byte.astype(jnp.uint8)
+
+
+def _unpack_codes(packed, pq_dim: int, pq_bits: int) -> jnp.ndarray:
+    """Inverse of :func:`_pack_codes`: (..., nbytes) uint8 → (..., pq_dim)
+    int32.  VPU shift/mask ops only — runs per gathered probe tile at
+    search time so the unpacked form never exists index-wide."""
+    if pq_bits == 8:
+        return packed.astype(jnp.int32)
+    lead = packed.shape[:-1]
+    bits = (packed.astype(jnp.int32)[..., :, None] >> jnp.arange(8)) & 1
+    bits = bits.reshape(lead + (packed.shape[-1] * 8,))[..., :pq_dim * pq_bits]
+    bits = bits.reshape(lead + (pq_dim, pq_bits))
+    return jnp.sum(bits << jnp.arange(pq_bits), axis=-1)
 
 
 def _calc_pq_dim(dim: int) -> int:
@@ -297,31 +344,79 @@ def build(params: IndexParams, dataset, ids=None) -> Index:
         codebooks = _train_codebooks_subspace(k_cb, resid, pq_dim, k,
                                               params.kmeans_n_iters)
 
-    # 5) encode + pack
+    # 5) encode + bit-pack + scatter into lists
     codes = _encode(resid, codebooks, labels,
                     params.codebook_kind == CodebookKind.PER_CLUSTER)
+    packed = _pack_codes(codes, params.pq_bits)
     if ids is None:
         ids = jnp.arange(n, dtype=jnp.int32)
     else:
         ids = jnp.asarray(ids, jnp.int32)
     list_codes, list_indices, list_sizes, _ = pack_lists(
-        codes, ids, labels, n_lists)
+        packed, ids, labels, n_lists)
     return Index(centers=centers, rotation=rotation, codebooks=codebooks,
                  list_codes=list_codes, list_indices=list_indices,
                  list_sizes=list_sizes, metric=params.metric,
                  codebook_kind=params.codebook_kind, pq_bits=params.pq_bits)
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7))
+def extend(index: Index, new_vectors, new_ids=None) -> Index:
+    """Add vectors to an existing index (reference ``ivf_pq::extend``,
+    neighbors/ivf_pq.cuh:103,128).  Functional: encodes the new vectors
+    with the trained centers/rotation/codebooks (no retraining, as in the
+    reference) and repacks the padded lists at the grown capacity.
+    """
+    x = jnp.asarray(new_vectors, jnp.float32)
+    expects(x.ndim == 2 and x.shape[1] == index.dim, "dim mismatch")
+    n_new = x.shape[0]
+    base = index.size
+    if new_ids is None:
+        new_ids = jnp.arange(base, base + n_new, dtype=jnp.int32)
+    else:
+        new_ids = jnp.asarray(new_ids, jnp.int32)
+        expects(new_ids.shape == (n_new,), "ids must be (n_new,)")
+
+    per_cluster = index.codebook_kind == CodebookKind.PER_CLUSTER
+    if index.metric == DistanceType.InnerProduct:
+        labels = jnp.argmax(x @ index.centers.T, axis=1).astype(jnp.int32)
+    else:
+        labels = min_cluster_and_distance(x, index.centers).key.astype(jnp.int32)
+    resid = (x - index.centers[labels]) @ index.rotation
+    codes = _encode(resid, index.codebooks, labels, per_cluster)
+    packed = _pack_codes(codes, index.pq_bits)
+
+    if base:
+        live = index.list_indices.reshape(-1) >= 0
+        nb = index.list_codes.shape[2]
+        old_codes = index.list_codes.reshape(-1, nb)[live]
+        old_ids = index.list_indices.reshape(-1)[live]
+        old_labels = jnp.repeat(jnp.arange(index.n_lists, dtype=jnp.int32),
+                                index.capacity)[live]
+        packed = jnp.concatenate([old_codes, packed], axis=0)
+        new_ids = jnp.concatenate([old_ids, new_ids])
+        labels = jnp.concatenate([old_labels, labels])
+    list_codes, list_indices, list_sizes, _ = pack_lists(
+        packed, new_ids, labels, index.n_lists)
+    return Index(centers=index.centers, rotation=index.rotation,
+                 codebooks=index.codebooks, list_codes=list_codes,
+                 list_indices=list_indices, list_sizes=list_sizes,
+                 metric=index.metric, codebook_kind=index.codebook_kind,
+                 pq_bits=index.pq_bits)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8))
 def _search_batch(q, probe_ids, leaves, metric_val: int, k: int,
-                  per_cluster: bool, lut_dtype_name: str, int_dtype_name: str):
+                  per_cluster: bool, lut_dtype_name: str, int_dtype_name: str,
+                  pq_bits: int):
     """Score probed lists via per-query LUTs (reference similarity kernels
     ivf_pq_search.cuh:594-738) with a running top-k merge."""
     centers, rotation, codebooks, list_codes, list_indices, list_sizes = leaves
     nq = q.shape[0]
     is_ip = metric_val == int(DistanceType.InnerProduct)
+    is_fp8 = lut_dtype_name == "float8_e4m3"
     lut_dtype = _LUT_DTYPES[lut_dtype_name]
-    acc_dtype = _LUT_DTYPES.get(int_dtype_name, jnp.float32)
+    acc_dtype = (jnp.float32 if is_fp8
+                 else _LUT_DTYPES.get(int_dtype_name, jnp.float32))
 
     rot_q = q @ rotation                                  # (nq, rot_dim)
     rot_centers = centers @ rotation                      # (n_lists, rot_dim)
@@ -355,8 +450,24 @@ def _search_batch(q, probe_ids, leaves, metric_val: int, k: int,
                        + jnp.sum(cb ** 2, -1)[None, :, :]
                        - 2.0 * jnp.einsum("qmd,mkd->qmk", r, cb))
             base = jnp.zeros((nq,), jnp.float32)
+        if is_fp8:
+            # fp8 e4m3's dynamic range can't hold raw squared distances:
+            # shift each (query, subspace) row to 0 and scale per query so
+            # the peak lands at _FP8_PEAK.  Positive per-query affine maps
+            # preserve the top-k ranking; the inverse map below restores
+            # approximate distances (the reference's fp8 LUT path likewise
+            # dequantizes with a scale, ivf_pq_search.cuh:469-494).
+            lo = jnp.min(lut, axis=2, keepdims=True)       # (nq, pq_dim, 1)
+            lut0 = lut - lo
+            scale = _FP8_PEAK / jnp.maximum(
+                jnp.max(lut0, axis=(1, 2)), 1e-30)         # (nq,)
+            lut = lut0 * scale[:, None, None]
+            base = base + jnp.sum(lo[:, :, 0], axis=1)     # re-added after
+        else:
+            scale = jnp.ones((nq,), jnp.float32)
         lut = lut.astype(lut_dtype)                        # (nq, pq_dim, kcb)
-        codes = list_codes[lists].astype(jnp.int32)        # (nq, cap, pq_dim)
+        codes = _unpack_codes(list_codes[lists], pq_dim, pq_bits)
+        # codes: (nq, cap, pq_dim) int32
         # LUT lookup as one-hot contraction: out[q,c] = Σ_m lut[q,m,code].
         # TPUs have no hardware gather — take_along_axis serializes on the
         # scalar unit (measured 6× slower), while the iota-compare one-hot
@@ -372,7 +483,8 @@ def _search_batch(q, probe_ids, leaves, metric_val: int, k: int,
         acc, _ = jax.lax.scan(
             lut_step, jnp.zeros((nq, codes.shape[1]), acc_dtype),
             (jnp.moveaxis(lut, 1, 0), jnp.moveaxis(codes, 2, 0)))
-        return acc.astype(jnp.float32) + base[:, None]
+        # fp8: invert the per-query affine quantization (scale is 1 else)
+        return (acc.astype(jnp.float32) / scale[:, None]) + base[:, None]
 
     best_d, best_i = scan_probe_lists(probe_ids, score_tile, list_indices,
                                       list_sizes, k, select_min=not is_ip,
@@ -416,7 +528,8 @@ def search(params: SearchParams, index: Index, queries, k: int,
                              int(index.metric), int(k),
                              index.codebook_kind == CodebookKind.PER_CLUSTER,
                              params.lut_dtype,
-                             params.internal_distance_dtype)
+                             params.internal_distance_dtype,
+                             index.pq_bits)
         out_d.append(d)
         out_i.append(i)
     d = out_d[0] if len(out_d) == 1 else jnp.concatenate(out_d, axis=0)

@@ -104,3 +104,78 @@ def test_ivf_pq_approx_distance_quality():
     # PQ distances approximate true distances within the quantization error
     rel = np.abs(np.array(d) - np.array(td)) / np.maximum(np.array(td), 1.0)
     assert np.median(rel) < 0.25
+
+
+def test_ivf_pq_packed_storage_bytes():
+    # pq_bits=4 codes cost half the bytes of pq_bits=8 (reference packing
+    # contract ivf_pq_types.hpp:56-65): storage per slot is
+    # ceil(pq_dim*pq_bits/8) bytes.
+    x, _ = make_data()
+    for bits, nbytes in [(4, 8), (5, 10), (6, 12), (8, 16)]:
+        idx = build(IndexParams(n_lists=50, pq_bits=bits, pq_dim=16, seed=5), x)
+        assert idx.list_codes.shape[2] == nbytes, (bits, idx.list_codes.shape)
+        assert idx.list_codes.dtype == np.uint8
+
+
+def test_ivf_pq_pack_roundtrip():
+    from raft_tpu.neighbors.ivf_pq import _pack_codes, _unpack_codes
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    for pq_dim, bits in [(16, 4), (12, 5), (16, 6), (8, 7), (16, 8), (5, 4)]:
+        codes = jnp.asarray(rng.integers(0, 1 << bits, (37, pq_dim)),
+                            jnp.uint8)
+        packed = _pack_codes(codes, bits)
+        assert packed.shape == (37, -(-pq_dim * bits // 8))
+        out = _unpack_codes(packed, pq_dim, bits)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(codes, np.int32))
+
+
+def test_ivf_pq_extend():
+    from raft_tpu.neighbors.ivf_pq import extend
+
+    x, q = make_data(n=4000)
+    k = 10
+    n0 = 3600
+    idx = build(IndexParams(n_lists=50, pq_bits=8, pq_dim=16, seed=5), x[:n0])
+    idx = extend(idx, x[n0:], np.arange(n0, 4000, dtype=np.int32))
+    assert idx.size == 4000
+    d, i = search(SearchParams(n_probes=20), idx, q, k)
+    _, ti = knn(x, q, k, DistanceType.L2Expanded)
+    # recall with 10% appended matches the all-at-once build gate
+    assert recall(i, np.array(ti)) >= 0.85
+    # appended ids are findable: query the new vectors themselves
+    d2, i2 = search(SearchParams(n_probes=20), idx, x[n0:n0 + 32], 1)
+    hit = np.mean(np.asarray(i2)[:, 0] == np.arange(n0, n0 + 32))
+    assert hit >= 0.9
+
+
+def test_ivf_pq_extend_packed_bits4():
+    from raft_tpu.neighbors.ivf_pq import extend
+
+    x, q = make_data()
+    idx = build(IndexParams(n_lists=50, pq_bits=4, pq_dim=16, seed=5),
+                x[:3600])
+    idx = extend(idx, x[3600:])
+    assert idx.size == 4000 and idx.list_codes.shape[2] == 8
+    d, i = search(SearchParams(n_probes=20), idx, q, 10)
+    _, ti = knn(x, q, 10, DistanceType.L2Expanded)
+    assert recall(i, np.array(ti)) >= 0.55
+
+
+def test_ivf_pq_fp8_lut():
+    x, q = make_data(n=2500, dim=32)
+    idx = build(IndexParams(n_lists=32, pq_bits=8, pq_dim=16, seed=6), x)
+    d32, i32 = search(SearchParams(n_probes=16, lut_dtype="float32"),
+                      idx, q, 10)
+    d8, i8 = search(SearchParams(n_probes=16, lut_dtype="float8_e4m3"),
+                    idx, q, 10)
+    _, ti = knn(x, q, 10, DistanceType.L2Expanded)
+    r32 = recall(i32, np.array(ti))
+    r8 = recall(i8, np.array(ti))
+    assert r8 >= r32 - 0.15, (r8, r32)
+    # dequantized distances stay close to the f32-LUT distances
+    rel = (np.abs(np.array(d8) - np.array(d32))
+           / np.maximum(np.array(d32), 1.0))
+    assert np.median(rel) < 0.1
