@@ -117,11 +117,27 @@ class Comms:
             self._group_rank_table = jnp.asarray(rank_table)
             self._mask_table = jnp.asarray(mask_table)
             self._members_table = jnp.asarray(members_table)
+            # Static ppermute tables for O(group)-traffic collectives
+            # (std_comms.hpp:107-171 builds a real NCCL sub-clique; the TPU
+            # analogue is within-group rings/butterflies — every group moves
+            # in the same ppermute, so one collective serves all groups).
+            gsz = self._group_size
+            self._perm_fwd = [(g[i], g[(i + 1) % gsz])
+                              for g in groups for i in range(gsz)]
+            if gsz & (gsz - 1) == 0:  # power of two → butterfly
+                self._perm_xor = [
+                    [(g[i], g[i ^ (1 << k)]) for g in groups for i in range(gsz)]
+                    for k in range((gsz - 1).bit_length())
+                ]
+            else:
+                self._perm_xor = None
         else:
             self._group_size = mesh.shape[axis_name]
             self._group_rank_table = None
             self._mask_table = None
             self._members_table = None
+            self._perm_fwd = None
+            self._perm_xor = None
 
     # -- introspection (reference core/comms.hpp:229-237) --------------------
     def get_size(self) -> int:
@@ -165,9 +181,33 @@ class Comms:
         """all_gather over the FULL axis (grouped selection is masked on top)."""
         return jax.lax.all_gather(x, self.axis_name)
 
-    def _my_mask(self):
-        """(n,)-bool membership mask of the calling rank's group."""
-        return self._mask_table[jax.lax.axis_index(self.axis_name)]
+    @staticmethod
+    def _combine(op: ReduceOp):
+        return {ReduceOp.SUM: jnp.add, ReduceOp.PROD: jnp.multiply,
+                ReduceOp.MIN: jnp.minimum, ReduceOp.MAX: jnp.maximum}[op]
+
+    def _group_allreduce(self, x, op: ReduceOp):
+        """Within-group allreduce with O(group) traffic.
+
+        Power-of-two groups: butterfly (recursive doubling) — log2(g)
+        ppermute rounds, each exchanging |x| bytes with the XOR partner
+        inside the group.  Other sizes: a rotation ring — g-1 rounds.
+        Either way traffic scales with the GROUP, not the world, unlike the
+        all_gather+mask fallback (the NCCL sub-clique property of reference
+        std_comms.hpp:107-171, expressed in ppermute).
+        """
+        x = jnp.asarray(x)
+        combine = self._combine(op)
+        if self._perm_xor is not None:
+            acc = x
+            for perm in self._perm_xor:
+                acc = combine(acc, jax.lax.ppermute(acc, self.axis_name, perm))
+            return acc
+        acc, y = x, x
+        for _ in range(self._group_size - 1):
+            y = jax.lax.ppermute(y, self.axis_name, self._perm_fwd)
+            acc = combine(acc, y)
+        return acc
 
     def allreduce(self, x, op: ReduceOp = ReduceOp.SUM):
         """reference comms_t::allreduce (core/comms.hpp:322)."""
@@ -176,31 +216,22 @@ class Comms:
                 # no pprod primitive: exp∘psum∘log is invalid for ≤0
                 return jnp.prod(self._gather_all(x), axis=0)
             return _REDUCERS[op](x, self.axis_name)
-        g = self._gather_all(x)  # (n, ...)
-        mask = self._my_mask()
-        mshape = (-1,) + (1,) * (g.ndim - 1)
-        m = mask.reshape(mshape)
-        if op == ReduceOp.SUM:
-            return jnp.sum(jnp.where(m, g, 0), axis=0)
-        if op == ReduceOp.PROD:
-            return jnp.prod(jnp.where(m, g, 1), axis=0)
-        if jnp.issubdtype(g.dtype, jnp.integer):
-            info = jnp.iinfo(g.dtype)
-            lo, hi = info.min, info.max
-        else:
-            lo, hi = -jnp.inf, jnp.inf
-        if op == ReduceOp.MIN:
-            return jnp.min(jnp.where(m, g, jnp.asarray(hi, g.dtype)), axis=0)
-        return jnp.max(jnp.where(m, g, jnp.asarray(lo, g.dtype)), axis=0)
+        return self._group_allreduce(x, op)
 
     def bcast(self, x, root: int = 0):
         """reference comms_t::bcast (core/comms.hpp:340,358): every rank
-        returns its group root's value (*root* is a rank-within-group)."""
-        g = self._gather_all(x)
+        returns its group root's value (*root* is a rank-within-group).
+
+        Grouped path: mask to the root's contribution, then the O(group)
+        ring/butterfly allreduce — traffic O(group)·|x|, not O(world)."""
         if self.groups is None:
-            return g[root]
-        root_global = self._members_table[jax.lax.axis_index(self.axis_name), root]
-        return jnp.take(g, root_global, axis=0)
+            return self._gather_all(x)[root]
+        x = jnp.asarray(x)
+        work = x.astype(jnp.int32) if x.dtype == jnp.bool_ else x
+        mine = self.get_rank() == root
+        masked = jnp.where(mine, work, jnp.zeros_like(work))
+        out = self._group_allreduce(masked, ReduceOp.SUM)
+        return out.astype(x.dtype) if x.dtype == jnp.bool_ else out
 
     def reduce(self, x, root: int = 0, op: ReduceOp = ReduceOp.SUM):
         """reference comms_t::reduce (core/comms.hpp:376): non-roots get the
@@ -211,12 +242,26 @@ class Comms:
     def allgather(self, x):
         """reference comms_t::allgather (core/comms.hpp:395) — concatenated
         along a new leading axis of size group_size (group members in key
-        order for split communicators)."""
-        g = self._gather_all(x)
+        order for split communicators).
+
+        Grouped path: rotation ring — g-1 ppermute rounds, O(group)·|x|
+        traffic per rank (vs O(world) for the all_gather+mask fallback).
+        After s forward rotations this rank holds the shard of the member
+        s positions behind it, so the stacked parts are rolled into
+        position order with a traced take."""
         if self.groups is None:
-            return g
-        members = self._members_table[jax.lax.axis_index(self.axis_name)]
-        return jnp.take(g, members, axis=0)
+            return self._gather_all(x)
+        x = jnp.asarray(x)
+        parts = [x]
+        y = x
+        for _ in range(self._group_size - 1):
+            y = jax.lax.ppermute(y, self.axis_name, self._perm_fwd)
+            parts.append(y)
+        stacked = jnp.stack(parts)  # stacked[s] = member at pos (p - s) % g
+        p = self.get_rank()
+        order = (p - jnp.arange(self._group_size, dtype=jnp.int32)) % self._group_size
+        # out[j] = member at pos j = stacked[(p - j) % g]
+        return jnp.take(stacked, order, axis=0)
 
     def allgatherv(self, x, counts: Sequence[int], pad_to: Optional[int] = None):
         """reference comms_t::allgatherv (core/comms.hpp:413): variable
@@ -240,12 +285,37 @@ class Comms:
     def gatherv(self, x, counts: Sequence[int], root: int = 0):
         return self.allgatherv(x, counts)
 
+    def _group_reduce_scatter(self, x, op: ReduceOp):
+        """Within-group ring reduce-scatter: g-1 ppermute rounds of ONE
+        chunk (|x|/g bytes) each — total traffic (g-1)/g·|x| per rank, the
+        bandwidth-optimal lowering (and the first half of a ring allreduce).
+
+        Chunk j enters the ring at rank (j+1)%g and accumulates over g-1
+        forward hops, landing fully reduced at rank j.  So rank p seeds
+        chunk (p-1)%g, and at round t combines the incoming partial chunk
+        (p-2-t)%g with its local shard of it; after g-1 rounds it holds
+        chunk p.
+        """
+        g = self._group_size
+        combine = self._combine(op)
+        chunk = x.shape[0] // g
+        xs = x.reshape((g, chunk) + x.shape[1:])  # xs[j] = local shard of chunk j
+        p = self.get_rank()
+        buf = jnp.take(xs, (p - 1) % g, axis=0)
+        for t in range(g - 1):
+            incoming = jax.lax.ppermute(buf, self.axis_name, self._perm_fwd)
+            recv_idx = (p - 2 - t) % g
+            buf = combine(incoming, jnp.take(xs, recv_idx, axis=0))
+        return buf  # fully-reduced chunk p
+
     def reducescatter(self, x, op: ReduceOp = ReduceOp.SUM):
         """reference comms_t::reducescatter (core/comms.hpp:481): reduce then
         scatter equal chunks; x's leading dim must be divisible by size."""
         expects(x.shape[0] % self.get_size() == 0,
                 "reducescatter requires leading dim divisible by group size")
-        if op != ReduceOp.SUM or self.groups is not None:
+        if self.groups is not None:
+            return self._group_reduce_scatter(x, op)
+        if op != ReduceOp.SUM:
             g = self.allreduce(x, op)
             rank = self.get_rank()
             chunk = x.shape[0] // self.get_size()
@@ -276,15 +346,30 @@ class Comms:
         g = self._gather_all(x)
         return jnp.stack([g[s] for s in srcs])
 
+    def _in_mapped_context(self) -> bool:
+        """True iff this communicator's axis is bound (i.e. we are tracing
+        inside its shard_map).  Explicit gate — no exception-probing."""
+        from jax._src import core as _core
+
+        return self.axis_name in _core.get_axis_env().axis_sizes
+
     def barrier(self):
         """reference comms_t::barrier (core/comms.hpp:255): inside shard_map
-        → a psum fence; outside → device sync."""
-        try:
+        → a psum fence.  Outside a mapped context this is only a LOCAL
+        device drain: correct single-process (all mesh devices are ours to
+        sync), an error multi-process (no cross-host rendezvous here —
+        reference barriers ride the NCCL clique, core/comms.hpp:255)."""
+        if self._in_mapped_context():
             return jax.lax.psum(jnp.ones(()), self.axis_name)
-        except NameError:  # outside a mapped context
-            for d in self.mesh.devices.flat:
-                jax.device_put(0.0, d).block_until_ready()
-            return None
+        if jax.process_count() > 1:
+            raise LogicError(
+                "Comms.barrier() outside shard_map is process-local; with "
+                f"{jax.process_count()} processes it cannot synchronize the "
+                "clique. Call it inside comms.run(...), or use the host p2p "
+                "plane for cross-process rendezvous.")
+        for d in self.mesh.devices.flat:
+            jax.device_put(0.0, d).block_until_ready()
+        return None
 
     # -- host p2p plane (UCX's role; reference isend/irecv/waitall) ----------
     def isend(self, obj, dst: int, tag: int = 0) -> Request:

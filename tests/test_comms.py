@@ -99,6 +99,92 @@ class TestSplit:
 
         assert int(comms.run(fn, jnp.zeros((n,)))) == 1
 
+    def _check_grouped(self, comms, sub, groups):
+        """Exercise every grouped collective and compare against numpy
+        per-group reference results."""
+        n = comms.get_size()
+        g = len(groups[0])
+        vals = np.arange(1.0, n + 1)  # rank r contributes r+1
+
+        def fn(x):
+            r = comms.get_global_rank().astype(jnp.float32) + 1
+            s = sub.allreduce(r, ReduceOp.SUM)[None]
+            mn = sub.allreduce(r, ReduceOp.MIN)[None]
+            mx = sub.allreduce(r, ReduceOp.MAX)[None]
+            pr = sub.allreduce(r, ReduceOp.PROD)[None]
+            bc = sub.bcast(r, root=1)[None]
+            ag = sub.allgather(r[None])[None]
+            rs = sub.reducescatter(jnp.full((g,), r))[None]
+            return s, mn, mx, pr, bc, ag, rs
+
+        out_specs = tuple(jax.sharding.PartitionSpec("world") for _ in range(7))
+        s, mn, mx, pr, bc, ag, rs = comms.run(
+            fn, jnp.zeros((n,)), out_specs=out_specs)
+        s, mn, mx, pr, bc = map(np.asarray, (s, mn, mx, pr, bc))
+        ag, rs = np.asarray(ag)[:, :, 0], np.asarray(rs)[:, 0]
+        for grp in groups:
+            gv = vals[grp]
+            for r_pos, r in enumerate(grp):
+                assert s[r] == gv.sum()
+                assert mn[r] == gv.min() and mx[r] == gv.max()
+                assert pr[r] == gv.prod()
+                assert bc[r] == vals[grp[1]]  # root=1 within group
+                np.testing.assert_allclose(ag[r], gv)
+                # reducescatter of a constant-per-rank vector: chunk r_pos
+                # of the sum == sum of the group's contributions
+                assert rs[r] == gv.sum()
+
+    def test_grouped_butterfly_2x4(self, comms):
+        """Power-of-two groups → recursive-doubling path."""
+        n = comms.get_size()
+        colors = [r // 4 for r in range(n)]
+        sub = comms.comm_split(colors)
+        groups = [list(range(4)), list(range(4, 8))]
+        self._check_grouped(comms, sub, groups)
+
+    def test_grouped_butterfly_interleaved(self, comms):
+        """Non-contiguous power-of-two groups (even/odd ranks)."""
+        n = comms.get_size()
+        sub = comms.comm_split([r % 2 for r in range(n)])
+        groups = [list(range(0, n, 2)), list(range(1, n, 2))]
+        self._check_grouped(comms, sub, groups)
+
+    def test_grouped_ring_3s(self):
+        """Group size 3 (not a power of two) → rotation-ring path, on a
+        6-device sub-mesh."""
+        from jax.sharding import Mesh
+
+        devs = jax.devices()[:6]
+        mesh = Mesh(np.array(devs), ("world",))
+        comms = build_comms(mesh, session_id="ring3")
+        sub = comms.comm_split([0, 0, 0, 1, 1, 1])
+        groups = [[0, 1, 2], [3, 4, 5]]
+        self._check_grouped(comms, sub, groups)
+
+    def test_grouped_keys_order(self, comms):
+        """allgather must follow key order within each group."""
+        n = comms.get_size()
+        sub = comms.comm_split([0] * n, keys=list(reversed(range(n))))
+
+        def fn(x):
+            r = comms.get_global_rank().astype(jnp.float32)
+            return sub.allgather(r[None])[None]
+
+        ag = np.asarray(comms.run(
+            fn, jnp.zeros((n,)),
+            out_specs=jax.sharding.PartitionSpec("world")))[:, :, 0]
+        for r in range(n):
+            np.testing.assert_allclose(ag[r], np.arange(n - 1.0, -1.0, -1.0))
+
+    def test_barrier_gates(self, comms):
+        # outside shard_map, single process: local drain, returns None
+        assert comms.barrier() is None
+
+        def fn(x):
+            return comms.barrier()
+
+        assert float(comms.run(fn, jnp.zeros((comms.get_size(),)))) > 0
+
     def test_split_validates(self, comms):
         from raft_tpu.core import LogicError
 
