@@ -6,22 +6,38 @@ strategies (``detail/coo_spmv.cuh``), L2/cosine-from-IP
 (``detail/l2_distance.cuh``), generic LP loop (``detail/lp_distance.cuh``)
 and binary metrics (``detail/bin_distance.cuh``).
 
-TPU-first redesign: the strategy zoo collapses into one **block-densify**
-engine.  CSR tiles are scattered into dense (block × dim) VMEM-resident
-tiles and handed to the dense :mod:`raft_tpu.distance` engines, so inner-
-product metrics ride the MXU and LP-loop metrics ride the fused VPU path.
-On TPU, densified tiles + static shapes beat gather-heavy sparse inner
-loops for the dimensionalities this library targets — the reference's own
-"dense smem" COO SpMV strategy is the same idea constrained to shared
-memory.  Batch sizes bound the densified footprint exactly like the
-reference's ``batch_size_index/query`` knobs (SURVEY.md §5).
+TPU-first redesign: the strategy zoo collapses into two engines.
+
+* **block-densify** (moderate dim): CSR tiles are scattered into dense
+  (block × dim) VMEM-resident tiles and handed to the dense
+  :mod:`raft_tpu.distance` engines, so inner-product metrics ride the MXU
+  and LP-loop metrics ride the fused VPU path.  The reference's own
+  "dense smem" COO SpMV strategy is the same idea constrained to shared
+  memory.
+* **feature-compressed** (high dim — the hash-table COO-SpMV role,
+  ``detail/coo_spmv.cuh`` + ``coo_spmv_strategies/``): each x-block is
+  densified onto its OWN sorted feature set ``u`` (≤ block-nnz columns —
+  independent of ``dim``), y-entries are matched into that compressed axis
+  by binary search, and the per-pair work runs on the compressed axis
+  (matmul for IP-family, tiled elementwise for the LP family).  Features a
+  y-row holds OUTSIDE ``u`` meet only zeros of x, so their contribution is
+  a per-row sum/max correction computed straight from the y entries.
+  Memory is O(block·block_nnz), never O(block·dim) — this is the engine
+  for 10⁴⁺-dimensional TF-IDF-style inputs where densification is
+  impossible (the inputs the reference's hash-table strategies exist for).
+
+Batch sizes bound the footprint exactly like the reference's
+``batch_size_index/query`` knobs (SURVEY.md §5).
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from raft_tpu.core.error import expects
 from raft_tpu.distance import DistanceType
@@ -53,18 +69,43 @@ SUPPORTED_SPARSE_DISTANCES = (
 )
 
 
+# metrics the block-densify engine cannot express through the dense
+# dispatch (reference computes them only sparsely, bin_distance.cuh)
+_COMPRESSED_ONLY = (DistanceType.JaccardExpanded, DistanceType.DiceExpanded)
+
+# dim above which "auto" switches to the feature-compressed engine (the
+# reference picks hash-table COO SpMV strategies by nnz/smem footprint;
+# here the criterion is the densified-tile width)
+HIGHDIM_THRESHOLD = 4096
+
+
 def pairwise_distance(x: CSR, y: CSR, metric: DistanceType = DistanceType.L2Expanded,
                       p: float = 2.0, batch_size_x: int = 4096,
-                      batch_size_y: Optional[int] = None) -> jnp.ndarray:
+                      batch_size_y: Optional[int] = None,
+                      engine: str = "auto") -> jnp.ndarray:
     """All-pairs distances between rows of two CSR matrices.
 
     Mirrors reference ``sparse::distance::pairwiseDistance``
     (sparse/distance/distance.cuh:68); returns a dense (m, n) matrix like
     the reference.
+
+    engine: "auto" (feature-compressed when dim > HIGHDIM_THRESHOLD or the
+    metric is sparse-only), "densify", or "compressed".
     """
     expects(metric in SUPPORTED_SPARSE_DISTANCES,
             f"metric {metric} not supported for sparse inputs")
     expects(x.shape[1] == y.shape[1], "pairwise_distance: dim mismatch")
+    expects(engine in ("auto", "densify", "compressed"),
+            f"unknown engine {engine!r}")
+    expects(not (engine == "densify" and metric in _COMPRESSED_ONLY),
+            f"{metric.name} has no densify path (sparse-only in the "
+            "reference, bin_distance.cuh) — use engine='compressed' or 'auto'")
+    if engine == "auto":
+        engine = ("compressed" if x.shape[1] > HIGHDIM_THRESHOLD
+                  or metric in _COMPRESSED_ONLY else "densify")
+    if engine == "compressed":
+        return _pairwise_compressed(x, y, metric, p, batch_size_x,
+                                    batch_size_y)
     m, n = x.shape[0], y.shape[0]
     bx = min(batch_size_x, m)
     by = min(batch_size_y or max(batch_size_x, 4096), n)
@@ -83,3 +124,218 @@ def pairwise_distance(x: CSR, y: CSR, metric: DistanceType = DistanceType.L2Expa
             row.append(_dense.pairwise_distance(xd, yd, metric, p=p))
         out_rows.append(row[0] if len(row) == 1 else jnp.concatenate(row, axis=1))
     return out_rows[0] if len(out_rows) == 1 else jnp.concatenate(out_rows, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# feature-compressed engine (reference detail/coo_spmv.cuh hash-strategy role)
+# ---------------------------------------------------------------------------
+
+def _seg_sum(v, rows, nrows):
+    # one extra segment collects padding rows; sliced off
+    return jax.ops.segment_sum(v, rows, num_segments=nrows + 1)[:nrows]
+
+
+def _row_stats(rows, vals, nrows):
+    """Exact per-row (Σv, Σv², nnz) from padded COO entries (padding rows
+    carry v=0 and land in the dropped extra segment)."""
+    s = _seg_sum(vals, rows, nrows)
+    sq = _seg_sum(vals * vals, rows, nrows)
+    nnz = _seg_sum((vals != 0).astype(vals.dtype), rows, nrows)
+    return s, sq, nnz
+
+
+def _canberra_terms(x, y):
+    den = jnp.abs(x) + jnp.abs(y)
+    return jnp.where(den > 0, jnp.abs(x - y) / jnp.where(den > 0, den, 1.0),
+                     0.0)
+
+
+def _js_acc(x, y):
+    # un-rooted Jensen-Shannon accumulation (dense _tile_jensen_shannon
+    # without the final sqrt·0.5 — applied after the outside-u correction)
+    m = 0.5 * (x + y)
+    safe = m > 0
+
+    def kl_part(a):
+        ok = (a > 0) & safe
+        return jnp.where(ok, a * (jnp.log(jnp.where(a > 0, a, 1.0))
+                                  - jnp.log(jnp.where(safe, m, 1.0))), 0.0)
+
+    return kl_part(x) + kl_part(y)
+
+
+# additive metrics: (pair_fn(x, y), zero_fn(y)) with Σ_f pair_fn and the
+# outside-u y-features contributing Σ zero_fn — pair_fn(0, 0) == 0 and
+# pair_fn(0, y) == zero_fn(y) by construction.  Final transforms applied
+# after the correction (so roots see the complete sum).
+_ADDITIVE = {
+    DistanceType.L1: (lambda x, y: jnp.abs(x - y), jnp.abs),
+    DistanceType.L2Unexpanded: (lambda x, y: (x - y) ** 2, lambda v: v * v),
+    DistanceType.L2SqrtUnexpanded: (lambda x, y: (x - y) ** 2, lambda v: v * v),
+    DistanceType.Canberra: (_canberra_terms,
+                            lambda v: (v != 0).astype(v.dtype)),
+    DistanceType.HammingUnexpanded: (
+        lambda x, y: (x != y).astype(x.dtype),
+        lambda v: (v != 0).astype(v.dtype)),
+    DistanceType.JensenShannon: (
+        _js_acc,
+        lambda v: jnp.where(v > 0, v, 0.0) * jnp.asarray(np.log(2.0), v.dtype)),
+}
+
+
+def _additive_tile(fn):
+    def tile(xi, yj):
+        return jnp.sum(fn(xi, yj), axis=-1)
+
+    return tile
+
+
+@functools.partial(jax.jit, static_argnames=("metric", "p", "bx", "by",
+                                             "ucap", "dim"))
+def _compressed_tile(xr, xc, xv, yr, yc, yv, metric: DistanceType, p: float,
+                     bx: int, by: int, ucap: int, dim: int):
+    """One (bx × by) output tile from padded COO entries of an x-block and a
+    y-block, via the compressed feature axis ``u`` of the x-block.
+
+    Padding convention: x pads have (row=bx, col=dim, val=0); y pads
+    (row=by, col=dim, val=0).  Pad scatters drop (mode='drop'); pad
+    segments are the sliced-off extra row of :func:`_seg_sum`.
+    """
+    dt = xv.dtype
+    u = jnp.unique(xc, size=ucap, fill_value=dim)  # sorted; fill sorts last
+    xpos = jnp.searchsorted(u, xc).astype(jnp.int32)
+    xd = jnp.zeros((bx, ucap), dt).at[xr, xpos].add(xv, mode="drop")
+    ypos = jnp.searchsorted(u, yc).astype(jnp.int32)
+    member = jnp.take(u, jnp.clip(ypos, 0, ucap - 1)) == yc
+    yd = jnp.zeros((by, ucap), dt).at[
+        yr, jnp.where(member, ypos, ucap)].add(yv, mode="drop")
+    y_out = (yr < by) & ~member  # real y entries outside u
+
+    def outside_sum(g0v):
+        return _seg_sum(jnp.where(y_out, g0v, 0), yr, by)
+
+    mm = functools.partial(jnp.matmul, precision="highest")
+
+    if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
+        _, xsq, _ = _row_stats(xr, xv, bx)
+        _, ysq, _ = _row_stats(yr, yv, by)
+        d = jnp.maximum(xsq[:, None] + ysq[None, :] - 2.0 * mm(xd, yd.T), 0.0)
+        return jnp.sqrt(d) if metric == DistanceType.L2SqrtExpanded else d
+    if metric == DistanceType.InnerProduct:
+        return mm(xd, yd.T)
+    if metric == DistanceType.CosineExpanded:
+        _, xsq, _ = _row_stats(xr, xv, bx)
+        _, ysq, _ = _row_stats(yr, yv, by)
+        denom = jnp.maximum(jnp.sqrt(xsq)[:, None] * jnp.sqrt(ysq)[None, :],
+                            1e-30)
+        return 1.0 - mm(xd, yd.T) / denom
+    if metric == DistanceType.CorrelationExpanded:
+        xs, xsq, _ = _row_stats(xr, xv, bx)
+        ys, ysq, _ = _row_stats(yr, yv, by)
+        k = dim
+        numer = k * mm(xd, yd.T) - xs[:, None] * ys[None, :]
+        q = k * xsq - xs * xs
+        r = k * ysq - ys * ys
+        denom = jnp.sqrt(jnp.maximum(q[:, None] * r[None, :], 1e-30))
+        return 1.0 - numer / denom
+    if metric == DistanceType.HellingerExpanded:
+        # scatter √|v| instead of v: IP of square roots
+        xs_ = jnp.zeros((bx, ucap), dt).at[xr, xpos].add(
+            jnp.sqrt(jnp.abs(xv)), mode="drop")
+        ys_ = jnp.zeros((by, ucap), dt).at[
+            yr, jnp.where(member, ypos, ucap)].add(
+            jnp.sqrt(jnp.abs(yv)), mode="drop")
+        return jnp.sqrt(jnp.maximum(1.0 - mm(xs_, ys_.T), 0.0))
+    if metric == DistanceType.RusselRaoExpanded:
+        # raw-value IP, matching the dense engine (russell_rao.cuh assumes
+        # boolean-valued inputs; the formula is applied to values as-is)
+        return (dim - mm(xd, yd.T)) * (1.0 / dim)
+    if metric == DistanceType.KLDivergence:
+        # 0.5·(Σ x log x − Σ x log y): both terms live entirely on u
+        # (x = 0 elsewhere; log y := 0 where y == 0, kl_divergence.cuh:27)
+        xlx = _seg_sum(jnp.where(xv > 0, xv * jnp.log(
+            jnp.where(xv > 0, xv, 1.0)), 0.0), xr, bx)
+        ylog = jnp.where(yd > 0, jnp.log(jnp.where(yd > 0, yd, 1.0)), 0.0)
+        return 0.5 * (xlx[:, None] - mm(xd, ylog.T))
+    if metric in (DistanceType.JaccardExpanded, DistanceType.DiceExpanded):
+        # reference bin_distance.cuh:114-157 / :168-213 on row SUMS + dot
+        xs, _, _ = _row_stats(xr, xv, bx)
+        ys, _, _ = _row_stats(yr, yv, by)
+        dot = mm(xd, yd.T)
+        union = xs[:, None] + ys[None, :]
+        both_empty = union == 0
+        if metric == DistanceType.JaccardExpanded:
+            denom = union - dot
+            sim = jnp.where(denom != 0, dot / jnp.where(denom != 0, denom, 1.0), 0.0)
+        else:
+            sim = jnp.where(union != 0, 2.0 * dot / jnp.where(union != 0, union, 1.0), 0.0)
+        return jnp.where(both_empty, 0.0, 1.0 - sim)
+    if metric == DistanceType.Linf:
+        base = _dense._blocked_reduce(xd, yd, _dense._tile_linf)
+        corr = jax.ops.segment_max(
+            jnp.where(y_out, jnp.abs(yv), 0.0), yr, num_segments=by + 1)[:by]
+        return jnp.maximum(base, corr[None, :])
+    if metric == DistanceType.LpUnexpanded:
+        pair = lambda a, b: jnp.power(jnp.abs(a - b), p)  # noqa: E731
+        base = _dense._blocked_reduce(xd, yd, _additive_tile(pair))
+        corr = outside_sum(jnp.power(jnp.abs(yv), p))
+        return jnp.power(base + corr[None, :], 1.0 / p)
+    pair, zero = _ADDITIVE[metric]
+    base = _dense._blocked_reduce(xd, yd, _additive_tile(pair))
+    acc = base + outside_sum(zero(yv))[None, :]
+    if metric == DistanceType.L2SqrtUnexpanded:
+        return jnp.sqrt(jnp.maximum(acc, 0.0))
+    if metric == DistanceType.HammingUnexpanded:
+        return acc * (1.0 / dim)
+    if metric == DistanceType.JensenShannon:
+        return jnp.sqrt(jnp.maximum(0.5 * acc, 0.0))
+    return acc
+
+
+def _block_entries(indptr, indices, data, i0, i1, bsz, cap, dim):
+    """Padded (rows_local, cols, vals) for CSR rows [i0, i1) — numpy."""
+    s, e = int(indptr[i0]), int(indptr[i1])
+    nz = e - s
+    rows = np.repeat(np.arange(i1 - i0), np.diff(indptr[i0:i1 + 1]))
+    r = np.full(cap, bsz, np.int32)
+    c = np.full(cap, dim, np.int32)
+    v = np.zeros(cap, data.dtype)
+    r[:nz] = rows
+    c[:nz] = indices[s:e]
+    v[:nz] = data[s:e]
+    return r, c, v
+
+
+def _pairwise_compressed(x: CSR, y: CSR, metric: DistanceType, p: float,
+                         batch_size_x: int, batch_size_y: Optional[int]):
+    m, dim = x.shape
+    n = y.shape[0]
+    bx = min(batch_size_x, m, 512)  # compressed tiles want narrower x-blocks
+    by = min(batch_size_y or 2048, n)
+    xip = np.asarray(x.indptr)
+    yip = np.asarray(y.indptr)
+    xind, xdat = np.asarray(x.indices), np.asarray(x.data)
+    yind, ydat = np.asarray(y.indices), np.asarray(y.data)
+
+    def roundup(v, q=256):
+        return max(q, -(-v // q) * q)
+
+    cap_x = roundup(max(int(xip[min(i0 + bx, m)] - xip[i0])
+                        for i0 in range(0, m, bx)))
+    cap_y = roundup(max(int(yip[min(j0 + by, n)] - yip[j0])
+                        for j0 in range(0, n, by)))
+    # ucap must cover every distinct column value in a padded x-block:
+    # distinct ≤ min(cap_x entries, dim features + the pad value dim)
+    ucap = min(cap_x, roundup(dim + 1, 128))
+
+    out = np.zeros((m, n), xdat.dtype)
+    for i0 in range(0, m, bx):
+        i1 = min(i0 + bx, m)
+        xr, xc, xv = _block_entries(xip, xind, xdat, i0, i1, bx, cap_x, dim)
+        for j0 in range(0, n, by):
+            j1 = min(j0 + by, n)
+            yr, yc, yv = _block_entries(yip, yind, ydat, j0, j1, by, cap_y, dim)
+            tile = _compressed_tile(xr, xc, xv, yr, yc, yv, metric, float(p),
+                                    bx, by, ucap, dim)
+            out[i0:i1, j0:j1] = np.asarray(tile)[: i1 - i0, : j1 - j0]
+    return jnp.asarray(out)
