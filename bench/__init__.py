@@ -1,0 +1,5 @@
+"""Per-primitive microbenchmarks (the reference's ``cpp/bench`` role).
+
+Run one family:   python -m bench.bench_distance
+Run everything:   python -m bench.run            (add BENCH_SMALL=1 for CI)
+"""

@@ -1,0 +1,66 @@
+"""Distance-family microbenches (reference cpp/bench/distance/*.cu).
+
+Backs the in-code perf claims of distance/pairwise.py (MXU vs VPU engines)
+and distance/pallas_kernels.py (XLA-fusion vs Pallas comparison).
+"""
+
+import numpy as np
+
+from bench.common import case, main_for
+from bench.sizes import size
+
+_M = size(5000, 256)
+_K = size(50, 16)
+_KM_N = size(100_000, 4096)
+_KM_K = size(1024, 64)
+_KM_D = size(128, 32)
+
+
+def _xy(m, n, k, seed=42):
+    import jax
+
+    rng = np.random.default_rng(seed)
+    return (jax.device_put(rng.random((m, k), dtype=np.float32)),
+            jax.device_put(rng.random((n, k), dtype=np.float32)))
+
+
+def _pairwise_case(metric):
+    def fn():
+        from raft_tpu.distance import pairwise_distance
+
+        x, y = _xy(_M, _M, _K)
+        nbytes = (_M * _K * 2 + _M * _M) * 4
+        return (lambda: pairwise_distance(x, y, metric)), {"bytes": nbytes}
+
+    return fn
+
+
+case("distance/l2sqrt_expanded")(_pairwise_case("euclidean"))
+case("distance/cosine")(_pairwise_case("cosine"))
+case("distance/l1_vpu")(_pairwise_case("l1"))
+
+
+@case("distance/fused_l2_nn")
+def bench_fused_l2_nn():
+    from raft_tpu.distance import fused_l2_nn_argmin
+
+    x, y = _xy(_KM_N, _KM_K, _KM_D)
+    flops = 2 * _KM_N * _KM_K * _KM_D
+    return (lambda: fused_l2_nn_argmin(x, y)), {"flops": flops}
+
+
+@case("distance/pallas_vs_xla_l1")
+def bench_pallas_l1():
+    """The pallas_kernels.py docstring comparison, runnable: L1 via the
+    opt-in Pallas engine when enabled, XLA fusion otherwise."""
+    from raft_tpu.distance import pairwise_distance
+
+    m = size(2048, 256)
+    k = size(256, 32)
+    x, y = _xy(m, m, k)
+    nbytes = (2 * m * k + m * m) * 4
+    return (lambda: pairwise_distance(x, y, "l1")), {"bytes": nbytes}
+
+
+if __name__ == "__main__":
+    main_for("bench.bench_distance")

@@ -1,0 +1,76 @@
+"""Linalg/matrix/random microbenches (reference cpp/bench/linalg/*.cu,
+cpp/bench/matrix/*.cu, cpp/bench/random/*.cu)."""
+
+import numpy as np
+
+from bench.common import case, main_for
+from bench.sizes import size
+
+_N = size(1 << 24, 1 << 16)
+_ROWS = size(16384, 512)
+_COLS = size(1024, 128)
+
+
+@case("linalg/reduce_rows")
+def bench_reduce():
+    import jax
+
+    from raft_tpu.linalg import reduce
+
+    rng = np.random.default_rng(0)
+    x = jax.device_put(rng.random((_ROWS, _COLS), dtype=np.float32))
+    return (lambda: reduce(x)), {"bytes": x.size * 4}
+
+
+@case("linalg/gemm_f32")
+def bench_gemm():
+    import jax
+
+    from raft_tpu.linalg import gemm
+
+    n = size(4096, 256)
+    rng = np.random.default_rng(0)
+    a = jax.device_put(rng.random((n, n), dtype=np.float32))
+    b = jax.device_put(rng.random((n, n), dtype=np.float32))
+    return (lambda: gemm(a, b)), {"flops": 2 * n ** 3}
+
+
+@case("matrix/argmin_cols")
+def bench_argmin():
+    import jax
+
+    from raft_tpu.matrix import argmin
+
+    rng = np.random.default_rng(0)
+    x = jax.device_put(rng.random((_ROWS, _COLS), dtype=np.float32))
+    return (lambda: argmin(x)), {"bytes": x.size * 4}
+
+
+@case("random/uniform")
+def bench_uniform():
+    from raft_tpu.random import RngState, uniform
+
+    def thunk():
+        return uniform(RngState(7), (_N,))
+
+    return thunk, {"bytes": _N * 4}
+
+
+@case("random/rmat")
+def bench_rmat():
+    import numpy as _np
+
+    from raft_tpu.random import RngState, rmat_rectangular_gen
+
+    n_edges = size(1 << 20, 1 << 12)
+    theta = _np.full((18, 4), [0.57, 0.19, 0.19, 0.05], dtype=_np.float32)
+
+    def thunk():
+        return rmat_rectangular_gen(RngState(3), theta, r_scale=18,
+                                    c_scale=18, n_edges=n_edges)[0]
+
+    return thunk, {"items": n_edges}
+
+
+if __name__ == "__main__":
+    main_for("bench.bench_linalg")

@@ -1,0 +1,84 @@
+"""Neighbors-family microbenches (reference cpp/bench/neighbors/*.cu):
+select_k, brute-force kNN, IVF-Flat and IVF-PQ build/search."""
+
+import numpy as np
+
+from bench.common import case, main_for
+from bench.sizes import size
+
+_N = size(200_000, 8192)
+_D = size(128, 32)
+_NQ = size(1024, 64)
+_LISTS = size(1000, 32)
+_K = 10
+
+
+def _clustered(n, nq, d, seed=0):
+    import jax
+
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 5, (_LISTS, d))
+    x = (centers[rng.integers(0, _LISTS, n)]
+         + rng.normal(0, 1, (n, d))).astype(np.float32)
+    q = (centers[rng.integers(0, _LISTS, nq)]
+         + rng.normal(0, 1, (nq, d))).astype(np.float32)
+    return jax.device_put(x), jax.device_put(q)
+
+
+@case("neighbors/select_k")
+def bench_select_k():
+    import jax
+
+    from raft_tpu.matrix import select_k
+
+    rng = np.random.default_rng(0)
+    d = jax.device_put(rng.random((_NQ, _N // 4), dtype=np.float32))
+    return (lambda: select_k(d, k=_K)), {"bytes": d.size * 4}
+
+
+@case("neighbors/brute_force_knn")
+def bench_bf_knn():
+    from raft_tpu.neighbors import knn
+
+    x, q = _clustered(_N // 4, _NQ, _D)
+    return (lambda: knn(x, q, _K)), {
+        "flops": 2 * (_N // 4) * _NQ * _D}
+
+
+@case("neighbors/ivf_flat_search")
+def bench_ivf_flat():
+    from raft_tpu.neighbors import ivf_flat
+
+    x, q = _clustered(_N, _NQ, _D)
+    index = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=_LISTS, seed=1), np.asarray(x))
+    sp = ivf_flat.SearchParams(n_probes=20)
+    return (lambda: ivf_flat.search(sp, index, q, _K)[1]), {"items": _NQ}
+
+
+@case("neighbors/ivf_pq_search")
+def bench_ivf_pq():
+    from raft_tpu.neighbors import ivf_pq
+
+    x, q = _clustered(_N, _NQ, _D)
+    index = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=_LISTS, pq_dim=min(32, _D), pq_bits=8,
+                           seed=1), np.asarray(x))
+    sp = ivf_pq.SearchParams(n_probes=20)
+    return (lambda: ivf_pq.search(sp, index, q, _K)[1]), {"items": _NQ}
+
+
+@case("neighbors/ivf_pq_build")
+def bench_ivf_pq_build():
+    from raft_tpu.neighbors import ivf_pq
+
+    x, _ = _clustered(_N // 4, 8, _D)
+    xh = np.asarray(x)
+    params = ivf_pq.IndexParams(n_lists=max(_LISTS // 4, 8),
+                                pq_dim=min(32, _D), pq_bits=8, seed=1)
+    return (lambda: ivf_pq.build(params, xh).list_codes), {
+        "items": _N // 4}
+
+
+if __name__ == "__main__":
+    main_for("bench.bench_neighbors")

@@ -1,0 +1,76 @@
+"""Sparse-family microbenches (reference cpp/bench/sparse/convert_csr.cu and
+the sparse distance benches): both distance engines, Lanczos, Borůvka MST."""
+
+import numpy as np
+
+from bench.common import case, main_for
+from bench.sizes import size
+
+
+def _random_csr(m, dim, nnz_row, seed):
+    rng = np.random.default_rng(seed)
+    cols = np.concatenate(
+        [np.sort(rng.choice(dim, nnz_row, replace=False)) for _ in range(m)]
+    ).astype(np.int32)
+    vals = rng.random(m * nnz_row).astype(np.float32) + 0.1
+    indptr = np.arange(m + 1, dtype=np.int32) * nnz_row
+    from raft_tpu.sparse import CSR
+
+    return CSR(indptr, cols, vals, (m, dim))
+
+
+@case("sparse/distance_densify")
+def bench_sparse_densify():
+    from raft_tpu.sparse.distance import pairwise_distance
+
+    m = size(2048, 128)
+    a = _random_csr(m, 1024, 32, 1)
+    b = _random_csr(m, 1024, 32, 2)
+    return (lambda: pairwise_distance(a, b, engine="densify")), {
+        "items": m * m}
+
+
+@case("sparse/distance_compressed_highdim")
+def bench_sparse_compressed():
+    from raft_tpu.sparse.distance import pairwise_distance
+
+    m = size(512, 64)
+    dim = size(50_000, 4096)
+    a = _random_csr(m, dim, 20, 1)
+    b = _random_csr(m, dim, 20, 2)
+    return (lambda: pairwise_distance(a, b, engine="compressed")), {
+        "items": m * m}
+
+
+@case("sparse/lanczos_smallest8")
+def bench_lanczos():
+    import scipy.sparse as sp
+
+    from raft_tpu.sparse import CSR, laplacian, lanczos_smallest
+
+    n = size(20_000, 1024)
+    g = sp.random(n, n, density=2e-3, format="csr", dtype=np.float32,
+                  random_state=1)
+    g = g + g.T
+    adj = CSR(g.indptr, g.indices, g.data, g.shape)
+    lap = laplacian(adj)
+    return (lambda: lanczos_smallest(lap, 8, tol=1e-6)[0]), {}
+
+
+@case("sparse/boruvka_mst")
+def bench_mst():
+    import scipy.sparse as sp
+
+    from raft_tpu.sparse import CSR
+    from raft_tpu.sparse.solver.mst import boruvka_mst
+
+    n = size(10_000, 512)
+    g = sp.random(n, n, density=4e-3, format="csr", dtype=np.float32,
+                  random_state=2)
+    g = g + g.T
+    adj = CSR(g.indptr, g.indices, g.data, g.shape)
+    return (lambda: boruvka_mst(adj).weight), {}
+
+
+if __name__ == "__main__":
+    main_for("bench.bench_sparse")

@@ -1,0 +1,91 @@
+"""Shared micro-benchmark fixture — the role of the reference's
+google-benchmark wrapper (cpp/bench/common/benchmark.hpp:108: stream-
+synchronized timing loop around each case).
+
+Each bench module registers cases with :func:`case`; running the module
+(or ``python -m bench.run``) times every case and emits one JSON line per
+case: {"bench": name, "value": v, "unit": u, ...extras}.
+
+Timing protocol: one untimed warmup call (compile), then ``iters`` timed
+calls, reporting the BEST wall time (matching bench.py and the reference's
+minimum-of-repetitions policy).  All calls are blocked on with
+``jax.block_until_ready``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Callable, Dict, List, Tuple
+
+_REGISTRY: List[Tuple[str, Callable]] = []
+
+
+def case(name: str):
+    """Decorator registering a bench case.  The function runs the workload
+    once and returns (thunk, work_dict) where thunk() -> device arrays and
+    work_dict carries units: {"bytes": n} and/or {"flops": n} and/or
+    {"items": n} (queries, rows...)."""
+
+    def deco(fn):
+        # idempotent: running `python -m bench.bench_foo` executes the
+        # module as __main__ AND main_for re-imports it under its canonical
+        # name — replace rather than duplicate.
+        for i, (n, _) in enumerate(_REGISTRY):
+            if n == name:
+                _REGISTRY[i] = (name, fn)
+                return fn
+        _REGISTRY.append((name, fn))
+        return fn
+
+    return deco
+
+
+def _time_best(thunk, iters: int) -> float:
+    import jax
+
+    jax.block_until_ready(thunk())  # warmup / compile
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(thunk())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def run_registered(iters: int = 10, select: str = "") -> List[Dict]:
+    """Time every registered case (filtered by substring *select*)."""
+    import jax
+
+    results = []
+    for name, fn in _REGISTRY:
+        if select and select not in name:
+            continue
+        thunk, work = fn()
+        best = _time_best(thunk, iters)
+        out = {"bench": name, "seconds": round(best, 6),
+               "platform": jax.default_backend()}
+        if "bytes" in work:
+            out["value"] = round(work["bytes"] / best / 1e9, 2)
+            out["unit"] = "GB/s"
+        elif "flops" in work:
+            out["value"] = round(work["flops"] / best / 1e12, 3)
+            out["unit"] = "TFLOP/s"
+        elif "items" in work:
+            out["value"] = round(work["items"] / best, 1)
+            out["unit"] = "items/s"
+        else:
+            out["value"] = round(1.0 / best, 3)
+            out["unit"] = "calls/s"
+        results.append(out)
+        print(json.dumps(out), flush=True)
+    return results
+
+
+def main_for(module_name: str):
+    """``python -m bench.bench_distance [substr] [iters]``."""
+    __import__(module_name)
+    select = sys.argv[1] if len(sys.argv) > 1 else ""
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+    run_registered(iters=iters, select=select)

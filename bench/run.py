@@ -1,0 +1,18 @@
+"""Run every bench family: ``python -m bench.run [substr] [iters]``.
+
+CI smoke: ``BENCH_SMALL=1 python -m bench.run '' 2`` (build.sh bench).
+"""
+
+import sys
+
+from bench.common import run_registered
+
+for mod in ("bench.bench_distance", "bench.bench_kmeans",
+            "bench.bench_neighbors", "bench.bench_sparse",
+            "bench.bench_linalg"):
+    __import__(mod)
+
+if __name__ == "__main__":
+    select = sys.argv[1] if len(sys.argv) > 1 else ""
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+    run_registered(iters=iters, select=select)

@@ -1,0 +1,14 @@
+"""Benchmark problem sizes.
+
+``BENCH_SMALL=1`` shrinks every case to a CI smoke size (the reference
+similarly parameterizes its google-benchmark cases; cpp/bench registers
+both small and large configs per primitive).
+"""
+
+import os
+
+SMALL = os.environ.get("BENCH_SMALL") == "1"
+
+
+def size(full: int, small: int) -> int:
+    return small if SMALL else full

@@ -27,6 +27,10 @@ for t in "${targets[@]}"; do
     bench)
       python bench.py
       ;;
+    microbench)
+      # per-primitive suite (reference cpp/bench role); BENCH_SMALL=1 for CI
+      python -m bench.run "${BENCH_SELECT:-}" "${BENCH_ITERS:-10}"
+      ;;
     checks)
       bash ci/checks.sh
       ;;
@@ -36,7 +40,7 @@ for t in "${targets[@]}"; do
       find . -name __pycache__ -type d -prune -exec rm -rf {} +
       ;;
     *)
-      echo "unknown target: $t (native|tests|bench|checks|clean)" >&2
+      echo "unknown target: $t (native|tests|bench|microbench|checks|clean)" >&2
       exit 1
       ;;
   esac

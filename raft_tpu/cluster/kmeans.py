@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.core.kvp import KeyValuePair
 from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams
 from raft_tpu.distance import DistanceType, pairwise_distance
@@ -297,10 +298,14 @@ def _resolve_batches(params: KMeansParams):
     return params.batch_samples, bc
 
 
-def fit(params: KMeansParams, x, sample_weights=None, centroids=None
-        ) -> KMeansOutput:
+@auto_sync_handle
+def fit(params: KMeansParams, x, sample_weights=None, centroids=None,
+        handle=None) -> KMeansOutput:
     """Full k-means fit (reference cluster/kmeans.cuh:85 ``fit``):
-    init (++/random/user array) → EM to convergence; best of n_init runs."""
+    init (++/random/user array) → EM to convergence; best of n_init runs.
+
+    *handle*: optional :class:`raft_tpu.core.Handle` (reference calling
+    convention, handle_t first arg); outputs are recorded on its stream."""
     x = jnp.asarray(x)
     expects(x.ndim == 2, "x must be [n_samples, n_features]")
     expects(params.n_clusters <= x.shape[0], "n_clusters must be <= n_samples")
@@ -332,8 +337,10 @@ def fit(params: KMeansParams, x, sample_weights=None, centroids=None
     return best
 
 
+@auto_sync_handle
 def predict(params: KMeansParams, x, centroids, sample_weights=None,
-            normalize_weight: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray]:
+            normalize_weight: bool = True, handle=None
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Labels + inertia for fixed centroids (reference kmeans.cuh ``predict``).
 
     *normalize_weight* matches the reference flag: normalize sample weights
@@ -349,11 +356,13 @@ def predict(params: KMeansParams, x, centroids, sample_weights=None,
     return nn.key, cluster_cost(nn, sample_weights)
 
 
-def fit_predict(params: KMeansParams, x, sample_weights=None, centroids=None
-                ) -> KMeansOutput:
+@auto_sync_handle
+def fit_predict(params: KMeansParams, x, sample_weights=None, centroids=None,
+                handle=None) -> KMeansOutput:
     """reference kmeans.cuh ``fit_predict``."""
-    out = fit(params, x, sample_weights, centroids)
-    labels, _ = predict(params, x, out.centroids, sample_weights)
+    out = fit(params, x, sample_weights, centroids, handle=handle)
+    labels, _ = predict(params, x, out.centroids, sample_weights,
+                        handle=handle)
     return KMeansOutput(out.centroids, out.inertia, out.n_iter, labels)
 
 

@@ -30,15 +30,26 @@ from raft_tpu.core.error import expects
 from raft_tpu.distance.distance_types import DistanceType
 
 
+def _as_comms(comms_or_handle) -> Comms:
+    """Accept a :class:`Comms` or a :class:`raft_tpu.core.Handle` carrying
+    one (reference convention: MNMG entry points take handle_t and call
+    ``handle.get_comms()``, DEVELOPER_GUIDE.md:11-25)."""
+    if hasattr(comms_or_handle, "get_comms"):
+        return comms_or_handle.get_comms()
+    return comms_or_handle
+
+
 def compute_new_centroids(x_shard, centroids, comms: Comms,
                           sample_weights=None, metric=DistanceType.L2Expanded,
                           batch_samples: int = 2048, batch_centroids: int = 1024):
     """One distributed E+M step on this rank's shard — the MNMG-composable
     building block (pylibraft ``compute_new_centroids``).
 
-    Must run inside the comms' shard_map context.  Returns
+    Must run inside the comms' shard_map context.  *comms* may be a Comms
+    or a Handle with comms injected.  Returns
     (new_centroids, weight_per_cluster, local_inertia_sum).
     """
+    comms = _as_comms(comms)
     from raft_tpu.cluster.kmeans import _weighted_cluster_sums
 
     k = centroids.shape[0]
@@ -110,11 +121,13 @@ def fit(params: KMeansParams, comms: Comms, x, centroids=None) -> KMeansOutput:
     """Distributed k-means fit over rows sharded across the comms axis.
 
     x: global [n, dim] array (host or device); it is sharded row-wise over
-    the mesh.  Init: user array, or k-means|| computed on rank data via the
+    the mesh.  *comms* may be a Comms or a Handle with comms injected.
+    Init: user array, or k-means|| computed on rank data via the
     single-device path (init cost is O(k·dim), negligible vs EM).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    comms = _as_comms(comms)
     x = jnp.asarray(x)
     n, dim = x.shape
     nranks = comms.get_size()
@@ -157,9 +170,10 @@ def _predict_program(comms: Comms, metric: DistanceType, bs: int, bc: int):
 
 
 def predict(params: KMeansParams, comms: Comms, x, centroids):
-    """Distributed labels + inertia."""
+    """Distributed labels + inertia (*comms*: Comms or Handle)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    comms = _as_comms(comms)
     x = jnp.asarray(x)
     centroids = jnp.asarray(centroids)
 

@@ -15,8 +15,13 @@ equivalents are:
   comms_t slot         → :meth:`Handle.set_comms` / :meth:`get_comms` /
                          :meth:`get_subcomm` (reference handle.hpp:239-262)
 
-Every public raft_tpu function takes a ``Handle`` first (or creates a default
-one), matching the reference's calling convention.
+The reference's calling convention (every function takes ``handle_t`` first,
+DEVELOPER_GUIDE.md:11-25) maps here to an optional ``handle=`` keyword on the
+public algorithm entry points (``@auto_sync_handle``, mirroring pylibraft):
+outputs are recorded on the handle's stream; a default handle is injected and
+synced when none is supplied.  Comms-bearing paths (``cluster.kmeans_mnmg``)
+accept a Handle wherever they take a communicator and consume
+``handle.get_comms()``.
 """
 
 from __future__ import annotations
@@ -41,7 +46,10 @@ class Stream:
 
     def __init__(self, name: str = "main"):
         self.name = name
-        self._inflight: "weakref.WeakSet" = weakref.WeakSet()
+        # list of weakrefs, NOT a WeakSet: jax ArrayImpl is weakrefable but
+        # unhashable, and WeakSet requires hashability (its add() raises
+        # TypeError, which would silently drop every array)
+        self._inflight: List["weakref.ref"] = []
         self._lock = threading.Lock()
 
     def record(self, *arrays: Any) -> None:
@@ -53,22 +61,26 @@ class Stream:
                 for leaf in jax.tree_util.tree_leaves(a):
                     if hasattr(leaf, "is_ready"):
                         try:
-                            self._inflight.add(leaf)
+                            self._inflight.append(weakref.ref(leaf))
                         except TypeError:  # non-weakrefable leaf
                             pass
+
+    def _live(self) -> List[Any]:
+        return [a for r in self._inflight if (a := r()) is not None]
 
     def synchronize(self) -> None:
         """Interruptibly wait for all recorded work (reference
         ``handle.sync_stream`` → ``interruptible::synchronize``)."""
         with self._lock:
-            pending = list(self._inflight)
-            self._inflight = weakref.WeakSet()
+            pending = self._live()
+            self._inflight = []
         interruptible.synchronize(*pending)
 
     def query(self) -> bool:
         """True if all recorded work has completed (``cudaStreamQuery``-like)."""
         with self._lock:
-            return all(getattr(a, "is_ready", lambda: True)() for a in self._inflight)
+            return all(getattr(a, "is_ready", lambda: True)()
+                       for a in self._live())
 
 
 class Handle:
@@ -219,13 +231,15 @@ def auto_sync_handle(fn):
         # Bind to find the handle whether passed positionally or by keyword.
         bound = sig.bind_partial(*args, **kwargs)
         supplied = bound.arguments.get("handle")
+        h = supplied if supplied is not None else default_handle()
+        bound.arguments["handle"] = h
+        out = fn(*bound.args, **bound.kwargs)
+        # Outputs are recorded on the handle's stream either way; with a
+        # caller-supplied handle the caller owns the sync (pylibraft
+        # semantics: handle.sync() after use), otherwise sync eagerly.
+        h.get_stream().record(out)
         if supplied is None:
-            h = default_handle()
-            bound.arguments["handle"] = h
-            out = fn(*bound.args, **bound.kwargs)
-            h.get_stream().record(out)
             h.sync_stream()
-            return out
-        return fn(*args, **kwargs)
+        return out
 
     return wrapper

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.core.kvp import KeyValuePair, kvp_min
 
 _BN = 1024  # column block: y-block (bn × k) + distance block (bm × bn) stay in VMEM
@@ -110,7 +111,8 @@ def fused_l2_nn_min_reduce(x, y, sqrt: bool = False, **kw) -> KeyValuePair:
     return fused_l2_nn(x, y, sqrt=sqrt, **kw)
 
 
-def fused_l2_nn_argmin(x, y, sqrt: bool = True):
+@auto_sync_handle
+def fused_l2_nn_argmin(x, y, sqrt: bool = True, handle=None):
     """Argmin-only convenience (pylibraft ``fused_l2_nn_argmin``,
-    distance/fused_l2_nn.pyx:64)."""
+    distance/fused_l2_nn.pyx:64, @auto_sync_handle there too)."""
     return fused_l2_nn(x, y, sqrt=sqrt).key

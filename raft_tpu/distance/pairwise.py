@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.error import LogicError, expects
+from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.distance.distance_types import DISTANCE_TYPES, DistanceType
 
 _BM = 128  # row-block (sublane-friendly)
@@ -296,15 +297,19 @@ def distance(x, y, metric: DistanceType, metric_arg: float = 2.0):
     return _distance_jit(x, y, DistanceType(metric), float(metric_arg))
 
 
+@auto_sync_handle
 def pairwise_distance(x, y, metric: Union[str, DistanceType] = "euclidean",
-                      metric_arg: float = 2.0, p: Optional[float] = None):
+                      metric_arg: float = 2.0, p: Optional[float] = None,
+                      handle=None):
     """Runtime-dispatched pairwise distance (reference
     ``pairwise_distance``, distance/distance.cuh:293; Python surface
-    pylibraft distance/pairwise_distance.pyx:95).
+    pylibraft distance/pairwise_distance.pyx:95, wrapped @auto_sync_handle
+    there too).
 
     Parameters mirror pylibraft: *metric* may be any name in
     ``DISTANCE_TYPES`` or a :class:`DistanceType`; *p* (alias *metric_arg*)
-    is the Minkowski exponent.
+    is the Minkowski exponent; *handle* an optional
+    :class:`raft_tpu.core.Handle` whose stream records the output.
     """
     if isinstance(metric, str):
         m = DISTANCE_TYPES.get(metric.lower())

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.distance.distance_types import DISTANCE_TYPES, DistanceType
 from raft_tpu.distance.pairwise import distance as _pairwise
 from raft_tpu.matrix.select_k import select_k
@@ -72,12 +73,14 @@ def _knn_scan(index, queries, k: int, metric: DistanceType,
     return best_d, best_i
 
 
+@auto_sync_handle
 def knn(index, queries, k: int,
         metric: Union[str, DistanceType] = DistanceType.L2SqrtExpanded,
         metric_arg: float = 2.0, *,
         batch_size_index: int = 8192,
         batch_size_query: int = 4096,
-        global_id_offset: int = 0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        global_id_offset: int = 0,
+        handle=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Exact k-nearest-neighbors of *queries* among rows of *index*.
 
     Reference ``brute_force::knn`` (neighbors/brute_force.cuh:144; impl

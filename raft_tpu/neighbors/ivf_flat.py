@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.cluster import build_hierarchical, min_cluster_and_distance
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.matrix.select_k import select_k
@@ -139,7 +140,8 @@ def _assign_lists(q, centers, metric: DistanceType) -> jnp.ndarray:
     return min_cluster_and_distance(q, centers).key.astype(jnp.int32)
 
 
-def build(params: IndexParams, dataset, ids=None) -> Index:
+@auto_sync_handle
+def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
     """Train + populate an IVF-Flat index (reference ``ivf_flat::build``,
     neighbors/ivf_flat.cuh:64 → ivf_flat_build.cuh:228)."""
     x = jnp.asarray(dataset)
@@ -250,8 +252,9 @@ def _scan_probes(queries, probe_ids, index_leaves, metric_val: int, k: int,
     return best_d, best_i
 
 
+@auto_sync_handle
 def search(params: SearchParams, index: Index, queries, k: int,
-           *, batch_size_query: int = 1024
+           *, batch_size_query: int = 1024, handle=None
            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Search the index (reference ``ivf_flat::search``,
     neighbors/ivf_flat.cuh:325 → ivf_flat_search.cuh:1057):

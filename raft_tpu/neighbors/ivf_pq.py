@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.cluster import build_hierarchical, min_cluster_and_distance
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.matrix.select_k import select_k
@@ -301,7 +302,8 @@ def _encode(residuals, codebooks, labels, per_cluster: bool):
     return jnp.argmin(d, axis=-1).astype(jnp.uint8)
 
 
-def build(params: IndexParams, dataset, ids=None) -> Index:
+@auto_sync_handle
+def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
     """Train + populate (reference ``ivf_pq::build``, ivf_pq_build.cuh)."""
     x = jnp.asarray(dataset, jnp.float32)
     expects(x.ndim == 2, "dataset must be (n, dim)")
@@ -468,17 +470,27 @@ def _search_batch(q, probe_ids, leaves, metric_val: int, k: int,
         lut = lut.astype(lut_dtype)                        # (nq, pq_dim, kcb)
         codes = _unpack_codes(list_codes[lists], pq_dim, pq_bits)
         # codes: (nq, cap, pq_dim) int32
-        # LUT lookup as one-hot contraction: out[q,c] = Σ_m lut[q,m,code].
-        # TPUs have no hardware gather — take_along_axis serializes on the
-        # scalar unit (measured 6× slower), while the iota-compare one-hot
-        # einsum rides the vector unit and XLA fuses the one-hot
-        # materialization into the contraction, one subspace per scan step.
-        def lut_step(acc, args):
-            lut_m, codes_m = args                          # (nq,kcb),(nq,cap)
-            oh = (codes_m[:, :, None] ==
-                  jnp.arange(kcb, dtype=codes_m.dtype)).astype(lut.dtype)
-            return acc + jnp.einsum("qck,qk->qc", oh, lut_m,
-                                    preferred_element_type=acc.dtype), None
+        # LUT lookup, out[q,c] = Σ_m lut[q,m,code]:
+        # * TPU: one-hot contraction.  No hardware gather —
+        #   take_along_axis serializes on the scalar unit (measured 6×
+        #   slower on v5e), while the iota-compare one-hot einsum rides the
+        #   MXU/VPU and XLA fuses the one-hot materialization into the
+        #   contraction, one subspace per scan step.
+        # * CPU (CI/fallback): the one-hot costs kcb× the flops of a
+        #   gather and CPU gathers are cheap — take_along_axis directly
+        #   (measured ~40× faster at the smoke bench size).
+        if jax.default_backend() == "cpu":
+            def lut_step(acc, args):
+                lut_m, codes_m = args                      # (nq,kcb),(nq,cap)
+                got = jnp.take_along_axis(lut_m, codes_m, axis=1)
+                return acc + got.astype(acc.dtype), None
+        else:
+            def lut_step(acc, args):
+                lut_m, codes_m = args                      # (nq,kcb),(nq,cap)
+                oh = (codes_m[:, :, None] ==
+                      jnp.arange(kcb, dtype=codes_m.dtype)).astype(lut.dtype)
+                return acc + jnp.einsum("qck,qk->qc", oh, lut_m,
+                                        preferred_element_type=acc.dtype), None
 
         acc, _ = jax.lax.scan(
             lut_step, jnp.zeros((nq, codes.shape[1]), acc_dtype),
@@ -494,8 +506,9 @@ def _search_batch(q, probe_ids, leaves, metric_val: int, k: int,
     return best_d, best_i
 
 
+@auto_sync_handle
 def search(params: SearchParams, index: Index, queries, k: int,
-           *, batch_size_query: int = 1024
+           *, batch_size_query: int = 1024, handle=None
            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Search (reference ``ivf_pq::search``, ivf_pq_search.cuh:780):
     coarse top-n_probes → per-probe LUT scoring → top-k.

@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.random.rng import RngState, _key_of
 
 
@@ -117,8 +118,9 @@ def multi_variable_gaussian(rng, mean, cov, n_samples: int = 1,
     return mean[None, :] + samples
 
 
+@auto_sync_handle
 def rmat_rectangular_gen(rng, theta, r_scale: int, c_scale: int, n_edges: int,
-                         clip_and_flip: bool = False):
+                         clip_and_flip: bool = False, handle=None):
     """Stochastic Kronecker (R-MAT) graph generator (reference
     random/rmat_rectangular_generator.cuh:75).
 

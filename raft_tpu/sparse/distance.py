@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.distance import DistanceType
 from raft_tpu.distance import pairwise as _dense
 from raft_tpu.sparse.op import csr_row_slice
@@ -79,10 +80,11 @@ _COMPRESSED_ONLY = (DistanceType.JaccardExpanded, DistanceType.DiceExpanded)
 HIGHDIM_THRESHOLD = 4096
 
 
+@auto_sync_handle
 def pairwise_distance(x: CSR, y: CSR, metric: DistanceType = DistanceType.L2Expanded,
                       p: float = 2.0, batch_size_x: int = 4096,
                       batch_size_y: Optional[int] = None,
-                      engine: str = "auto") -> jnp.ndarray:
+                      engine: str = "auto", handle=None) -> jnp.ndarray:
     """All-pairs distances between rows of two CSR matrices.
 
     Mirrors reference ``sparse::distance::pairwiseDistance``
@@ -121,7 +123,8 @@ def pairwise_distance(x: CSR, y: CSR, metric: DistanceType = DistanceType.L2Expa
         for j0 in range(0, n, by):
             j1 = min(j0 + by, n)
             yd = csr_to_dense(csr_row_slice(y, j0, j1))
-            row.append(_dense.pairwise_distance(xd, yd, metric, p=p))
+            # undecorated dispatcher: no per-tile default-handle sync
+            row.append(_dense.distance(xd, yd, metric, p))
         out_rows.append(row[0] if len(row) == 1 else jnp.concatenate(row, axis=1))
     return out_rows[0] if len(out_rows) == 1 else jnp.concatenate(out_rows, axis=0)
 

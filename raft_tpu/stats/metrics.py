@@ -15,7 +15,11 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
-from raft_tpu.distance import DistanceType, pairwise_distance
+from raft_tpu.distance import DistanceType
+# the undecorated dispatcher: these call sites sit inside batch loops,
+# where the public @auto_sync_handle wrapper would force a blocking
+# default-handle sync per tile
+from raft_tpu.distance.pairwise import distance as pairwise_distance
 
 
 # -- classification / regression ---------------------------------------------

@@ -1,0 +1,72 @@
+"""Handle threading through public APIs (reference calling convention,
+DEVELOPER_GUIDE.md:11-25; pylibraft @auto_sync_handle wrappers)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.cluster import kmeans, kmeans_mnmg
+from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams
+from raft_tpu.comms import build_comms
+from raft_tpu.core import Handle, LogicError
+from raft_tpu.distance import fused_l2_nn_argmin, pairwise_distance
+from raft_tpu.neighbors import ivf_flat, knn
+
+
+@pytest.fixture(scope="module")
+def data():
+    return np.random.default_rng(0).random((96, 12), dtype=np.float32)
+
+
+def test_supplied_handle_records_outputs(data):
+    h = Handle()
+    d = pairwise_distance(data, data, "euclidean", handle=h)
+    # the output must have been recorded on the handle's stream
+    assert len(h.get_stream()._inflight) > 0
+    h.sync()
+    assert h.get_stream().query()
+    assert d.shape == (96, 96)
+
+
+def test_default_handle_syncs_eagerly(data):
+    d = pairwise_distance(data, data, "cityblock")
+    np.testing.assert_allclose(np.diag(np.asarray(d)), 0.0, atol=1e-5)
+
+
+def test_handle_through_cluster_and_neighbors(data):
+    h = Handle(n_streams=2)
+    out = kmeans.fit(KMeansParams(n_clusters=4, max_iter=4), data, handle=h)
+    h.sync()
+    assert out.centroids.shape == (4, 12)
+    labels, inertia = kmeans.predict(
+        KMeansParams(n_clusters=4), data, out.centroids, handle=h)
+    h.sync()
+    assert labels.shape == (96,)
+    _ = fused_l2_nn_argmin(data, out.centroids, handle=h)
+    _, idx = knn(data, data[:8], 3, handle=h)
+    h.sync()
+    assert idx.shape == (8, 3)
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=4, seed=0), data,
+                           handle=h)
+    dd, ii = ivf_flat.search(ivf_flat.SearchParams(n_probes=2), index,
+                             data[:5], 2, handle=h)
+    h.sync()
+    assert ii.shape == (5, 2)
+
+
+def test_mnmg_accepts_handle(data):
+    comms = build_comms()
+    h = Handle(mesh=comms.mesh)
+    h.set_comms(comms)
+    n = comms.get_size() * 8
+    params = KMeansParams(n_clusters=2, init=InitMethod.Array, max_iter=3)
+    out = kmeans_mnmg.fit(params, h, data[:n], centroids=data[:2])
+    assert out.centroids.shape == (2, 12)
+    labels, _ = kmeans_mnmg.predict(params, h, data[:n], out.centroids)
+    assert labels.shape == (n,)
+
+
+def test_mnmg_handle_without_comms_raises(data):
+    h = Handle()
+    params = KMeansParams(n_clusters=2, init=InitMethod.Array, max_iter=2)
+    with pytest.raises(LogicError):
+        kmeans_mnmg.fit(params, h, data[:16], centroids=data[:2])
