@@ -81,7 +81,9 @@ class Comms:
 
     def __init__(self, mesh=None, axis_name: str = "world",
                  groups: Optional[List[List[int]]] = None,
-                 session_id: str = "default", host_rank: int = 0):
+                 session_id: str = "default", host_rank: int = 0,
+                 coordinator: Optional[str] = None,
+                 host_world: Optional[int] = None):
         if mesh is None:
             devs = jax.devices()
             from jax.sharding import Mesh
@@ -94,6 +96,19 @@ class Comms:
         self._host_rank = host_rank  # used by the host p2p plane
         self._aborted = False
         self._run_cache: dict = {}
+        # Host p2p plane: TCP mailbox (cross-process, ucp_helper.hpp role)
+        # when a coordinator address is configured, else process-local
+        # queues.  RAFT_TPU_COORD_ADDR is the ambient default.
+        from raft_tpu.comms import hostcomm
+
+        coordinator = coordinator or hostcomm.default_coordinator()
+        if coordinator is not None:
+            self._mailbox = hostcomm.TcpMailbox(coordinator, session_id,
+                                                host_rank)
+        else:
+            self._mailbox = None
+        self._host_world = (host_world if host_world is not None
+                            else jax.process_count())
         if groups is not None:
             sizes = {len(g) for g in groups}
             expects(len(sizes) == 1, "comm_split groups must be equal-sized")
@@ -173,8 +188,12 @@ class Comms:
         for r, (c, k) in enumerate(zip(colors, keys)):
             groups.setdefault(c, []).append((k, r))
         group_list = [[r for _, r in sorted(v)] for _, v in sorted(groups.items())]
-        return Comms(self.mesh, self.axis_name, group_list, self.session_id,
-                     self._host_rank)
+        sub = Comms(self.mesh, self.axis_name, group_list, self.session_id,
+                    self._host_rank)
+        # share the parent's host plane (one mailbox connection per process)
+        sub._mailbox = self._mailbox
+        sub._host_world = self._host_world
+        return sub
 
     # -- device collectives (used inside shard_map) --------------------------
     def _gather_all(self, x):
@@ -355,26 +374,38 @@ class Comms:
 
     def barrier(self):
         """reference comms_t::barrier (core/comms.hpp:255): inside shard_map
-        → a psum fence.  Outside a mapped context this is only a LOCAL
-        device drain: correct single-process (all mesh devices are ours to
-        sync), an error multi-process (no cross-host rendezvous here —
-        reference barriers ride the NCCL clique, core/comms.hpp:255)."""
+        → a psum fence.  Outside a mapped context: a local device drain,
+        preceded by a cross-process mailbox rendezvous when this
+        communicator spans multiple host processes; without a mailbox,
+        multi-process barrier is a hard error rather than a silent
+        process-local no-op."""
         if self._in_mapped_context():
             return jax.lax.psum(jnp.ones(()), self.axis_name)
-        if jax.process_count() > 1:
-            raise LogicError(
-                "Comms.barrier() outside shard_map is process-local; with "
-                f"{jax.process_count()} processes it cannot synchronize the "
-                "clique. Call it inside comms.run(...), or use the host p2p "
-                "plane for cross-process rendezvous.")
+        if self._host_world > 1:
+            if self._mailbox is None:
+                raise LogicError(
+                    "Comms.barrier() outside shard_map is process-local; "
+                    f"with {self._host_world} processes it needs the host "
+                    "p2p plane (pass coordinator=... / set "
+                    "RAFT_TPU_COORD_ADDR), or call it inside comms.run(...).")
+            from raft_tpu.comms.hostcomm import host_barrier
+
+            try:
+                host_barrier(self._mailbox, self._host_rank, self._host_world)
+            except (TimeoutError, ConnectionError, OSError) as e:
+                self._aborted = True  # clique is broken; poison it
+                raise LogicError(f"comms barrier failed: {e}") from e
         for d in self.mesh.devices.flat:
             jax.device_put(0.0, d).block_until_ready()
         return None
 
     # -- host p2p plane (UCX's role; reference isend/irecv/waitall) ----------
     def isend(self, obj, dst: int, tag: int = 0) -> Request:
-        box = _mailboxes.box((self.session_id, self._host_rank, dst, tag))
-        box.put(obj)
+        if self._mailbox is not None:
+            self._mailbox.put(dst, tag, obj)
+        else:
+            box = _mailboxes.box((self.session_id, self._host_rank, dst, tag))
+            box.put(obj)
         return Request("send", dst, tag, obj, done=True)
 
     def irecv(self, src: int, tag: int = 0) -> Request:
@@ -383,15 +414,21 @@ class Comms:
     def waitall(self, requests: Sequence[Request], timeout: float = 60.0):
         for r in requests:
             if r.kind == "recv" and not r.done:
-                box = _mailboxes.box((self.session_id, r.peer, self._host_rank, r.tag))
                 try:
-                    r.payload = box.get(timeout=timeout)
-                except queue.Empty:
+                    if self._mailbox is not None:
+                        r.payload = self._mailbox.get(r.peer, r.tag, timeout)
+                    else:
+                        box = _mailboxes.box(
+                            (self.session_id, r.peer, self._host_rank, r.tag))
+                        r.payload = box.get(timeout=timeout)
+                except (queue.Empty, TimeoutError, ConnectionError,
+                        OSError) as e:
                     self._aborted = True
+                    detail = f": {e}" if str(e) else ""
                     raise LogicError(
-                        f"comms waitall: timed out after {timeout}s waiting for "
+                        f"comms waitall: failed after {timeout}s waiting for "
                         f"recv from rank {r.peer} tag {r.tag} "
-                        f"(session {self.session_id})") from None
+                        f"(session {self.session_id}){detail}") from None
                 r.done = True
         return [r.payload for r in requests if r.kind == "recv"]
 
@@ -467,9 +504,14 @@ class Comms:
         return jitted(*args)
 
 
-def build_comms(mesh=None, axis_name: str = "world", session_id: str = "default"
-                ) -> Comms:
+def build_comms(mesh=None, axis_name: str = "world", session_id: str = "default",
+                coordinator: Optional[str] = None, host_rank: int = 0,
+                host_world: Optional[int] = None) -> Comms:
     """Construct a world communicator (reference ``build_comms_nccl_only``,
     comms/std_comms.hpp:42 — no NCCL uid rendezvous needed: the mesh IS the
-    clique)."""
-    return Comms(mesh, axis_name, session_id=session_id)
+    clique).  *coordinator* ("host:port" of a
+    :class:`raft_tpu.comms.hostcomm.MailboxServer`) enables the
+    cross-process host p2p plane (``build_comms_nccl_ucx``'s role)."""
+    return Comms(mesh, axis_name, session_id=session_id,
+                 coordinator=coordinator, host_rank=host_rank,
+                 host_world=host_world)

@@ -1,0 +1,240 @@
+"""Cross-process host p2p plane — the role UCX plays in the reference
+(comms/detail/ucp_helper.hpp, std_comms.hpp:55-96: tagged host send/recv
+beside the NCCL device plane).
+
+TPU-first shape: device traffic rides XLA collectives over ICI; what is
+left for the host plane is small tagged control messages (worker metadata,
+rendezvous, user payloads).  A TCP mailbox keyed by
+``(session, src, dst, tag)`` covers that without bringing in a transport
+framework: one process (conventionally host rank 0) runs
+:class:`MailboxServer`; every process — including rank 0 — talks to it
+with :class:`TcpMailbox`.
+
+Wire format: 4-byte big-endian length + pickle.  Trust model matches the
+reference's UCX plane: a private cluster interconnect — do not expose the
+port beyond it (pickle deserializes arbitrary objects).
+
+``Comms`` uses a :class:`TcpMailbox` instead of the process-local queues
+when built with ``coordinator="host:port"`` (or RAFT_TPU_COORD_ADDR); see
+``comms.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import queue
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from raft_tpu.core.error import LogicError
+
+_LEN = struct.Struct(">I")
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("mailbox peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class MailboxServer:
+    """Threaded TCP mailbox: PUT appends to a keyed queue, GET blocks until
+    a message for the key arrives (or times out).
+
+    Runs in-process on daemon threads; ``address`` reports the bound
+    (host, port) so callers can pass it to workers (port 0 → ephemeral).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        # key → [Queue, waiter_count].  Puts happen under the lock (Queue.put
+        # never blocks) so a drained box can be reaped exactly when it is
+        # empty AND unwaited — long-lived coordinators must not accumulate
+        # one dead dict entry per (session, src, dst, tag) ever used.
+        boxes: Dict[Tuple, list] = {}
+        lock = threading.Lock()
+
+        def put(key, payload):
+            with lock:
+                entry = boxes.setdefault(key, [queue.Queue(), 0])
+                entry[0].put(payload)
+
+        def get(key, timeout):
+            with lock:
+                entry = boxes.setdefault(key, [queue.Queue(), 0])
+                entry[1] += 1
+            try:
+                return entry[0].get(timeout=timeout)
+            finally:
+                with lock:
+                    entry[1] -= 1
+                    if entry[1] == 0 and entry[0].empty():
+                        boxes.pop(key, None)
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        msg = _recv_msg(self.request)
+                        op = msg["op"]
+                        if op == "put":
+                            put(msg["key"], msg["payload"])
+                            _send_msg(self.request, {"ok": True})
+                        elif op == "get":
+                            try:
+                                payload = get(msg["key"], msg["timeout"])
+                                _send_msg(self.request,
+                                          {"ok": True, "payload": payload})
+                            except queue.Empty:
+                                _send_msg(self.request,
+                                          {"ok": False, "error": "timeout"})
+                        else:
+                            _send_msg(self.request,
+                                      {"ok": False, "error": f"bad op {op}"})
+                except (ConnectionError, EOFError, OSError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.address: Tuple[str, int] = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="raft-tpu-mailbox")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class TcpMailbox:
+    """Client of a :class:`MailboxServer` — the per-process host p2p
+    endpoint (ucp_helper.hpp's send/recv handles).
+
+    One persistent connection per thread (the server handles each
+    connection on its own thread, so a blocking GET does not stall PUTs
+    from other processes).
+    """
+
+    def __init__(self, coordinator: str, session_id: str, rank: int,
+                 connect_timeout: float = 30.0):
+        host, _, port = coordinator.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self.session_id = session_id
+        self.rank = rank
+        self._local = threading.local()
+        self._connect_timeout = connect_timeout
+
+    def _sock(self) -> socket.socket:
+        s = getattr(self._local, "sock", None)
+        if s is None:
+            s = socket.create_connection(self._addr,
+                                         timeout=self._connect_timeout)
+            self._local.sock = s
+        return s
+
+    def _rpc(self, msg: dict, timeout: float) -> dict:
+        # The deadline is enforced client-side too (a dead coordinator or a
+        # partition without FIN must not hang the clique past the timeout
+        # contract); +5s margin lets the server's own queue timeout answer
+        # first in the healthy case.
+        s = self._sock()
+        s.settimeout(timeout + 5.0)
+        try:
+            _send_msg(s, msg)
+            return _recv_msg(s)
+        except socket.timeout:
+            # connection state is now ambiguous (a late reply would
+            # desynchronize the framing) — drop it
+            self.close()
+            raise TimeoutError(
+                f"mailbox coordinator {self._addr} unresponsive after "
+                f"{timeout + 5.0:.0f}s") from None
+
+    def put(self, dst: int, tag: int, obj: Any, timeout: float = 60.0) -> None:
+        key = (self.session_id, self.rank, dst, tag)
+        resp = self._rpc({"op": "put", "key": key, "payload": obj}, timeout)
+        if not resp.get("ok"):
+            raise LogicError(f"mailbox put failed: {resp.get('error')}")
+
+    def get(self, src: int, tag: int, timeout: float = 60.0) -> Any:
+        key = (self.session_id, src, self.rank, tag)
+        resp = self._rpc({"op": "get", "key": key, "timeout": timeout},
+                         timeout)
+        if not resp.get("ok"):
+            raise TimeoutError(
+                f"mailbox get timed out: src={src} tag={tag} "
+                f"session={self.session_id}")
+        return resp["payload"]
+
+    def close(self) -> None:
+        s = getattr(self._local, "sock", None)
+        if s is not None:
+            s.close()
+            self._local.sock = None
+
+
+_BARRIER_TAG = -0xB0B  # reserved tag for host_barrier rounds
+
+
+def host_barrier(mailbox: TcpMailbox, rank: int, world: int,
+                 timeout: float = 60.0) -> None:
+    """Cross-process rendezvous over the mailbox (the reference's barrier
+    rides the NCCL clique, comms_t::barrier core/comms.hpp:255; multi-host
+    control rendezvous is the UCX plane's job).
+
+    Flat gather-release on one reserved tag: every rank PUTs a token to
+    rank 0; rank 0 collects ``world-1`` tokens then releases everyone.
+    Back-to-back barriers are safe without epoch numbering — each
+    (src → dst, tag) mailbox is FIFO, so tokens from barrier N+1 queue
+    behind barrier N's.
+    """
+    tag = _BARRIER_TAG
+    if world <= 1:
+        return
+    if rank == 0:
+        for src in range(1, world):
+            got = mailbox.get(src, tag, timeout)
+            if got != ("arrive", src):
+                raise LogicError(f"barrier: bad token {got!r} from {src}")
+        for dst in range(1, world):
+            mailbox.put(dst, tag, ("release", 0))
+    else:
+        mailbox.put(0, tag, ("arrive", rank))
+        got = mailbox.get(0, tag, timeout)
+        if got != ("release", 0):
+            raise LogicError(f"barrier: bad release {got!r}")
+
+
+def default_coordinator() -> Optional[str]:
+    """RAFT_TPU_COORD_ADDR, if set (the raft-dask session passes the
+    scheduler address around the same way)."""
+    return os.environ.get("RAFT_TPU_COORD_ADDR") or None
