@@ -382,6 +382,14 @@ class Comms:
         if self._in_mapped_context():
             return jax.lax.psum(jnp.ones(()), self.axis_name)
         if self._host_world > 1:
+            if self.groups is not None:
+                # The host plane has no host-rank↔device-group mapping, so a
+                # sub-communicator host rendezvous would silently wait on the
+                # whole world (and deadlock when other groups are busy).
+                raise LogicError(
+                    "Comms.barrier() outside shard_map is not supported on a "
+                    "split communicator across processes — barrier on the "
+                    "parent/world comms, or inside comms.run(...).")
             if self._mailbox is None:
                 raise LogicError(
                     "Comms.barrier() outside shard_map is process-local; "
@@ -402,7 +410,12 @@ class Comms:
     # -- host p2p plane (UCX's role; reference isend/irecv/waitall) ----------
     def isend(self, obj, dst: int, tag: int = 0) -> Request:
         if self._mailbox is not None:
-            self._mailbox.put(dst, tag, obj)
+            try:
+                self._mailbox.put(dst, tag, obj)
+            except (TimeoutError, ConnectionError, OSError) as e:
+                self._aborted = True  # host plane broken → poison the clique
+                raise LogicError(
+                    f"comms isend to rank {dst} tag {tag} failed: {e}") from e
         else:
             box = _mailboxes.box((self.session_id, self._host_rank, dst, tag))
             box.put(obj)

@@ -178,6 +178,11 @@ class TcpMailbox:
             raise TimeoutError(
                 f"mailbox coordinator {self._addr} unresponsive after "
                 f"{timeout + 5.0:.0f}s") from None
+        except (ConnectionError, OSError):
+            # dead socket must not be cached: the next RPC reconnects
+            # (e.g. a restarted coordinator on the same address)
+            self.close()
+            raise
 
     def put(self, dst: int, tag: int, obj: Any, timeout: float = 60.0) -> None:
         key = (self.session_id, self.rank, dst, tag)

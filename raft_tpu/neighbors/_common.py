@@ -48,6 +48,104 @@ def pack_lists(payload, ids, labels, n_lists: int,
     return data, idx, counts.astype(jnp.int32), capacity
 
 
+def pack_lists_chunked(payload, ids, labels, n_lists: int,
+                       chunk_cap: Optional[int] = None,
+                       quantile: float = 0.9):
+    """Scatter rows into CHUNKED padded blocks: fixed-capacity physical rows,
+    big lists split across several.
+
+    The flat ``pack_lists`` pads every list to the LARGEST list's size —
+    on skewed cluster-size distributions that wastes memory quadratically-
+    ish (the reference tracks per-list allocations instead,
+    ivf_list.hpp/list_data).  Here a logical list of size s occupies
+    ``ceil(s / cap)`` physical rows of a (n_phys+1, cap, …) block, so waste
+    is bounded by cap per chunk; the last physical row is a reserved empty
+    dummy that padding entries of ``chunk_table`` point at.
+
+    cap policy: the *quantile* of nonzero list sizes, rounded up to the TPU
+    sublane (8) — most lists fit one chunk, outliers split.
+
+    Returns (data (n_phys+1, cap, …), idx (n_phys+1, cap) int32 -1-padded,
+    phys_sizes (n_phys+1,) int32, logical_counts (n_lists,) int32,
+    chunk_table (n_lists, max_chunks) int32 physical-row ids (dummy-padded),
+    owner (n_phys+1,) int32 logical list of each physical row, cap).
+    """
+    n = payload.shape[0]
+    labels_h = np.asarray(labels)
+    counts = np.bincount(labels_h, minlength=n_lists).astype(np.int64)
+    if chunk_cap is None:
+        nz = counts[counts > 0]
+        q = int(np.percentile(nz, quantile * 100)) if nz.size else 8
+        chunk_cap = max(8, -(-q // 8) * 8)
+    cap = int(chunk_cap)
+    n_chunks = np.maximum(-(-counts // cap), 1)  # empty lists keep 1 row
+    max_chunks = int(n_chunks.max()) if n_lists else 1
+    starts = np.zeros(n_lists + 1, np.int64)
+    np.cumsum(n_chunks, out=starts[1:])
+    n_phys = int(starts[-1])
+    dummy = n_phys  # reserved empty physical row
+
+    # vectorized table construction (build/extend run this per repack)
+    owner = np.zeros(n_phys + 1, np.int32)
+    owner[:n_phys] = np.repeat(np.arange(n_lists, dtype=np.int32),
+                               n_chunks)
+    chunk_ord = np.arange(n_phys) - starts[owner[:n_phys]]
+    phys_sizes = np.zeros(n_phys + 1, np.int32)
+    phys_sizes[:n_phys] = np.minimum(
+        cap, np.maximum(0, counts[owner[:n_phys]] - chunk_ord * cap))
+    chunk_table = np.full((n_lists, max_chunks), dummy, np.int32)
+    chunk_table[owner[:n_phys], chunk_ord] = np.arange(n_phys,
+                                                       dtype=np.int32)
+
+    # rank within logical list → (physical row, slot)
+    order = jnp.argsort(jnp.asarray(labels), stable=True)
+    sorted_labels = jnp.asarray(labels)[order]
+    start = jnp.searchsorted(sorted_labels, jnp.arange(n_lists))
+    rank_sorted = jnp.arange(n) - start[sorted_labels]
+    rank = jnp.zeros((n,), jnp.int32).at[order].set(
+        rank_sorted.astype(jnp.int32))
+    starts_j = jnp.asarray(starts[:n_lists], jnp.int32)
+    phys = starts_j[labels] + rank // cap
+    flat_pos = phys * cap + rank % cap
+    tail = payload.shape[1:]
+    data = jnp.zeros(((n_phys + 1) * cap,) + tail, payload.dtype
+                     ).at[flat_pos].set(payload)
+    data = data.reshape((n_phys + 1, cap) + tail)
+    idx = jnp.full(((n_phys + 1) * cap,), -1, jnp.int32
+                   ).at[flat_pos].set(jnp.asarray(ids, jnp.int32)
+                                      ).reshape(n_phys + 1, cap)
+    return (data, idx, jnp.asarray(phys_sizes),
+            jnp.asarray(counts.astype(np.int32)),
+            jnp.asarray(chunk_table), jnp.asarray(owner), cap)
+
+
+def expand_probes(probe_ids, chunk_table, n_rows: int):
+    """(nq, n_probes) logical probes → (nq, budget) physical rows.
+
+    *n_rows* is the physical block's leading dim (n_phys + 1; the reserved
+    dummy is row n_rows-1).  Expansion is COMPACTED: dummy entries (every
+    chunk slot past a probe's real chunks) are stably sorted to the back
+    and the row list truncated to the static worst case any one query can
+    need — ``n_probes + extra`` where ``extra = n_phys - n_lists`` is the
+    total number of continuation chunks in the whole index.  Without
+    compaction the probe scan would run n_probes·max_chunks steps, almost
+    all scoring the masked dummy tile when one skewed list dominates.
+    Chunk-major pre-order keeps the first chunk of every probe in the
+    earliest scan steps.
+    """
+    n_probes = probe_ids.shape[1]
+    n_lists = chunk_table.shape[0]
+    dummy = n_rows - 1
+    extra = max(0, (n_rows - 1) - n_lists)
+    ph = chunk_table[probe_ids]               # (nq, n_probes, max_chunks)
+    flat = jnp.swapaxes(ph, 1, 2).reshape(probe_ids.shape[0], -1)
+    budget = min(flat.shape[1], n_probes + extra)
+    if budget == flat.shape[1]:
+        return flat
+    order = jnp.argsort(flat == dummy, axis=1, stable=True)[:, :budget]
+    return jnp.take_along_axis(flat, order, axis=1)
+
+
 def scan_probe_lists(probe_ids, score_tile: Callable, list_indices,
                      list_sizes, k: int, select_min: bool, dtype
                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:

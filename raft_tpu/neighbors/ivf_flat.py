@@ -39,7 +39,8 @@ from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.neighbors._common import (
     empty_result,
-    pack_lists,
+    expand_probes,
+    pack_lists_chunked,
     scan_probe_lists,
     subsample_trainset,
 )
@@ -72,11 +73,18 @@ class SearchParams:
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class Index:
-    """IVF-Flat index: padded dense inverted lists.
+    """IVF-Flat index: CHUNKED padded inverted lists.
 
-    ``list_data``    (n_lists, capacity, dim) — stored vectors (storage dtype)
-    ``list_indices`` (n_lists, capacity) int32 — source ids, -1 at padding
-    ``list_sizes``   (n_lists,) int32
+    A logical list of size s occupies ceil(s / cap) fixed-capacity physical
+    rows (bounded padding waste on skewed cluster sizes — the reference
+    allocates per list, ivf_list.hpp; flat max-capacity padding would be
+    quadratic-ish there).  The last physical row is a reserved empty dummy.
+
+    ``list_data``    (n_phys+1, cap, dim) — stored vectors (storage dtype)
+    ``list_indices`` (n_phys+1, cap) int32 — source ids, -1 at padding
+    ``phys_sizes``   (n_phys+1,) int32 — live rows per physical chunk
+    ``chunk_table``  (n_lists, max_chunks) int32 — logical → physical rows
+    ``list_sizes``   (n_lists,) int32 — logical list sizes
     ``centers``      (n_lists, dim) f32 coarse centroids
     """
 
@@ -84,6 +92,8 @@ class Index:
     list_data: jnp.ndarray
     list_indices: jnp.ndarray
     list_sizes: jnp.ndarray
+    phys_sizes: jnp.ndarray
+    chunk_table: jnp.ndarray
     metric: DistanceType
     adaptive_centers: bool = False
 
@@ -97,6 +107,7 @@ class Index:
 
     @property
     def capacity(self) -> int:
+        """Per-chunk capacity."""
         return self.list_data.shape[1]
 
     @property
@@ -106,13 +117,14 @@ class Index:
     @property
     def padding_fraction(self) -> float:
         """Fraction of allocated list slots that are padding — the metric
-        SURVEY.md §7 says to measure for the padded-list design."""
-        total = self.n_lists * self.capacity
+        SURVEY.md §7 says to measure for the padded-list design (bounded
+        by construction under chunking)."""
+        total = self.list_data.shape[0] * self.capacity
         return 1.0 - self.size / max(total, 1)
 
     def tree_flatten(self):
         leaves = (self.centers, self.list_data, self.list_indices,
-                  self.list_sizes)
+                  self.list_sizes, self.phys_sizes, self.chunk_table)
         return leaves, (self.metric, self.adaptive_centers)
 
     @classmethod
@@ -157,9 +169,11 @@ def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
     centers = build_hierarchical(RngState(params.seed), cx, n_lists,
                                  params.kmeans_n_iters)
     index = Index(centers=centers,
-                  list_data=jnp.zeros((n_lists, 8, x.shape[1]), x.dtype),
-                  list_indices=jnp.full((n_lists, 8), -1, jnp.int32),
+                  list_data=jnp.zeros((1, 8, x.shape[1]), x.dtype),
+                  list_indices=jnp.full((1, 8), -1, jnp.int32),
                   list_sizes=jnp.zeros((n_lists,), jnp.int32),
+                  phys_sizes=jnp.zeros((1,), jnp.int32),
+                  chunk_table=jnp.zeros((n_lists, 1), jnp.int32),
                   metric=params.metric,
                   adaptive_centers=params.adaptive_centers)
     if params.add_data_on_build:
@@ -185,13 +199,14 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
     q = _normalize_rows(xf) if index.metric == DistanceType.CosineExpanded else xf
     labels = _assign_lists(q, index.centers, index.metric)
 
-    # merge with existing live rows
+    # merge with existing live rows (physical rows are owner-labelled via
+    # the chunk table's inverse)
     if base:
+        owner = _owner_of(index.chunk_table, index.list_data.shape[0])
         old_mask = index.list_indices.reshape(-1) >= 0
         old_flat_data = index.list_data.reshape(-1, index.dim)[old_mask]
         old_flat_ids = index.list_indices.reshape(-1)[old_mask]
-        old_labels = jnp.repeat(jnp.arange(index.n_lists, dtype=jnp.int32),
-                                index.capacity)[old_mask]
+        old_labels = jnp.repeat(owner, index.capacity)[old_mask]
         all_data = jnp.concatenate(
             [old_flat_data, xa.astype(old_flat_data.dtype)], axis=0)
         all_ids = jnp.concatenate([old_flat_ids, new_ids])
@@ -199,8 +214,8 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
     else:
         all_data, all_ids, all_labels = xa, new_ids, labels
 
-    data, idx, sizes, _ = pack_lists(all_data, all_ids, all_labels,
-                                     index.n_lists)
+    data, idx, phys_sizes, sizes, chunk_table, _, _ = pack_lists_chunked(
+        all_data, all_ids, all_labels, index.n_lists)
     centers = index.centers
     if index.adaptive_centers:
         # drift centers toward the mean of their members (reference
@@ -211,8 +226,18 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
         cnt = jnp.maximum(sizes.astype(centers.dtype), 1)[:, None]
         centers = jnp.where(sizes[:, None] > 0, sums / cnt, centers)
     return Index(centers=centers, list_data=data, list_indices=idx,
-                 list_sizes=sizes, metric=index.metric,
+                 list_sizes=sizes, phys_sizes=phys_sizes,
+                 chunk_table=chunk_table, metric=index.metric,
                  adaptive_centers=index.adaptive_centers)
+
+
+def _owner_of(chunk_table, n_phys_rows: int):
+    """Inverse of the chunk table: physical row → logical list (dummy and
+    unreferenced rows map to 0; their sizes are 0 so they never score)."""
+    n_lists, max_chunks = chunk_table.shape
+    owners = jnp.repeat(jnp.arange(n_lists, dtype=jnp.int32), max_chunks)
+    return jnp.zeros((n_phys_rows,), jnp.int32).at[
+        chunk_table.reshape(-1)].set(owners, mode="drop")
 
 
 @functools.partial(jax.jit, static_argnums=(3, 4, 5))
@@ -220,18 +245,19 @@ def _scan_probes(queries, probe_ids, index_leaves, metric_val: int, k: int,
                  sqrt: bool):
     """Score all probed lists for a query batch and select top-k.
 
-    One `lax.scan` step per probe rank: gathers each query's p-th probed
-    list (nq, capacity, dim) and contracts it against the queries — the
-    TPU analogue of the reference's per-(query, probe) interleaved scan
-    blocks (ivf_flat_search.cuh:658-782), with the running top-k merge
-    playing the role of the in-kernel warp-sort queues.
+    One `lax.scan` step per (probe rank, chunk): logical probes expand
+    through the chunk table into physical rows, each step gathers one
+    (nq, cap, dim) tile and contracts it against the queries — the TPU
+    analogue of the reference's per-(query, probe) interleaved scan blocks
+    (ivf_flat_search.cuh:658-782), with the running top-k merge playing
+    the role of the in-kernel warp-sort queues.
     """
-    centers, list_data, list_indices, list_sizes = index_leaves
+    list_data, list_indices, phys_sizes, chunk_table = index_leaves
     is_ip = metric_val == int(DistanceType.InnerProduct)
     is_cos = metric_val == int(DistanceType.CosineExpanded)
 
-    def score_tile(lists):
-        data = list_data[lists].astype(queries.dtype)       # (nq, cap, dim)
+    def score_tile(rows):
+        data = list_data[rows].astype(queries.dtype)        # (nq, cap, dim)
         dots = jnp.einsum("qd,qcd->qc", queries, data,
                           preferred_element_type=queries.dtype)
         if is_ip:
@@ -244,8 +270,10 @@ def _scan_probes(queries, probe_ids, index_leaves, metric_val: int, k: int,
         qn = jnp.sum(queries ** 2, axis=-1, keepdims=True)
         return qn + xn - 2.0 * dots
 
-    best_d, best_i = scan_probe_lists(probe_ids, score_tile, list_indices,
-                                      list_sizes, k, select_min=not is_ip,
+    phys_probes = expand_probes(probe_ids, chunk_table,
+                                list_data.shape[0])
+    best_d, best_i = scan_probe_lists(phys_probes, score_tile, list_indices,
+                                      phys_sizes, k, select_min=not is_ip,
                                       dtype=queries.dtype)
     if sqrt:
         best_d = jnp.sqrt(jnp.maximum(best_d, 0))
@@ -272,8 +300,8 @@ def search(params: SearchParams, index: Index, queries, k: int,
     if index.metric == DistanceType.CosineExpanded:
         qf = _normalize_rows(qf)
     sqrt = index.metric == DistanceType.L2SqrtExpanded
-    leaves = (index.centers, index.list_data, index.list_indices,
-              index.list_sizes)
+    leaves = (index.list_data, index.list_indices, index.phys_sizes,
+              index.chunk_table)
     out_d, out_i = [], []
     for q0 in range(0, qf.shape[0], batch_size_query):
         q1 = min(q0 + batch_size_query, qf.shape[0])

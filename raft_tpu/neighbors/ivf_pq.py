@@ -48,7 +48,8 @@ from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.neighbors._common import (
     empty_result,
-    pack_lists,
+    expand_probes,
+    pack_lists_chunked,
     scan_probe_lists,
     subsample_trainset,
 )
@@ -107,10 +108,17 @@ class Index:
     ``rotation``  (dim, rot_dim) orthonormal transform
     ``codebooks`` PER_SUBSPACE: (pq_dim, 2^bits, ds); PER_CLUSTER:
                   (n_lists, 2^bits, ds) — ds = rot_dim // pq_dim
-    ``list_codes``   (n_lists, capacity, ⌈pq_dim·pq_bits/8⌉) uint8,
+    Lists are CHUNKED (bounded padding on skewed cluster sizes; the last
+    physical row is a reserved empty dummy — see
+    ``_common.pack_lists_chunked``):
+
+    ``list_codes``   (n_phys+1, cap, ⌈pq_dim·pq_bits/8⌉) uint8,
                      bit-packed (LSB-first bitstream of pq_bits codes)
-    ``list_indices`` (n_lists, capacity) int32, -1 padding
-    ``list_sizes``   (n_lists,) int32
+    ``list_indices`` (n_phys+1, cap) int32, -1 padding
+    ``phys_sizes``   (n_phys+1,) int32 live rows per physical chunk
+    ``chunk_table``  (n_lists, max_chunks) int32 logical → physical rows
+    ``owner``        (n_phys+1,) int32 logical list of each physical row
+    ``list_sizes``   (n_lists,) int32 logical sizes
     """
 
     centers: jnp.ndarray
@@ -119,6 +127,9 @@ class Index:
     list_codes: jnp.ndarray
     list_indices: jnp.ndarray
     list_sizes: jnp.ndarray
+    phys_sizes: jnp.ndarray
+    chunk_table: jnp.ndarray
+    owner: jnp.ndarray
     metric: DistanceType
     codebook_kind: CodebookKind
     pq_bits: int
@@ -155,7 +166,8 @@ class Index:
 
     def tree_flatten(self):
         leaves = (self.centers, self.rotation, self.codebooks,
-                  self.list_codes, self.list_indices, self.list_sizes)
+                  self.list_codes, self.list_indices, self.list_sizes,
+                  self.phys_sizes, self.chunk_table, self.owner)
         return leaves, (self.metric, self.codebook_kind, self.pq_bits)
 
     @classmethod
@@ -354,11 +366,12 @@ def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
         ids = jnp.arange(n, dtype=jnp.int32)
     else:
         ids = jnp.asarray(ids, jnp.int32)
-    list_codes, list_indices, list_sizes, _ = pack_lists(
-        packed, ids, labels, n_lists)
+    (list_codes, list_indices, phys_sizes, list_sizes, chunk_table,
+     owner, _) = pack_lists_chunked(packed, ids, labels, n_lists)
     return Index(centers=centers, rotation=rotation, codebooks=codebooks,
                  list_codes=list_codes, list_indices=list_indices,
-                 list_sizes=list_sizes, metric=params.metric,
+                 list_sizes=list_sizes, phys_sizes=phys_sizes,
+                 chunk_table=chunk_table, owner=owner, metric=params.metric,
                  codebook_kind=params.codebook_kind, pq_bits=params.pq_bits)
 
 
@@ -392,16 +405,16 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
         nb = index.list_codes.shape[2]
         old_codes = index.list_codes.reshape(-1, nb)[live]
         old_ids = index.list_indices.reshape(-1)[live]
-        old_labels = jnp.repeat(jnp.arange(index.n_lists, dtype=jnp.int32),
-                                index.capacity)[live]
+        old_labels = jnp.repeat(index.owner, index.capacity)[live]
         packed = jnp.concatenate([old_codes, packed], axis=0)
         new_ids = jnp.concatenate([old_ids, new_ids])
         labels = jnp.concatenate([old_labels, labels])
-    list_codes, list_indices, list_sizes, _ = pack_lists(
-        packed, new_ids, labels, index.n_lists)
+    (list_codes, list_indices, phys_sizes, list_sizes, chunk_table,
+     owner, _) = pack_lists_chunked(packed, new_ids, labels, index.n_lists)
     return Index(centers=index.centers, rotation=index.rotation,
                  codebooks=index.codebooks, list_codes=list_codes,
                  list_indices=list_indices, list_sizes=list_sizes,
+                 phys_sizes=phys_sizes, chunk_table=chunk_table, owner=owner,
                  metric=index.metric, codebook_kind=index.codebook_kind,
                  pq_bits=index.pq_bits)
 
@@ -412,7 +425,8 @@ def _search_batch(q, probe_ids, leaves, metric_val: int, k: int,
                   pq_bits: int):
     """Score probed lists via per-query LUTs (reference similarity kernels
     ivf_pq_search.cuh:594-738) with a running top-k merge."""
-    centers, rotation, codebooks, list_codes, list_indices, list_sizes = leaves
+    (centers, rotation, codebooks, list_codes, list_indices,
+     phys_sizes, chunk_table, owner) = leaves
     nq = q.shape[0]
     is_ip = metric_val == int(DistanceType.InnerProduct)
     is_fp8 = lut_dtype_name == "float8_e4m3"
@@ -428,7 +442,8 @@ def _search_batch(q, probe_ids, leaves, metric_val: int, k: int,
     else:
         pq_dim, kcb, ds = codebooks.shape
 
-    def score_tile(lists):
+    def score_tile(rows):
+        lists = owner[rows]                                # logical list ids
         c_rot = rot_centers[lists]                         # (nq, rot_dim)
         r = (rot_q - c_rot).reshape(nq, pq_dim, ds)        # query residual
         cb = (codebooks[lists] if per_cluster else codebooks)
@@ -468,7 +483,7 @@ def _search_batch(q, probe_ids, leaves, metric_val: int, k: int,
         else:
             scale = jnp.ones((nq,), jnp.float32)
         lut = lut.astype(lut_dtype)                        # (nq, pq_dim, kcb)
-        codes = _unpack_codes(list_codes[lists], pq_dim, pq_bits)
+        codes = _unpack_codes(list_codes[rows], pq_dim, pq_bits)
         # codes: (nq, cap, pq_dim) int32
         # LUT lookup, out[q,c] = Σ_m lut[q,m,code]:
         # * TPU: one-hot contraction.  No hardware gather —
@@ -498,8 +513,10 @@ def _search_batch(q, probe_ids, leaves, metric_val: int, k: int,
         # fp8: invert the per-query affine quantization (scale is 1 else)
         return (acc.astype(jnp.float32) / scale[:, None]) + base[:, None]
 
-    best_d, best_i = scan_probe_lists(probe_ids, score_tile, list_indices,
-                                      list_sizes, k, select_min=not is_ip,
+    phys_probes = expand_probes(probe_ids, chunk_table,
+                                list_codes.shape[0])
+    best_d, best_i = scan_probe_lists(phys_probes, score_tile, list_indices,
+                                      phys_sizes, k, select_min=not is_ip,
                                       dtype=jnp.float32)
     if metric_val == int(DistanceType.L2SqrtExpanded):
         best_d = jnp.sqrt(jnp.maximum(best_d, 0))
@@ -525,7 +542,8 @@ def search(params: SearchParams, index: Index, queries, k: int,
     n_probes = min(params.n_probes, index.n_lists)
     is_ip = index.metric == DistanceType.InnerProduct
     leaves = (index.centers, index.rotation, index.codebooks,
-              index.list_codes, index.list_indices, index.list_sizes)
+              index.list_codes, index.list_indices, index.phys_sizes,
+              index.chunk_table, index.owner)
     out_d, out_i = [], []
     for q0 in range(0, q.shape[0], batch_size_query):
         q1 = min(q0 + batch_size_query, q.shape[0])
