@@ -72,7 +72,6 @@ def bench_mstep_onehot():
 @case("kmeans/mstep_scatter")
 def bench_mstep_scatter():
     import jax
-    import jax.numpy as jnp
 
     x, _, labels = _data()
     w = jax.device_put(np.ones(_N, np.float32))

@@ -11,7 +11,11 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== compile =="
-python -m compileall -q raft_tpu tests bench.py __graft_entry__.py
+python -m compileall -q raft_tpu tests bench ci docs bench.py __graft_entry__.py
+
+echo "== style =="
+# stdlib lint gate (ci/checks/style.sh role; no third-party linters here)
+python ci/lint.py
 
 echo "== blacklist =="
 # only real imports/usages count — docstrings cite reference CUDA symbols

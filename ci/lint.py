@@ -1,0 +1,115 @@
+"""Minimal style gate (the reference's ci/checks/style.sh role).
+
+No third-party linters ship in this environment, so this implements the
+high-signal subset with stdlib ast/tokenize:
+
+  * unused imports (skipping __init__.py re-export files and `# noqa` lines)
+  * tabs in indentation, trailing whitespace
+  * lines over 100 columns
+  * bare `except:` clauses
+  * f-strings with no placeholders
+
+Exit code 1 on any finding.  Run: ``python ci/lint.py [paths...]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+MAX_LINE = 100
+
+
+def check_file(path: pathlib.Path):
+    src = path.read_text()
+    findings = []
+    lines = src.splitlines()
+    for i, line in enumerate(lines, 1):
+        if "noqa" in line:
+            continue
+        if line.rstrip("\n") != line.rstrip():
+            findings.append((i, "trailing whitespace"))
+        if line.startswith("\t") or (line[: len(line) - len(line.lstrip())]
+                                     .find("\t") >= 0):
+            findings.append((i, "tab in indentation"))
+        if len(line) > MAX_LINE:
+            findings.append((i, f"line too long ({len(line)} > {MAX_LINE})"))
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [(e.lineno or 0, f"syntax error: {e.msg}")]
+
+    # format specs are themselves JoinedStr nodes — exclude them from the
+    # placeholder check
+    spec_ids = {id(fv.format_spec) for fv in ast.walk(tree)
+                if isinstance(fv, ast.FormattedValue)
+                and fv.format_spec is not None}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            if "noqa" not in lines[node.lineno - 1]:
+                findings.append((node.lineno, "bare except"))
+        if isinstance(node, ast.JoinedStr) and id(node) not in spec_ids:
+            if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+                if "noqa" not in lines[node.lineno - 1]:
+                    findings.append((node.lineno,
+                                     "f-string without placeholders"))
+
+    if path.name != "__init__.py":
+        imported = {}  # alias -> lineno
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = (a.asname or a.name).split(".")[0]
+                    imported[name] = node.lineno
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue  # compiler directives, not names
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    imported[a.asname or a.name] = node.lineno
+        used = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                pass  # the base Name node is walked separately
+        # names in docstrings/comments don't count; __all__ strings do
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Assign)
+                    and any(getattr(t, "id", None) == "__all__"
+                            for t in node.targets)):
+                for el in ast.walk(node.value):
+                    if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                        used.add(el.value)
+        for name, lineno in sorted(imported.items(), key=lambda kv: kv[1]):
+            if name not in used and "noqa" not in lines[lineno - 1]:
+                findings.append((lineno, f"unused import: {name}"))
+    return findings
+
+
+def main(argv):
+    roots = [pathlib.Path(p) for p in (argv or ["raft_tpu", "tests", "bench",
+                                                "ci", "docs", "bench.py",
+                                                "__graft_entry__.py"])]
+    files = []
+    for r in roots:
+        if r.is_dir():
+            files.extend(sorted(r.rglob("*.py")))
+        elif r.suffix == ".py":
+            files.append(r)
+    bad = 0
+    for f in files:
+        for lineno, msg in check_file(f):
+            print(f"{f}:{lineno}: {msg}")
+            bad += 1
+    if bad:
+        print(f"lint: {bad} finding(s)", file=sys.stderr)
+        return 1
+    print(f"lint: {len(files)} files clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

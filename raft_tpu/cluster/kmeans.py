@@ -16,7 +16,6 @@ syncs inertia to host every iteration — reference kmeans.cuh:470-505).
 from __future__ import annotations
 
 import functools
-from dataclasses import replace
 from typing import NamedTuple, Optional, Tuple
 
 import jax

@@ -16,7 +16,6 @@ per mesocluster.
 from __future__ import annotations
 
 import math
-from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp

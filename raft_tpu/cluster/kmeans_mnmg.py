@@ -16,14 +16,12 @@ trips per iteration.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from raft_tpu.cluster.kmeans import KMeansOutput, min_cluster_and_distance
-from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams
+from raft_tpu.cluster.kmeans_types import KMeansParams
 from raft_tpu.comms.comms import Comms
 from raft_tpu.comms.comms_types import ReduceOp
 from raft_tpu.core.error import expects

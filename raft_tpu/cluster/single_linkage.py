@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import enum
 import functools
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -31,10 +31,9 @@ Usage:
 
 from __future__ import annotations
 
-import functools
 import queue
 import threading
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -8,8 +8,8 @@ host-side p2p plane.
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
-from typing import Any, Optional
+from dataclasses import dataclass
+from typing import Any
 
 
 class Status(enum.Enum):

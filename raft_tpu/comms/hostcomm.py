@@ -28,7 +28,6 @@ import socket
 import socketserver
 import struct
 import threading
-import time
 from typing import Any, Dict, Optional, Tuple
 
 from raft_tpu.core.error import LogicError

@@ -9,9 +9,7 @@ reference's bool returns.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
-import numpy as np
 
 from raft_tpu.comms.comms import Comms
 from raft_tpu.comms.comms_types import ReduceOp

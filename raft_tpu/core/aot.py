@@ -26,7 +26,6 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
 def enable_persistent_cache(path: Optional[str] = None) -> str:

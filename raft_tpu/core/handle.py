@@ -31,7 +31,7 @@ import weakref
 from typing import Any, Dict, List, Optional
 
 from raft_tpu.core import interruptible
-from raft_tpu.core.error import LogicError, expects
+from raft_tpu.core.error import expects
 
 
 class Stream:

@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import threading
 import time
-import weakref
 from typing import Any, Dict, Optional
 
 from raft_tpu.core.error import InterruptedError_

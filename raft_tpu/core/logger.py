@@ -58,7 +58,8 @@ class _CallbackHandler(logging.Handler):
     """Callback sink (reference core/detail/callback_sink.hpp): forwards every
     formatted record to a user callback; optional flush callback."""
 
-    def __init__(self, callback: Callable[[int, str], None], flush: Optional[Callable[[], None]] = None):
+    def __init__(self, callback: Callable[[int, str], None],
+                 flush: Optional[Callable[[], None]] = None):
         super().__init__()
         self._callback = callback
         self._flush = flush

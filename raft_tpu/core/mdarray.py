@@ -19,11 +19,11 @@ row/col-major API surface (e.g. pairwise_distance accepts either order).
 from __future__ import annotations
 
 import enum
-from typing import Any, Optional, Sequence, Tuple
+from typing import Any, Sequence, Tuple
 
 import numpy as np
 
-from raft_tpu.core.error import LogicError, expects
+from raft_tpu.core.error import expects
 
 
 class MemoryType(enum.Enum):

@@ -13,7 +13,6 @@ carry — no m×n matrix ever materializes in HBM.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp

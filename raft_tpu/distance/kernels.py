@@ -8,9 +8,7 @@ All four ride the MXU (RBF via the expanded-L2 trick).
 
 from __future__ import annotations
 
-import functools
 
-import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.error import LogicError

@@ -6,7 +6,6 @@ Counterpart of reference raft/label/classlabels.cuh:41-116
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 

@@ -10,12 +10,9 @@ tf32-vs-fp32 analogue of cublasMath modes).
 
 from __future__ import annotations
 
-from typing import Optional
 
-import jax
 import jax.numpy as jnp
 
-from raft_tpu.core.error import expects
 
 
 def gemm(a, b, alpha=1.0, beta=0.0, c=None, trans_a: bool = False,

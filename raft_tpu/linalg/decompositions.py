@@ -11,8 +11,7 @@ share one backend, because XLA chooses its own algorithm.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

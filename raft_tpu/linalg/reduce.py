@@ -11,7 +11,7 @@ callers that encode intent in the name.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

@@ -26,11 +26,10 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Optional, Tuple, Union
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import auto_sync_handle

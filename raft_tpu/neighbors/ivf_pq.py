@@ -35,7 +35,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import functools
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

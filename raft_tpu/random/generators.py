@@ -13,7 +13,7 @@ import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import auto_sync_handle
-from raft_tpu.random.rng import RngState, _key_of
+from raft_tpu.random.rng import _key_of
 
 
 def make_blobs(

@@ -12,7 +12,7 @@ advances the subsequence exactly like the reference's
 from __future__ import annotations
 
 import enum
-from typing import Optional, Sequence, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -22,7 +22,6 @@ TPU-first redesign:
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, Optional, Tuple, Union
 
 import jax

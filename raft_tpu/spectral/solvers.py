@@ -10,7 +10,7 @@ so downstream callers translate one-to-one.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax.numpy as jnp
 

@@ -9,7 +9,7 @@ kl_divergence,contingency_matrix,dispersion,information_criterion}.cuh.
 from __future__ import annotations
 
 import enum
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -13,7 +13,6 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from raft_tpu.core.error import expects
 
 
 def mean(data, sample: bool = False):

@@ -3,7 +3,7 @@
 import jax.numpy as jnp
 import numpy as np
 
-from raft_tpu.core.aot import AotFunction, aot, enable_persistent_cache
+from raft_tpu.core.aot import aot, enable_persistent_cache
 
 
 def test_aot_caches_per_signature():

@@ -8,7 +8,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from raft_tpu.comms import Comms, CommsSession, ReduceOp, Status, build_comms
+from raft_tpu.comms import CommsSession, ReduceOp, Status, build_comms
 from raft_tpu.comms import self_tests
 from raft_tpu.comms.session import local_handle
 

@@ -116,7 +116,6 @@ class TestInterruptible:
         interruptible.synchronize(x)
 
     def test_cancel_from_other_thread(self):
-        import jax
 
         from raft_tpu.core.error import InterruptedError_
 

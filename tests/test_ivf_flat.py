@@ -7,7 +7,6 @@ import pytest
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.neighbors import knn
 from raft_tpu.neighbors.ivf_flat import (
-    Index,
     IndexParams,
     SearchParams,
     build,

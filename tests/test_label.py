@@ -2,7 +2,6 @@
 
 import numpy as np
 
-import jax.numpy as jnp
 
 from raft_tpu import label
 from raft_tpu.matrix import select_k

@@ -18,7 +18,6 @@ requires_native = pytest.mark.skipif(
 @requires_native
 def test_dendrogram_matches_scipy():
     from scipy.cluster.hierarchy import linkage
-    from scipy.spatial.distance import pdist
 
     rng = np.random.default_rng(0)
     x = rng.random((60, 4))

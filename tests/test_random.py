@@ -4,7 +4,6 @@
 import numpy as np
 import pytest
 
-import jax.numpy as jnp
 
 from raft_tpu import random as rrandom
 from raft_tpu.random import RngState

@@ -5,7 +5,6 @@ import numpy as np
 import pytest
 import scipy.sparse as sp
 
-import jax.numpy as jnp
 
 from raft_tpu.sparse import (
     COO,
@@ -14,7 +13,6 @@ from raft_tpu.sparse import (
     coo_remove_zeros,
     coo_sort,
     coo_sum_duplicates,
-    coo_to_csr,
     coo_to_dense,
     csr_add,
     csr_degree,
@@ -22,7 +20,6 @@ from raft_tpu.sparse import (
     csr_to_coo,
     csr_to_dense,
     csr_transpose,
-    dense_to_coo,
     dense_to_csr,
     laplacian,
     row_normalize,

@@ -113,7 +113,6 @@ def test_highdim_sparse_bounded_memory(metric):
 
 
 def test_auto_engine_picks_compressed_for_highdim():
-    from raft_tpu.sparse import distance as sd
 
     a = random_csr(10, 16, seed=11)
     a.data[:] = 1.0  # the jaccard formula presumes boolean-valued rows
