@@ -157,11 +157,14 @@ def bench_ivf_pq():
          + rng.normal(0, 1, (nq, dim))).astype(np.float32)
     index = ivf_pq.build(ivf_pq.IndexParams(n_lists=1000, pq_dim=32,
                                             pq_bits=8, seed=1), x)
-    sp = ivf_pq.SearchParams(n_probes=20)
-    best = _time_best(lambda: ivf_pq.search(sp, index, q, k)[0], iters=5)
+    sp = ivf_pq.SearchParams(n_probes=40)
+    best = _time_best(lambda: ivf_pq.search(sp, index, q, k)[0], iters=3)
     qps = nq / best
-    _, i = ivf_pq.search(sp, index, q, k)
-    _, ti = knn(x, q, k)
+    # recall gate on a query subsample — full-set brute-force ground truth
+    # quadrupled the bench cost without changing the estimate
+    nsub = min(256, nq)
+    _, i = ivf_pq.search(sp, index, q[:nsub], k)
+    _, ti = knn(x, q[:nsub], k)
     i, ti = np.array(i), np.array(ti)
     recall = sum(len(set(a.tolist()) & set(b.tolist()))
                  for a, b in zip(i, ti)) / ti.size

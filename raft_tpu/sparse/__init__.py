@@ -42,6 +42,9 @@ from raft_tpu.sparse.linalg import (  # noqa: F401
     fit_embedding,
     laplacian,
     row_normalize,
+    EllHybrid,
+    csr_to_ell,
+    ell_spmv,
     spmm,
     spmv,
     symmetrize,

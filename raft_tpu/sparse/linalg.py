@@ -24,11 +24,110 @@ def spmv(csr: CSR, x) -> jnp.ndarray:
     The reference uses cusparse SpMV (sparse/detail/cusparse_wrappers.h);
     here: gather x at column indices, multiply, segment-sum by row.  Padding
     rows (id n_rows) are dropped by ``num_segments``.
+
+    NOTE: the segment-sum lowers to a scatter, which serializes on TPU.
+    Iterative solvers that apply the same matrix many times should convert
+    once with :func:`csr_to_ell` and use :func:`ell_spmv` (pure
+    gather+reduce — no scatter in the hot loop).
     """
     x = jnp.asarray(x)
     expects(x.shape[0] == csr.shape[1], "spmv: dimension mismatch")
     prod = csr.data * x[csr.indices]
     return jax.ops.segment_sum(prod, csr.row_ids(), num_segments=csr.shape[0])
+
+
+@jax.tree_util.register_pytree_node_class
+class EllHybrid:
+    """Row-padded (ELL) sparse layout + COO overflow — the TPU SpMV format.
+
+    ``cols``/``vals`` are (n_rows, r) with r ≈ the row-nnz quantile; rows
+    longer than r spill their tail into the (small) COO overflow arrays.
+    The matvec is then a dense gather + row reduction (VPU-friendly, no
+    scatter) plus a scatter only over the overflow tail — the classic
+    HYB format cusparse itself used, chosen here because XLA's scatter
+    lowering on TPU serializes while gathers vectorize.
+    """
+
+    def __init__(self, cols, vals, ov_rows, ov_cols, ov_vals, shape):
+        self.cols = cols
+        self.vals = vals
+        self.ov_rows = ov_rows
+        self.ov_cols = ov_cols
+        self.ov_vals = ov_vals
+        self.shape = tuple(shape)
+
+    def tree_flatten(self):
+        return ((self.cols, self.vals, self.ov_rows, self.ov_cols,
+                 self.ov_vals), self.shape)
+
+    @classmethod
+    def tree_unflatten(cls, shape, leaves):
+        return cls(*leaves, shape)
+
+
+def csr_to_ell(csr: CSR, quantile: float = 0.95) -> EllHybrid:
+    """Host-side CSR → :class:`EllHybrid` conversion (one-time cost; do it
+    outside the solver loop)."""
+    import numpy as np
+
+    indptr = np.asarray(csr.indptr)
+    nnz = int(indptr[-1])
+    n_rows = csr.shape[0]
+    if nnz == 0:  # empty matrix: one all-zero column, no overflow
+        zcols = np.zeros((n_rows, 1), np.int32)
+        zvals = np.zeros((n_rows, 1), np.asarray(csr.data).dtype)
+        empty = np.zeros(0, np.int32)
+        return EllHybrid(jnp.asarray(zcols), jnp.asarray(zvals),
+                         jnp.asarray(empty), jnp.asarray(empty),
+                         jnp.asarray(zvals[:0, 0]), csr.shape)
+    # static-capacity CSRs pad indices/data past indptr[-1] — drop padding
+    indices = np.asarray(csr.indices)[:nnz]
+    data = np.asarray(csr.data)[:nnz]
+    nnz_row = np.diff(indptr)
+    r = int(np.percentile(nnz_row, quantile * 100)) if n_rows else 0
+    r = max(1, -(-max(r, 1) // 8) * 8)
+    offs = np.arange(r)
+    starts = indptr[:-1].astype(np.int64)
+    valid = offs[None, :] < nnz_row[:, None]
+    take = np.where(valid, starts[:, None] + offs[None, :], 0)
+    cols = np.where(valid, indices[take], 0).astype(np.int32)
+    vals = np.where(valid, data[take], 0)
+    # entries at position >= r within their row spill to COO overflow
+    pos = np.arange(len(indices)) - np.repeat(starts, nnz_row)
+    ovm = pos >= r
+    ov_rows = np.repeat(np.arange(n_rows, dtype=np.int32), nnz_row)[ovm]
+    ov_cols = indices[ovm].astype(np.int32)
+    ov_vals = data[ovm]
+    return EllHybrid(jnp.asarray(cols), jnp.asarray(vals),
+                     jnp.asarray(ov_rows), jnp.asarray(ov_cols),
+                     jnp.asarray(ov_vals), csr.shape)
+
+
+def ell_spmv(ell: EllHybrid, x) -> jnp.ndarray:
+    """y = A @ x over :class:`EllHybrid` — gather + row-sum on the padded
+    block (no scatter), scatter only over the overflow tail."""
+    x = jnp.asarray(x)
+    y = jnp.sum(ell.vals * x[ell.cols], axis=1)
+    if ell.ov_rows.shape[0]:
+        y = y + jax.ops.segment_sum(ell.ov_vals * x[ell.ov_cols], ell.ov_rows,
+                                    num_segments=ell.shape[0])
+    return y
+
+
+def best_matvec(csr: CSR):
+    """``A @ ·`` closure using the fastest available layout.
+
+    Concrete CSR → one-time host-side ELL conversion (scatter-free hot
+    loop).  Traced CSR (inside jit/vmap — the host conversion is
+    impossible) → plain :func:`spmv`.
+    """
+    import jax.core
+
+    if isinstance(csr.indptr, jax.core.Tracer) \
+            or isinstance(csr.indices, jax.core.Tracer):
+        return lambda v: spmv(csr, v)
+    ell = csr_to_ell(csr)
+    return lambda v: ell_spmv(ell, v)
 
 
 def spmm(csr: CSR, b) -> jnp.ndarray:

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
 from raft_tpu.sparse.types import CSR
-from raft_tpu.sparse.linalg import spmv
+from raft_tpu.sparse.linalg import best_matvec
 
 
 def _degrees(adj: CSR) -> jnp.ndarray:
@@ -35,9 +35,14 @@ def laplacian_matvec(adj: CSR) -> Tuple[Callable, jnp.ndarray]:
     """
     expects(adj.shape[0] == adj.shape[1], "laplacian: matrix must be square")
     deg = _degrees(adj)
+    # lazy: deg-only callers (analyze_partition) must not pay the host-side
+    # ELL conversion; first mv call builds the scatter-free operator
+    box = []
 
     def mv(x):
-        return deg * x - spmv(adj, x)
+        if not box:
+            box.append(best_matvec(adj))
+        return deg * x - box[0](x)
 
     return mv, deg
 
@@ -52,8 +57,12 @@ def modularity_matvec(adj: CSR) -> Tuple[Callable, jnp.ndarray, jnp.ndarray]:
     deg = _degrees(adj)
     edge_sum = jnp.sum(deg)  # 2m for an undirected (symmetric) graph
 
+    box = []
+
     def mv(x):
+        if not box:
+            box.append(best_matvec(adj))
         scale = jnp.dot(deg, x) / jnp.maximum(edge_sum, 1e-30)
-        return spmv(adj, x) - deg * scale
+        return box[0](x) - deg * scale
 
     return mv, deg, edge_sum

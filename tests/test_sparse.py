@@ -164,3 +164,24 @@ def test_adj_to_csr():
     adj = rng.random((6, 9)) < 0.4
     out = adj_to_csr(adj)
     np.testing.assert_allclose(csr_to_dense(out), adj.astype(np.float32))
+
+
+def test_ell_hybrid_matches_spmv():
+    import scipy.sparse as sp
+    from raft_tpu.sparse import csr_to_ell, ell_spmv, spmv
+
+    rng = np.random.default_rng(5)
+    # skewed rows: a few dense rows force the COO overflow path
+    g = sp.random(300, 300, density=0.02, format="lil", dtype=np.float32,
+                  random_state=3)
+    g[7, :150] = rng.random(150)
+    g[42, :80] = rng.random(80)
+    g = g.tocsr()
+    a = CSR(g.indptr, g.indices, g.data, g.shape)
+    ell = csr_to_ell(a)
+    assert ell.ov_rows.shape[0] > 0  # overflow exercised
+    x = rng.random(300).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(ell_spmv(ell, x)),
+                               np.asarray(spmv(a, x)), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ell_spmv(ell, x)), g @ x,
+                               rtol=1e-4, atol=1e-4)

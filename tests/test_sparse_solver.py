@@ -166,3 +166,55 @@ def test_fit_embedding_separates_blocks():
     assert len(np.unique(side[:20])) == 1
     assert len(np.unique(side[20:])) == 1
     assert side[0] != side[20]
+
+
+def test_lanczos_clustered_spectrum():
+    """Near-degenerate eigenvalue clusters must not be skipped (deflation
+    restarts; the single weighted restart vector used to miss pairs)."""
+    import scipy.sparse as sps
+    from scipy.sparse.linalg import eigsh
+
+    rng = np.random.default_rng(0)
+    n = 1500
+    g = sps.random(n, n, density=4e-3, format="csr", dtype=np.float32,
+                   random_state=1)
+    g = g + g.T
+    adj = CSR(g.indptr, g.indices, g.data, g.shape)
+    lap = laplacian(adj)
+    evals, _ = lanczos_smallest(lap, 6, tol=1e-7)
+    ref = np.sort(eigsh(sps.csgraph.laplacian(g).astype(np.float64), k=6,
+                        which="SM", return_eigenvectors=False))
+    np.testing.assert_allclose(np.sort(np.asarray(evals)), ref, atol=2e-3)
+
+
+def test_lanczos_rank_deficient_returns_k():
+    """A (near-)rank-1 PSD operator must still yield k orthonormal pairs
+    (random-complement fill with Rayleigh quotients)."""
+    n, k = 200, 3
+    rng = np.random.default_rng(1)
+    u = rng.random(n).astype(np.float32)
+    u /= np.linalg.norm(u)
+
+    def mv(v):
+        return 5.0 * u * (u @ v)
+
+    from raft_tpu.sparse.solver.lanczos import _lanczos
+
+    evals, vecs = _lanczos(mv, n, k, largest=True)
+    assert evals.shape == (k,) and vecs.shape == (n, k)
+    assert abs(float(evals[0]) - 5.0) < 1e-3
+    # remaining pairs live in the null space with eigenvalue ~0
+    np.testing.assert_allclose(np.asarray(evals[1:]), 0.0, atol=1e-3)
+    gram = np.asarray(vecs).T @ np.asarray(vecs)
+    np.testing.assert_allclose(gram, np.eye(k), atol=1e-3)
+
+
+def test_lanczos_empty_graph_ell():
+    """csr_to_ell/spmv path on an all-zero matrix must not crash."""
+    from raft_tpu.sparse import csr_to_ell, ell_spmv
+
+    n = 16
+    empty = CSR(np.zeros(n + 1, np.int32), np.zeros(0, np.int32),
+                np.zeros(0, np.float32), (n, n))
+    y = np.asarray(ell_spmv(csr_to_ell(empty), np.ones(n, np.float32)))
+    np.testing.assert_allclose(y, 0.0)
