@@ -1,0 +1,93 @@
+"""ANN index serialization (checkpoint/resume).
+
+The 22.12 reference keeps ANN indexes in-memory only (no ``serialize``
+symbols in ivf_flat_types.hpp/ivf_pq_types.hpp — SURVEY.md §5); later RAFT
+versions added ``serialize``/``deserialize`` per index type.  Provided here
+because TPU pods make rebuild-on-every-process expensive: build once, save,
+and each process loads the artifact.
+
+Format: a single ``.npz`` (numpy archive) holding every array leaf plus a
+JSON-encoded aux header (metric, codebook kind, pq_bits, versioning).
+Arrays come back as numpy; jax consumes them zero-copy on first use.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import LogicError, expects
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+
+_MAGIC = "raft-tpu-index"
+_VERSION = 1
+
+
+def _pack(kind: str, index, aux: dict) -> dict:
+    arrays = {f.name: np.asarray(getattr(index, f.name))
+              for f in dataclasses.fields(index)
+              if f.name not in aux}
+    header = {"magic": _MAGIC, "version": _VERSION, "kind": kind, "aux": aux}
+    arrays["__header__"] = np.frombuffer(
+        json.dumps(header).encode(), dtype=np.uint8)
+    return arrays
+
+
+def _normalize(path) -> str:
+    """np.savez silently appends '.npz' to suffix-less names — normalize up
+    front so save and load agree on the on-disk path."""
+    path = str(path)
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def _unpack(path, kind: str):
+    path = _normalize(path)
+    with np.load(path) as z:
+        expects("__header__" in z.files,
+                f"{path}: not a raft-tpu index file (no header)")
+        header = json.loads(bytes(z["__header__"]).decode())
+        expects(header.get("magic") == _MAGIC,
+                f"{path}: not a raft-tpu index file")
+        expects(header.get("version") == _VERSION,
+                f"{path}: unsupported index version {header.get('version')}")
+        if header["kind"] != kind:
+            raise LogicError(
+                f"{path} holds a {header['kind']} index, not {kind}")
+        arrays = {k: z[k] for k in z.files if k != "__header__"}
+    return header["aux"], arrays
+
+
+def save_ivf_flat(path, index: ivf_flat.Index) -> None:
+    """Write an IVF-Flat index to *path* (``.npz``)."""
+    aux = {"metric": int(index.metric),
+           "adaptive_centers": bool(index.adaptive_centers)}
+    np.savez(_normalize(path), **_pack("ivf_flat", index, aux))
+
+
+def load_ivf_flat(path) -> ivf_flat.Index:
+    aux, a = _unpack(path, "ivf_flat")
+    return ivf_flat.Index(
+        **{k: jnp.asarray(v) for k, v in a.items()},
+        metric=DistanceType(aux["metric"]),
+        adaptive_centers=aux["adaptive_centers"])
+
+
+def save_ivf_pq(path, index: ivf_pq.Index) -> None:
+    """Write an IVF-PQ index to *path* (``.npz``)."""
+    aux = {"metric": int(index.metric),
+           "codebook_kind": int(index.codebook_kind),
+           "pq_bits": int(index.pq_bits)}
+    np.savez(_normalize(path), **_pack("ivf_pq", index, aux))
+
+
+def load_ivf_pq(path) -> ivf_pq.Index:
+    aux, a = _unpack(path, "ivf_pq")
+    return ivf_pq.Index(
+        **{k: jnp.asarray(v) for k, v in a.items()},
+        metric=DistanceType(aux["metric"]),
+        codebook_kind=ivf_pq.CodebookKind(aux["codebook_kind"]),
+        pq_bits=aux["pq_bits"])

@@ -123,3 +123,30 @@ def test_ivf_flat_skew_bounded_padding():
     q = x[::50]
     d, i = search(SearchParams(n_probes=64), idx, q, 1)
     np.testing.assert_array_equal(np.array(i)[:, 0], np.arange(0, n, 50))
+
+
+def test_ivf_flat_serialize_roundtrip(tmp_path):
+    from raft_tpu.neighbors.serialize import load_ivf_flat, save_ivf_flat
+
+    x, q = make_data(n=600, dim=16)
+    idx = build(IndexParams(n_lists=8, seed=2), x)
+    p = tmp_path / "flat.npz"
+    save_ivf_flat(p, idx)
+    idx2 = load_ivf_flat(p)
+    d1, i1 = search(SearchParams(n_probes=8), idx, q, 5)
+    d2, i2 = search(SearchParams(n_probes=8), idx2, q, 5)
+    np.testing.assert_array_equal(np.array(i1), np.array(i2))
+    np.testing.assert_allclose(np.array(d1), np.array(d2), rtol=1e-6)
+
+
+def test_serialize_kind_mismatch(tmp_path):
+    from raft_tpu.core import LogicError
+    from raft_tpu.neighbors.serialize import load_ivf_pq, save_ivf_flat
+    import pytest as _pytest
+
+    x, _ = make_data(n=200, dim=8)
+    idx = build(IndexParams(n_lists=4, seed=2), x)
+    p = tmp_path / "flat.npz"
+    save_ivf_flat(p, idx)
+    with _pytest.raises(LogicError):
+        load_ivf_pq(p)

@@ -179,3 +179,25 @@ def test_ivf_pq_fp8_lut():
     rel = (np.abs(np.array(d8) - np.array(d32))
            / np.maximum(np.array(d32), 1.0))
     assert np.median(rel) < 0.1
+
+
+def test_ivf_pq_serialize_roundtrip(tmp_path):
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.neighbors.serialize import load_ivf_pq, save_ivf_pq
+
+    rng = np.random.default_rng(4)
+    x = rng.normal(0, 1, (800, 32)).astype(np.float32)
+    idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=8, pq_dim=8, pq_bits=4,
+                                          seed=3), x)
+    p = tmp_path / "pq.npz"
+    save_ivf_pq(p, idx)
+    idx2 = load_ivf_pq(p)
+    assert idx2.pq_bits == 4 and idx2.codebook_kind == idx.codebook_kind
+    sp = ivf_pq.SearchParams(n_probes=4)
+    d1, i1 = ivf_pq.search(sp, idx, x[:16], 5)
+    d2, i2 = ivf_pq.search(sp, idx2, x[:16], 5)
+    np.testing.assert_array_equal(np.array(i1), np.array(i2))
+    np.testing.assert_allclose(np.array(d1), np.array(d2), rtol=1e-6)
+    # extend works on a loaded index
+    idx3 = ivf_pq.extend(idx2, x[:50] + 0.01)
+    assert idx3.size == idx.size + 50
