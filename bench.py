@@ -210,6 +210,11 @@ def _child_main():
     """Run one metric and print its JSON line (runs under the watchdog)."""
     import jax
 
+    # On-disk executable reuse across child processes / driver rounds;
+    # first TPU compile of each program is the dominant bench overhead.
+    from raft_tpu.core.aot import try_enable_persistent_cache
+
+    try_enable_persistent_cache()
     result = _METRICS[os.environ.get("BENCH_METRIC", "pairwise")]()
     result["platform"] = jax.default_backend()
     print(json.dumps(result), flush=True)

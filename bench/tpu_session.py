@@ -1,0 +1,133 @@
+"""One-shot TPU measurement session: run when the chip is reachable.
+
+    python -m bench.tpu_session [out.jsonl]
+
+Runs, in order of value: the five headline configs (same code as bench.py),
+a k-means E-step batch-size sweep (the 0.78× config's main tuning knob),
+IVF-PQ stage timings (build / coarse / scan), and Lanczos on the ELL path.
+Appends one JSON line per measurement so a mid-session tunnel loss keeps
+everything recorded so far.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else "tpu_session_results.jsonl"
+
+
+def emit(obj):
+    line = json.dumps(obj)
+    print(line, flush=True)
+    with open(OUT, "a") as f:
+        f.write(line + "\n")
+
+
+def timed(fn, iters=10):
+    import jax
+
+    jax.block_until_ready(fn())
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def headline():
+    env = dict(os.environ)
+    for m in ("pairwise", "kmeans", "kmeans_mnmg", "ivf_pq", "lanczos"):
+        env["BENCH_METRIC"] = m
+        env["BENCH_TIMEOUT_S"] = "900"
+        try:
+            out = subprocess.run(
+                [sys.executable, "bench.py"], env=env, timeout=1000,
+                stdout=subprocess.PIPE).stdout.decode()
+            for line in reversed(out.strip().splitlines()):
+                if line.startswith("{"):
+                    emit({"stage": "headline", **json.loads(line)})
+                    break
+        except subprocess.TimeoutExpired:
+            emit({"stage": "headline", "metric": m, "error": "timeout"})
+
+
+def kmeans_sweep():
+    import jax
+
+    from raft_tpu.cluster import min_cluster_and_distance, update_centroids
+
+    rng = np.random.default_rng(0)
+    x = jax.device_put(rng.random((100_000, 128), dtype=np.float32))
+    c = jax.device_put(rng.random((1024, 128), dtype=np.float32))
+    for bs in (2048, 4096, 8192, 16384, 32768):
+        for prec in ("high", "default"):
+            def em(cc, bs=bs, prec=prec):
+                nn = min_cluster_and_distance(x, cc, batch_samples=bs,
+                                              precision=prec)
+                new, _ = update_centroids(x, nn.key, 1024, old_centroids=cc)
+                return new
+
+            emj = jax.jit(em)
+            try:
+                best = timed(lambda: emj(c), iters=8)
+                emit({"stage": "kmeans_sweep", "batch_samples": bs,
+                      "precision": prec, "iter_s": round(1.0 / best, 1)})
+            except Exception as e:  # noqa: BLE001 - record and continue
+                emit({"stage": "kmeans_sweep", "batch_samples": bs,
+                      "precision": prec, "error": str(e)[:120]})
+
+
+def ivf_pq_stages():
+    import jax
+
+    from raft_tpu.neighbors import ivf_pq
+
+    rng = np.random.default_rng(0)
+    n, dim, nq = 200_000, 128, 1024
+    centers = rng.normal(0, 5, (1000, dim))
+    x = (centers[rng.integers(0, 1000, n)]
+         + rng.normal(0, 1, (n, dim))).astype(np.float32)
+    q = (centers[rng.integers(0, 1000, nq)]
+         + rng.normal(0, 1, (nq, dim))).astype(np.float32)
+    t0 = time.perf_counter()
+    index = ivf_pq.build(ivf_pq.IndexParams(n_lists=1000, pq_dim=32,
+                                            pq_bits=8, seed=1), x)
+    jax.block_until_ready(index.list_codes)
+    emit({"stage": "ivf_pq", "build_s": round(time.perf_counter() - t0, 2)})
+    for probes in (20, 40, 80):
+        sp = ivf_pq.SearchParams(n_probes=probes)
+        best = timed(lambda: ivf_pq.search(sp, index, q, 10)[1], iters=5)
+        emit({"stage": "ivf_pq", "n_probes": probes,
+              "qps": round(nq / best, 1)})
+
+
+def lanczos_stage():
+    import scipy.sparse as sp
+
+    from raft_tpu.sparse import CSR, laplacian, lanczos_smallest
+
+    n = 20_000
+    g = sp.random(n, n, density=2e-3, format="csr", dtype=np.float32,
+                  random_state=1)
+    g = g + g.T
+    adj = CSR(g.indptr, g.indices, g.data, g.shape)
+    lap = laplacian(adj)
+    best = timed(lambda: lanczos_smallest(lap, 8, tol=1e-6)[0], iters=3)
+    emit({"stage": "lanczos", "solves_s": round(1.0 / best, 3)})
+
+
+if __name__ == "__main__":
+    import jax
+
+    emit({"stage": "session", "platform": jax.default_backend(),
+          "devices": [str(d) for d in jax.devices()]})
+    headline()
+    kmeans_sweep()
+    ivf_pq_stages()
+    lanczos_stage()
+    emit({"stage": "session", "done": True})

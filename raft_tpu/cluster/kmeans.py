@@ -264,7 +264,12 @@ class KMeansOutput(NamedTuple):
     labels: Optional[jnp.ndarray] = None
 
 
-@functools.partial(jax.jit, static_argnames=("metric", "max_iter", "batch_samples",
+# Jitted as a whole (tol included in the statics: it only appears in the
+# while_loop cond, and a handful of distinct tols per process is cheaper
+# than threading it as a traced operand).  Statics match the reference's
+# compile-time template parameters.
+@functools.partial(jax.jit, static_argnames=("metric", "max_iter", "tol",
+                                             "batch_samples",
                                              "batch_centroids"))
 def _fit_main(x, centroids0, weights, metric: DistanceType, max_iter: int,
               tol: float, batch_samples: int, batch_centroids: int):

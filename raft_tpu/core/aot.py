@@ -40,6 +40,15 @@ def enable_persistent_cache(path: Optional[str] = None) -> str:
     return path
 
 
+def try_enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
+    """Best-effort :func:`enable_persistent_cache`: returns None instead of
+    raising when the cache directory is unwritable (sandboxed CI)."""
+    try:
+        return enable_persistent_cache(path)
+    except OSError:
+        return None
+
+
 def _bucket_dim(n: int) -> int:
     if n <= 8:
         return 8

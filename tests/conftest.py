@@ -28,12 +28,9 @@ jax.config.update("jax_enable_x64", True)
 # Persistent compilation cache: the suite is dominated by recompiles of the
 # same programs across test processes (VERDICT r1 weak #7); warm runs reuse
 # on-disk executables.
-from raft_tpu.core.aot import enable_persistent_cache  # noqa: E402
+from raft_tpu.core.aot import try_enable_persistent_cache  # noqa: E402
 
-try:
-    enable_persistent_cache()
-except OSError:
-    pass  # unwritable HOME (sandboxed CI): run without the disk cache
+try_enable_persistent_cache()  # skips silently on unwritable HOME (CI)
 
 import pytest  # noqa: E402
 
