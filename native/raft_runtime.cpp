@@ -138,4 +138,32 @@ int64_t rt_coo_canonicalize(int32_t* rows, int32_t* cols, double* vals,
   return out;
 }
 
+// CSR → ELL-hybrid conversion (sparse/linalg.py csr_to_ell's hot path):
+// per row, copy up to r leading entries into the padded (n_rows, r) block;
+// entries past r spill into the COO overflow arrays.  Values are copied
+// bytewise (elem_size) so every dtype shares one symbol.  ell_cols /
+// ell_vals must be zero-initialized by the caller; ov_* sized to the
+// overflow count (Σ max(nnz_row − r, 0)).  Returns 0 on success.
+int rt_csr_to_ell(const int64_t* indptr, const int32_t* indices,
+                  const char* data, int64_t elem_size, int64_t n_rows,
+                  int64_t r, int32_t* ell_cols, char* ell_vals,
+                  int32_t* ov_rows, int32_t* ov_cols, char* ov_vals) {
+  int64_t ov = 0;
+  for (int64_t i = 0; i < n_rows; ++i) {
+    const int64_t s = indptr[i];
+    const int64_t e = indptr[i + 1];
+    if (e < s) return 1;
+    const int64_t take = std::min(e - s, r);
+    std::memcpy(ell_cols + i * r, indices + s, take * sizeof(int32_t));
+    std::memcpy(ell_vals + (i * r) * elem_size, data + s * elem_size,
+                take * elem_size);
+    for (int64_t j = s + r; j < e; ++j, ++ov) {
+      ov_rows[ov] = static_cast<int32_t>(i);
+      ov_cols[ov] = indices[j];
+      std::memcpy(ov_vals + ov * elem_size, data + j * elem_size, elem_size);
+    }
+  }
+  return 0;
+}
+
 }  // extern "C"

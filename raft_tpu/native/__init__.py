@@ -24,12 +24,13 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> Optional[Path]:
+def _build(force: bool = False) -> Optional[Path]:
     src = _NATIVE_DIR / "raft_runtime.cpp"
     out = _NATIVE_DIR / _LIB_NAME
     if not src.exists():
         return None
-    if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
+    if not force and out.exists() \
+            and out.stat().st_mtime >= src.stat().st_mtime:
         return out
     try:
         subprocess.run(
@@ -41,6 +42,35 @@ def _build() -> Optional[Path]:
         return None
 
 
+def _bind(lib: ctypes.CDLL) -> None:
+    """Declare every symbol's signature; AttributeError when the .so is
+    stale (built from an older source missing a symbol)."""
+    lib.rt_build_dendrogram.restype = ctypes.c_int
+    lib.rt_build_dendrogram.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.rt_extract_flattened_clusters.restype = ctypes.c_int
+    lib.rt_extract_flattened_clusters.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.rt_make_monotonic.restype = ctypes.c_int64
+    lib.rt_make_monotonic.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.rt_coo_canonicalize.restype = ctypes.c_int64
+    lib.rt_coo_canonicalize.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_int]
+    lib.rt_csr_to_ell.restype = ctypes.c_int
+    lib.rt_csr_to_ell.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_char_p]
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     with _lock:
@@ -49,32 +79,20 @@ def _load() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("RAFT_TPU_DISABLE_NATIVE"):
             return None
-        path = _build()
-        if path is None:
-            return None
-        try:
-            lib = ctypes.CDLL(str(path))
-        except OSError:
-            return None
-        lib.rt_build_dendrogram.restype = ctypes.c_int
-        lib.rt_build_dendrogram.argtypes = [
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int64)]
-        lib.rt_extract_flattened_clusters.restype = ctypes.c_int
-        lib.rt_extract_flattened_clusters.argtypes = [
-            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int32)]
-        lib.rt_make_monotonic.restype = ctypes.c_int64
-        lib.rt_make_monotonic.argtypes = [
-            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_int32)]
-        lib.rt_coo_canonicalize.restype = ctypes.c_int64
-        lib.rt_coo_canonicalize.argtypes = [
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_int]
-        _lib = lib
-        return _lib
+        for force in (False, True):
+            path = _build(force=force)
+            if path is None:
+                return None
+            try:
+                lib = ctypes.CDLL(str(path))
+                _bind(lib)
+            except (OSError, AttributeError):
+                # stale cached .so (e.g. mtime-preserving deploys) missing a
+                # newer symbol: force one rebuild, else fall back to numpy
+                continue
+            _lib = lib
+            return _lib
+        return None
 
 
 def is_available() -> bool:
@@ -159,3 +177,39 @@ def coo_canonicalize_host(rows, cols, vals, drop_zeros: bool = True
         vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         rows.shape[0], 1 if drop_zeros else 0)
     return rows[:nnz], cols[:nnz], vals[:nnz]
+
+
+def csr_to_ell_host(indptr, indices, data, r: int
+                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                               np.ndarray, np.ndarray]:
+    """Native CSR → ELL-hybrid conversion (sparse/linalg.csr_to_ell's hot
+    path): returns (ell_cols (n, r) i32, ell_vals (n, r), ov_rows, ov_cols,
+    ov_vals).  Raises RuntimeError when the native runtime is unavailable
+    (the caller keeps its numpy path)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native runtime unavailable")
+    indptr = np.ascontiguousarray(np.asarray(indptr), dtype=np.int64)
+    indices = _i32(indices)
+    data = np.ascontiguousarray(np.asarray(data))
+    n_rows = indptr.shape[0] - 1
+    nnz_row = np.diff(indptr)
+    n_ov = int(np.maximum(nnz_row - r, 0).sum())
+    ell_cols = np.zeros((n_rows, r), np.int32)
+    ell_vals = np.zeros((n_rows, r), data.dtype)
+    ov_rows = np.empty(n_ov, np.int32)
+    ov_cols = np.empty(n_ov, np.int32)
+    ov_vals = np.empty(n_ov, data.dtype)
+    rc = lib.rt_csr_to_ell(
+        indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.cast(data.ctypes.data, ctypes.c_char_p),
+        data.dtype.itemsize, n_rows, int(r),
+        ell_cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.cast(ell_vals.ctypes.data, ctypes.c_char_p),
+        ov_rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ov_cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.cast(ov_vals.ctypes.data, ctypes.c_char_p))
+    if rc != 0:
+        raise ValueError("csr_to_ell_host: malformed indptr")
+    return ell_cols, ell_vals, ov_rows, ov_cols, ov_vals

@@ -86,18 +86,24 @@ def csr_to_ell(csr: CSR, quantile: float = 0.95) -> EllHybrid:
     nnz_row = np.diff(indptr)
     r = int(np.percentile(nnz_row, quantile * 100)) if n_rows else 0
     r = max(1, -(-max(r, 1) // 8) * 8)
-    offs = np.arange(r)
-    starts = indptr[:-1].astype(np.int64)
-    valid = offs[None, :] < nnz_row[:, None]
-    take = np.where(valid, starts[:, None] + offs[None, :], 0)
-    cols = np.where(valid, indices[take], 0).astype(np.int32)
-    vals = np.where(valid, data[take], 0)
-    # entries at position >= r within their row spill to COO overflow
-    pos = np.arange(len(indices)) - np.repeat(starts, nnz_row)
-    ovm = pos >= r
-    ov_rows = np.repeat(np.arange(n_rows, dtype=np.int32), nnz_row)[ovm]
-    ov_cols = indices[ovm].astype(np.int32)
-    ov_vals = data[ovm]
+    try:
+        from raft_tpu.native import csr_to_ell_host
+
+        cols, vals, ov_rows, ov_cols, ov_vals = csr_to_ell_host(
+            indptr, indices, data, r)
+    except RuntimeError:  # no toolchain: vectorized numpy fallback
+        offs = np.arange(r)
+        starts = indptr[:-1].astype(np.int64)
+        valid = offs[None, :] < nnz_row[:, None]
+        take = np.where(valid, starts[:, None] + offs[None, :], 0)
+        cols = np.where(valid, indices[take], 0).astype(np.int32)
+        vals = np.where(valid, data[take], 0)
+        # entries at position >= r within their row spill to COO overflow
+        pos = np.arange(len(indices)) - np.repeat(starts, nnz_row)
+        ovm = pos >= r
+        ov_rows = np.repeat(np.arange(n_rows, dtype=np.int32), nnz_row)[ovm]
+        ov_cols = indices[ovm].astype(np.int32)
+        ov_vals = data[ovm]
     return EllHybrid(jnp.asarray(cols), jnp.asarray(vals),
                      jnp.asarray(ov_rows), jnp.asarray(ov_cols),
                      jnp.asarray(ov_vals), csr.shape)

@@ -123,3 +123,22 @@ def test_make_monotonic_native_path():
     labels = np.array([30, 10, 30, 20], np.int32)
     out = np.array(make_monotonic(labels))
     np.testing.assert_array_equal(out, [2, 0, 2, 1])
+
+
+@requires_native
+def test_native_csr_to_ell_matches_numpy():
+    import scipy.sparse as sps
+
+    rng = np.random.default_rng(8)
+    g = sps.random(200, 500, density=0.05, format="csr", dtype=np.float32,
+                   random_state=2)
+    r = 8
+    cols, vals, ovr, ovc, ovv = native.csr_to_ell_host(
+        g.indptr.astype(np.int64), g.indices, g.data, r)
+    # reconstruct and compare against scipy
+    dense = np.zeros(g.shape, np.float32)
+    rows = np.repeat(np.arange(g.shape[0]), r).reshape(200, r)
+    mask = vals != 0
+    dense[rows[mask], cols[mask]] = vals[mask]
+    dense[ovr, ovc] = ovv
+    np.testing.assert_allclose(dense, g.toarray(), rtol=1e-6)
