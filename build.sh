@@ -31,6 +31,10 @@ for t in "${targets[@]}"; do
       # per-primitive suite (reference cpp/bench role); BENCH_SMALL=1 for CI
       python -m bench.run "${BENCH_SELECT:-}" "${BENCH_ITERS:-10}"
       ;;
+    docs)
+      # regenerate the per-package API reference (reference docs build role)
+      JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python docs/gen_api.py
+      ;;
     checks)
       bash ci/checks.sh
       ;;
@@ -40,7 +44,7 @@ for t in "${targets[@]}"; do
       find . -name __pycache__ -type d -prune -exec rm -rf {} +
       ;;
     *)
-      echo "unknown target: $t (native|tests|bench|microbench|checks|clean)" >&2
+      echo "unknown target: $t (native|tests|bench|microbench|docs|checks|clean)" >&2
       exit 1
       ;;
   esac
