@@ -3,11 +3,13 @@
 # (reference build.sh:21-55: libraft pylibraft raft-dask docs tests bench).
 #
 # Targets:
-#   native   build the C++ host runtime (native/libraft_tpu_runtime.so)
-#   tests    run the pytest suite on the 8-device virtual CPU mesh
-#   bench    run the headline benchmark (real accelerator if present)
-#   checks   run the CI gate (ci/checks.sh)
-#   clean    remove build artifacts
+#   native      build the C++ host runtime (native/libraft_tpu_runtime.so)
+#   tests       run the pytest suite on the 8-device virtual CPU mesh
+#   bench       run the headline benchmark (real accelerator if present)
+#   microbench  run the per-primitive suite (bench/; BENCH_SMALL=1 for CI)
+#   docs        regenerate docs/api from the live public surface
+#   checks      run the CI gate (ci/checks.sh)
+#   clean       remove build artifacts
 #
 # Default (no args): native + tests.
 set -euo pipefail

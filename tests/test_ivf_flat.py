@@ -150,3 +150,29 @@ def test_serialize_kind_mismatch(tmp_path):
     save_ivf_flat(p, idx)
     with _pytest.raises(LogicError):
         load_ivf_pq(p)
+
+
+def test_ivf_flat_sequential_extends_with_ids():
+    """Multiple extends with custom ids on chunked storage keep ids/recall."""
+    rng = np.random.default_rng(9)
+    dim = 12
+    a = rng.normal(0, 1, (400, dim)).astype(np.float32)
+    b = rng.normal(0, 1, (300, dim)).astype(np.float32)
+    c = rng.normal(0, 1, (200, dim)).astype(np.float32)
+    ids_a = np.arange(1000, 1400, dtype=np.int32)
+    ids_b = np.arange(5000, 5300, dtype=np.int32)
+    ids_c = np.arange(9000, 9200, dtype=np.int32)
+    idx = build(IndexParams(n_lists=16, seed=1, add_data_on_build=False),
+                np.concatenate([a, b, c]))
+    idx = extend(idx, a, ids_a)
+    idx = extend(idx, b, ids_b)
+    idx = extend(idx, c, ids_c)
+    assert idx.size == 900
+    got_ids = np.asarray(idx.list_indices)
+    got_ids = np.sort(got_ids[got_ids >= 0])
+    np.testing.assert_array_equal(
+        got_ids, np.sort(np.concatenate([ids_a, ids_b, ids_c])))
+    # each point's own id is its 1-NN at full probes
+    d, i = search(SearchParams(n_probes=16), idx, b[:25], 1)
+    np.testing.assert_array_equal(np.asarray(i)[:, 0], ids_b[:25])
+    np.testing.assert_allclose(np.asarray(d)[:, 0], 0.0, atol=1e-4)
