@@ -73,8 +73,6 @@ def check_file(path: pathlib.Path):
         for node in ast.walk(tree):
             if isinstance(node, ast.Name):
                 used.add(node.id)
-            elif isinstance(node, ast.Attribute):
-                pass  # the base Name node is walked separately
         # names in docstrings/comments don't count; __all__ strings do
         for node in ast.walk(tree):
             if (isinstance(node, ast.Assign)

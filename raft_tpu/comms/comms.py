@@ -115,22 +115,15 @@ class Comms:
             n = mesh.shape[axis_name]
             ranks = set(r for g in groups for r in g)
             expects(ranks == set(range(n)), "groups must cover every rank exactly once")
-            # Static per-rank tables (closed over as constants): rank-within-
-            # group, group membership mask, and group member list — jax 0.9's
-            # shard_map has no axis_index_groups, so grouped collectives are
-            # implemented as one full all_gather + a static masked reduction
-            # (still a single ICI collective; XLA fuses the epilogue).
+            # Static rank-within-group table (closed over as a constant):
+            # jax 0.9's shard_map has no axis_index_groups, so grouped
+            # collectives are hand-lowered to within-group ppermute
+            # rings/butterflies (see _group_allreduce below).
             rank_table = np.zeros(n, np.int32)
-            mask_table = np.zeros((n, n), bool)
-            members_table = np.zeros((n, self._group_size), np.int32)
             for g in groups:
                 for pos, r in enumerate(g):
                     rank_table[r] = pos
-                    mask_table[r, g] = True
-                    members_table[r] = g
             self._group_rank_table = jnp.asarray(rank_table)
-            self._mask_table = jnp.asarray(mask_table)
-            self._members_table = jnp.asarray(members_table)
             # Static ppermute tables for O(group)-traffic collectives
             # (std_comms.hpp:107-171 builds a real NCCL sub-clique; the TPU
             # analogue is within-group rings/butterflies — every group moves
@@ -148,8 +141,6 @@ class Comms:
         else:
             self._group_size = mesh.shape[axis_name]
             self._group_rank_table = None
-            self._mask_table = None
-            self._members_table = None
             self._perm_fwd = None
             self._perm_xor = None
 

@@ -160,11 +160,17 @@ def _tile_linf(xi, yj):
     return jnp.max(jnp.abs(xi - yj), axis=-1)
 
 
+def canberra_terms(x, y):
+    # reference distance/detail/canberra.cuh: 0/0 → 0.  Unsummed so the
+    # sparse feature-compressed engine can apply outside-block corrections
+    # before reducing.
+    num = jnp.abs(x - y)
+    den = jnp.abs(x) + jnp.abs(y)
+    return jnp.where(den > 0, num / jnp.where(den > 0, den, 1.0), 0.0)
+
+
 def _tile_canberra(xi, yj):
-    # reference distance/detail/canberra.cuh: 0/0 → 0
-    num = jnp.abs(xi - yj)
-    den = jnp.abs(xi) + jnp.abs(yj)
-    return jnp.sum(jnp.where(den > 0, num / jnp.where(den > 0, den, 1.0), 0.0), axis=-1)
+    return jnp.sum(canberra_terms(xi, yj), axis=-1)
 
 
 def _tile_lp(p: float):
@@ -185,9 +191,11 @@ def _tile_braycurtis(xi, yj):
     return jnp.where(den > 0, num / jnp.where(den > 0, den, 1.0), 0.0)
 
 
-def _tile_jensen_shannon(xi, yj):
-    # reference distance/detail/jensen_shannon.cuh: sqrt(0.5·(KL(x‖m)+KL(y‖m)))
-    m = 0.5 * (xi + yj)
+def jensen_shannon_terms(x, y):
+    # reference distance/detail/jensen_shannon.cuh: the per-feature
+    # KL(x‖m)+KL(y‖m) accumulation, un-rooted (callers apply
+    # sqrt(0.5·Σ) after any corrections)
+    m = 0.5 * (x + y)
     safe = m > 0
 
     def kl_part(a):
@@ -195,7 +203,11 @@ def _tile_jensen_shannon(xi, yj):
         return jnp.where(ok, a * (jnp.log(jnp.where(a > 0, a, 1.0))
                                   - jnp.log(jnp.where(safe, m, 1.0))), 0.0)
 
-    acc = jnp.sum(kl_part(xi) + kl_part(yj), axis=-1)
+    return kl_part(x) + kl_part(y)
+
+
+def _tile_jensen_shannon(xi, yj):
+    acc = jnp.sum(jensen_shannon_terms(xi, yj), axis=-1)
     return jnp.sqrt(jnp.maximum(0.5 * acc, 0.0))
 
 

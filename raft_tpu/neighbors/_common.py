@@ -17,6 +17,16 @@ import numpy as np
 from raft_tpu.matrix.select_k import select_k
 
 
+def _ranks_within(labels, n: int, n_lists: int):
+    """rank[i] = position of row i within its label's group (stable)."""
+    order = jnp.argsort(labels, stable=True)
+    sorted_labels = labels[order]
+    start = jnp.searchsorted(sorted_labels, jnp.arange(n_lists))
+    rank_sorted = jnp.arange(n) - start[sorted_labels]
+    return jnp.zeros((n,), jnp.int32).at[order].set(
+        rank_sorted.astype(jnp.int32))
+
+
 def pack_lists(payload, ids, labels, n_lists: int,
                capacity: Optional[int] = None
                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, int]:
@@ -31,12 +41,7 @@ def pack_lists(payload, ids, labels, n_lists: int,
     counts = jnp.bincount(labels, length=n_lists)
     if capacity is None:
         capacity = max(8, -(-int(jnp.max(counts)) // 8) * 8)
-    order = jnp.argsort(labels, stable=True)
-    sorted_labels = labels[order]
-    start = jnp.searchsorted(sorted_labels, jnp.arange(n_lists))
-    rank_sorted = jnp.arange(n) - start[sorted_labels]
-    rank = jnp.zeros((n,), jnp.int32).at[order].set(
-        rank_sorted.astype(jnp.int32))
+    rank = _ranks_within(labels, n, n_lists)
     flat_pos = labels * capacity + rank
     tail = payload.shape[1:]
     data = jnp.zeros((n_lists * capacity,) + tail, payload.dtype
@@ -98,12 +103,7 @@ def pack_lists_chunked(payload, ids, labels, n_lists: int,
                                                        dtype=np.int32)
 
     # rank within logical list → (physical row, slot)
-    order = jnp.argsort(jnp.asarray(labels), stable=True)
-    sorted_labels = jnp.asarray(labels)[order]
-    start = jnp.searchsorted(sorted_labels, jnp.arange(n_lists))
-    rank_sorted = jnp.arange(n) - start[sorted_labels]
-    rank = jnp.zeros((n,), jnp.int32).at[order].set(
-        rank_sorted.astype(jnp.int32))
+    rank = _ranks_within(jnp.asarray(labels), n, n_lists)
     starts_j = jnp.asarray(starts[:n_lists], jnp.int32)
     phys = starts_j[labels] + rank // cap
     flat_pos = phys * cap + rank % cap

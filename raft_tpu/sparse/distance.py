@@ -147,26 +147,6 @@ def _row_stats(rows, vals, nrows):
     return s, sq, nnz
 
 
-def _canberra_terms(x, y):
-    den = jnp.abs(x) + jnp.abs(y)
-    return jnp.where(den > 0, jnp.abs(x - y) / jnp.where(den > 0, den, 1.0),
-                     0.0)
-
-
-def _js_acc(x, y):
-    # un-rooted Jensen-Shannon accumulation (dense _tile_jensen_shannon
-    # without the final sqrt·0.5 — applied after the outside-u correction)
-    m = 0.5 * (x + y)
-    safe = m > 0
-
-    def kl_part(a):
-        ok = (a > 0) & safe
-        return jnp.where(ok, a * (jnp.log(jnp.where(a > 0, a, 1.0))
-                                  - jnp.log(jnp.where(safe, m, 1.0))), 0.0)
-
-    return kl_part(x) + kl_part(y)
-
-
 # additive metrics: (pair_fn(x, y), zero_fn(y)) with Σ_f pair_fn and the
 # outside-u y-features contributing Σ zero_fn — pair_fn(0, 0) == 0 and
 # pair_fn(0, y) == zero_fn(y) by construction.  Final transforms applied
@@ -175,13 +155,13 @@ _ADDITIVE = {
     DistanceType.L1: (lambda x, y: jnp.abs(x - y), jnp.abs),
     DistanceType.L2Unexpanded: (lambda x, y: (x - y) ** 2, lambda v: v * v),
     DistanceType.L2SqrtUnexpanded: (lambda x, y: (x - y) ** 2, lambda v: v * v),
-    DistanceType.Canberra: (_canberra_terms,
+    DistanceType.Canberra: (_dense.canberra_terms,
                             lambda v: (v != 0).astype(v.dtype)),
     DistanceType.HammingUnexpanded: (
         lambda x, y: (x != y).astype(x.dtype),
         lambda v: (v != 0).astype(v.dtype)),
     DistanceType.JensenShannon: (
-        _js_acc,
+        _dense.jensen_shannon_terms,
         lambda v: jnp.where(v > 0, v, 0.0) * jnp.asarray(np.log(2.0), v.dtype)),
 }
 
