@@ -232,3 +232,28 @@ class TestGram:
         k = gram_matrix(self.x, self.y, p)
         sq = scipy_dist.cdist(self.x, self.y, "sqeuclidean")
         np.testing.assert_allclose(np.asarray(k), np.exp(-0.7 * sq), rtol=1e-8)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_pairwise_random_shapes_vs_scipy(dtype):
+    """Property sweep: random shapes (incl. m/n/k == 1) across dtypes — the
+    reference supports double everywhere (f64 paths are API surface)."""
+    from scipy.spatial.distance import cdist
+
+    names = {"euclidean": "euclidean", "sqeuclidean": "sqeuclidean",
+             "cityblock": "cityblock", "chebyshev": "chebyshev",
+             "canberra": "canberra", "cosine": "cosine",
+             "braycurtis": "braycurtis", "hamming": "hamming"}
+    rng = np.random.default_rng(7)
+    for trial in range(12):
+        m = int(rng.integers(1, 40))
+        n = int(rng.integers(1, 40))
+        k = int(rng.integers(1, 24))
+        name = list(names)[trial % len(names)]
+        x = rng.random((m, k)).astype(dtype)
+        y = rng.random((n, k)).astype(dtype)
+        got = np.asarray(pairwise_distance(x, y, name))
+        ref = cdist(x.astype(np.float64), y.astype(np.float64), names[name])
+        tol = 2e-3 if dtype == np.float32 else 1e-8
+        np.testing.assert_allclose(got, ref, rtol=tol, atol=tol,
+                                   err_msg=f"{name} m={m} n={n} k={k}")
