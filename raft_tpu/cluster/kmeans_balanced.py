@@ -7,14 +7,19 @@ EM iterations interleaved with ``adjust_centers`` which re-seeds
 under-populated clusters from over-populated ones).  Used by IVF-Flat /
 IVF-PQ index builds.
 
-TPU notes: EM steps are jitted (fused-L2-NN E-step + segment-sum M-step);
-the mesocluster split runs on host (dynamic subset shapes), padding each
-subset to a power-of-two bucket so XLA compiles O(log n) shapes, not one
-per mesocluster.
+TPU notes: the whole EM loop of every stage lives inside a single jitted
+``lax.fori_loop`` program, so one index build costs a handful of device
+dispatches, not hundreds — essential when the host↔device link has real
+latency (remote-attached TPUs).  The per-mesocluster fine stage is ONE
+vmapped masked-EM program over all mesoclusters at once (padded row sets +
+per-meso center masks) instead of a Python loop of per-meso solves; the
+reference's scalar host loop (ann_kmeans_balanced.cuh:942-1010) would
+serialize ~√k round trips.
 """
 
 from __future__ import annotations
 
+import functools
 import math
 
 import jax
@@ -25,16 +30,28 @@ from raft_tpu.cluster.kmeans import min_cluster_and_distance, update_centroids
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.random.rng import RngState
 
+_ADJUST_THRESHOLD = 0.25
 
-def adjust_centers(centers, counts, x, labels, distances, threshold: float = 0.25):
+
+def adjust_centers(centers, counts, x, labels, distances, threshold: float = _ADJUST_THRESHOLD,
+                   mask=None):
     """Re-seed clusters whose size is below ``threshold · average`` with data
     points drawn from crowded clusters (reference ann_kmeans_balanced.cuh
     ``adjust_centers`` — there a scalar host loop; here one vectorized pass:
     the donors are the points with the highest (cluster-size × distance)
-    score, i.e. far-out members of fat clusters)."""
+    score, i.e. far-out members of fat clusters).
+
+    ``mask`` (k,) bool marks live centers: masked-out ones are excluded from
+    the average and never re-seeded (used by the batched fine stage, where
+    per-meso quotas differ)."""
     k = centers.shape[0]
-    avg = jnp.mean(counts)
-    small = counts < (avg * threshold)
+    if mask is None:
+        avg = jnp.mean(counts)
+        small = counts < (avg * threshold)
+    else:
+        avg = jnp.sum(counts) / jnp.maximum(
+            jnp.sum(mask.astype(counts.dtype)), 1)
+        small = mask & (counts < (avg * threshold))
     n_small = jnp.sum(small.astype(jnp.int32))
     score = counts[labels] * distances  # crowded-cluster outliers first
     _, donor_idx = jax.lax.top_k(score, k)  # at most k donors needed
@@ -44,6 +61,28 @@ def adjust_centers(centers, counts, x, labels, distances, threshold: float = 0.2
     new_centers = jnp.where(small[:, None], donors[jnp.clip(small_rank, 0, k - 1)],
                             centers)
     return new_centers, n_small
+
+
+@functools.partial(jax.jit, static_argnames=("n_clusters", "n_iters", "metric",
+                                             "adjust_every"))
+def _em_program(x, centers0, n_clusters: int, n_iters: int,
+                metric: DistanceType, adjust_every: int):
+    """The full balancing-EM loop as one compiled program (one dispatch)."""
+
+    def body(it, centers):
+        nn = min_cluster_and_distance(x, centers, metric)
+        centers, counts = update_centroids(x, nn.key, n_clusters,
+                                           old_centroids=centers)
+        if adjust_every:
+            def do_adjust(c):
+                c2, _ = adjust_centers(c, counts, x, nn.key, nn.value)
+                return c2
+
+            centers = jax.lax.cond(it % adjust_every == adjust_every - 1,
+                                   do_adjust, lambda c: c, centers)
+        return centers
+
+    return jax.lax.fori_loop(0, n_iters, body, centers0)
 
 
 def build_clusters(rng: RngState, x, n_clusters: int, n_iters: int = 20,
@@ -60,31 +99,69 @@ def build_clusters(rng: RngState, x, n_clusters: int, n_iters: int = 20,
     if centers.shape[0] < n_clusters:  # tiny inputs: repeat rows
         reps = -(-n_clusters // centers.shape[0])
         centers = jnp.tile(centers, (reps, 1))[:n_clusters]
-    for it in range(n_iters):
-        nn = min_cluster_and_distance(x, centers, metric)
-        centers, counts = update_centroids(x, nn.key, n_clusters,
-                                           old_centroids=centers)
-        if adjust_every and (it % adjust_every == adjust_every - 1):
-            centers, _ = adjust_centers(centers, counts, x, nn.key, nn.value)
-    return centers
+    return _em_program(x, centers, n_clusters, n_iters, metric, adjust_every)
 
 
-def _bucket_pad(idx: np.ndarray, rng: np.random.Generator) -> np.ndarray:
-    """Pad an index set to the next power of two by resampling, bounding the
-    number of distinct XLA shapes."""
-    target = 1 << max(3, (len(idx) - 1).bit_length())
-    if len(idx) == target:
-        return idx
-    extra = rng.choice(idx, target - len(idx), replace=True)
-    return np.concatenate([idx, extra])
+@functools.partial(jax.jit, static_argnames=("n_iters", "adjust_every"))
+def _fine_stage(xs, c0, cmask, n_iters: int, adjust_every: int = 2):
+    """Masked Lloyd-EM with balancing over ALL mesoclusters at once.
+
+    xs (B, m, d) padded per-meso rows; c0 (B, k_max, d) seed centers;
+    cmask (B, k_max) marks each meso's live centers (quota varies per meso).
+    Masked-out centers get +inf distance so no point selects them, take no
+    part in balancing, and are dropped host-side after training.  One
+    compiled program regardless of B.
+    """
+
+    def one(x, c, mask):
+        k = c.shape[0]
+
+        def body(it, c):
+            d = (jnp.sum(x * x, 1, keepdims=True) + jnp.sum(c * c, 1)[None, :]
+                 - 2.0 * jnp.matmul(x, c.T, precision="high"))
+            d = jnp.where(mask[None, :], d, jnp.inf)
+            labels = jnp.argmin(d, axis=1)
+            dist = jnp.min(d, axis=1)
+            oh = (labels[:, None] == jnp.arange(k, dtype=labels.dtype)
+                  ).astype(x.dtype)
+            counts = jnp.sum(oh, axis=0)
+            sums = oh.T @ x
+            new = jnp.where((counts[:, None] > 0) & mask[:, None],
+                            sums / jnp.maximum(counts, 1)[:, None], c)
+
+            def do_adjust(c):
+                c2, _ = adjust_centers(c, counts, x, labels, dist, mask=mask)
+                return c2
+
+            if adjust_every:
+                new = jax.lax.cond(it % adjust_every == adjust_every - 1,
+                                   do_adjust, lambda c: c, new)
+            return new
+
+        return jax.lax.fori_loop(0, n_iters, body, c)
+
+    return jax.vmap(one)(xs, c0, cmask)
+
+
+def _bucket_size(size: int, cap: int) -> int:
+    """Next power of two ≥ size, floored at 8, bounded by ``cap`` — bounds
+    the number of distinct XLA shapes AND the padded-batch memory."""
+    return min(1 << max(3, (size - 1).bit_length()), cap)
+
+
+# Bound on padded rows per mesocluster in the batched fine stage: with the
+# usual dim≈128 f32 this caps the gathered batch at B·2^15·128·4 ≈ 0.5 GB
+# for B=32.  Mesoclusters beyond it train on a uniform row subsample, like
+# the reference's trainset-fraction bound.
+_FINE_ROW_CAP = 1 << 15
 
 
 def build_hierarchical(rng: RngState, x, n_clusters: int, n_iters: int = 20,
                        metric: DistanceType = DistanceType.L2Expanded):
     """Two-level balanced clustering (reference ann_kmeans_balanced.cuh:942
     ``build_hierarchical``): ≈√n_clusters mesoclusters, then fine clusters
-    within each mesocluster proportional to its population, then global
-    balancing EM iterations."""
+    within each mesocluster proportional to its population (one batched
+    device program — see :func:`_fine_stage`), then global balancing EM."""
     x = jnp.asarray(x)
     n = x.shape[0]
     if n_clusters <= 32 or n <= 4 * n_clusters:
@@ -104,22 +181,33 @@ def build_hierarchical(rng: RngState, x, n_clusters: int, n_iters: int = 20,
     while quota.sum() > n_clusters:
         i = np.argmax(np.where(quota > 1, quota, -1))  # never zero a non-empty meso
         quota[i] -= 1
+
+    # Batched fine stage: pad every non-empty meso's row set to ONE shared
+    # capacity (resampling real rows, so padding is just mild duplication),
+    # seed k_max centers each, and solve them all in a single vmapped
+    # program.  Replaces a per-meso host loop of ~√k solves.
+    live = np.nonzero(quota > 0)[0]
     host_rng = np.random.default_rng(rng.seed + 1000)
-    x_host = np.asarray(x)
-    fine = []
-    for m in range(n_meso):
+    cap = _bucket_size(int(sizes[live].max()), _FINE_ROW_CAP)
+    k_max = int(quota.max())
+    idx_mat = np.empty((len(live), cap), np.int32)
+    seed_mat = np.empty((len(live), k_max), np.int32)
+    for b, m in enumerate(live):
         idx = np.nonzero(meso_labels == m)[0]
-        if len(idx) == 0:
-            continue
-        idx = _bucket_pad(idx, host_rng)
-        sub = jnp.asarray(x_host[idx])
-        fine.append(build_clusters(rng, sub, int(quota[m]),
-                                   max(4, n_iters // 2), metric))
-    centers = jnp.concatenate(fine, axis=0)[:n_clusters]
-    # global balancing passes over the full dataset
-    for it in range(max(2, n_iters // 4)):
-        nn = min_cluster_and_distance(x, centers, metric)
-        centers, counts = update_centroids(x, nn.key, n_clusters,
-                                           old_centroids=centers)
-        centers, _ = adjust_centers(centers, counts, x, nn.key, nn.value)
-    return centers
+        if len(idx) > cap:          # only mesos beyond _FINE_ROW_CAP
+            take = host_rng.choice(idx, cap, replace=False)
+        else:                       # keep EVERY real row, pad by duplication
+            take = np.concatenate(
+                [idx, host_rng.choice(idx, cap - len(idx), replace=True)])
+        idx_mat[b] = take
+        seed_mat[b] = host_rng.choice(idx, k_max, replace=len(idx) < k_max)
+    cmask = jnp.asarray(np.arange(k_max)[None, :] < quota[live][:, None])
+    xs = x[jnp.asarray(idx_mat)]                       # (B, cap, dim) gather
+    c0 = x[jnp.asarray(seed_mat)]                      # (B, k_max, dim)
+    fine = np.asarray(_fine_stage(xs, c0, cmask, max(4, n_iters // 2)))
+    centers = jnp.asarray(np.concatenate(
+        [fine[b, :quota[m]] for b, m in enumerate(live)])[:n_clusters])
+
+    # global balancing passes over the full dataset — one compiled program
+    return _em_program(x, centers, n_clusters, max(2, n_iters // 4), metric,
+                       adjust_every=1)
