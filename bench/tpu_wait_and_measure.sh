@@ -1,0 +1,17 @@
+#!/bin/bash
+# Poll for TPU availability; when the tunnel is live, run the measurement
+# session (bench/tpu_session.py) once and exit.  The axon backend BLOCKS
+# (rather than failing) while the tunnel is down, so the probe runs in a
+# timeout-guarded subprocess.
+cd "$(dirname "$0")/.."
+for i in $(seq 1 "${1:-60}"); do
+  if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "tpu live (probe $i) — starting session" >&2
+    timeout 7200 python -m bench.tpu_session
+    exit $?
+  fi
+  echo "probe $i: tpu unreachable" >&2
+  sleep 240
+done
+echo "gave up waiting for tpu" >&2
+exit 1

@@ -22,14 +22,42 @@ TPU-first redesign:
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from raft_tpu.core.error import expects
 from raft_tpu.sparse.types import CSR
-from raft_tpu.sparse.linalg import best_matvec
+from raft_tpu.sparse.linalg import csr_to_ell, ell_spmv, spmv
+
+
+# --- static operator appliers -----------------------------------------------
+# Module-level (stable-identity) so _solve_program's jit cache is reused
+# across solves; a per-call closure would retrace/rec compile every call.
+
+def _apply_op(op, v):
+    """A @ v for an EllHybrid (scatter-free hot path) or CSR operator."""
+    if isinstance(op, CSR):
+        return spmv(op, v)
+    return ell_spmv(op, v)
+
+
+def _apply_shifted_neg(op, v):
+    """(σ, A) → σ·v − A·v: the spectral complement used for smallest-side
+    searches (extremal convergence without shift-invert solves)."""
+    sigma, inner = op
+    return sigma * v - _apply_op(inner, v)
+
+
+def _operator_for(a: CSR):
+    """One-time host-side ELL conversion: the Krylov loop applies A
+    m×restarts times and scatters must stay out of it on TPU.  The solver
+    driver is host-only (it syncs on the lock count), so *a* is always
+    concrete here."""
+    return csr_to_ell(a)
 
 
 def _gershgorin_upper(csr: CSR) -> jnp.ndarray:
@@ -51,8 +79,9 @@ def _lanczos_decomp(matvec, v0, m: int):
     """
     n = v0.shape[0]
     dtype = v0.dtype
-    eps = jnp.asarray(jnp.finfo(dtype).tiny ** 0.5, dtype)
-    q0 = v0 / jnp.maximum(jnp.linalg.norm(v0), eps)
+    tiny = jnp.asarray(jnp.finfo(dtype).tiny ** 0.5, dtype)
+    ulp = jnp.asarray(jnp.finfo(dtype).eps, dtype)
+    q0 = v0 / jnp.maximum(jnp.linalg.norm(v0), tiny)
     Q = jnp.zeros((m + 1, n), dtype).at[0].set(q0)
     alpha = jnp.zeros((m,), dtype)
     beta = jnp.zeros((m,), dtype)
@@ -68,8 +97,17 @@ def _lanczos_decomp(matvec, v0, m: int):
         w = w - Q.T @ (Q @ w)
         w = w - Q.T @ (Q @ w)
         b = jnp.linalg.norm(w)
-        beta = beta.at[j].set(b)
-        qn = jnp.where(b > eps, w / jnp.maximum(b, eps), jnp.zeros_like(w))
+        # Breakdown test must be RELATIVE to the recurrence scale: comparing
+        # against tiny**0.5 lets reorthogonalization noise (~ulp·scale, i.e.
+        # ~1e-13 after an exact invariant-subspace breakdown) be normalized
+        # into a garbage basis vector, after which the recurrence explodes
+        # (observed: beta growing to ~1e3 on a rank-1 operator of norm 5).
+        # A spurious-zero qn is harmless: the remaining steps stay zero and
+        # T decouples.
+        scale = jnp.maximum(jnp.max(jnp.abs(alpha)), jnp.max(beta))
+        good = b > 128.0 * ulp * jnp.maximum(scale, tiny)
+        beta = beta.at[j].set(jnp.where(good, b, jnp.asarray(0, dtype)))
+        qn = jnp.where(good, w / jnp.maximum(b, tiny), jnp.zeros_like(w))
         Q = Q.at[j + 1].set(qn)
         return Q, alpha, beta
 
@@ -91,10 +129,133 @@ def _ritz(Q, alpha, beta, k: int, largest: bool):
     return evals, vecs, resid
 
 
-def _lanczos(matvec: Callable, n: int, k: int, *, largest: bool,
+def _solve_impl(operator, v0, *, apply_fn: Callable, k: int, m: int,
+                largest: bool, max_restarts: int, tol: float):
+    """The ENTIRE restarted solve as one compiled program.
+
+    The reference drives restarts from the host (detail/lanczos.cuh:746);
+    here the restart+locking loop is a ``lax.while_loop`` so a solve costs
+    one dispatch and zero per-restart host syncs — on a remote-attached TPU
+    the old host loop's ~15 scalar pulls per restart dominated solve time.
+
+    ``apply_fn(operator, v)`` applies A; it is a STATIC module-level
+    function so repeated solves (same shapes) reuse the jit cache — a
+    per-call closure would retrace every time.
+    """
+    n = v0.shape[0]
+    dtype = v0.dtype
+    eps = jnp.asarray(jnp.finfo(dtype).tiny ** 0.5, dtype)
+
+    # Warm the operator ONCE at this (outer) trace level: lazily-memoizing
+    # callables (e.g. spectral.laplacian_matvec's first-use ELL build) must
+    # not capture their state inside one sub-trace (the first one_round's
+    # fori_loop) and replay it in a sibling sub-trace (the restart loop's
+    # lax.cond branch) — that is a tracer leak.  The result is unused and
+    # DCE'd; only the trace-time side effect matters.
+    apply_fn(operator, jnp.zeros_like(v0))
+
+    def one_round(v0, locked):
+        # Deflated operator P·A·P with P = I − UᵀU over the locked Ritz
+        # vectors: converged directions are projected out so restarts hunt
+        # the REMAINING spectrum — a single weighted restart vector cannot
+        # separate clustered eigenvalues (observed: near-degenerate pairs
+        # skipped at default ncv).  Valid for the largest-side searches this
+        # module performs (deflated directions collapse to eigenvalue 0, at
+        # the bottom of the shifted non-negative spectra used here).
+        def mv(v):
+            v = v - locked.T @ (locked @ v)
+            w = apply_fn(operator, v)
+            return w - locked.T @ (locked @ w)
+
+        Q, alpha, beta = _lanczos_decomp(mv, v0, m)
+        return _ritz(Q, alpha, beta, k, largest)
+
+    locked0 = jnp.zeros((k, n), dtype)
+    lvals0 = jnp.zeros((k,), dtype)
+    evals0, vecs0, resid0 = one_round(v0, locked0)
+    state0 = (jnp.asarray(0), v0, locked0, lvals0, jnp.asarray(0),
+              evals0, vecs0, resid0, jnp.asarray(False))
+
+    def cond(state):
+        it, *_, done = state
+        return (it < max_restarts) & ~done
+
+    def body(state):
+        it, v0, locked, lvals, nl, evals, vecs, resid, _ = state
+        slot = jnp.arange(k)
+        scale = jnp.maximum(jnp.max(jnp.abs(evals)),
+                            jnp.max(jnp.where(slot < nl, jnp.abs(lvals), 0.0)))
+        scale = jnp.maximum(scale, 1e-30)
+        conv = resid <= tol * scale
+
+        # lock converged Ritz pairs (extremal-first order from _ritz);
+        # re-orthogonalize against already-locked vectors, skip duplicates
+        def lock_one(carry, i):
+            locked, lvals, nl = carry
+            u = vecs[:, i]
+            u = u - locked.T @ (locked @ u)
+            nrm = jnp.linalg.norm(u)
+            take = conv[i] & (nl < k) & (nrm > eps)
+            cand = locked.at[nl].set(u / jnp.maximum(nrm, eps))
+            locked = jnp.where(take, cand, locked)
+            lvals = jnp.where(take, lvals.at[nl].set(evals[i]), lvals)
+            return (locked, lvals, nl + take.astype(nl.dtype)), None
+
+        (locked, lvals, nl), _ = jax.lax.scan(lock_one, (locked, lvals, nl),
+                                              jnp.arange(k))
+        # restart toward the unconverged directions; a collapsed restart
+        # vector (rank-deficient remainder) means there is nothing further
+        # to extract — stop instead of burning rounds on zero Krylov spaces
+        w = jnp.where(conv, jnp.asarray(0, dtype), resid + tol)
+        v0n = vecs @ w
+        done = (nl >= k) | (jnp.linalg.norm(v0n) <= eps)
+        evals, vecs, resid = jax.lax.cond(
+            done, lambda a, b: (evals, vecs, resid), one_round, v0n, locked)
+        return (it + 1, v0n, locked, lvals, nl, evals, vecs, resid, done)
+
+    (_, _, locked, lvals, nl, evals, vecs, resid, _) = jax.lax.while_loop(
+        cond, body, state0)
+    return evals, vecs, resid, locked, lvals, nl
+
+
+# Module-level program for the static appliers (_apply_op /
+# _apply_shifted_neg): every CSR-based solve with the same shape signature
+# reuses one compiled executable.
+_solve_program = jax.jit(_solve_impl,
+                         static_argnames=("apply_fn", "k", "m", "largest",
+                                          "max_restarts", "tol"))
+
+
+@functools.lru_cache(maxsize=8)
+def _callable_program(apply_fn: Callable):
+    """Per-callable jitted solve, LRU-bounded.
+
+    User matvec callables are usually fresh closures, so routing them
+    through the module-level ``_solve_program`` (static arg) would add a
+    permanently-retained jit-cache entry — compiled executable plus the
+    closure's captured device buffers — on EVERY solve.  The LRU bounds
+    that to 8 programs; evicted entries free their cache with the jit
+    object."""
+    return jax.jit(functools.partial(_solve_impl, apply_fn=apply_fn),
+                   static_argnames=("k", "m", "largest", "max_restarts",
+                                    "tol"))
+
+
+def _solve(apply_fn, operator, v0, **kw):
+    if apply_fn is _apply_op or apply_fn is _apply_shifted_neg:
+        return _solve_program(operator, v0, apply_fn=apply_fn, **kw)
+    return _callable_program(apply_fn)(operator, v0, **kw)
+
+
+def _lanczos(apply_fn: Callable, operator, n: int, k: int, *, largest: bool,
              ncv: Optional[int] = None, max_restarts: int = 15,
              tol: float = 1e-6, seed: int = 0, dtype=jnp.float32,
              v0=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Driver: one :func:`_solve_program` dispatch + host-side tail repair.
+
+    ``apply_fn(operator, v)`` applies A — pass a module-level function so
+    the compiled solve is reused across calls (see :func:`_solve_program`).
+    """
     expects(1 <= k < n, "lanczos: need 1 <= k < n")
     # Subspace sizing: larger single rounds beat many small restarted ones
     # on dense bulk spectra (measured on a 3k random-graph Laplacian:
@@ -109,98 +270,53 @@ def _lanczos(matvec: Callable, n: int, k: int, *, largest: bool,
         v0 = jax.random.normal(jax.random.PRNGKey(seed), (n,), dtype)
     v0 = jnp.asarray(v0, dtype)
 
-    @jax.jit
-    def one_round(v0, locked):
-        # Deflated operator P·A·P with P = I − UᵀU over the locked Ritz
-        # vectors: converged directions are projected out so restarts hunt
-        # the REMAINING spectrum — a single weighted restart vector cannot
-        # separate clustered eigenvalues (observed: near-degenerate pairs
-        # skipped at default ncv).  Valid for the largest-side searches this
-        # module performs (deflated directions collapse to eigenvalue 0, at
-        # the bottom of the shifted non-negative spectra used here).
-        def mv(v):
-            v = v - locked.T @ (locked @ v)
-            w = matvec(v)
-            return w - locked.T @ (locked @ w)
+    evals, vecs, resid, locked, lvals, nl = _solve(
+        apply_fn, operator, v0, k=k, m=m, largest=largest,
+        max_restarts=max_restarts, tol=tol)
 
-        Q, alpha, beta = _lanczos_decomp(mv, v0, m)
-        evals, vecs, resid = _ritz(Q, alpha, beta, k, largest)
-        return evals, vecs, resid
-
-    # Restart loop on host (bounded, few iterations); the reference's
-    # restarted Lanczos plays the same role (detail/lanczos.cuh:746).
-    locked = jnp.zeros((k, n), dtype)
-    locked_vals = []
     eps = float(jnp.finfo(dtype).tiny) ** 0.5
-    evals, vecs, resid = one_round(v0, locked)
-    for _ in range(max_restarts):
-        scale = max(float(jnp.max(jnp.abs(evals))),
-                    max((abs(v) for v in locked_vals), default=0.0), 1e-30)
-        conv = resid <= tol * scale
-        # lock converged Ritz pairs (extremal-first order from _ritz)
-        for i in range(k):
-            if len(locked_vals) >= k:
-                break
-            if bool(conv[i]):
-                u = vecs[:, i]
-                u = u - locked.T @ (locked @ u)
-                nrm = float(jnp.linalg.norm(u))
-                if nrm <= eps:
-                    continue  # duplicate of an already-locked vector
-                locked = locked.at[len(locked_vals)].set(u / nrm)
-                locked_vals.append(float(evals[i]))
-        if len(locked_vals) >= k:
-            break
-        # restart toward the unconverged directions; a collapsed restart
-        # vector (rank-deficient remainder) means there is nothing further
-        # to extract — stop instead of burning rounds on zero Krylov spaces
-        w = jnp.where(conv, 0.0, resid + tol)
-        v0 = jnp.sum(vecs * w[None, :], axis=1)
-        if float(jnp.linalg.norm(v0)) <= eps:
-            break
-        evals, vecs, resid = one_round(v0, locked)
-
-    if not locked_vals:
+    n_locked = int(nl)  # the solve's single host sync
+    if n_locked == 0:
         return evals, vecs
-    n_locked = len(locked_vals)
-    if n_locked < k:
-        # fill with the best unconverged Ritz pairs; if the operator's
-        # effective rank ran out (degenerate directions), complete the
-        # basis with random orthonormal vectors and their Rayleigh
-        # quotients so callers ALWAYS get k columns
-        extra_vals, extra_vecs = [], []
+    if n_locked >= k:  # success path: stay on device, no further sync
+        order = jnp.argsort(-lvals[:k]) if largest else jnp.argsort(lvals[:k])
+        return lvals[:k][order], locked[:k].T[:, order]
+    locked_vals = [float(v) for v in np.asarray(lvals)[:n_locked]]
 
-        def free_part(u):
-            u = u - locked.T @ (locked @ u)
-            for v in extra_vecs:
-                u = u - v * jnp.dot(v, u)
-            return u
+    # Partial convergence (rare): fill with the best unconverged Ritz pairs;
+    # if the operator's effective rank ran out (degenerate directions),
+    # complete the basis with random orthonormal vectors and their Rayleigh
+    # quotients so callers ALWAYS get k columns.
+    extra_vals, extra_vecs = [], []
 
-        for i in range(k):
-            if n_locked + len(extra_vals) >= k:
-                break
-            u = free_part(vecs[:, i])
-            nrm = float(jnp.linalg.norm(u))
-            if nrm <= eps:
-                continue
-            extra_vals.append(float(evals[i]))
-            extra_vecs.append(u / nrm)
-        key = jax.random.PRNGKey(seed + 1)
-        while n_locked + len(extra_vals) < k:
-            key, sub = jax.random.split(key)
-            u = free_part(jax.random.normal(sub, (n,), dtype))
-            nrm = float(jnp.linalg.norm(u))
-            if nrm <= eps:
-                continue
-            u = u / nrm
-            extra_vals.append(float(jnp.dot(u, matvec(u))))
-            extra_vecs.append(u)
-        all_vals = jnp.asarray(locked_vals + extra_vals, dtype)
-        all_vecs = jnp.concatenate(
-            [locked[:n_locked].T] + [v[:, None] for v in extra_vecs], axis=1)
-    else:
-        all_vals = jnp.asarray(locked_vals[:k], dtype)
-        all_vecs = locked[:k].T
+    def free_part(u):
+        u = u - locked.T @ (locked @ u)
+        for v in extra_vecs:
+            u = u - v * jnp.dot(v, u)
+        return u
+
+    for i in range(k):
+        if n_locked + len(extra_vals) >= k:
+            break
+        u = free_part(vecs[:, i])
+        nrm = float(jnp.linalg.norm(u))
+        if nrm <= eps:
+            continue
+        extra_vals.append(float(evals[i]))
+        extra_vecs.append(u / nrm)
+    key = jax.random.PRNGKey(seed + 1)
+    while n_locked + len(extra_vals) < k:
+        key, sub = jax.random.split(key)
+        u = free_part(jax.random.normal(sub, (n,), dtype))
+        nrm = float(jnp.linalg.norm(u))
+        if nrm <= eps:
+            continue
+        u = u / nrm
+        extra_vals.append(float(jnp.dot(u, apply_fn(operator, u))))
+        extra_vecs.append(u)
+    all_vals = jnp.asarray(locked_vals + extra_vals, dtype)
+    all_vecs = jnp.concatenate(
+        [locked[:n_locked].T] + [v[:, None] for v in extra_vecs], axis=1)
     order = jnp.argsort(-all_vals) if largest else jnp.argsort(all_vals)
     order = order[:k]
     return all_vals[order], all_vecs[:, order]
@@ -220,19 +336,17 @@ def lanczos_smallest(a: Union[CSR, Callable], n_components: int, *,
         n = a.shape[0]
         expects(a.shape[0] == a.shape[1], "lanczos: matrix must be square")
         sigma = _gershgorin_upper(a)
-        # one-time ELL conversion (best_matvec): the Krylov loop applies A
-        # m x restarts times; scatters must stay out of it on TPU
-        mv = best_matvec(a)
-        matvec = lambda v: sigma * v - mv(v)  # noqa: E731
         dtype = a.data.dtype
-        evals, vecs = _lanczos(matvec, n, n_components, largest=True, ncv=ncv,
+        evals, vecs = _lanczos(_apply_shifted_neg, (sigma, _operator_for(a)),
+                               n, n_components, largest=True, ncv=ncv,
                                max_restarts=max_restarts, tol=tol, seed=seed,
                                dtype=dtype, v0=v0)
         return (sigma - evals), vecs
     expects(n is not None, "lanczos with a matvec callable needs n")
-    # For a bare operator run on -A and negate.
-    neg = lambda v: -a(v)  # noqa: E731
-    evals, vecs = _lanczos(neg, n, n_components, largest=True, ncv=ncv,
+    # For a bare operator run on -A and negate.  The fresh lambda means a
+    # retrace per call — unavoidable for arbitrary user callables.
+    neg = lambda op, v: -a(v)  # noqa: E731
+    evals, vecs = _lanczos(neg, (), n, n_components, largest=True, ncv=ncv,
                            max_restarts=max_restarts, tol=tol, seed=seed,
                            dtype=dtype, v0=v0)
     return -evals, vecs
@@ -248,11 +362,11 @@ def lanczos_largest(a: Union[CSR, Callable], n_components: int, *,
     if isinstance(a, CSR):
         expects(a.shape[0] == a.shape[1], "lanczos: matrix must be square")
         n = a.shape[0]
-        matvec = best_matvec(a)
-        dtype = a.data.dtype
-    else:
-        expects(n is not None, "lanczos with a matvec callable needs n")
-        matvec = a
-    return _lanczos(matvec, n, n_components, largest=True, ncv=ncv,
+        return _lanczos(_apply_op, _operator_for(a), n, n_components,
+                        largest=True, ncv=ncv, max_restarts=max_restarts,
+                        tol=tol, seed=seed, dtype=a.data.dtype, v0=v0)
+    expects(n is not None, "lanczos with a matvec callable needs n")
+    apply = lambda op, v: a(v)  # noqa: E731 — retrace per call (user callable)
+    return _lanczos(apply, (), n, n_components, largest=True, ncv=ncv,
                     max_restarts=max_restarts, tol=tol, seed=seed,
                     dtype=dtype, v0=v0)

@@ -200,7 +200,7 @@ def test_lanczos_rank_deficient_returns_k():
 
     from raft_tpu.sparse.solver.lanczos import _lanczos
 
-    evals, vecs = _lanczos(mv, n, k, largest=True)
+    evals, vecs = _lanczos(lambda op, v: mv(v), (), n, k, largest=True)
     assert evals.shape == (k,) and vecs.shape == (n, k)
     assert abs(float(evals[0]) - 5.0) < 1e-3
     # remaining pairs live in the null space with eigenvalue ~0
