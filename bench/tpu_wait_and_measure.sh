@@ -5,7 +5,7 @@
 # timeout-guarded subprocess.
 cd "$(dirname "$0")/.."
 for i in $(seq 1 "${1:-60}"); do
-  if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+  if timeout -k 10 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "tpu live (probe $i) — starting session" >&2
     timeout 7200 python -m bench.tpu_session
     exit $?

@@ -120,20 +120,35 @@ def ell_spmv(ell: EllHybrid, x) -> jnp.ndarray:
     return y
 
 
-def best_matvec(csr: CSR):
-    """``A @ ·`` closure using the fastest available layout.
+def matvec_operand(csr: CSR):
+    """Best SpMV *operand* for :func:`apply_matvec` — a pytree that can be
+    passed through jit boundaries (unlike a closure, whose identity breaks
+    jit caching and whose captured buffers outlive the caller).
 
     Concrete CSR → one-time host-side ELL conversion (scatter-free hot
     loop).  Traced CSR (inside jit/vmap — the host conversion is
-    impossible) → plain :func:`spmv`.
+    impossible) → the CSR itself (plain :func:`spmv`).
     """
     import jax.core
 
     if isinstance(csr.indptr, jax.core.Tracer) \
             or isinstance(csr.indices, jax.core.Tracer):
-        return lambda v: spmv(csr, v)
-    ell = csr_to_ell(csr)
-    return lambda v: ell_spmv(ell, v)
+        return csr
+    return csr_to_ell(csr)
+
+
+def apply_matvec(op, v) -> jnp.ndarray:
+    """``A @ v`` for a :func:`matvec_operand` result (EllHybrid or CSR)."""
+    if isinstance(op, CSR):
+        return spmv(op, v)
+    return ell_spmv(op, v)
+
+
+def best_matvec(csr: CSR):
+    """``A @ ·`` closure over :func:`matvec_operand` (prefer the operand +
+    :func:`apply_matvec` pair when crossing jit boundaries)."""
+    op = matvec_operand(csr)
+    return lambda v: apply_matvec(op, v)
 
 
 def spmm(csr: CSR, b) -> jnp.ndarray:

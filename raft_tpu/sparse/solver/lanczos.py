@@ -23,6 +23,7 @@ TPU-first redesign:
 from __future__ import annotations
 
 import functools
+import weakref
 from typing import Callable, Optional, Tuple, Union
 
 import jax
@@ -31,33 +32,20 @@ import numpy as np
 
 from raft_tpu.core.error import expects
 from raft_tpu.sparse.types import CSR
-from raft_tpu.sparse.linalg import csr_to_ell, ell_spmv, spmv
+from raft_tpu.sparse.linalg import apply_matvec, matvec_operand
 
 
 # --- static operator appliers -----------------------------------------------
 # Module-level (stable-identity) so _solve_program's jit cache is reused
-# across solves; a per-call closure would retrace/rec compile every call.
-
-def _apply_op(op, v):
-    """A @ v for an EllHybrid (scatter-free hot path) or CSR operator."""
-    if isinstance(op, CSR):
-        return spmv(op, v)
-    return ell_spmv(op, v)
-
+# across solves; a per-call closure would retrace/recompile every call.
+# CSR operators use sparse.linalg's (matvec_operand, apply_matvec) pair:
+# one-time host-side ELL conversion, scatter-free in the Krylov loop.
 
 def _apply_shifted_neg(op, v):
     """(σ, A) → σ·v − A·v: the spectral complement used for smallest-side
     searches (extremal convergence without shift-invert solves)."""
     sigma, inner = op
-    return sigma * v - _apply_op(inner, v)
-
-
-def _operator_for(a: CSR):
-    """One-time host-side ELL conversion: the Krylov loop applies A
-    m×restarts times and scatters must stay out of it on TPU.  The solver
-    driver is host-only (it syncs on the lock count), so *a* is always
-    concrete here."""
-    return csr_to_ell(a)
+    return sigma * v - apply_matvec(inner, v)
 
 
 def _gershgorin_upper(csr: CSR) -> jnp.ndarray:
@@ -129,8 +117,13 @@ def _ritz(Q, alpha, beta, k: int, largest: bool):
     return evals, vecs, resid
 
 
-def _solve_impl(operator, v0, *, apply_fn: Callable, k: int, m: int,
-                largest: bool, max_restarts: int, tol: float):
+# Incremented each time _solve_impl is TRACED (its Python body runs only at
+# trace time) — lets tests assert jit-cache reuse without private JAX APIs.
+_trace_count = 0
+
+
+def _solve_impl(operator, v0, tol, max_restarts, *, apply_fn: Callable,
+                k: int, m: int, largest: bool):
     """The ENTIRE restarted solve as one compiled program.
 
     The reference drives restarts from the host (detail/lanczos.cuh:746);
@@ -140,18 +133,23 @@ def _solve_impl(operator, v0, *, apply_fn: Callable, k: int, m: int,
 
     ``apply_fn(operator, v)`` applies A; it is a STATIC module-level
     function so repeated solves (same shapes) reuse the jit cache — a
-    per-call closure would retrace every time.
+    per-call closure would retrace every time.  ``tol``/``max_restarts``
+    are dynamic scalar operands for the same reason: sweeping tolerances
+    must not recompile the program.
     """
+    global _trace_count
+    _trace_count += 1
     n = v0.shape[0]
     dtype = v0.dtype
     eps = jnp.asarray(jnp.finfo(dtype).tiny ** 0.5, dtype)
 
-    # Warm the operator ONCE at this (outer) trace level: lazily-memoizing
-    # callables (e.g. spectral.laplacian_matvec's first-use ELL build) must
-    # not capture their state inside one sub-trace (the first one_round's
-    # fori_loop) and replay it in a sibling sub-trace (the restart loop's
-    # lax.cond branch) — that is a tracer leak.  The result is unused and
-    # DCE'd; only the trace-time side effect matters.
+    # Warm the operator ONCE at this (outer) trace level: a user callable
+    # that lazily memoizes state on first use (e.g. building a converted
+    # layout in a closure cell) must not capture that state inside one
+    # sub-trace (the first one_round's fori_loop) and replay it in a
+    # sibling sub-trace (the restart loop's lax.cond branch) — that is a
+    # tracer leak.  The result is unused and DCE'd; only the trace-time
+    # side effect matters.
     apply_fn(operator, jnp.zeros_like(v0))
 
     def one_round(v0, locked):
@@ -218,39 +216,81 @@ def _solve_impl(operator, v0, *, apply_fn: Callable, k: int, m: int,
     return evals, vecs, resid, locked, lvals, nl
 
 
-# Module-level program for the static appliers (_apply_op /
-# _apply_shifted_neg): every CSR-based solve with the same shape signature
-# reuses one compiled executable.
+# Module-level program for the static appliers: every solve with the same
+# shape signature reuses one compiled executable.
 _solve_program = jax.jit(_solve_impl,
-                         static_argnames=("apply_fn", "k", "m", "largest",
-                                          "max_restarts", "tol"))
+                         static_argnames=("apply_fn", "k", "m", "largest"))
 
 
-@functools.lru_cache(maxsize=8)
-def _callable_program(apply_fn: Callable):
-    """Per-callable jitted solve, LRU-bounded.
-
-    User matvec callables are usually fresh closures, so routing them
-    through the module-level ``_solve_program`` (static arg) would add a
-    permanently-retained jit-cache entry — compiled executable plus the
-    closure's captured device buffers — on EVERY solve.  The LRU bounds
-    that to 8 programs; evicted entries free their cache with the jit
-    object."""
-    return jax.jit(functools.partial(_solve_impl, apply_fn=apply_fn),
-                   static_argnames=("k", "m", "largest", "max_restarts",
-                                    "tol"))
+def _apply_partial(op, v):
+    """op is a ``jax.tree_util.Partial`` riding through jit as a DYNAMIC
+    operand: its captured arrays are traced leaves and its wrapped function
+    is part of the (stable) treedef — so Partial-based operators (e.g.
+    spectral.laplacian_matvec) share one compiled solve across graphs."""
+    return op(v)
 
 
-def _solve(apply_fn, operator, v0, **kw):
-    if apply_fn is _apply_op or apply_fn is _apply_shifted_neg:
-        return _solve_program(operator, v0, apply_fn=apply_fn, **kw)
-    return _callable_program(apply_fn)(operator, v0, **kw)
+def _apply_partial_neg(op, v):
+    return -op(v)
+
+
+_STATIC_APPLIERS = (apply_matvec, _apply_shifted_neg, _apply_partial,
+                    _apply_partial_neg)
+
+# Per-user-callable programs, keyed WEAKLY on the callable and referencing
+# it only through a weakref: repeat solves with a reused plain callable hit
+# the jit cache, while dropping the callable releases the compiled program
+# (and the operand buffers its trace baked in as constants).
+_CALLABLE_PROGS = weakref.WeakKeyDictionary()
+
+
+def _callable_entry(a: Callable, negate: bool):
+    """(apply_fn, program) for a plain user matvec callable."""
+    recordable = True
+    try:
+        entry = _CALLABLE_PROGS.get(a)
+    except TypeError:  # unhashable callable
+        recordable, entry = False, None
+    if entry is None:
+        try:
+            ref = weakref.ref(a)
+        except TypeError:  # unweakrefable: per-call entry, dies with frame
+            recordable = False
+            ref = lambda a=a: a  # noqa: E731
+
+        def apply_pos(op, v):
+            return ref()(v)
+
+        def apply_neg(op, v):
+            return -ref()(v)
+
+        entry = {}
+        for neg, fn in ((False, apply_pos), (True, apply_neg)):
+            entry[neg] = (fn, jax.jit(
+                functools.partial(_solve_impl, apply_fn=fn),
+                static_argnames=("k", "m", "largest")))
+        if recordable:
+            _CALLABLE_PROGS[a] = entry
+    return entry[negate]
+
+
+def _solve(apply_fn, operator, v0, tol, max_restarts, *, program=None, **kw):
+    if program is not None:
+        return program(operator, v0, tol, max_restarts, **kw)
+    if apply_fn in _STATIC_APPLIERS:
+        return _solve_program(operator, v0, tol, max_restarts,
+                              apply_fn=apply_fn, **kw)
+    # Anonymous applier (e.g. internal tests): per-call jit, released with
+    # this frame.
+    prog = jax.jit(functools.partial(_solve_impl, apply_fn=apply_fn),
+                   static_argnames=("k", "m", "largest"))
+    return prog(operator, v0, tol, max_restarts, **kw)
 
 
 def _lanczos(apply_fn: Callable, operator, n: int, k: int, *, largest: bool,
              ncv: Optional[int] = None, max_restarts: int = 15,
              tol: float = 1e-6, seed: int = 0, dtype=jnp.float32,
-             v0=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+             v0=None, program=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Driver: one :func:`_solve_program` dispatch + host-side tail repair.
 
     ``apply_fn(operator, v)`` applies A — pass a module-level function so
@@ -271,8 +311,8 @@ def _lanczos(apply_fn: Callable, operator, n: int, k: int, *, largest: bool,
     v0 = jnp.asarray(v0, dtype)
 
     evals, vecs, resid, locked, lvals, nl = _solve(
-        apply_fn, operator, v0, k=k, m=m, largest=largest,
-        max_restarts=max_restarts, tol=tol)
+        apply_fn, operator, v0, jnp.asarray(tol, dtype), max_restarts,
+        program=program, k=k, m=m, largest=largest)
 
     eps = float(jnp.finfo(dtype).tiny) ** 0.5
     n_locked = int(nl)  # the solve's single host sync
@@ -331,24 +371,34 @@ def lanczos_smallest(a: Union[CSR, Callable], n_components: int, *,
     Reference ``computeSmallestEigenvectors`` (sparse/solver/lanczos.cuh:68).
     *a* is a :class:`CSR` or a ``matvec`` callable (pass *n* then).
     Returns (eigenvalues [k] ascending, eigenvectors [n, k]).
+
+    A plain callable must be PURE over immutable captured state: its solve
+    program is cached per callable, with captured arrays baked in as
+    constants — mutating them between solves returns stale results.  For
+    operator data that varies between solves, pass a
+    ``jax.tree_util.Partial`` (its arrays are dynamic operands).
     """
     if isinstance(a, CSR):
         n = a.shape[0]
         expects(a.shape[0] == a.shape[1], "lanczos: matrix must be square")
         sigma = _gershgorin_upper(a)
         dtype = a.data.dtype
-        evals, vecs = _lanczos(_apply_shifted_neg, (sigma, _operator_for(a)),
+        evals, vecs = _lanczos(_apply_shifted_neg, (sigma, matvec_operand(a)),
                                n, n_components, largest=True, ncv=ncv,
                                max_restarts=max_restarts, tol=tol, seed=seed,
                                dtype=dtype, v0=v0)
         return (sigma - evals), vecs
     expects(n is not None, "lanczos with a matvec callable needs n")
-    # For a bare operator run on -A and negate.  The fresh lambda means a
-    # retrace per call — unavoidable for arbitrary user callables.
-    neg = lambda op, v: -a(v)  # noqa: E731
-    evals, vecs = _lanczos(neg, (), n, n_components, largest=True, ncv=ncv,
-                           max_restarts=max_restarts, tol=tol, seed=seed,
-                           dtype=dtype, v0=v0)
+    # For a bare operator run on -A and negate.  A jax.tree_util.Partial
+    # rides through jit as a dynamic operand (one compiled program across
+    # operators); other callables get a weak-cached per-callable program.
+    if isinstance(a, jax.tree_util.Partial):
+        apply_fn, op, program = _apply_partial_neg, a, None
+    else:
+        (apply_fn, program), op = _callable_entry(a, negate=True), ()
+    evals, vecs = _lanczos(apply_fn, op, n, n_components, largest=True,
+                           ncv=ncv, max_restarts=max_restarts, tol=tol,
+                           seed=seed, dtype=dtype, v0=v0, program=program)
     return -evals, vecs
 
 
@@ -358,15 +408,21 @@ def lanczos_largest(a: Union[CSR, Callable], n_components: int, *,
                     seed: int = 0, v0=None, dtype=jnp.float32):
     """Largest eigenpairs (reference ``computeLargestEigenvectors``,
     sparse/solver/lanczos.cuh:132).  Returns (eigenvalues [k] descending,
-    eigenvectors [n, k])."""
+    eigenvectors [n, k]).  Same callable contract as
+    :func:`lanczos_smallest`: plain callables must be pure over immutable
+    captured state; use ``jax.tree_util.Partial`` for varying data."""
     if isinstance(a, CSR):
         expects(a.shape[0] == a.shape[1], "lanczos: matrix must be square")
         n = a.shape[0]
-        return _lanczos(_apply_op, _operator_for(a), n, n_components,
+        return _lanczos(apply_matvec, matvec_operand(a), n, n_components,
                         largest=True, ncv=ncv, max_restarts=max_restarts,
                         tol=tol, seed=seed, dtype=a.data.dtype, v0=v0)
     expects(n is not None, "lanczos with a matvec callable needs n")
-    apply = lambda op, v: a(v)  # noqa: E731 — retrace per call (user callable)
-    return _lanczos(apply, (), n, n_components, largest=True, ncv=ncv,
+    if isinstance(a, jax.tree_util.Partial):  # shared compiled program
+        return _lanczos(_apply_partial, a, n, n_components, largest=True,
+                        ncv=ncv, max_restarts=max_restarts, tol=tol,
+                        seed=seed, dtype=dtype, v0=v0)
+    apply_fn, program = _callable_entry(a, negate=False)
+    return _lanczos(apply_fn, (), n, n_components, largest=True, ncv=ncv,
                     max_restarts=max_restarts, tol=tol, seed=seed,
-                    dtype=dtype, v0=v0)
+                    dtype=dtype, v0=v0, program=program)

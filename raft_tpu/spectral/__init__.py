@@ -9,6 +9,7 @@ metrics ``analyze_partition`` / ``analyze_modularity``.
 """
 
 from raft_tpu.spectral.matrix import (
+    degrees,
     laplacian_matvec,
     modularity_matvec,
 )
@@ -26,6 +27,7 @@ from raft_tpu.spectral.partition import (
 )
 
 __all__ = [
+    "degrees",
     "laplacian_matvec",
     "modularity_matvec",
     "EigenSolverConfig",

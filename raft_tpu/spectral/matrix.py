@@ -18,13 +18,24 @@ import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
 from raft_tpu.sparse.types import CSR
-from raft_tpu.sparse.linalg import best_matvec
+from raft_tpu.sparse.linalg import apply_matvec, matvec_operand
 
 
-def _degrees(adj: CSR) -> jnp.ndarray:
-    """Weighted degree vector d_i = Σ_j a_ij."""
+def degrees(adj: CSR) -> jnp.ndarray:
+    """Weighted degree vector d_i = Σ_j a_ij (use this directly when only
+    degrees are needed — the operator builders below also pay the one-time
+    ELL conversion)."""
     return jax.ops.segment_sum(adj.data, adj.row_ids(),
                                num_segments=adj.shape[0])
+
+
+def _laplacian_apply(deg, op, x):
+    return deg * x - apply_matvec(op, x)
+
+
+def _modularity_apply(deg, edge_sum, op, x):
+    scale = jnp.dot(deg, x) / jnp.maximum(edge_sum, 1e-30)
+    return apply_matvec(op, x) - deg * scale
 
 
 def laplacian_matvec(adj: CSR) -> Tuple[Callable, jnp.ndarray]:
@@ -32,19 +43,17 @@ def laplacian_matvec(adj: CSR) -> Tuple[Callable, jnp.ndarray]:
 
     Returns (matvec, degrees).  Reference ``laplacian_matrix_t::mv``
     computes the same two-term SpMV (spectral/matrix_wrappers.hpp).
+
+    The matvec is a ``jax.tree_util.Partial`` of a module-level applier:
+    its state (degrees + ELL operand) rides through jit boundaries as
+    dynamic operands, so consumers like the Lanczos solver reuse ONE
+    compiled program across graphs instead of retracing per closure — and
+    nothing pins the graph's buffers beyond the Partial's own lifetime.
     """
     expects(adj.shape[0] == adj.shape[1], "laplacian: matrix must be square")
-    deg = _degrees(adj)
-    # lazy: deg-only callers (analyze_partition) must not pay the host-side
-    # ELL conversion; first mv call builds the scatter-free operator
-    box = []
-
-    def mv(x):
-        if not box:
-            box.append(best_matvec(adj))
-        return deg * x - box[0](x)
-
-    return mv, deg
+    deg = degrees(adj)
+    return jax.tree_util.Partial(_laplacian_apply, deg,
+                                 matvec_operand(adj)), deg
 
 
 def modularity_matvec(adj: CSR) -> Tuple[Callable, jnp.ndarray, jnp.ndarray]:
@@ -52,17 +61,10 @@ def modularity_matvec(adj: CSR) -> Tuple[Callable, jnp.ndarray, jnp.ndarray]:
 
     Returns (matvec, degrees, edge_sum) where ``edge_sum = Σ_ij a_ij = 2m``.
     Reference ``modularity_matrix_t::mv`` (spectral/matrix_wrappers.hpp).
+    Same ``Partial`` design as :func:`laplacian_matvec`.
     """
     expects(adj.shape[0] == adj.shape[1], "modularity: matrix must be square")
-    deg = _degrees(adj)
+    deg = degrees(adj)
     edge_sum = jnp.sum(deg)  # 2m for an undirected (symmetric) graph
-
-    box = []
-
-    def mv(x):
-        if not box:
-            box.append(best_matvec(adj))
-        scale = jnp.dot(deg, x) / jnp.maximum(edge_sum, 1e-30)
-        return box[0](x) - deg * scale
-
-    return mv, deg, edge_sum
+    return jax.tree_util.Partial(_modularity_apply, deg, edge_sum,
+                                 matvec_operand(adj)), deg, edge_sum

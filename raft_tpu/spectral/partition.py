@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from raft_tpu.core.error import expects
 from raft_tpu.sparse.types import CSR
 from raft_tpu.sparse.linalg import spmm
-from raft_tpu.spectral.matrix import laplacian_matvec, modularity_matvec
+from raft_tpu.spectral.matrix import degrees, laplacian_matvec, modularity_matvec
 from raft_tpu.spectral.solvers import LanczosEigenSolver, KMeansClusterSolver
 
 
@@ -103,7 +103,7 @@ def analyze_partition(adj: CSR, n_clusters: int, labels
     labels = jnp.asarray(labels)
     n = adj.shape[0]
     expects(labels.shape[0] == n, "labels must have one entry per vertex")
-    _, deg = laplacian_matvec(adj)
+    deg = degrees(adj)  # deg-only: skip the operator build
     U = _one_hot(labels, n_clusters, adj.data.dtype)        # (n, k)
     LU = deg[:, None] * U - spmm(adj, U)                    # one SpMM, not k SpMVs
     cut = jnp.sum(U * LU, axis=0)                            # (k,) uᵀLu
@@ -121,7 +121,8 @@ def analyze_modularity(adj: CSR, n_clusters: int, labels) -> jnp.ndarray:
     labels = jnp.asarray(labels)
     n = adj.shape[0]
     expects(labels.shape[0] == n, "labels must have one entry per vertex")
-    _, deg, edge_sum = modularity_matvec(adj)
+    deg = degrees(adj)
+    edge_sum = jnp.sum(deg)
     U = _one_hot(labels, n_clusters, adj.data.dtype)
     BU = spmm(adj, U) - deg[:, None] * (deg @ U)[None, :] / jnp.maximum(edge_sum, 1e-30)
     q = jnp.sum(U * BU)

@@ -201,3 +201,34 @@ class TestReviewRegressions:
                     (20, 1))
         centers = cluster.build_hierarchical(RngState(0), x, 48, n_iters=4)
         assert centers.shape == (48, 8)
+
+    def test_fine_stage_respects_center_mask(self):
+        """Masked-out centers (quota padding) must neither attract points nor
+        be re-seeded by balancing — they come back exactly as seeded."""
+        from raft_tpu.cluster.kmeans_balanced import _fine_stage
+
+        rng = np.random.default_rng(3)
+        xs = jnp.asarray(rng.normal(0, 1, (2, 256, 8)).astype(np.float32))
+        # seed masked centers FAR away; if they took part in EM they would
+        # move (no point is near them, so balancing would re-seed them)
+        c0 = np.concatenate([rng.normal(0, 1, (2, 4, 8)),
+                             np.full((2, 4, 8), 1e6)], axis=1).astype(np.float32)
+        cmask = jnp.asarray(np.repeat([[True] * 4 + [False] * 4], 2, axis=0))
+        out = np.asarray(_fine_stage(jnp.asarray(xs), jnp.asarray(c0), cmask,
+                                     n_iters=6))
+        np.testing.assert_array_equal(out[:, 4:], c0[:, 4:])  # untouched
+        assert np.all(np.abs(out[:, :4]) < 100)  # live centers moved to data
+
+    def test_hierarchical_skewed_populations(self):
+        """Quotas follow mesocluster populations; the concatenated centers
+        must still total exactly n_clusters and cover the heavy region."""
+        rng = np.random.default_rng(4)
+        heavy = rng.normal(0, 0.5, (9000, 8))
+        light = rng.normal(20, 0.5, (500, 8))
+        x = np.concatenate([heavy, light]).astype(np.float32)
+        centers = cluster.build_hierarchical(RngState(0), x, 100, n_iters=6)
+        assert centers.shape == (100, 8)
+        c = np.asarray(centers)
+        assert np.isfinite(c).all()
+        n_heavy = int((np.linalg.norm(c - 0.0, axis=1) < 10).sum())
+        assert n_heavy > 60  # heavy region got the bulk of the quota

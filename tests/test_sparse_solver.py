@@ -209,6 +209,78 @@ def test_lanczos_rank_deficient_returns_k():
     np.testing.assert_allclose(gram, np.eye(k), atol=1e-3)
 
 
+def test_lanczos_breakdown_is_relative_to_scale():
+    """Invariant-subspace breakdown: a low-rank CSR operator must NOT let
+    reorthogonalization noise (~ulp·scale) re-enter as garbage basis vectors
+    (regression: absolute tiny**0.5 threshold exploded the recurrence —
+    beta grew to ~1e3 on a rank-1 operator of norm 5)."""
+    import scipy.sparse as sps
+
+    from raft_tpu.sparse import CSR, lanczos_largest
+
+    n, k = 120, 4
+    rng = np.random.default_rng(5)
+    u = rng.random(n).astype(np.float32)
+    u /= np.linalg.norm(u)
+    dense = 5.0 * np.outer(u, u)
+    dense[np.abs(dense) < 1e-3] = 0.0  # sparsify
+    g = sps.csr_matrix(dense.astype(np.float32))
+    a = CSR(g.indptr, g.indices, g.data, g.shape)
+    evals, vecs = lanczos_largest(a, k, tol=1e-6)
+    top = float(np.asarray(evals)[0])
+    ref = float(np.linalg.eigvalsh(g.toarray())[-1])
+    assert abs(top - ref) < 1e-2
+    # no explosion: every returned eigenvalue bounded by the operator norm
+    assert np.all(np.abs(np.asarray(evals)) <= ref * 1.01 + 1e-3)
+
+
+def test_lanczos_repeated_solves_share_compiled_program():
+    """CSR solves route through the module-level jitted program — repeat
+    solves at the same shapes must not retrace (the old per-call closure
+    recompiled every solve)."""
+    import scipy.sparse as sps
+
+    from raft_tpu.sparse import CSR, laplacian, lanczos_smallest
+    from raft_tpu.sparse.solver import lanczos as L
+
+    n = 300
+    g = sps.random(n, n, density=0.01, format="csr", dtype=np.float32,
+                   random_state=2)
+    g = g + g.T
+    adj = CSR(g.indptr, g.indices, g.data, g.shape)
+    lap = laplacian(adj)
+    lanczos_smallest(lap, 3, tol=1e-4)
+    traces0 = L._trace_count
+    lanczos_smallest(lap, 3, tol=1e-4, seed=1)
+    lanczos_smallest(lap, 3, tol=2e-3, seed=2)  # tol is dynamic, no retrace
+    assert L._trace_count == traces0
+
+
+def test_lanczos_reused_callable_hits_weak_cache():
+    """A reused plain matvec callable must reuse its compiled program
+    (weak-cached); dropping the callable must release the cache entry."""
+    import gc
+
+    from raft_tpu.sparse.solver import lanczos as L
+
+    n = 150
+    rng = np.random.default_rng(0)
+    M = rng.normal(0, 1, (n, n)).astype(np.float32)
+    M = M @ M.T
+
+    def op(v):
+        return M @ v
+
+    L.lanczos_largest(op, 3, n=n)
+    traces0 = L._trace_count
+    L.lanczos_largest(op, 3, n=n, seed=1)
+    assert L._trace_count == traces0
+    assert op in L._CALLABLE_PROGS
+    del op
+    gc.collect()
+    assert len(L._CALLABLE_PROGS) == 0
+
+
 def test_lanczos_empty_graph_ell():
     """csr_to_ell/spmv path on an all-zero matrix must not crash."""
     from raft_tpu.sparse import csr_to_ell, ell_spmv
