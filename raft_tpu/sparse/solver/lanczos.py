@@ -237,23 +237,24 @@ def _apply_partial_neg(op, v):
 _STATIC_APPLIERS = (apply_matvec, _apply_shifted_neg, _apply_partial,
                     _apply_partial_neg)
 
-# Per-user-callable programs, keyed WEAKLY on the callable and referencing
-# it only through a weakref: repeat solves with a reused plain callable hit
-# the jit cache, while dropping the callable releases the compiled program
-# (and the operand buffers its trace baked in as constants).
-_CALLABLE_PROGS = weakref.WeakKeyDictionary()
+# Per-user-callable programs, keyed by the callable's IDENTITY (id()) —
+# __eq__-based keying would let two equal-but-distinct callables share one
+# program whose trace baked the FIRST one's data in as constants.  A
+# weakref finalizer evicts the entry when the callable dies, releasing the
+# compiled program (and the operand buffers embedded in it); the entry
+# itself references the callable only weakly.
+_CALLABLE_PROGS: dict = {}
 
 
 def _callable_entry(a: Callable, negate: bool):
     """(apply_fn, program) for a plain user matvec callable."""
-    recordable = True
-    try:
-        entry = _CALLABLE_PROGS.get(a)
-    except TypeError:  # unhashable callable
-        recordable, entry = False, None
+    key = id(a)
+    entry = _CALLABLE_PROGS.get(key)
     if entry is None:
+        recordable = True
         try:
             ref = weakref.ref(a)
+            weakref.finalize(a, _CALLABLE_PROGS.pop, key, None)
         except TypeError:  # unweakrefable: per-call entry, dies with frame
             recordable = False
             ref = lambda a=a: a  # noqa: E731
@@ -270,7 +271,7 @@ def _callable_entry(a: Callable, negate: bool):
                 functools.partial(_solve_impl, apply_fn=fn),
                 static_argnames=("k", "m", "largest")))
         if recordable:
-            _CALLABLE_PROGS[a] = entry
+            _CALLABLE_PROGS[key] = entry
     return entry[negate]
 
 
@@ -293,8 +294,10 @@ def _lanczos(apply_fn: Callable, operator, n: int, k: int, *, largest: bool,
              v0=None, program=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Driver: one :func:`_solve_program` dispatch + host-side tail repair.
 
-    ``apply_fn(operator, v)`` applies A — pass a module-level function so
-    the compiled solve is reused across calls (see :func:`_solve_program`).
+    ``apply_fn(operator, v)`` applies A.  Compiled-program reuse: the
+    appliers in ``_STATIC_APPLIERS`` share the module-level jit; a
+    ``program`` from :func:`_callable_entry` is reused per callable; any
+    other apply_fn retraces per call.
     """
     expects(1 <= k < n, "lanczos: need 1 <= k < n")
     # Subspace sizing: larger single rounds beat many small restarted ones
