@@ -43,7 +43,9 @@ def headline():
     import signal
 
     env = dict(os.environ)
-    for m in ("pairwise", "kmeans", "kmeans_mnmg", "ivf_pq", "lanczos"):
+    # Not-yet-recorded configs first: the tunnel window can close mid-session
+    # (it did in r2a AND r2b), and pairwise/kmeans already have live numbers.
+    for m in ("kmeans_mnmg", "ivf_pq", "lanczos", "pairwise", "kmeans"):
         env["BENCH_METRIC"] = m
         env["BENCH_TIMEOUT_S"] = "600"
         # The outer timeout must exceed bench.py's worst case (two platform

@@ -224,9 +224,21 @@ def init_plus_plus(rng: RngState, x, n_clusters: int,
        on the (small) candidate set.
     """
     x = jnp.asarray(x)
-    n, dim = x.shape
     l = max(1, int(oversampling_factor * n_clusters))
-    key0 = rng.next_key()
+    return _pp_program(x, rng.next_key(), n_clusters, l, n_rounds, metric)
+
+
+@functools.partial(jax.jit, static_argnames=("n_clusters", "l", "n_rounds",
+                                             "metric"))
+def _pp_program(x, base_key, n_clusters: int, l: int, n_rounds: int,
+                metric: DistanceType):
+    """All k-means|| rounds + the weighted k-means++ finish as ONE compiled
+    program — the per-round host loop cost ~3 dispatches × n_rounds on a
+    remote-attached TPU for no benefit (every round has identical shapes).
+    Per-step keys are derived in-program from one base key."""
+    n, dim = x.shape
+    key0 = jax.random.fold_in(base_key, n_rounds + 1)
+    key_pp = jax.random.fold_in(base_key, n_rounds + 2)
     first = x[jax.random.randint(key0, (), 0, n)]
     # Fixed-capacity candidate buffer (1 + n_rounds·l): ONE compiled shape
     # for every round instead of a recompile per growing concatenation.
@@ -234,20 +246,22 @@ def init_plus_plus(rng: RngState, x, n_clusters: int,
     # change any point's min distance (argmin ties resolve to the lowest
     # slot), and they collect zero ownership weight below.
     cap = 1 + n_rounds * l
-    candidates = jnp.broadcast_to(first[None, :], (cap, dim)).copy()
-    n_filled = 1
-    for r in range(n_rounds):
-        nn = min_cluster_and_distance(x, candidates, metric)
+    candidates = jnp.broadcast_to(first[None, :], (cap, dim))
+
+    def round_body(r, cand):
+        nn = min_cluster_and_distance(x, cand, metric)
         probs = jnp.maximum(nn.value, 1e-37)
-        key = rng.next_key()
-        idx = jax.random.categorical(key, jnp.log(probs), shape=(l,))
-        candidates = jax.lax.dynamic_update_slice(candidates, x[idx], (n_filled, 0))
-        n_filled += l
+        idx = jax.random.categorical(jax.random.fold_in(base_key, r),
+                                     jnp.log(probs), shape=(l,))
+        return jax.lax.dynamic_update_slice(cand, x[idx], (1 + r * l, 0))
+
+    if n_rounds > 0:  # fori_loop traces its body even for zero trips
+        candidates = jax.lax.fori_loop(0, n_rounds, round_body, candidates)
     # weight candidates by how many points they own (duplicate slots collect
     # zero: argmin ties go to the first occurrence)
     nn = min_cluster_and_distance(x, candidates, metric)
     counts = jnp.zeros((cap,), x.dtype).at[nn.key].add(1.0)
-    return _weighted_kmeans_pp(rng.next_key(), candidates, counts, n_clusters)
+    return _weighted_kmeans_pp(key_pp, candidates, counts, n_clusters)
 
 
 kmeans_plus_plus = init_plus_plus  # reference kmeans.cuh ``kmeans_plus_plus``

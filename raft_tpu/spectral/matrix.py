@@ -22,9 +22,10 @@ from raft_tpu.sparse.linalg import apply_matvec, matvec_operand
 
 
 def degrees(adj: CSR) -> jnp.ndarray:
-    """Weighted degree vector d_i = Σ_j a_ij (use this directly when only
-    degrees are needed — the operator builders below also pay the one-time
-    ELL conversion)."""
+    """Weighted degree vector d_i = Σ_j a_ij.
+
+    Use this directly when only degrees are needed — the operator builders
+    below also pay the one-time ELL conversion."""
     return jax.ops.segment_sum(adj.data, adj.row_ids(),
                                num_segments=adj.shape[0])
 
