@@ -80,22 +80,30 @@ def kmeans_sweep():
     rng = np.random.default_rng(0)
     x = jax.device_put(rng.random((100_000, 128), dtype=np.float32))
     c = jax.device_put(rng.random((1024, 128), dtype=np.float32))
+
+    def run_one(tag, **mcad_kw):
+        def em(cc):
+            nn = min_cluster_and_distance(x, cc, **mcad_kw)
+            new, _ = update_centroids(x, nn.key, 1024, old_centroids=cc)
+            return new
+
+        emj = jax.jit(em)
+        try:
+            best = timed(lambda: emj(c), iters=8)
+            emit({"stage": "kmeans_sweep", "iter_s": round(1.0 / best, 1),
+                  **tag})
+        except Exception as e:  # noqa: BLE001 - record and continue
+            emit({"stage": "kmeans_sweep", "error": str(e)[:120], **tag})
+
+    # A/B: fused Pallas E-step engine vs XLA (distance tile stays in VMEM).
+    # "default" = single-pass bf16 dot, "high" = f32 dot in-kernel.
+    for prec in ("default", "high"):
+        run_one({"engine": "pallas", "precision": prec},
+                engine="pallas", precision=prec)
     for bs in (2048, 4096, 8192, 16384, 32768):
         for prec in ("high", "default"):
-            def em(cc, bs=bs, prec=prec):
-                nn = min_cluster_and_distance(x, cc, batch_samples=bs,
-                                              precision=prec)
-                new, _ = update_centroids(x, nn.key, 1024, old_centroids=cc)
-                return new
-
-            emj = jax.jit(em)
-            try:
-                best = timed(lambda: emj(c), iters=8)
-                emit({"stage": "kmeans_sweep", "batch_samples": bs,
-                      "precision": prec, "iter_s": round(1.0 / best, 1)})
-            except Exception as e:  # noqa: BLE001 - record and continue
-                emit({"stage": "kmeans_sweep", "batch_samples": bs,
-                      "precision": prec, "error": str(e)[:120]})
+            run_one({"batch_samples": bs, "precision": prec},
+                    batch_samples=bs, precision=prec)
 
 
 def ivf_pq_stages():

@@ -41,19 +41,39 @@ _L2_METRICS = (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
 # faster than full-f32 emulation on v5e with zero argmin flips on k-means-
 # scale data; pass precision="highest" for bit-exact f32.
 @functools.partial(jax.jit, static_argnames=("metric", "batch_samples",
-                                             "batch_centroids", "precision"))
+                                             "batch_centroids", "precision",
+                                             "engine"))
 def min_cluster_and_distance(x, centroids, metric: DistanceType = DistanceType.L2Expanded,
                              batch_samples: int = 2048, batch_centroids: int = 1024,
-                             precision: str = "high") -> KeyValuePair:
+                             precision: str = "high",
+                             engine: Optional[str] = None) -> KeyValuePair:
     """Nearest centroid (index, distance) per sample — the E-step
     (reference kmeans_common.cuh:341; fusedL2NNMinReduce fast path :416).
 
     Distances are *squared* L2 for the L2-family metrics (matching the
     reference, which runs k-means on squared distances), cosine distance for
     CosineExpanded; batched over (batch_samples × batch_centroids) tiles.
+
+    ``engine``: "xla" (default) or "pallas" (fused Pallas kernel for the
+    L2 family).  ``RAFT_TPU_PALLAS_NN=1`` flips the default — read at
+    trace time, so set it before the first call.
     """
     m, dim = x.shape
     if metric in _L2_METRICS:
+        from raft_tpu.distance import pallas_fused_l2nn
+
+        if engine == "pallas" or (engine is None
+                                  and pallas_fused_l2nn.is_enabled()):
+            # Fused Pallas engine: the (block, k) distance tile never
+            # leaves VMEM (the jnp path's XLA lowering round-trips it
+            # through HBM before the argmin).  Single-pass bf16 only for
+            # precision="default" — "high" promises bf16x3-quality argmins
+            # (zero flips, see module comment), which single-pass bf16
+            # does not deliver.
+            val, idx = pallas_fused_l2nn.fused_l2_nn_pallas(
+                x, centroids, bf16_dot=(precision == "default"),
+                interpret=pallas_fused_l2nn.interpret_requested())
+            return KeyValuePair(key=idx, value=val.astype(x.dtype))
         bs = min(batch_samples, m)
         nb = -(-m // bs)
         xp = jnp.pad(x, ((0, nb * bs - m), (0, 0)))

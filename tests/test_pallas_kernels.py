@@ -48,3 +48,39 @@ def test_pallas_blocking_invariance():
     b = np.array(_pairwise_pallas(x, y, "l1", 2.0, bm=32, bn=128,
                                   interpret=True))
     np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_fused_l2_nn_pallas_matches_jnp():
+    """Pallas fused distance+argmin (interpret mode) must agree with the
+    jnp engine on values, indices, and tie-breaking."""
+    from raft_tpu.distance.fused_l2_nn import fused_l2_nn
+    from raft_tpu.distance.pallas_fused_l2nn import fused_l2_nn_pallas
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(0, 1, (513, 40)).astype(np.float32)   # non-multiples
+    y = rng.normal(0, 1, (300, 40)).astype(np.float32)
+    y[7] = y[211]                                        # exact tie pair
+    val, idx = fused_l2_nn_pallas(x, y, bm=128, bn=128, bf16_dot=False,
+                                  interpret=True)
+    ref = fused_l2_nn(x, y, sqrt=False, precision="highest")
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(ref.key))
+    np.testing.assert_allclose(np.asarray(val), np.asarray(ref.value),
+                               atol=1e-3)
+
+
+def test_min_cluster_and_distance_pallas_engine():
+    """engine="pallas" routes the k-means E-step through the fused kernel
+    with identical assignments (interpret mode auto-selected off-TPU)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.cluster import min_cluster_and_distance
+
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(0, 1, (400, 24)).astype(np.float32))
+    c = jnp.asarray(rng.normal(0, 1, (32, 24)).astype(np.float32))
+    base = min_cluster_and_distance(x, c, precision="highest")
+    out = min_cluster_and_distance(x, c, precision="highest",
+                                   engine="pallas")
+    np.testing.assert_array_equal(np.asarray(out.key), np.asarray(base.key))
+    np.testing.assert_allclose(np.asarray(out.value), np.asarray(base.value),
+                               atol=1e-3)
