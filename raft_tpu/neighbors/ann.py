@@ -1,0 +1,155 @@
+"""Unified ANN dispatch — the legacy ``approx_knn_*`` surface.
+
+Counterpart of reference ``spatial/knn/ann.cuh:41,70``
+(``approx_knn_build_index`` / ``approx_knn_search``) and the param structs
+in ``spatial/knn/ann_common.h:84-104`` (``IVFFlatParam`` / ``IVFPQParam`` /
+``IVFSQParam`` + ``from_legacy_index_params`` conversion): one entry point
+that dispatches on the param type to the concrete index implementations.
+
+The reference's IVF-SQ (scalar quantizer) delegates to FAISS; here it maps
+to IVF-Flat with int8/uint8 compressed storage — the same
+8-bit-per-component role (ivf_flat.py stores int8/uint8 natively,
+ivf_flat_types.hpp:58).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+
+_SQ_METRICS = (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded)
+
+
+def _sq_encode(v, lo: float, scale: float) -> jnp.ndarray:
+    """The shared SQ8 affine code map — index and queries MUST agree."""
+    return jnp.clip(jnp.round((v - lo) / scale) - 128, -128, 127
+                    ).astype(jnp.int8)
+
+
+class QuantizerType(enum.Enum):
+    """Reference ``QuantizerType`` (ann_common.h:73-81).  Only the 8-bit
+    kinds have a native TPU storage mapping; the rest raise."""
+
+    QT_8bit = "QT_8bit"
+    QT_4bit = "QT_4bit"
+    QT_8bit_uniform = "QT_8bit_uniform"
+    QT_4bit_uniform = "QT_4bit_uniform"
+    QT_fp16 = "QT_fp16"
+    QT_8bit_direct = "QT_8bit_direct"
+    QT_6bit = "QT_6bit"
+
+
+@dataclasses.dataclass
+class IVFParam:
+    """Reference ``IVFParam`` (ann_common.h:87-90)."""
+
+    nlist: int = 1024
+    nprobe: int = 20
+
+
+@dataclasses.dataclass
+class IVFFlatParam(IVFParam):
+    """Reference ``IVFFlatParam`` (ann_common.h:92)."""
+
+
+@dataclasses.dataclass
+class IVFPQParam(IVFParam):
+    """Reference ``IVFPQParam`` (ann_common.h:95-99).  ``M`` = number of
+    subquantizers (pq_dim), ``n_bits`` = bits per code."""
+
+    M: int = 0
+    n_bits: int = 8
+    use_precomputed_tables: bool = False  # accepted for parity; LUTs are
+    # always built per query batch here (ivf_pq._search_batch)
+
+
+@dataclasses.dataclass
+class IVFSQParam(IVFParam):
+    """Reference ``IVFSQParam`` (ann_common.h:101-104)."""
+
+    qtype: QuantizerType = QuantizerType.QT_8bit
+    encode_residual: bool = True  # accepted for parity
+
+
+@dataclasses.dataclass
+class KnnIndex:
+    """Reference ``knnIndex`` (ann_common.h:35): metric + nprobe + exactly
+    one concrete index."""
+
+    metric: DistanceType
+    metric_arg: float
+    nprobe: int
+    ivf_flat_index: Optional[ivf_flat.Index] = None
+    ivf_pq_index: Optional[ivf_pq.Index] = None
+    sq_scale: Optional[Tuple[float, float]] = None  # (lo, scale) for IVF-SQ
+
+
+def approx_knn_build_index(params: IVFParam, data,
+                           metric: DistanceType = DistanceType.L2Expanded,
+                           metric_arg: float = 2.0, handle=None) -> KnnIndex:
+    """Build the index selected by the param type (reference
+    ``approx_knn_build_index``, spatial/knn/ann.cuh:41; param conversion
+    ``from_legacy_index_params``, ann_common.h:106-117)."""
+    x = jnp.asarray(data)
+    if isinstance(params, IVFPQParam):
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=params.nlist, metric=metric,
+                               pq_dim=params.M, pq_bits=params.n_bits),
+            x, handle=handle)
+        return KnnIndex(metric, metric_arg, params.nprobe, ivf_pq_index=idx)
+    if isinstance(params, IVFSQParam):
+        expects(params.qtype in (QuantizerType.QT_8bit,
+                                 QuantizerType.QT_8bit_uniform,
+                                 QuantizerType.QT_8bit_direct),
+                f"ann: no TPU storage mapping for {params.qtype}")
+        # The affine shift is ranking-preserving for L2 only (it changes
+        # dot products by data-dependent terms); reject other metrics.
+        expects(metric in _SQ_METRICS,
+                "ann: IVF-SQ supports L2Expanded/L2SqrtExpanded only")
+        # 8-bit scalar quantization = IVF-Flat over an int8 affine mapping
+        # of the data (the FAISS SQ8 role).
+        lo, hi = jnp.min(x), jnp.max(x)
+        scale = jnp.maximum(hi - lo, 1e-30) / 255.0
+        xq = _sq_encode(x, lo, scale)
+        idx = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=params.nlist, metric=metric), xq,
+            handle=handle)
+        return KnnIndex(metric, metric_arg, params.nprobe,
+                        ivf_flat_index=idx,
+                        sq_scale=(float(lo), float(scale)))
+    expects(isinstance(params, IVFParam), "ann: unknown param type")
+    idx = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=params.nlist, metric=metric), x,
+        handle=handle)
+    return KnnIndex(metric, metric_arg, params.nprobe, ivf_flat_index=idx)
+
+
+def approx_knn_search(index: KnnIndex, queries, k: int, handle=None
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Search whichever index the handle carries (reference
+    ``approx_knn_search``, spatial/knn/ann.cuh:70).  Returns
+    (distances [nq, k], indices [nq, k])."""
+    q = jnp.asarray(queries)
+    if index.ivf_pq_index is not None:
+        return ivf_pq.search(ivf_pq.SearchParams(n_probes=index.nprobe),
+                             index.ivf_pq_index, q, k, handle=handle)
+    expects(index.ivf_flat_index is not None, "ann: empty index")
+    if index.sq_scale is not None:  # quantize queries with the SQ mapping
+        lo, scale = index.sq_scale
+        d, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=index.nprobe),
+                               index.ivf_flat_index,
+                               _sq_encode(q, lo, scale), k, handle=handle)
+        # distances come back in code units; restore the data scale
+        # (L2 family only — enforced at build)
+        factor = scale if index.metric == DistanceType.L2SqrtExpanded \
+            else scale * scale
+        return d * factor, i
+    return ivf_flat.search(ivf_flat.SearchParams(n_probes=index.nprobe),
+                           index.ivf_flat_index, q, k, handle=handle)

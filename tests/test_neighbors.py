@@ -115,3 +115,78 @@ def test_haversine_knn():
     np.testing.assert_allclose(np.array(d), rd, atol=1e-4)
     # nearest neighbor of a barely-perturbed point is the point itself
     assert np.array_equal(np.array(i)[:, 0], np.arange(10))
+
+
+class TestAnnDispatch:
+    """Legacy approx_knn_* surface (reference spatial/knn/ann.cuh:41,70 +
+    ann_common.h param structs)."""
+
+    def _data(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(0, 1, (3000, 32)).astype(np.float32)
+        q = x[:20] + 0.01 * rng.normal(0, 1, (20, 32)).astype(np.float32)
+        return x, q
+
+    def _recall_vs_exact(self, x, q, d, i, k):
+        from raft_tpu.neighbors import knn
+
+        _, ti = knn(x, q, k)
+        ti = np.asarray(ti)
+        i = np.asarray(i)
+        return sum(len(set(a.tolist()) & set(b.tolist()))
+                   for a, b in zip(i, ti)) / ti.size
+
+    @pytest.mark.parametrize("params", [
+        pytest.param("flat", id="ivf_flat"),
+        pytest.param("pq", id="ivf_pq"),
+        pytest.param("sq", id="ivf_sq8"),
+    ])
+    def test_build_search_dispatch(self, params):
+        from raft_tpu.neighbors import ann
+
+        x, q = self._data()
+        p = {"flat": ann.IVFFlatParam(nlist=32, nprobe=16),
+             "pq": ann.IVFPQParam(nlist=32, nprobe=8, M=8, n_bits=8),
+             "sq": ann.IVFSQParam(nlist=32, nprobe=8)}[params]
+        index = ann.approx_knn_build_index(p, x)
+        d, i = ann.approx_knn_search(index, q, 5)
+        assert d.shape == (20, 5) and i.shape == (20, 5)
+        rec = self._recall_vs_exact(x, q, d, i, 5)
+        assert rec > (0.6 if params != "flat" else 0.9), rec
+
+    def test_sq_rejects_unmapped_quantizer(self):
+        from raft_tpu.core.error import RaftError
+        from raft_tpu.neighbors import ann
+
+        x, _ = self._data()
+        with pytest.raises(RaftError, match="no TPU storage mapping"):
+            ann.approx_knn_build_index(
+                ann.IVFSQParam(nlist=8, nprobe=2,
+                               qtype=ann.QuantizerType.QT_6bit), x)
+
+    def test_sq_rejects_inner_product(self):
+        from raft_tpu.core.error import RaftError
+        from raft_tpu.distance import DistanceType
+        from raft_tpu.neighbors import ann
+
+        x, _ = self._data()
+        with pytest.raises(RaftError, match="L2Expanded"):
+            ann.approx_knn_build_index(
+                ann.IVFSQParam(nlist=8, nprobe=2), x,
+                metric=DistanceType.InnerProduct)
+
+    def test_sq_distances_in_data_scale(self):
+        from raft_tpu.neighbors import ann, knn
+
+        rng = np.random.default_rng(1)
+        x = (50.0 + 40.0 * rng.random((2000, 16))).astype(np.float32)
+        q = x[:8]
+        index = ann.approx_knn_build_index(
+            ann.IVFSQParam(nlist=16, nprobe=16), x)
+        d, i = ann.approx_knn_search(index, q, 3)
+        dref, _ = knn(x, q, 3, metric="sqeuclidean")
+        # dominant quantization error is the cross term 2·Σ δ_i ε_i with
+        # ε ~ U(±scale/2): a few percent of the distance, not scale² ~ 6×
+        # (which is what an unscaled code-unit result would be off by)
+        np.testing.assert_allclose(np.asarray(d), np.asarray(dref),
+                                   rtol=0.05, atol=10.0)
