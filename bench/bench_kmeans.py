@@ -85,5 +85,41 @@ def bench_mstep_scatter():
     return (lambda: mstep(labels)), {"flops": 2 * _N * _K * _D}
 
 
+@case("kmeans/estep_pallas")
+def bench_estep_pallas():
+    """Fused Pallas distance+argmin engine (pallas_fused_l2nn.py) vs the
+    XLA engine (kmeans/estep) — the A/B behind the engine="pallas" knob.
+    TPU-only: off-TPU the kernel runs under the Pallas interpreter,
+    ~1000x slower than the XLA path at these sizes."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return None, {"skip": "tpu-only (Pallas interpret mode on cpu)"}
+    from raft_tpu.cluster import min_cluster_and_distance
+
+    x, c, _ = _data()
+    return (lambda: min_cluster_and_distance(x, c, engine="pallas")), {
+        "flops": 2 * _N * _K * _D}
+
+
+@case("kmeans/balanced_build")
+def bench_balanced_build():
+    """build_hierarchical — the IVF coarse-quantizer trainer; one batched
+    fine-stage program since the round-2 dispatch-storm fix
+    (kmeans_balanced.py)."""
+    import jax
+
+    from raft_tpu.cluster import build_hierarchical
+    from raft_tpu.random import RngState
+
+    x, _, _ = _data()
+
+    def run():
+        return jax.block_until_ready(
+            build_hierarchical(RngState(0), x, 256, n_iters=8))
+
+    return run, {}
+
+
 if __name__ == "__main__":
     main_for("bench.bench_kmeans")

@@ -63,6 +63,10 @@ def run_registered(iters: int = 10, select: str = "") -> List[Dict]:
         if select and select not in name:
             continue
         thunk, work = fn()
+        if thunk is None:  # case opted out (e.g. TPU-only kernel on CPU)
+            print(json.dumps({"bench": name,
+                              "skipped": work.get("skip", "")}), flush=True)
+            continue
         best = _time_best(thunk, iters)
         out = {"bench": name, "seconds": round(best, 6),
                "platform": jax.default_backend()}

@@ -120,7 +120,8 @@ def ivf_pq_stages():
          + rng.normal(0, 1, (nq, dim))).astype(np.float32)
     t0 = time.perf_counter()
     index = ivf_pq.build(ivf_pq.IndexParams(n_lists=1000, pq_dim=32,
-                                            pq_bits=8, seed=1), x)
+                                            pq_bits=8, seed=1,
+                                            rotation_kind="pca_balanced"), x)
     jax.block_until_ready(index.list_codes)
     emit({"stage": "ivf_pq", "build_s": round(time.perf_counter() - t0, 2)})
     for probes in (20, 40, 80):
