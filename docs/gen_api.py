@@ -92,14 +92,19 @@ def _public_members(mod):
     return out
 
 
-def render(pkg: str, blurb: str) -> str:
-    mod = importlib.import_module(f"raft_tpu.{pkg}")
-    lines = [f"# `raft_tpu.{pkg}`", "", blurb + ".", ""]
-    head = inspect.cleandoc(mod.__doc__ or "").strip()
-    if head:
-        lines += [head.splitlines()[0], ""]
+# Lazily-imported submodules that never appear in the package __init__'s
+# namespace walk but ARE public API (raft_tpu/neighbors/__init__.py
+# __getattr__) — rendered as their own sections.
+_SUBMODULES = {
+    "neighbors": ["ivf_flat", "ivf_pq", "ball_cover", "ann", "serialize"],
+}
+
+
+def _render_members(mod, lines, only_own: bool = False):
     classes, funcs = [], []
     for name, obj in _public_members(mod):
+        if only_own and getattr(obj, "__module__", "") != mod.__name__:
+            continue  # skip re-exports (DistanceType etc.) in submodules
         (classes if inspect.isclass(obj) else funcs).append((name, obj))
     if classes:
         lines += ["## Classes", ""]
@@ -126,6 +131,22 @@ def render(pkg: str, blurb: str) -> str:
             if doc:
                 lines.append(f"  — {doc}")
     lines.append("")
+
+
+def render(pkg: str, blurb: str) -> str:
+    mod = importlib.import_module(f"raft_tpu.{pkg}")
+    lines = [f"# `raft_tpu.{pkg}`", "", blurb + ".", ""]
+    head = inspect.cleandoc(mod.__doc__ or "").strip()
+    if head:
+        lines += [head.splitlines()[0], ""]
+    _render_members(mod, lines)
+    for sub in _SUBMODULES.get(pkg, []):
+        smod = importlib.import_module(f"raft_tpu.{pkg}.{sub}")
+        lines += [f"# `raft_tpu.{pkg}.{sub}`", ""]
+        shead = inspect.cleandoc(smod.__doc__ or "").strip()
+        if shead:
+            lines += [shead.splitlines()[0], ""]
+        _render_members(smod, lines, only_own=True)
     return "\n".join(lines)
 
 
