@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import auto_sync_handle
+from raft_tpu.core.logger import traced
 from raft_tpu.core.kvp import KeyValuePair
 from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams
 from raft_tpu.distance import DistanceType, pairwise_distance
@@ -336,6 +337,7 @@ def _resolve_batches(params: KMeansParams):
     return params.batch_samples, bc
 
 
+@traced("raft_tpu.cluster.kmeans.fit")
 @auto_sync_handle
 def fit(params: KMeansParams, x, sample_weights=None, centroids=None,
         handle=None) -> KMeansOutput:

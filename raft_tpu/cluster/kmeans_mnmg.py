@@ -25,6 +25,7 @@ from raft_tpu.cluster.kmeans_types import KMeansParams
 from raft_tpu.comms.comms import Comms
 from raft_tpu.comms.comms_types import ReduceOp
 from raft_tpu.core.error import expects
+from raft_tpu.core.logger import traced
 from raft_tpu.distance.distance_types import DistanceType
 
 
@@ -115,6 +116,7 @@ def _fit_program(comms: Comms, max_iter: int, tol: float, metric: DistanceType,
                            lambda: local_fit)
 
 
+@traced("raft_tpu.cluster.kmeans_mnmg.fit")
 def fit(params: KMeansParams, comms: Comms, x, centroids=None) -> KMeansOutput:
     """Distributed k-means fit over rows sharded across the comms axis.
 

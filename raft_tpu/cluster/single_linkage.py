@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.logger import traced
 from raft_tpu.distance import DistanceType, pairwise_distance
 
 
@@ -164,6 +165,7 @@ def extract_flattened_clusters(children: np.ndarray, n_clusters: int, n: int
     return labels.astype(np.int32)  # same dtype as the native path
 
 
+@traced("raft_tpu.cluster.single_linkage")
 def single_linkage(x, metric: DistanceType = DistanceType.L2SqrtExpanded,
                    linkage: LinkageDistance = LinkageDistance.PAIRWISE,
                    n_clusters: int = 2, c: int = 15) -> SingleLinkageOutput:

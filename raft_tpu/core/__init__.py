@@ -28,6 +28,7 @@ from raft_tpu.core.logger import (  # noqa: F401
     log_trace,
     log_warn,
     time_range,
+    traced,
 )
 from raft_tpu.core.mdarray import (  # noqa: F401
     Layout,

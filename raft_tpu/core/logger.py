@@ -219,3 +219,20 @@ class time_range:
         if self._ann is not None:
             self._ann.__exit__(*exc)
         return False
+
+
+def traced(name: str):
+    """Decorator form of :class:`time_range` — annotates an algorithm entry
+    point (the reference places NVTX ranges the same way, e.g.
+    cluster/detail/kmeans.cuh:371)."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with time_range(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco

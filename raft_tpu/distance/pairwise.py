@@ -36,6 +36,7 @@ import jax.numpy as jnp
 
 from raft_tpu.core.error import LogicError, expects
 from raft_tpu.core.handle import auto_sync_handle
+from raft_tpu.core.logger import traced
 from raft_tpu.distance.distance_types import DISTANCE_TYPES, DistanceType
 
 _BM = 128  # row-block (sublane-friendly)
@@ -309,6 +310,7 @@ def distance(x, y, metric: DistanceType, metric_arg: float = 2.0):
     return _distance_jit(x, y, DistanceType(metric), float(metric_arg))
 
 
+@traced("raft_tpu.distance.pairwise_distance")
 @auto_sync_handle
 def pairwise_distance(x, y, metric: Union[str, DistanceType] = "euclidean",
                       metric_arg: float = 2.0, p: Optional[float] = None,

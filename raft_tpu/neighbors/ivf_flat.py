@@ -33,6 +33,7 @@ import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import auto_sync_handle
+from raft_tpu.core.logger import traced
 from raft_tpu.cluster import build_hierarchical, min_cluster_and_distance
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.matrix.select_k import select_k
@@ -151,6 +152,7 @@ def _assign_lists(q, centers, metric: DistanceType) -> jnp.ndarray:
     return min_cluster_and_distance(q, centers).key.astype(jnp.int32)
 
 
+@traced("raft_tpu.neighbors.ivf_flat.build")
 @auto_sync_handle
 def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
     """Train + populate an IVF-Flat index (reference ``ivf_flat::build``,
@@ -279,6 +281,7 @@ def _scan_probes(queries, probe_ids, index_leaves, metric_val: int, k: int,
     return best_d, best_i
 
 
+@traced("raft_tpu.neighbors.ivf_flat.search")
 @auto_sync_handle
 def search(params: SearchParams, index: Index, queries, k: int,
            *, batch_size_query: int = 1024, handle=None

@@ -43,6 +43,7 @@ import numpy as np
 
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import auto_sync_handle
+from raft_tpu.core.logger import traced
 from raft_tpu.cluster import build_hierarchical, min_cluster_and_distance
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.matrix.select_k import select_k
@@ -345,6 +346,7 @@ def _encode(residuals, codebooks, labels, per_cluster: bool):
     return jnp.argmin(d, axis=-1).astype(jnp.uint8)
 
 
+@traced("raft_tpu.neighbors.ivf_pq.build")
 @auto_sync_handle
 def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
     """Train + populate (reference ``ivf_pq::build``, ivf_pq_build.cuh)."""
@@ -567,6 +569,7 @@ def _search_batch(q, probe_ids, leaves, metric_val: int, k: int,
     return best_d, best_i
 
 
+@traced("raft_tpu.neighbors.ivf_pq.search")
 @auto_sync_handle
 def search(params: SearchParams, index: Index, queries, k: int,
            *, batch_size_query: int = 1024, handle=None

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.logger import traced
 from raft_tpu.sparse.types import CSR
 from raft_tpu.sparse.linalg import apply_matvec, matvec_operand
 
@@ -365,6 +366,7 @@ def _lanczos(apply_fn: Callable, operator, n: int, k: int, *, largest: bool,
     return all_vals[order], all_vecs[:, order]
 
 
+@traced("raft_tpu.sparse.lanczos_smallest")
 def lanczos_smallest(a: Union[CSR, Callable], n_components: int, *,
                      n: Optional[int] = None, ncv: Optional[int] = None,
                      max_restarts: int = 15, tol: float = 1e-6,
@@ -405,6 +407,7 @@ def lanczos_smallest(a: Union[CSR, Callable], n_components: int, *,
     return -evals, vecs
 
 
+@traced("raft_tpu.sparse.lanczos_largest")
 def lanczos_largest(a: Union[CSR, Callable], n_components: int, *,
                     n: Optional[int] = None, ncv: Optional[int] = None,
                     max_restarts: int = 15, tol: float = 1e-6,

@@ -23,6 +23,7 @@ from typing import Tuple
 import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.logger import traced
 from raft_tpu.sparse.types import CSR
 from raft_tpu.sparse.linalg import spmm
 from raft_tpu.spectral.matrix import degrees, laplacian_matvec, modularity_matvec
@@ -38,6 +39,7 @@ def _transform_eigen_matrix(vecs: jnp.ndarray) -> jnp.ndarray:
     return v / nrm
 
 
+@traced("raft_tpu.spectral.partition")
 def partition(adj: CSR, eigen_solver: LanczosEigenSolver,
               cluster_solver: KMeansClusterSolver
               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -58,6 +60,7 @@ def partition(adj: CSR, eigen_solver: LanczosEigenSolver,
     return labels, eig_vals, eig_vecs, inertia
 
 
+@traced("raft_tpu.spectral.modularity_maximization")
 def modularity_maximization(adj: CSR, eigen_solver: LanczosEigenSolver,
                             cluster_solver: KMeansClusterSolver
                             ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,

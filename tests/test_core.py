@@ -241,3 +241,22 @@ class TestReviewRegressions:
         a, b = Logger(), Logger()
         assert a is b is Logger.get()
         assert len(a._logger.handlers) == 1
+
+
+def test_traced_decorator_preserves_semantics():
+    """@traced (the NVTX-range analogue at algorithm entries) must be
+    transparent: same results, same metadata, range emitted via
+    jax.profiler without error."""
+    from raft_tpu.core import traced
+
+    calls = []
+
+    @traced("raft_tpu.test.op")
+    def op(a, b=2):
+        """docstring survives"""
+        calls.append((a, b))
+        return a + b
+
+    assert op(1, b=3) == 4
+    assert op.__name__ == "op" and "survives" in op.__doc__
+    assert calls == [(1, 3)]
