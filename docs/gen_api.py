@@ -96,7 +96,8 @@ def _public_members(mod):
 # namespace walk but ARE public API (raft_tpu/neighbors/__init__.py
 # __getattr__) — rendered as their own sections.
 _SUBMODULES = {
-    "neighbors": ["ivf_flat", "ivf_pq", "ball_cover", "ann", "serialize"],
+    "neighbors": ["ivf_flat", "ivf_pq", "ball_cover", "ann", "knn_mnmg",
+                  "serialize"],
 }
 
 

@@ -22,20 +22,13 @@ import jax.numpy as jnp
 
 from raft_tpu.cluster.kmeans import KMeansOutput, min_cluster_and_distance
 from raft_tpu.cluster.kmeans_types import KMeansParams
-from raft_tpu.comms.comms import Comms
+from raft_tpu.comms.comms import Comms, as_comms
 from raft_tpu.comms.comms_types import ReduceOp
 from raft_tpu.core.error import expects
 from raft_tpu.core.logger import traced
 from raft_tpu.distance.distance_types import DistanceType
 
 
-def _as_comms(comms_or_handle) -> Comms:
-    """Accept a :class:`Comms` or a :class:`raft_tpu.core.Handle` carrying
-    one (reference convention: MNMG entry points take handle_t and call
-    ``handle.get_comms()``, DEVELOPER_GUIDE.md:11-25)."""
-    if hasattr(comms_or_handle, "get_comms"):
-        return comms_or_handle.get_comms()
-    return comms_or_handle
 
 
 def compute_new_centroids(x_shard, centroids, comms: Comms,
@@ -48,7 +41,7 @@ def compute_new_centroids(x_shard, centroids, comms: Comms,
     or a Handle with comms injected.  Returns
     (new_centroids, weight_per_cluster, local_inertia_sum).
     """
-    comms = _as_comms(comms)
+    comms = as_comms(comms)
     from raft_tpu.cluster.kmeans import _weighted_cluster_sums
 
     k = centroids.shape[0]
@@ -127,7 +120,7 @@ def fit(params: KMeansParams, comms: Comms, x, centroids=None) -> KMeansOutput:
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    comms = _as_comms(comms)
+    comms = as_comms(comms)
     x = jnp.asarray(x)
     n, dim = x.shape
     nranks = comms.get_size()
@@ -173,7 +166,7 @@ def predict(params: KMeansParams, comms: Comms, x, centroids):
     """Distributed labels + inertia (*comms*: Comms or Handle)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    comms = _as_comms(comms)
+    comms = as_comms(comms)
     x = jnp.asarray(x)
     centroids = jnp.asarray(centroids)
 

@@ -507,6 +507,15 @@ class Comms:
         return jitted(*args)
 
 
+def as_comms(comms_or_handle) -> "Comms":
+    """Accept a :class:`Comms` or a Handle carrying one (reference
+    convention: MNMG entry points take handle_t and call
+    ``handle.get_comms()``, DEVELOPER_GUIDE.md:11-25)."""
+    if hasattr(comms_or_handle, "get_comms"):
+        return comms_or_handle.get_comms()
+    return comms_or_handle
+
+
 def build_comms(mesh=None, axis_name: str = "world", session_id: str = "default",
                 coordinator: Optional[str] = None, host_rank: int = 0,
                 host_world: Optional[int] = None) -> Comms:

@@ -1,0 +1,77 @@
+"""Distributed (OPG) brute-force kNN over a sharded index.
+
+The reference ecosystem's MNMG brute-force pattern (cuML's distributed
+``brute_force_knn`` driven through raft comms): each rank holds a shard of
+index rows, queries are replicated, every rank computes a local top-k,
+and per-rank candidate sets are allgathered and merged with
+``knn_merge_parts`` (reference neighbors/brute_force.cuh:76,144).  One
+shard_map program: local scan + allgather over ICI + on-device merge.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.logger import traced
+from raft_tpu.comms.comms import as_comms
+from raft_tpu.cluster.kmeans_mnmg import _cached_program
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.neighbors.brute_force import knn, knn_merge_parts
+
+
+def _search_program(comms, k: int, metric, metric_arg: float, rows_per: int):
+    """Per-shard search body, cached per (comms, statics) so repeated
+    searches reuse comms.run's identity-keyed jit cache instead of
+    retracing per call (see kmeans_mnmg._fit_program's measurement)."""
+
+    def local(xs, qs):
+        d, i = knn(xs, qs, k, metric, metric_arg)
+        rank = jax.lax.axis_index(comms.axis_name)
+        i = i + (rank * rows_per).astype(i.dtype)   # local → global ids
+        dd = comms.allgather(d)                     # (world, nq, k)
+        ii = comms.allgather(i)
+        return knn_merge_parts(dd, ii, k, metric=metric)
+
+    return _cached_program(comms, ("knn", k, metric, metric_arg, rows_per),
+                           lambda: local)
+
+
+@traced("raft_tpu.neighbors.knn_mnmg")
+def knn_mnmg(comms, index, queries, k: int,
+             metric=DistanceType.L2SqrtExpanded, metric_arg: float = 2.0):
+    """Exact kNN of *queries* among the rows of *index*, index sharded
+    row-wise over the communicator's mesh (queries replicated).
+
+    *comms* may be a Comms or a Handle carrying one.  Returns
+    (distances [nq, k], global indices [nq, k]) — identical (up to ties)
+    to single-device ``knn(index, queries, k)``.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    comms = as_comms(comms)
+    # A split communicator's get_size()/get_rank() are group-local while
+    # P(axis_name) shards over the FULL mesh axis — the id arithmetic
+    # below would silently corrupt: require the full-axis communicator.
+    expects(getattr(comms, "groups", None) is None,
+            "knn_mnmg needs a full (non-split) communicator")
+    x = jnp.asarray(index)
+    q = jnp.asarray(queries)
+    nranks = comms.get_size()
+    n = x.shape[0]
+    expects(n % nranks == 0,
+            f"n ({n}) must be divisible by the number of ranks ({nranks}) — "
+            "pad the index shard (OPG assumes equal parts)")
+    rows_per = n // nranks
+    expects(k <= rows_per,
+            "k must not exceed rows per shard (each rank contributes k "
+            "candidates)")
+
+    local = _search_program(comms, int(k), metric, float(metric_arg),
+                            rows_per)
+    x_sharded = jax.device_put(
+        x, NamedSharding(comms.mesh, P(comms.axis_name, None)))
+    return comms.run(local, x_sharded, q,
+                     in_specs=(P(comms.axis_name, None), P(None, None)),
+                     out_specs=(P(None, None), P(None, None)))

@@ -85,3 +85,44 @@ def test_uneven_shards_rejected(comms):
     x = np.random.default_rng(0).random((1001, 4)).astype(np.float32)
     with pytest.raises(LogicError):
         kmeans_mnmg.fit(KMeansParams(n_clusters=2), comms, x)
+
+
+def test_knn_mnmg_matches_single_device(comms):
+    """OPG sharded brute-force kNN == single-device kNN (up to ties)."""
+    from raft_tpu.neighbors import knn
+    from raft_tpu.neighbors.knn_mnmg import knn_mnmg
+
+    rng = np.random.default_rng(0)
+    n = 64 * comms.get_size()
+    x = rng.normal(0, 1, (n, 12)).astype(np.float32)
+    q = rng.normal(0, 1, (24, 12)).astype(np.float32)
+    d, i = knn_mnmg(comms, x, q, 5)
+    dref, iref = knn(x, q, 5)
+    np.testing.assert_allclose(np.asarray(d), np.asarray(dref), atol=1e-4)
+    # distance sets agree even where exact ties permute ids
+    assert np.mean(np.asarray(i) == np.asarray(iref)) > 0.99
+
+
+def test_knn_mnmg_inner_product(comms):
+    from raft_tpu.distance import DistanceType
+    from raft_tpu.neighbors import knn
+    from raft_tpu.neighbors.knn_mnmg import knn_mnmg
+
+    rng = np.random.default_rng(1)
+    n = 32 * comms.get_size()
+    x = rng.normal(0, 1, (n, 8)).astype(np.float32)
+    q = rng.normal(0, 1, (16, 8)).astype(np.float32)
+    d, i = knn_mnmg(comms, x, q, 4, metric=DistanceType.InnerProduct)
+    dref, iref = knn(x, q, 4, DistanceType.InnerProduct)
+    np.testing.assert_allclose(np.asarray(d), np.asarray(dref), atol=1e-4)
+
+
+def test_knn_mnmg_k_exceeds_shard_rejected(comms):
+    from raft_tpu.core.error import RaftError
+    from raft_tpu.neighbors.knn_mnmg import knn_mnmg
+
+    rng = np.random.default_rng(2)
+    n = 8 * comms.get_size()
+    x = rng.normal(0, 1, (n, 4)).astype(np.float32)
+    with pytest.raises(RaftError, match="rows per shard"):
+        knn_mnmg(comms, x, x[:4], 9)
