@@ -28,12 +28,41 @@ import jax
 import jax.numpy as jnp
 
 
+def _machine_fingerprint() -> str:
+    """CPU-feature fingerprint for scoping the on-disk cache.
+
+    XLA:CPU AOT results encode the COMPILE machine's instruction-set
+    features; loading them on a host without those features logs
+    "could lead to execution errors such as SIGILL" and can crash.  A
+    shared HOME persisted across heterogeneous hosts (observed across
+    build rounds) therefore must not share one cache directory."""
+    import hashlib
+    import platform
+
+    feats = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 lists ISA extensions under "flags", ARM under "Features"
+                if line.startswith(("flags", "Features")):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    blob = f"{platform.machine()}|{feats}"
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
 def enable_persistent_cache(path: Optional[str] = None) -> str:
     """Enable JAX's on-disk compilation cache (idempotent).  Returns the
-    cache directory."""
-    path = path or os.environ.get(
+    cache directory.  The machine fingerprint is appended to EVERY base
+    (default, ``RAFT_TPU_CACHE_DIR``, or explicit *path*) — see
+    :func:`_machine_fingerprint` for why sharing one directory across
+    heterogeneous hosts crashes."""
+    base = path or os.environ.get(
         "RAFT_TPU_CACHE_DIR",
-        os.path.join(os.path.expanduser("~"), ".cache", "raft_tpu", "xla"))
+        os.path.join(os.path.expanduser("~"), ".cache", "raft_tpu"))
+    path = os.path.join(base, f"xla-{_machine_fingerprint()}")
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
