@@ -48,7 +48,10 @@ def _machine_fingerprint() -> str:
                     feats = " ".join(sorted(line.split(":", 1)[1].split()))
                     break
     except OSError:
-        pass
+        # No /proc/cpuinfo (non-Linux): fall back to per-hostname scoping —
+        # coarser (same host always shares; distinct hosts never do), but
+        # it preserves the no-cross-host-AOT guarantee this exists for.
+        feats = f"host:{platform.node()}"
     blob = f"{platform.machine()}|{feats}"
     return hashlib.sha256(blob.encode()).hexdigest()[:12]
 

@@ -67,3 +67,14 @@ from raft_tpu.linalg.decompositions import (  # noqa: F401
     svd_qr,
     svd_reconstruction,
 )
+
+
+def __getattr__(name):
+    # Legacy alias: the reference forwards raft/linalg/lanczos.hpp to the
+    # sparse solver (SURVEY.md §2.3 factorizations row); mirror that here
+    # lazily to avoid importing the sparse package for dense-only users.
+    if name in ("lanczos_smallest", "lanczos_largest"):
+        from raft_tpu.sparse import solver
+
+        return getattr(solver, name)
+    raise AttributeError(f"module 'raft_tpu.linalg' has no attribute {name!r}")
