@@ -57,7 +57,7 @@ def test_persistent_cache_dir(tmp_path):
     assert os.path.isdir(d)
 
 
-def test_persistent_cache_scoped_by_machine_fingerprint(tmp_path):
+def test_persistent_cache_scoped_by_machine_fingerprint(tmp_path, monkeypatch):
     """Every cache base gains the machine-fingerprint subdir (cross-host
     XLA:CPU AOT reuse can SIGILL — see _machine_fingerprint); the
     fingerprint is stable within a process."""
@@ -70,11 +70,6 @@ def test_persistent_cache_scoped_by_machine_fingerprint(tmp_path):
     assert d.endswith(f"xla-{fp}")
     assert d.startswith(str(tmp_path / "base"))
 
-    import os
-
-    os.environ["RAFT_TPU_CACHE_DIR"] = str(tmp_path / "envbase")
-    try:
-        d2 = enable_persistent_cache()
-        assert d2.endswith(f"xla-{fp}") and str(tmp_path / "envbase") in d2
-    finally:
-        del os.environ["RAFT_TPU_CACHE_DIR"]
+    monkeypatch.setenv("RAFT_TPU_CACHE_DIR", str(tmp_path / "envbase"))
+    d2 = enable_persistent_cache()
+    assert d2.endswith(f"xla-{fp}") and str(tmp_path / "envbase") in d2
