@@ -187,3 +187,40 @@ class TestDispersionIC:
         np.testing.assert_allclose(bic, np.log(50) * 3 - 2 * ll)
         aicc = np.asarray(stats.information_criterion_batched(ll, stats.IC_Type.AICc, 3, 50))
         np.testing.assert_allclose(aicc, 2 * (3 + 3 * 4 / (50 - 3 - 1)) - 2 * ll)
+
+
+class TestDtypeSweep:
+    """Reference-style parameterized dtype grid (test/stats/* ValuesIn
+    sweeps): summary statistics agree with numpy oracles in both f32 and
+    f64 at dtype-appropriate tolerances, and preserve the input dtype."""
+
+    @pytest.mark.parametrize("dtype,tol", [(np.float32, 1e-5),
+                                           (np.float64, 1e-12)])
+    def test_summary_stats_vs_numpy(self, dtype, tol):
+        from raft_tpu import stats
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(2.0, 3.0, (257, 19)).astype(dtype)
+        np.testing.assert_allclose(np.asarray(stats.mean(x)),
+                                   x.mean(axis=0), rtol=tol, atol=tol)
+        mu, var = stats.meanvar(x)
+        np.testing.assert_allclose(np.asarray(var), x.var(axis=0, ddof=1),
+                                   rtol=100 * tol, atol=100 * tol)
+        np.testing.assert_allclose(np.asarray(stats.cov(x)),
+                                   np.cov(x.T), rtol=100 * tol,
+                                   atol=100 * tol)
+        lo, hi = stats.minmax(x)
+        np.testing.assert_array_equal(np.asarray(lo), x.min(axis=0))
+        np.testing.assert_array_equal(np.asarray(hi), x.max(axis=0))
+        assert np.asarray(stats.mean(x)).dtype == dtype
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    def test_weighted_mean_vs_numpy(self, dtype):
+        from raft_tpu import stats
+
+        rng = np.random.default_rng(1)
+        x = rng.normal(0, 1, (64, 8)).astype(dtype)
+        w = rng.random(8).astype(dtype)
+        got = np.asarray(stats.row_weighted_mean(x, w))
+        ref = (x * w).sum(axis=1) / w.sum()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
