@@ -40,16 +40,33 @@ A100_BASELINE_KMEANS_ITERS = 300.0
 M, N, K = 5000, 5000, 50
 
 
-def _time_best(fn, iters=20):
+#: Conservative HBM-bandwidth rooflines (GB/s) by TPU device kind, used as a
+#: sanity cap on effective-GB/s results: a bandwidth-bound op cannot sustain
+#: more than the memory system delivers, so any higher reading is a
+#: measurement artifact (the round-2 failure: repeated identical dispatches
+#: were elided/served from a cache, yielding 2136 GB/s on a ~819 GB/s chip).
+_HBM_GBPS = {
+    "TPU v2": 700.0,
+    "TPU v3": 900.0,
+    "TPU v4": 1228.0,
+    "TPU v5 lite": 819.0,
+    "TPU v5e": 819.0,
+    "TPU v5": 2765.0,
+    "TPU v5p": 2765.0,
+    "TPU v6 lite": 1640.0,
+    "TPU v6e": 1640.0,
+}
+
+
+def _hbm_roofline_gbps():
+    """HBM bandwidth cap for the default device, or None if unknown (CPU)."""
     import jax
 
-    jax.block_until_ready(fn())  # warmup/compile
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn())
-        times.append(time.perf_counter() - t0)
-    return min(times)
+    kind = jax.devices()[0].device_kind
+    for name, bw in _HBM_GBPS.items():
+        if kind.lower().startswith(name.lower()):
+            return bw
+    return None
 
 
 def bench_pairwise():
@@ -60,15 +77,42 @@ def bench_pairwise():
     rng = np.random.default_rng(42)
     x = jax.device_put(rng.random((M, K), dtype=np.float32))
     y = jax.device_put(rng.random((N, K), dtype=np.float32))
-    best = _time_best(lambda: pairwise_distance(x, y, "euclidean"))
+
+    @jax.jit
+    def step(xc):
+        d = pairwise_distance(xc, y, "euclidean")
+        # Chain a scalar of the output back into the next input so no two
+        # dispatches are identical: repeated identical dispatches can be
+        # elided / served from a result cache by the runtime (this exact
+        # hazard produced the invalid 2136 GB/s round-2 reading — above the
+        # v5e HBM roofline).  1e-12 on O(1) data leaves the workload
+        # numerically unchanged; the extra (5000,50) add is ~0.2% of bytes.
+        return xc + 1e-12 * d[0, 0], d
+
+    xc, d = step(x)
+    jax.block_until_ready(d)  # warmup/compile
+    n_chain, best = 5, float("inf")
+    for _ in range(4):
+        t0 = time.perf_counter()
+        for _ in range(n_chain):
+            xc, d = step(xc)
+        jax.block_until_ready(d)
+        best = min(best, (time.perf_counter() - t0) / n_chain)
     nbytes = (M * K + N * K + M * N) * 4
     gbps = nbytes / best / 1e9
-    return {
+    result = {
         "metric": "pairwise_distance_l2sqrt_5000x50_f32",
         "value": round(gbps, 2),
         "unit": "GB/s",
         "vs_baseline": round(gbps / A100_BASELINE_GBPS, 3),
     }
+    roofline = _hbm_roofline_gbps()
+    if roofline is not None and gbps > roofline:
+        # Never record an impossible number as clean: flag it for humans and
+        # downstream consumers (BENCH_TPU.md, the judge) alike.
+        result["suspect"] = True
+        result["roofline_gbps"] = roofline
+    return result
 
 
 def bench_kmeans():
@@ -186,7 +230,17 @@ def bench_ivf_pq():
                                             pq_bits=8, seed=1,
                                             rotation_kind="pca_balanced"), x)
     sp = ivf_pq.SearchParams(n_probes=40)
-    best = _time_best(lambda: ivf_pq.search(sp, index, q, k)[0], iters=3)
+    # Chained timing (no two dispatches identical — see bench_pairwise).
+    qc = jax.device_put(q)
+    d = ivf_pq.search(sp, index, qc, k)[0]
+    jax.block_until_ready(d)  # warmup/compile
+    best = float("inf")
+    for _ in range(3):
+        qc = qc + 1e-12 * d[0, 0]
+        t0 = time.perf_counter()
+        d = ivf_pq.search(sp, index, qc, k)[0]
+        jax.block_until_ready(d)
+        best = min(best, time.perf_counter() - t0)
     qps = nq / best
     # recall gate on a query subsample — full-set brute-force ground truth
     # quadrupled the bench cost without changing the estimate
@@ -218,7 +272,22 @@ def bench_lanczos():
     g = g + g.T
     adj = CSR(g.indptr, g.indices, g.data, g.shape)
     lap = laplacian(adj)
-    best = _time_best(lambda: lanczos_smallest(lap, 8, tol=1e-6)[0], iters=3)
+    # Chained timing: perturb the start vector with the previous solve's
+    # smallest eigenvalue so no two dispatches are identical (see
+    # bench_pairwise for the elision hazard this avoids).
+    import jax
+    import jax.numpy as jnp
+
+    v0 = jnp.asarray(rng.normal(0, 1, n), jnp.float32)
+    evals = lanczos_smallest(lap, 8, tol=1e-6, v0=v0)[0]
+    jax.block_until_ready(evals)  # warmup/compile
+    best = float("inf")
+    for _ in range(3):
+        v0 = v0 + 1e-9 * evals[0]
+        t0 = time.perf_counter()
+        evals = lanczos_smallest(lap, 8, tol=1e-6, v0=v0)[0]
+        jax.block_until_ready(evals)
+        best = min(best, time.perf_counter() - t0)
     solves = 1.0 / best
     # A100 ballpark: ~2 solves/s for this size via cusparse+steqr
     return {
