@@ -38,12 +38,6 @@ _L2_METRICS = (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
 # building blocks (reference cluster/detail/kmeans_common.cuh)
 # ---------------------------------------------------------------------------
 
-# k-means E-steps default to "high" (bf16x3) matmul precision: measured ~2x
-# faster than full-f32 emulation on v5e with zero argmin flips on k-means-
-# scale data; pass precision="highest" for bit-exact f32.
-@functools.partial(jax.jit, static_argnames=("metric", "batch_samples",
-                                             "batch_centroids", "precision",
-                                             "engine"))
 def min_cluster_and_distance(x, centroids, metric: DistanceType = DistanceType.L2Expanded,
                              batch_samples: int = 2048, batch_centroids: int = 1024,
                              precision: str = "high",
@@ -55,16 +49,42 @@ def min_cluster_and_distance(x, centroids, metric: DistanceType = DistanceType.L
     reference, which runs k-means on squared distances), cosine distance for
     CosineExpanded; batched over (batch_samples × batch_centroids) tiles.
 
-    ``engine``: "xla" (default) or "pallas" (fused Pallas kernel for the
-    L2 family).  ``RAFT_TPU_PALLAS_NN=1`` flips the default — read at
-    trace time, so set it before the first call.
+    ``engine``: "xla" (default) or "pallas" (fused Pallas kernel, L2 family
+    only).  ``RAFT_TPU_PALLAS_NN=1`` flips the default.  The env default is
+    resolved here, OUTSIDE the jit cache, so flipping the variable between
+    calls takes effect (an ``engine=None`` cache key would silently keep the
+    first-compiled engine).
     """
+    if engine is None:
+        from raft_tpu.distance import pallas_fused_l2nn
+
+        engine = "pallas" if (metric in _L2_METRICS
+                              and pallas_fused_l2nn.is_enabled()) else "xla"
+    elif engine == "pallas" and metric not in _L2_METRICS:
+        raise ValueError(
+            f"engine='pallas' supports only the L2 metric family, got {metric}")
+    elif engine not in ("xla", "pallas"):
+        raise ValueError(f"unknown engine {engine!r}; expected 'xla' or 'pallas'")
+    return _min_cluster_and_distance(x, centroids, metric=metric,
+                                     batch_samples=batch_samples,
+                                     batch_centroids=batch_centroids,
+                                     precision=precision, engine=engine)
+
+
+# k-means E-steps default to "high" (bf16x3) matmul precision: measured ~2x
+# faster than full-f32 emulation on v5e with zero argmin flips on k-means-
+# scale data; pass precision="highest" for bit-exact f32.
+@functools.partial(jax.jit, static_argnames=("metric", "batch_samples",
+                                             "batch_centroids", "precision",
+                                             "engine"))
+def _min_cluster_and_distance(x, centroids, metric: DistanceType,
+                              batch_samples: int, batch_centroids: int,
+                              precision: str, engine: str) -> KeyValuePair:
     m, dim = x.shape
     if metric in _L2_METRICS:
         from raft_tpu.distance import pallas_fused_l2nn
 
-        if engine == "pallas" or (engine is None
-                                  and pallas_fused_l2nn.is_enabled()):
+        if engine == "pallas":
             # Fused Pallas engine: the (block, k) distance tile never
             # leaves VMEM (the jnp path's XLA lowering round-trips it
             # through HBM before the argmin).  Single-pass bf16 only for

@@ -105,6 +105,14 @@ def approx_knn_build_index(params: IVFParam, data,
             x, handle=handle)
         return KnnIndex(metric, metric_arg, params.nprobe, ivf_pq_index=idx)
     if isinstance(params, IVFSQParam):
+        # All three accepted 8-bit qtypes collapse to ONE global (lo, scale)
+        # uniform affine map, unlike FAISS QT_8bit which trains per-dimension
+        # ranges.  Deliberate: per-dim scaling is not L2-ranking-preserving
+        # when distances are computed directly in code space (each dimension
+        # would contribute with a different squared scale), so matching it
+        # would require decode-to-float scan — costing the int8 storage/
+        # bandwidth win.  On data with strongly heterogeneous per-dimension
+        # scales, recall may trail the reference's SQ8 accordingly.
         expects(params.qtype in (QuantizerType.QT_8bit,
                                  QuantizerType.QT_8bit_uniform,
                                  QuantizerType.QT_8bit_direct),

@@ -23,6 +23,7 @@ TPU-first redesign:
 from __future__ import annotations
 
 import functools
+import inspect
 import weakref
 from typing import Callable, Optional, Tuple, Union
 
@@ -143,6 +144,7 @@ def _solve_impl(operator, v0, tol, max_restarts, *, apply_fn: Callable,
     n = v0.shape[0]
     dtype = v0.dtype
     eps = jnp.asarray(jnp.finfo(dtype).tiny ** 0.5, dtype)
+    ulp = jnp.asarray(jnp.finfo(dtype).eps, dtype)
 
     # Warm the operator ONCE at this (outer) trace level: a user callable
     # that lazily memoizes state on first use (e.g. building a converted
@@ -194,7 +196,12 @@ def _solve_impl(operator, v0, tol, max_restarts, *, apply_fn: Callable,
             u = vecs[:, i]
             u = u - locked.T @ (locked @ u)
             nrm = jnp.linalg.norm(u)
-            take = conv[i] & (nl < k) & (nrm > eps)
+            # Duplicate test must be RELATIVE, like the breakdown test in
+            # _lanczos_decomp: a Ritz vector duplicating a locked one leaves
+            # a projected remainder of ~ulp (u is unit norm), far above the
+            # absolute tiny**0.5 (~1e-19 f32) — which would normalize that
+            # noise and lock it as a spurious eigenvector.
+            take = conv[i] & (nl < k) & (nrm > 128.0 * ulp)
             cand = locked.at[nl].set(u / jnp.maximum(nrm, eps))
             locked = jnp.where(take, cand, locked)
             lvals = jnp.where(take, lvals.at[nl].set(evals[i]), lvals)
@@ -223,6 +230,22 @@ _solve_program = jax.jit(_solve_impl,
                          static_argnames=("apply_fn", "k", "m", "largest"))
 
 
+@functools.partial(jax.jit, static_argnames=("apply_fn", "iters"))
+def _power_repair(operator, basis, u0, shift, eps, *, apply_fn: Callable,
+                  iters: int = 64):
+    """64 rounds of deflated, spectrum-shifted power iteration — the
+    multiplicity-repair engine of :func:`_lanczos`'s host tail.  *basis* is
+    a fixed-capacity (cap, n) projector (zero rows are no-ops) so every
+    repair attempt of a solve reuses ONE compiled program."""
+    def body(_, u):
+        w = apply_fn(operator, u) + shift * u
+        w = w - basis.T @ (basis @ w)
+        nrm = jnp.linalg.norm(w)
+        return jnp.where(nrm > eps, w / jnp.maximum(nrm, eps), u)
+
+    return jax.lax.fori_loop(0, iters, body, u0)
+
+
 def _apply_partial(op, v):
     """op is a ``jax.tree_util.Partial`` riding through jit as a DYNAMIC
     operand: its captured arrays are traced leaves and its wrapped function
@@ -248,23 +271,42 @@ _CALLABLE_PROGS: dict = {}
 
 
 def _callable_entry(a: Callable, negate: bool):
-    """(apply_fn, program) for a plain user matvec callable."""
-    key = id(a)
+    """(apply_fn, program) for a plain user matvec callable.
+
+    Bound methods get special keying: ``obj.method`` creates a FRESH
+    bound-method object on every attribute access, so an ``id(a)`` key
+    would be evicted the moment the call returns and every solve with the
+    "same" method would silently retrace.  Key on (owner id, underlying
+    function) and weakref the owner instead.
+    """
+    bound = inspect.ismethod(a)
+    anchor = a.__self__ if bound else a
+    key = (id(anchor), a.__func__) if bound else id(anchor)
     entry = _CALLABLE_PROGS.get(key)
     if entry is None:
         recordable = True
         try:
-            ref = weakref.ref(a)
-            weakref.finalize(a, _CALLABLE_PROGS.pop, key, None)
+            ref = weakref.ref(anchor)
+            weakref.finalize(anchor, _CALLABLE_PROGS.pop, key, None)
         except TypeError:  # unweakrefable: per-call entry, dies with frame
             recordable = False
-            ref = lambda a=a: a  # noqa: E731
+            ref = lambda anchor=anchor: anchor  # noqa: E731
 
-        def apply_pos(op, v):
-            return ref()(v)
+        if bound:
+            func = a.__func__
 
-        def apply_neg(op, v):
-            return -ref()(v)
+            def apply_pos(op, v):
+                return func(ref(), v)
+
+            def apply_neg(op, v):
+                return -func(ref(), v)
+        else:
+
+            def apply_pos(op, v):
+                return ref()(v)
+
+            def apply_neg(op, v):
+                return -ref()(v)
 
         entry = {}
         for neg, fn in ((False, apply_pos), (True, apply_neg)):
@@ -319,6 +361,7 @@ def _lanczos(apply_fn: Callable, operator, n: int, k: int, *, largest: bool,
         program=program, k=k, m=m, largest=largest)
 
     eps = float(jnp.finfo(dtype).tiny) ** 0.5
+    ulp = float(jnp.finfo(dtype).eps)
     n_locked = int(nl)  # the solve's single host sync
     if n_locked == 0:
         return evals, vecs
@@ -329,8 +372,8 @@ def _lanczos(apply_fn: Callable, operator, n: int, k: int, *, largest: bool,
 
     # Partial convergence (rare): fill with the best unconverged Ritz pairs;
     # if the operator's effective rank ran out (degenerate directions),
-    # complete the basis with random orthonormal vectors and their Rayleigh
-    # quotients so callers ALWAYS get k columns.
+    # complete via deflated power iteration from random restarts so callers
+    # ALWAYS get k columns of actual eigenvector quality.
     extra_vals, extra_vecs = [], []
 
     def free_part(u):
@@ -344,19 +387,70 @@ def _lanczos(apply_fn: Callable, operator, n: int, k: int, *, largest: bool,
             break
         u = free_part(vecs[:, i])
         nrm = float(jnp.linalg.norm(u))
-        if nrm <= eps:
+        # RELATIVE duplicate test (Ritz vectors are unit norm): a Ritz pair
+        # duplicating a locked one leaves ~ulp projected remainder, far
+        # above the absolute tiny**0.5 — normalizing that noise would
+        # report a spurious eigenvector under a converged eigenvalue.
+        if nrm <= 128.0 * ulp:
             continue
         extra_vals.append(float(evals[i]))
         extra_vecs.append(u / nrm)
+
+    # Eigenvalue multiplicity repair: a direction degenerate with a locked
+    # eigenvalue is UNREACHABLE from the original Krylov sequence (invariant
+    # subspace — restarts stay inside it up to rounding noise), so the solve
+    # can exhaust restarts with nl < k.  Power-iterate random restarts on
+    # the deflated, spectrum-shifted operator: each converges to the
+    # DOMINANT remaining eigendirection, with its honest Rayleigh quotient
+    # as the value.  Keep repairing while the newly found direction beats
+    # the current k-th best — an inferior pair locked early (e.g. a
+    # 0-eigenvector of a low-rank operator) must not displace a
+    # still-missing degenerate extremal copy; the final top-k sort below
+    # drops the loser.
+    shift_mag = max(
+        float(np.max(np.abs(np.asarray(lvals)[:max(n_locked, 1)]))),
+        float(np.max(np.abs(np.asarray(evals)))), 1.0)
+    # largest: shift up so the largest algebraic eigenvalue dominates in
+    # magnitude; plain `largest=False` solves shift down symmetrically
+    # (smallest-eigenpair callers already negate via apply_fn).
+    shift = jnp.asarray(shift_mag if largest else -shift_mag, dtype)
+    sign = 1.0 if largest else -1.0
+
     key = jax.random.PRNGKey(seed + 1)
-    while n_locked + len(extra_vals) < k:
+    margin = float(tol) * shift_mag
+    attempts = 2 * k + 4  # bound on repair attempts
+    cap = k + attempts    # fixed deflation-basis capacity: ONE compile of
+    #                       the repair program per solve signature (a
+    #                       per-attempt basis shape would retrace each time)
+    eps_arr = jnp.asarray(eps, dtype)
+    for _ in range(attempts):
+        # Deflate against everything found so far INCLUDING previous repairs:
+        # without the extras in the projector, iteration re-converges onto an
+        # already-repaired direction and its final free_part leaves noise.
+        basis = (locked if not extra_vecs
+                 else jnp.concatenate([locked, jnp.stack(extra_vecs)], axis=0))
+        basis = jnp.pad(basis, ((0, cap - basis.shape[0]), (0, 0)))
+
         key, sub = jax.random.split(key)
         u = free_part(jax.random.normal(sub, (n,), dtype))
         nrm = float(jnp.linalg.norm(u))
         if nrm <= eps:
-            continue
+            break  # deflated space exhausted
+        u = _power_repair(operator, basis, u / nrm, shift, eps_arr,
+                          apply_fn=apply_fn)
+        u = free_part(u)
+        nrm = float(jnp.linalg.norm(u))
+        if nrm <= eps:
+            break
         u = u / nrm
-        extra_vals.append(float(jnp.dot(u, apply_fn(operator, u))))
+        lam = float(jnp.dot(u, apply_fn(operator, u)))
+        if n_locked + len(extra_vals) >= k:
+            # basis already full: keep hunting only while each new dominant
+            # remaining direction still beats the current k-th best value
+            cur = sorted(locked_vals + extra_vals, key=lambda v: -sign * v)
+            if sign * lam <= sign * cur[k - 1] + margin:
+                break  # no better than what we already return
+        extra_vals.append(lam)
         extra_vecs.append(u)
     all_vals = jnp.asarray(locked_vals + extra_vals, dtype)
     all_vecs = jnp.concatenate(

@@ -232,3 +232,44 @@ class TestReviewRegressions:
         assert np.isfinite(c).all()
         n_heavy = int((np.linalg.norm(c - 0.0, axis=1) < 10).sum())
         assert n_heavy > 60  # heavy region got the bulk of the quota
+
+
+class TestEngineResolution:
+    def test_pallas_engine_rejected_for_non_l2(self):
+        x = np.random.default_rng(0).random((32, 8), dtype=np.float32)
+        c = x[:4]
+        with pytest.raises(ValueError, match="L2 metric family"):
+            cluster.min_cluster_and_distance(
+                x, c, metric=DistanceType.CosineExpanded, engine="pallas")
+
+    def test_unknown_engine_rejected(self):
+        x = np.random.default_rng(0).random((32, 8), dtype=np.float32)
+        with pytest.raises(ValueError, match="unknown engine"):
+            cluster.min_cluster_and_distance(x, x[:4], engine="cuda")
+
+    def test_env_default_resolved_per_call(self, monkeypatch):
+        """RAFT_TPU_PALLAS_NN is resolved OUTSIDE the jit cache: flipping it
+        between same-shape calls must change the selected engine (ADVICE r2:
+        an engine=None cache key silently kept the first compiled engine)."""
+        from raft_tpu.cluster import kmeans as K
+
+        seen = []
+        orig = K._min_cluster_and_distance
+
+        def spy(*a, **kw):
+            seen.append(kw["engine"])
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(K, "_min_cluster_and_distance", spy)
+        x = np.random.default_rng(0).random((32, 8), dtype=np.float32)
+        c = x[:4]
+        from raft_tpu.distance import pallas_fused_l2nn
+
+        monkeypatch.setattr(pallas_fused_l2nn, "is_enabled", lambda: False)
+        cluster.min_cluster_and_distance(x, c)
+        # flip the gate between same-shape calls (on TPU this is the
+        # RAFT_TPU_PALLAS_NN env var; is_enabled() additionally requires a
+        # real TPU backend, so patch the gate itself here on CPU)
+        monkeypatch.setattr(pallas_fused_l2nn, "is_enabled", lambda: True)
+        cluster.min_cluster_and_distance(x, c)
+        assert seen == ["xla", "pallas"]
