@@ -1,0 +1,94 @@
+"""Cold-start latency: first public-API call in a FRESH process, with and
+without a prewarmed persistent cache.
+
+The reference kills first-call compile latency by shipping precompiled
+instantiation libraries (libraft-distance, cpp/src/distance/
+pairwise_distance.cu:24-52); raft_tpu's equivalent is
+``raft_tpu.prewarm()`` populating the on-disk executable cache that the
+AOT-wrapped public entry points consult.  This bench measures exactly the
+user-visible effect: wall time of the first ``pairwise_distance`` call in a
+brand-new process,
+
+  cold — empty cache directory (pure JIT), vs
+  warm — after one ``prewarm()`` on the same machine.
+
+Usage: ``python -m bench.bench_aot``.  Emits one JSON line:
+{"bench": "aot/first_call", "cold_s": …, "warm_s": …, "speedup": …}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+M, N, K = 5000, 5000, 50
+
+_CHILD = r"""
+import json, os, time
+import numpy as np
+rng = np.random.default_rng(0)
+x = rng.random((%d, %d), dtype=np.float32)
+y = rng.random((%d, %d), dtype=np.float32)
+from raft_tpu.distance import pairwise_distance
+import jax, jax.numpy as jnp
+jax.block_until_ready(jnp.zeros(()) + 1)  # backend bring-up, untimed
+t0 = time.perf_counter()
+jax.block_until_ready(pairwise_distance(x, y, "euclidean"))
+first = time.perf_counter() - t0
+t0 = time.perf_counter()
+jax.block_until_ready(pairwise_distance(x, y, "euclidean"))
+steady = time.perf_counter() - t0
+print(json.dumps({"first_call_s": first, "steady_s": steady,
+                  "overhead_s": first - steady}))
+""" % (M, K, N, K)
+
+
+def _run_child(code: str, cache_dir: str, timeout: int = 900,
+               no_cache: bool = False) -> dict:
+    env = dict(os.environ)
+    env["RAFT_TPU_CACHE_DIR"] = cache_dir
+    if no_cache:
+        env["RAFT_TPU_NO_PERSISTENT_CACHE"] = "1"
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=timeout)
+    if out.returncode != 0:
+        raise RuntimeError(f"bench_aot child failed:\n{out.stderr[-2000:]}")
+    for line in reversed(out.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError("bench_aot child produced no JSON")
+
+
+def main():
+    with tempfile.TemporaryDirectory(prefix="raft_tpu_aot_bench") as tmp:
+        # Cold child must not WRITE the cache the warm child reads, or the
+        # measured speedup would not be attributable to prewarm().
+        cold = _run_child(_CHILD, tmp, no_cache=True)
+        # Populate the cache the supported way (fresh process, same dir).
+        t0 = time.perf_counter()
+        _run_child(
+            "import json, raft_tpu; "
+            f"print(json.dumps(raft_tpu.prewarm(shapes=(({M}, {N}, {K}),), "
+            "metrics=('euclidean',), select_k_shapes=())))", tmp)
+        prewarm_s = time.perf_counter() - t0
+        warm = _run_child(_CHILD, tmp)
+    # overhead = first call minus steady-state: the compile/load cost the
+    # prewarmed cache is supposed to remove.
+    print(json.dumps({
+        "bench": "aot/first_call",
+        "cold_first_s": round(cold["first_call_s"], 3),
+        "warm_first_s": round(warm["first_call_s"], 3),
+        "cold_overhead_s": round(cold["overhead_s"], 3),
+        "warm_overhead_s": round(warm["overhead_s"], 3),
+        "prewarm_s": round(prewarm_s, 3),
+        "overhead_speedup": (round(cold["overhead_s"] / warm["overhead_s"], 2)
+                             if warm["overhead_s"] > 0 else None),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

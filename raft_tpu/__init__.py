@@ -34,6 +34,7 @@ from raft_tpu.core import (  # noqa: F401
     RaftError,
     LogicError,
     expects,
+    prewarm,
 )
 
 # Subpackages are imported lazily to keep `import raft_tpu` fast and to avoid

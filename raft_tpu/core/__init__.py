@@ -47,3 +47,10 @@ from raft_tpu.core.mdarray import (  # noqa: F401
     row_major,
 )
 from raft_tpu.core import interruptible  # noqa: F401
+from raft_tpu.core.aot import (  # noqa: F401
+    AotFunction,
+    aot,
+    enable_persistent_cache,
+    try_enable_persistent_cache,
+)
+from raft_tpu.core.prewarm import prewarm  # noqa: F401

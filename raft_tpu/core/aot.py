@@ -87,6 +87,51 @@ def _bucket_dim(n: int) -> int:
     return 1 << (int(n - 1).bit_length())
 
 
+_persistent_attempted = False
+
+
+def _ensure_persistent_cache():
+    """Lazily point JAX's compilation cache at the on-disk directory before
+    the first AOT compile, so every executable an :class:`AotFunction`
+    builds survives the process — the "ship precompiled libs" half of the
+    reference mechanism.  Opt out with ``RAFT_TPU_NO_PERSISTENT_CACHE=1``."""
+    global _persistent_attempted
+    if _persistent_attempted:
+        return
+    _persistent_attempted = True
+    if os.environ.get("RAFT_TPU_NO_PERSISTENT_CACHE", "") == "1":
+        return
+    if jax.config.jax_compilation_cache_dir is not None:
+        return  # the user already configured a cache — never clobber it
+    try_enable_persistent_cache()
+
+
+def is_tracer(*values) -> bool:
+    """True if any value is a JAX tracer: an :class:`AotFunction` cannot be
+    invoked inside a trace (a compiled executable is opaque to tracing) —
+    callers fall back to their inline implementation there."""
+    return any(isinstance(v, jax.core.Tracer) for v in values)
+
+
+def aot_dispatchable(*values) -> bool:
+    """True when an eager call may dispatch an AOT executable: no tracers
+    (opaque to tracing) and every committed jax array on the default device
+    (the executable is lowered for the default device only; inputs placed on
+    another chip or sharded across a mesh must take the jit path, which
+    specializes per placement)."""
+    for v in values:
+        for leaf in jax.tree_util.tree_leaves(v):
+            if isinstance(leaf, jax.core.Tracer):
+                return False
+            if isinstance(leaf, jax.Array):
+                try:
+                    if leaf.sharding.device_set != {jax.devices()[0]}:
+                        return False
+                except Exception:  # unusual array types: be conservative
+                    return False
+    return True
+
+
 class AotFunction:
     """A function with a per-signature compiled-executable cache."""
 
@@ -98,17 +143,35 @@ class AotFunction:
         self._cache: Dict[Any, Any] = {}
         functools.update_wrapper(self, fn)
 
+    def _bucket_shape(self, shape):
+        if self._bucket and len(shape) >= 1:
+            return (_bucket_dim(shape[0]),) + shape[1:]
+        return shape
+
+    @staticmethod
+    def _leaf_spec(leaf):
+        """(shape, dtype) for an array-like or a ShapeDtypeStruct spec (the
+        latter lets :func:`raft_tpu.core.prewarm.prewarm` describe
+        signatures without materializing data)."""
+        if isinstance(leaf, jax.ShapeDtypeStruct):
+            return leaf.shape, leaf.dtype
+        return jnp.shape(leaf), jnp.result_type(leaf)
+
     def _signature(self, args):
+        """Hashable signature; dynamic args may be pytrees of arrays (the
+        reference's runtime API passes whole index structures by pointer —
+        here a tuple of device arrays plays that role)."""
         sig = []
         for i, a in enumerate(args):
             if i in self._static:
                 sig.append(("static", a))
             else:
-                a = jnp.asarray(a)
-                shape = a.shape
-                if self._bucket and a.ndim >= 1:
-                    shape = (_bucket_dim(shape[0]),) + shape[1:]
-                sig.append((shape, str(a.dtype)))
+                leaves, treedef = jax.tree_util.tree_flatten(a)
+                entry = tuple(
+                    (self._bucket_shape(self._leaf_spec(leaf)[0]),
+                     str(self._leaf_spec(leaf)[1]))
+                    for leaf in leaves)
+                sig.append((treedef, entry))
         return tuple(sig)
 
     def compiled(self, *args):
@@ -117,32 +180,34 @@ class AotFunction:
         sig = self._signature(args)
         entry = self._cache.get(sig)
         if entry is None:
+            _ensure_persistent_cache()
             jitted = jax.jit(self._fn, static_argnums=self._static)
             lower_args = []
             for i, a in enumerate(args):
                 if i in self._static:
                     lower_args.append(a)
                 else:
-                    a = jnp.asarray(a)
-                    shape, dtype = sig[i]
-                    lower_args.append(jax.ShapeDtypeStruct(shape, a.dtype))
+                    lower_args.append(jax.tree_util.tree_map(
+                        lambda leaf: jax.ShapeDtypeStruct(
+                            self._bucket_shape(self._leaf_spec(leaf)[0]),
+                            self._leaf_spec(leaf)[1]), a))
             entry = jitted.lower(*lower_args).compile()
             self._cache[sig] = entry
         return entry
 
     def __call__(self, *args):
         exe = self.compiled(*args)
-        call_args = []
-        for i, a in enumerate(args):
-            if i in self._static:
-                continue  # static args are baked into the executable
-            a = jnp.asarray(a)
-            if self._bucket and a.ndim >= 1:
-                b = _bucket_dim(a.shape[0])
-                if b != a.shape[0]:
-                    pad = [(0, b - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
-                    a = jnp.pad(a, pad)
-            call_args.append(a)
+
+        def prep(leaf):
+            leaf = jnp.asarray(leaf)
+            b = self._bucket_shape(leaf.shape)
+            if b != leaf.shape:
+                pad = [(0, b[0] - leaf.shape[0])] + [(0, 0)] * (leaf.ndim - 1)
+                leaf = jnp.pad(leaf, pad)
+            return leaf
+
+        call_args = [jax.tree_util.tree_map(prep, a)
+                     for i, a in enumerate(args) if i not in self._static]
         return exe(*call_args)
 
     @property

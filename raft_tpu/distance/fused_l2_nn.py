@@ -12,11 +12,10 @@ carry — no m×n matrix ever materializes in HBM.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core.aot import aot, aot_dispatchable
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.core.kvp import KeyValuePair, kvp_min
@@ -29,9 +28,8 @@ _BM = 2048  # row block: measured sweet spot on v5e (distance tile ≈ 8 MB)
 _PRECISION = "highest"
 
 
-@functools.partial(jax.jit, static_argnames=("sqrt", "block_n", "precision"))
-def _fused_l2_nn(x, y, x_norms, y_norms, sqrt: bool, block_n: int,
-                 precision: str = _PRECISION):
+def _fused_l2_nn_impl(x, y, x_norms, y_norms, sqrt: bool, block_n: int,
+                      precision: str = _PRECISION):
     m, k = x.shape
     n = y.shape[0]
     bn = min(block_n, n)
@@ -89,6 +87,14 @@ def _fused_l2_nn(x, y, x_norms, y_norms, sqrt: bool, block_n: int,
     return best_val, best_key
 
 
+# Traced callers (the k-means E-step's trace) inline this jit; the eager
+# public entry dispatches the AOT executable cache instead (precompiled-libs
+# role, see raft_tpu.core.aot).
+_fused_l2_nn = jax.jit(_fused_l2_nn_impl,
+                       static_argnames=("sqrt", "block_n", "precision"))
+_fused_l2_nn_aot = aot(_fused_l2_nn_impl, static_argnums=(4, 5, 6))
+
+
 def fused_l2_nn(x, y, sqrt: bool = False, x_norms=None, y_norms=None,
                 block_n: int = _BN, precision: str = _PRECISION) -> KeyValuePair:
     """For each row of x, the nearest row of y by (squared) L2 —
@@ -101,7 +107,12 @@ def fused_l2_nn(x, y, sqrt: bool = False, x_norms=None, y_norms=None,
         x_norms = jnp.sum(x * x, axis=1)
     if y_norms is None:
         y_norms = jnp.sum(y * y, axis=1)
-    val, idx = _fused_l2_nn(x, y, x_norms, y_norms, bool(sqrt), int(block_n), precision)
+    if aot_dispatchable(x, y, x_norms, y_norms):
+        val, idx = _fused_l2_nn_aot(x, y, x_norms, y_norms, bool(sqrt),
+                                    int(block_n), precision)
+    else:  # tracer (inline) or off-default-device placement (jit)
+        val, idx = _fused_l2_nn(x, y, x_norms, y_norms, bool(sqrt),
+                                int(block_n), precision)
     return KeyValuePair(key=idx, value=val)
 
 

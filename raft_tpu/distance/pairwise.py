@@ -28,12 +28,12 @@ range checks.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Union
 
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core.aot import aot, aot_dispatchable, is_tracer
 from raft_tpu.core.error import LogicError, expects
 from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.core.logger import traced
@@ -295,9 +295,17 @@ def _dispatch(x, y, metric: DistanceType, metric_arg: float):
                      "sparse-only; Precomputed is a sentinel)")
 
 
-@functools.partial(jax.jit, static_argnames=("metric", "metric_arg"))
-def _distance_jit(x, y, metric: DistanceType, metric_arg: float):
-    return _dispatch(x, y, metric, metric_arg)
+# The eager public path dispatches via an AOT executable cache (reference
+# role: linking against precompiled libraft-distance instantiations,
+# cpp/src/distance/pairwise_distance.cu:24-52): each (shape, dtype, metric)
+# signature is lowered+compiled once, and the compile consults the
+# persistent on-disk cache — a fresh process's first call for a previously
+# compiled signature loads the executable instead of compiling it.  The jit
+# stays for calls the AOT path cannot serve: tracers (inline into the
+# enclosing trace) and inputs committed off the default device or sharded
+# (jit specializes per placement; the AOT executable targets device 0 only).
+_distance_aot = aot(_dispatch, static_argnums=(2, 3))
+_distance_jit = jax.jit(_dispatch, static_argnums=(2, 3))
 
 
 def distance(x, y, metric: DistanceType, metric_arg: float = 2.0):
@@ -307,7 +315,13 @@ def distance(x, y, metric: DistanceType, metric_arg: float = 2.0):
     y = jnp.asarray(y)
     expects(x.ndim == 2 and y.ndim == 2, "x and y must be 2-d")
     expects(x.shape[1] == y.shape[1], "x and y must have the same number of columns")
-    return _distance_jit(x, y, DistanceType(metric), float(metric_arg))
+    metric = DistanceType(metric)
+    metric_arg = float(metric_arg)
+    if is_tracer(x, y):  # inside someone's jit: inline into their trace
+        return _dispatch(x, y, metric, metric_arg)
+    if aot_dispatchable(x, y):
+        return _distance_aot(x, y, metric, metric_arg)
+    return _distance_jit(x, y, metric, metric_arg)
 
 
 @traced("raft_tpu.distance.pairwise_distance")

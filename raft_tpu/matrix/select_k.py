@@ -16,6 +16,29 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core.aot import aot, aot_dispatchable, is_tracer
+
+
+def _select_k_impl(values, k: int, select_min: bool):
+    if select_min:
+        vals, idx = jax.lax.top_k(-values, k)
+        return -vals, idx
+    return jax.lax.top_k(values, k)
+
+
+def _select_k_payload_impl(values, indices, k: int, select_min: bool):
+    vals, idx = _select_k_impl(values, k, select_min)
+    return vals, jnp.take_along_axis(indices, idx, axis=-1)
+
+
+# Eager calls dispatch AOT-cached executables (precompiled-libs role, see
+# raft_tpu.core.aot); traced calls inline into the caller's program; inputs
+# committed off the default device take the placement-specializing jit.
+_select_k_aot = aot(_select_k_impl, static_argnums=(1, 2))
+_select_k_payload_aot = aot(_select_k_payload_impl, static_argnums=(2, 3))
+_select_k_jit = jax.jit(_select_k_impl, static_argnums=(1, 2))
+_select_k_payload_jit = jax.jit(_select_k_payload_impl, static_argnums=(2, 3))
+
 
 def select_k(values, k: int, select_min: bool = True, indices=None
              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -26,14 +49,20 @@ def select_k(values, k: int, select_min: bool = True, indices=None
     pair); otherwise positions are returned.
     """
     values = jnp.asarray(values)
-    if select_min:
-        vals, idx = jax.lax.top_k(-values, k)
-        vals = -vals
-    else:
-        vals, idx = jax.lax.top_k(values, k)
+    k = int(k)
+    select_min = bool(select_min)
+    if is_tracer(values, indices):
+        if indices is not None:
+            return _select_k_payload_impl(values, jnp.asarray(indices), k,
+                                          select_min)
+        return _select_k_impl(values, k, select_min)
     if indices is not None:
-        idx = jnp.take_along_axis(jnp.asarray(indices), idx, axis=-1)
-    return vals, idx
+        indices = jnp.asarray(indices)
+        fn = (_select_k_payload_aot if aot_dispatchable(values, indices)
+              else _select_k_payload_jit)
+        return fn(values, indices, k, select_min)
+    fn = _select_k_aot if aot_dispatchable(values) else _select_k_jit
+    return fn(values, k, select_min)
 
 
 def select_min_k(values, k: int, indices=None):
