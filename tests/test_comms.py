@@ -190,8 +190,46 @@ class TestSplit:
 
         with pytest.raises(LogicError):
             comms.comm_split([0])  # wrong length
+
+    def test_unequal_groups_allreduce(self, comms):
+        """NCCL comm_split allows any color partition; shape-preserving
+        collectives must work on unequal groups (3+5 split): within-group
+        sums of global ranks."""
+        import jax.numpy as jnp
+
+        n = comms.mesh.shape[comms.axis_name]
+        if n != 8:
+            pytest.skip("shaped for the 8-device mesh")
+        sub = comms.comm_split([0] * 3 + [1] * 5)
+
+        def fn(x):
+            s = sub.allreduce(comms.get_global_rank().astype(jnp.float32))
+            r = comms.get_global_rank()
+            exp = jnp.where(r < 3, 3.0, float(sum(range(3, 8))))
+            from raft_tpu.comms.comms_types import ReduceOp
+
+            ok = (s == exp) & (sub.get_group_size() == jnp.where(r < 3, 3, 5))
+            return comms.allreduce(ok.astype(jnp.int32), ReduceOp.MIN)
+
+        assert int(comms.run(fn, np.zeros(n, np.float32))) == 1
+
+    def test_unequal_groups_reject_shape_changing(self, comms):
+        """allgather/reducescatter outputs are group-size-shaped: one SPMD
+        program cannot express them over unequal groups — explicit error."""
+        from raft_tpu.core import LogicError
+
+        n = comms.mesh.shape[comms.axis_name]
+        if n != 8:
+            pytest.skip("shaped for the 8-device mesh")
+        sub = comms.comm_split([0] * 3 + [1] * 5)
         with pytest.raises(LogicError):
-            comms.comm_split([0] * 3 + [1] * 5)  # unequal groups
+            sub.get_size()
+
+        def ag(x):
+            return sub.allgather(x)
+
+        with pytest.raises(LogicError):
+            comms.run(ag, np.zeros(n, np.float32))
 
 
 class TestHostP2P:

@@ -1,0 +1,124 @@
+"""Pod-like-scale grouped-collective tests: 32 virtual devices.
+
+The in-process suite runs on the 8-device mesh (conftest); a v5p-32 target
+(BASELINE.md) implies group shapes the 8-device mesh cannot represent —
+groups of 16, 2×16 splits, deep butterflies.  jax pins the device count at
+first backend init, so the 32-device profile runs in ONE subprocess that
+executes the whole battery and prints a verdict line per check (the
+reference analogue: per-clique comm_split tests on real clusters,
+std_comms.hpp:107-171).
+"""
+
+import os
+import subprocess
+import sys
+
+_SCRIPT = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=32"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from raft_tpu.comms import build_comms, self_tests
+from raft_tpu.comms.comms_types import ReduceOp
+
+N = 32
+mesh = Mesh(np.array(jax.devices()[:N]), ("world",))
+comms = build_comms(mesh)
+failures = []
+
+def check(name, ok):
+    print(("ok " if ok else "FAIL ") + name, flush=True)
+    if not ok:
+        failures.append(name)
+
+# full-axis self-test battery at 32 devices
+for t, ok in self_tests.run_all(comms).items():
+    check("world32/" + t, ok)
+
+# grouped collectives: pow2 sizes ride the butterfly, others the ring
+for gsize in (4, 8, 16):
+    ngroups = N // gsize
+    sub = comms.comm_split([r // gsize for r in range(N)])
+
+    def fn(x, sub=sub, gsize=gsize):
+        r = comms.get_global_rank()
+        grp = r // gsize
+        # allreduce: within-group sum of global ranks
+        s = sub.allreduce(r.astype(jnp.float32))
+        base = grp * gsize
+        exp_sum = (base * gsize + gsize * (gsize - 1) // 2).astype(jnp.float32)
+        ok = s == exp_sum
+        # allgather: group members in order
+        g = sub.allgather(r.astype(jnp.float32)[None])
+        exp_g = base.astype(jnp.float32) + jnp.arange(gsize, dtype=jnp.float32)
+        ok &= jnp.all(g.ravel() == exp_g)
+        # reducescatter: ones -> each member holds gsize
+        rs = sub.reducescatter(jnp.ones((gsize,)))
+        ok &= jnp.all(rs == float(gsize))
+        return comms.allreduce(ok.astype(jnp.int32), ReduceOp.MIN)
+
+    check(f"split{gsize}x{ngroups}/allreduce+allgather+reducescatter",
+          int(comms.run(fn, np.zeros(N, np.float32))) == 1)
+
+# odd, non-dividing sizes: 32 = 3*10 + 2 and 32 = 5*6 + 2 -> unequal last
+# group; shape-preserving collectives must still be exact per group
+for gsize in (3, 5):
+    colors = [r // gsize for r in range(N)]
+    sub = comms.comm_split(colors)
+    sizes = np.bincount(colors)
+
+    def fn(x, sub=sub, colors=colors, sizes=sizes):
+        r = comms.get_global_rank()
+        col = jnp.asarray(colors, jnp.int32)[r]
+        s = sub.allreduce(r.astype(jnp.float32))
+        grp_sums = np.zeros(len(sizes), np.float32)
+        for rr, c in enumerate(colors):
+            grp_sums[c] += rr
+        ok = s == jnp.asarray(grp_sums)[col]
+        ok &= sub.get_group_size() == jnp.asarray(sizes, jnp.int32)[col]
+        mn = sub.allreduce(r.astype(jnp.float32), ReduceOp.MIN)
+        grp_mins = np.asarray([min(rr for rr, c in enumerate(colors) if c == cc)
+                               for cc in range(len(sizes))], np.float32)
+        ok &= mn == jnp.asarray(grp_mins)[col]
+        return comms.allreduce(ok.astype(jnp.int32), ReduceOp.MIN)
+
+    check(f"split{gsize}(unequal)/allreduce sum+min",
+          int(comms.run(fn, np.zeros(N, np.float32))) == 1)
+
+# multicast over a small participant set: O(group) ring, world untouched
+srcs = [3, 17, 30]
+dsts = [3, 5, 17, 21, 30]
+
+def fn_mc(x):
+    r = comms.get_global_rank()
+    got = comms.device_multicast_sendrecv(r.astype(jnp.float32),
+                                          dsts=dsts, srcs=srcs)
+    member = jnp.isin(r, jnp.asarray(sorted(set(dsts) | set(srcs))))
+    exp = jnp.asarray([float(s) for s in srcs])
+    ok = jnp.where(member, jnp.all(got == exp), jnp.all(got == 0.0))
+    return comms.allreduce(ok.astype(jnp.int32), ReduceOp.MIN)
+
+check("multicast/participant-ring", int(comms.run(fn_mc, np.zeros(N, np.float32))) == 1)
+
+print("SCALE32 DONE failures=%d" % len(failures), flush=True)
+raise SystemExit(1 if failures else 0)
+"""
+
+
+def test_comms_battery_at_32_devices():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    out = subprocess.run([sys.executable, "-c", _SCRIPT], env=env,
+                         capture_output=True, text=True, timeout=900,
+                         cwd=os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__))))
+    sys.stdout.write(out.stdout)
+    assert "SCALE32 DONE failures=0" in out.stdout, out.stdout + out.stderr[-2000:]
+    assert out.returncode == 0
