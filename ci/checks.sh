@@ -45,6 +45,15 @@ print(f"{len(mods)} modules import cleanly")
 EOF
 
 echo "== tests =="
-python -m pytest tests/ -q
+# Shard per-file across workers when the host has the cores for it (the
+# reference parallelizes via per-family gtest binaries, ci/gpu/build.sh:
+# 106-121; --dist loadfile is the same per-family split).  On small hosts
+# (this round's runner has 1 vCPU) xdist workers would only contend.
+NPROC=$(python -c "import os; print(len(os.sched_getaffinity(0)))")
+if [ "${NPROC}" -ge 4 ] && python -c "import xdist" 2>/dev/null; then
+  python -m pytest tests/ -q -n "$((NPROC / 2))" --dist loadfile
+else
+  python -m pytest tests/ -q
+fi
 
 echo "CI checks passed"
