@@ -27,21 +27,59 @@ def emit(obj):
         f.write(line + "\n")
 
 
-def timed(fn, iters=10):
+def timed_chained(fn, x0, feedback, iters=10):
+    """Best-of-iters timing with DATA-DEPENDENT chaining: ``fn(x)`` returns
+    the output to time, ``feedback(x, out)`` derives the next input from it
+    so no two dispatches are identical (the r2 elision hazard — see
+    bench.py:bench_pairwise)."""
     import jax
 
-    jax.block_until_ready(fn())
+    x = x0
+    out = fn(x)
+    jax.block_until_ready(out)  # warmup/compile
     best = float("inf")
     for _ in range(iters):
+        x = feedback(x, out)
         t0 = time.perf_counter()
-        jax.block_until_ready(fn())
+        out = fn(x)
+        jax.block_until_ready(out)
         best = min(best, time.perf_counter() - t0)
     return best
 
 
-def headline():
+def run_subprocess_emit(argv, timeout, stage, env=None, **tag):
+    """Run a measurement subprocess in its own process group, emit its last
+    JSON line under *stage*, group-killing on timeout (a plain kill would
+    leak backend helper children; an orphaned child holding the exclusive
+    chip starves every later measurement — see bench._orphan_watchdog).
+
+    Children CAN bring up the TPU while this session process holds it (the
+    r2a session's headline children recorded live numbers under a live
+    parent); the hazard the timeout bounds is a wedged bring-up."""
     import signal
 
+    proc = subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
+                            start_new_session=True)
+    try:
+        out = proc.communicate(timeout=timeout)[0].decode()
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        proc.wait()
+        emit({"stage": stage, "error": "timeout", **tag})
+        return
+    for line in reversed(out.strip().splitlines()):
+        if line.startswith("{"):
+            # success rows carry their own metric fields; *tag* labels
+            # only the error emissions
+            emit({"stage": stage, **json.loads(line)})
+            return
+    emit({"stage": stage, "error": "no JSON", **tag})
+
+
+def headline():
     env = dict(os.environ)
     # Not-yet-recorded configs first: the tunnel window can close mid-session
     # (it did in r2a AND r2b), and pairwise/kmeans already have live numbers.
@@ -54,22 +92,8 @@ def headline():
         # child.  If we do have to kill bench.py here, its child is a
         # separate session that killpg can't reach — the child's orphan
         # watchdog (bench._orphan_watchdog) reaps it within ~10 s.
-        proc = subprocess.Popen([sys.executable, "bench.py"], env=env,
-                                stdout=subprocess.PIPE,
-                                start_new_session=True)
-        try:
-            out = proc.communicate(timeout=2200)[0].decode()
-            for line in reversed(out.strip().splitlines()):
-                if line.startswith("{"):
-                    emit({"stage": "headline", **json.loads(line)})
-                    break
-        except subprocess.TimeoutExpired:
-            try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                proc.kill()
-            proc.wait()
-            emit({"stage": "headline", "metric": m, "error": "timeout"})
+        run_subprocess_emit([sys.executable, "bench.py"], 2200, "headline",
+                            env=dict(env), metric=m)
 
 
 def kmeans_sweep():
@@ -89,7 +113,8 @@ def kmeans_sweep():
 
         emj = jax.jit(em)
         try:
-            best = timed(lambda: emj(c), iters=8)
+            # chained: each timed step consumes the previous centroids
+            best = timed_chained(emj, c, lambda cc, out: out, iters=8)
             emit({"stage": "kmeans_sweep", "iter_s": round(1.0 / best, 1),
                   **tag})
         except Exception as e:  # noqa: BLE001 - record and continue
@@ -124,11 +149,24 @@ def ivf_pq_stages():
                                             rotation_kind="pca_balanced"), x)
     jax.block_until_ready(index.list_codes)
     emit({"stage": "ivf_pq", "build_s": round(time.perf_counter() - t0, 2)})
+    qj = jax.device_put(q)
     for probes in (20, 40, 80):
         sp = ivf_pq.SearchParams(n_probes=probes)
-        best = timed(lambda: ivf_pq.search(sp, index, q, 10)[1], iters=5)
+        best = timed_chained(
+            lambda qq, sp=sp: ivf_pq.search(sp, index, qq, 10)[0],
+            qj, lambda qq, d: qq + 1e-12 * d[0, 0], iters=5)
         emit({"stage": "ivf_pq", "n_probes": probes,
               "qps": round(nq / best, 1)})
+
+
+def aot_cold_start_stage():
+    """Cold-vs-prewarmed first-call latency on the real chip — where AOT
+    matters most (first TPU compiles are 20-40 s).  Children run
+    sequentially under a live parent (the r2a-proven headline pattern);
+    placed LAST so a wedged bring-up costs only the bounded timeout after
+    everything else is recorded."""
+    run_subprocess_emit([sys.executable, "-m", "bench.bench_aot"], 1800,
+                        "aot")
 
 
 def lanczos_stage():
@@ -142,7 +180,15 @@ def lanczos_stage():
     g = g + g.T
     adj = CSR(g.indptr, g.indices, g.data, g.shape)
     lap = laplacian(adj)
-    best = timed(lambda: lanczos_smallest(lap, 8, tol=1e-6)[0], iters=3)
+    import jax.numpy as jnp
+
+    # Random start vector (ones is the Laplacian's null eigenvector — it
+    # would degenerate the Krylov space AND zero the chained perturbation).
+    v0 = jnp.asarray(np.random.default_rng(2).normal(0, 1, n), jnp.float32)
+    best = timed_chained(
+        lambda v: lanczos_smallest(lap, 8, tol=1e-6, v0=v)[0],
+        v0, lambda v, evals: v * (1.0 + 1e-9 * (1.0 + jnp.abs(evals[0]))),
+        iters=3)
     emit({"stage": "lanczos", "solves_s": round(1.0 / best, 3)})
 
 
@@ -155,4 +201,5 @@ if __name__ == "__main__":
     kmeans_sweep()
     ivf_pq_stages()
     lanczos_stage()
+    aot_cold_start_stage()
     emit({"stage": "session", "done": True})

@@ -257,3 +257,53 @@ def test_pairwise_random_shapes_vs_scipy(dtype):
         tol = 2e-3 if dtype == np.float32 else 1e-8
         np.testing.assert_allclose(got, ref, rtol=tol, atol=tol,
                                    err_msg=f"{name} m={m} n={n} k={k}")
+
+
+class TestLayoutSweep:
+    """Reference distance tests sweep isRowMajor for every metric
+    (test/distance/distance_base.cuh): inputs in either memory order must
+    produce identical results.  On TPU the XLA layout is internal — the
+    parity obligation is that F-ordered (column-major) host arrays, strided
+    views, and transposed views all round-trip through the public API."""
+
+    METRICS = ["euclidean", "sqeuclidean", "cosine", "l1", "chebyshev",
+               "canberra", "correlation", "hamming", "jensenshannon"]
+
+    @pytest.mark.parametrize("metric", METRICS)
+    def test_fortran_order_inputs(self, metric):
+        from raft_tpu.distance import pairwise_distance
+
+        rng = np.random.default_rng(3)
+        x = rng.random((70, 24), dtype=np.float32) + 0.1
+        y = rng.random((50, 24), dtype=np.float32) + 0.1
+        ref = np.asarray(pairwise_distance(x, y, metric))
+        xf = np.asfortranarray(x)
+        yf = np.asfortranarray(y)
+        assert not xf.flags.c_contiguous
+        out = np.asarray(pairwise_distance(xf, yf, metric))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("metric", ["euclidean", "cosine", "l1"])
+    def test_strided_and_transposed_views(self, metric):
+        from raft_tpu.distance import pairwise_distance
+
+        rng = np.random.default_rng(4)
+        big = rng.random((140, 48), dtype=np.float32) + 0.1
+        x = big[::2, ::2]              # non-contiguous strided view
+        yt = np.ascontiguousarray(big[:50, :24])
+        ref = np.asarray(pairwise_distance(np.ascontiguousarray(x), yt, metric))
+        out = np.asarray(pairwise_distance(x, yt, metric))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+        xt = np.ascontiguousarray(x).T.copy().T   # transposed-storage view
+        out_t = np.asarray(pairwise_distance(xt, yt, metric))
+        np.testing.assert_allclose(out_t, ref, rtol=1e-5, atol=1e-5)
+
+    def test_fused_l2_nn_fortran_inputs(self):
+        from raft_tpu.distance import fused_l2_nn
+
+        rng = np.random.default_rng(5)
+        x = rng.random((90, 16), dtype=np.float32)
+        y = rng.random((40, 16), dtype=np.float32)
+        ref = fused_l2_nn(x, y)
+        out = fused_l2_nn(np.asfortranarray(x), np.asfortranarray(y))
+        np.testing.assert_array_equal(np.asarray(out.key), np.asarray(ref.key))
