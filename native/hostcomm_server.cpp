@@ -1,0 +1,384 @@
+// Native host-comm mailbox server — the UCX-role counterpart of the
+// reference's native host p2p plane (comms/detail/ucp_helper.hpp beside
+// std_comms.hpp).  The Python TcpMailbox client speaks a binary framed
+// protocol; this server routes opaque payload bytes by a binary key
+// (session, src, dst, tag) without ever deserializing them (the Python
+// fallback server in raft_tpu/comms/hostcomm.py implements the same
+// protocol on daemon threads).
+//
+// Design: one poll(2) loop per server on its own thread, non-blocking
+// sockets, one in-flight request per connection (the client RPCs
+// serially).  Blocking GETs register a waiter with a deadline; PUTs serve
+// the oldest live waiter before boxing.  A self-pipe wakes the loop for
+// shutdown.
+//
+// Frame (client -> server), all integers big-endian:
+//   u32 total_len (bytes after this field)
+//   u8  op                1=put, 2=get
+//   u16 session_len, session bytes
+//   i64 src, i64 dst, i64 tag
+//   f64 timeout_secs      (get only; ignored for put)
+//   payload bytes         (put only)
+// Reply (server -> client):
+//   u32 total_len, u8 status (1=ok, 0=timeout/error), payload bytes
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <atomic>
+#include <cstring>
+#include <ctime>
+#include <deque>
+#include <fcntl.h>
+#include <mutex>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+double now_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return double(ts.tv_sec) + double(ts.tv_nsec) * 1e-9;
+}
+
+uint64_t be64(const uint8_t* p) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; ++i) v = (v << 8) | p[i];
+  return v;
+}
+
+struct Conn {
+  int fd = -1;
+  std::vector<uint8_t> rx;     // accumulated request bytes
+  std::vector<uint8_t> tx;     // queued reply bytes awaiting POLLOUT
+  size_t tx_off = 0;
+  bool waiting = false;        // blocked in a GET
+  std::string wait_key;
+  double deadline = 0.0;
+};
+
+constexpr size_t kFrameCap = 64u << 20;  // per-frame and per-conn TX cap
+
+// Fully non-blocking send: whatever the kernel buffer refuses is queued on
+// the connection and drained under POLLOUT by the event loop — a stalled
+// peer NEVER blocks the loop thread (its own replies just queue; the
+// connection is dropped if the backlog passes kFrameCap).
+bool flush_tx(Conn& c) {
+  while (c.tx_off < c.tx.size()) {
+    ssize_t w = ::send(c.fd, c.tx.data() + c.tx_off, c.tx.size() - c.tx_off,
+                       MSG_NOSIGNAL);
+    if (w > 0) {
+      c.tx_off += size_t(w);
+      continue;
+    }
+    if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+    if (w < 0 && errno == EINTR) continue;
+    return false;
+  }
+  if (c.tx_off == c.tx.size()) {
+    c.tx.clear();
+    c.tx_off = 0;
+  } else if (c.tx_off > (1u << 20)) {  // compact a drained prefix
+    c.tx.erase(c.tx.begin(), c.tx.begin() + long(c.tx_off));
+    c.tx_off = 0;
+  }
+  return true;
+}
+
+bool send_reply(Conn& c, uint8_t status, const uint8_t* payload, size_t n) {
+  if (n > kFrameCap || c.tx.size() - c.tx_off > kFrameCap) return false;
+  uint32_t total = htonl(uint32_t(1 + n));
+  const uint8_t* tp = reinterpret_cast<const uint8_t*>(&total);
+  c.tx.insert(c.tx.end(), tp, tp + 4);
+  c.tx.push_back(status);
+  if (n) c.tx.insert(c.tx.end(), payload, payload + n);
+  return flush_tx(c);
+}
+
+struct Server {
+  int listen_fd = -1;
+  int wake_r = -1, wake_w = -1;  // self-pipe
+  int port = 0;
+  std::thread thread;
+  std::atomic<bool> stop_flag{false};
+
+  std::unordered_map<int, Conn> conns;
+  std::unordered_map<std::string, std::deque<std::string>> boxes;
+  // waiters in arrival order per key (fds; Conn holds deadline)
+  std::unordered_map<std::string, std::deque<int>> waiters;
+
+  void drop_conn(int fd) {
+    auto it = conns.find(fd);
+    if (it != conns.end()) {
+      if (it->second.waiting) {
+        auto w = waiters.find(it->second.wait_key);
+        if (w != waiters.end()) {
+          auto& dq = w->second;
+          for (auto q = dq.begin(); q != dq.end(); ++q)
+            if (*q == fd) { dq.erase(q); break; }
+          if (dq.empty()) waiters.erase(w);
+        }
+      }
+      conns.erase(it);
+    }
+    ::close(fd);
+  }
+
+  // Returns false if the connection must be dropped.
+  bool handle_frame(Conn& c, const uint8_t* f, size_t n) {
+    if (n < 1 + 2) return false;
+    uint8_t op = f[0];
+    uint16_t slen = uint16_t((f[1] << 8) | f[2]);
+    size_t key_end = size_t(3) + slen + 24;
+    if (n < key_end + 8) return false;
+    // binary key: session bytes + src/dst/tag (already big-endian on wire)
+    std::string key(reinterpret_cast<const char*>(f + 3), slen + 24);
+    const uint8_t* after_key = f + key_end;
+    uint64_t tbits = be64(after_key);
+    double timeout;
+    std::memcpy(&timeout, &tbits, 8);
+    const uint8_t* payload = after_key + 8;
+    size_t payload_n = n - key_end - 8;
+
+    if (op == 1) {  // PUT
+      // serve the oldest still-connected waiter first
+      auto w = waiters.find(key);
+      while (w != waiters.end() && !w->second.empty()) {
+        int wfd = w->second.front();
+        w->second.pop_front();
+        if (w->second.empty()) waiters.erase(w);
+        auto ci = conns.find(wfd);
+        if (ci == conns.end() || !ci->second.waiting) {
+          w = waiters.find(key);
+          continue;  // stale entry
+        }
+        ci->second.waiting = false;
+        if (!send_reply(ci->second, 1, payload, payload_n)) drop_conn(wfd);
+        return send_reply(c, 1, nullptr, 0);
+      }
+      boxes[key].emplace_back(reinterpret_cast<const char*>(payload),
+                              payload_n);
+      return send_reply(c, 1, nullptr, 0);
+    }
+    if (op == 2) {  // GET
+      auto b = boxes.find(key);
+      if (b != boxes.end() && !b->second.empty()) {
+        std::string msg = std::move(b->second.front());
+        b->second.pop_front();
+        if (b->second.empty()) boxes.erase(b);
+        return send_reply(c, 1,
+                          reinterpret_cast<const uint8_t*>(msg.data()),
+                          msg.size());
+      }
+      c.waiting = true;
+      c.wait_key = key;
+      c.deadline = now_s() + (timeout > 0 ? timeout : 0);
+      waiters[key].push_back(c.fd);
+      return true;  // reply deferred
+    }
+    return send_reply(c, 0, reinterpret_cast<const uint8_t*>("bad op"), 6);
+  }
+
+  void expire_waiters() {
+    double t = now_s();
+    std::vector<int> expired;
+    for (auto& kv : conns)
+      if (kv.second.waiting && kv.second.deadline <= t)
+        expired.push_back(kv.first);
+    for (int fd : expired) {
+      auto& c = conns[fd];
+      c.waiting = false;
+      auto w = waiters.find(c.wait_key);
+      if (w != waiters.end()) {
+        auto& dq = w->second;
+        for (auto q = dq.begin(); q != dq.end(); ++q)
+          if (*q == fd) { dq.erase(q); break; }
+        if (dq.empty()) waiters.erase(w);
+      }
+      if (!send_reply(c, 0, reinterpret_cast<const uint8_t*>("timeout"), 7))
+        drop_conn(fd);
+    }
+  }
+
+  int next_poll_ms() {
+    double t = now_s(), best = 1e18;
+    for (auto& kv : conns)
+      if (kv.second.waiting && kv.second.deadline < best)
+        best = kv.second.deadline;
+    if (best > 1e17) return 1000;
+    double ms = (best - t) * 1000.0;
+    if (ms < 0) return 0;
+    if (ms > 1000) return 1000;
+    return int(ms) + 1;
+  }
+
+  void loop() {
+    while (!stop_flag) {
+      std::vector<struct pollfd> pfds;
+      pfds.push_back({listen_fd, POLLIN, 0});
+      pfds.push_back({wake_r, POLLIN, 0});
+      for (auto& kv : conns) {
+        short ev = 0;
+        if (!kv.second.waiting) ev |= POLLIN;
+        if (!kv.second.tx.empty()) ev |= POLLOUT;
+        if (ev) pfds.push_back({kv.first, ev, 0});
+      }
+      int rc = ::poll(pfds.data(), nfds_t(pfds.size()), next_poll_ms());
+      if (rc < 0 && errno != EINTR) break;
+      expire_waiters();
+      if (rc <= 0) continue;
+      for (auto& pf : pfds) {
+        if (!pf.revents) continue;
+        if (pf.fd == wake_r) {
+          char buf[64];
+          while (::read(wake_r, buf, sizeof buf) > 0) {}
+          continue;
+        }
+        if (pf.fd == listen_fd) {
+          for (;;) {
+            int cfd = ::accept(listen_fd, nullptr, nullptr);
+            if (cfd < 0) break;
+            int fl = fcntl(cfd, F_GETFL, 0);
+            fcntl(cfd, F_SETFL, fl | O_NONBLOCK);
+            int one = 1;
+            setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+            conns[cfd] = Conn{};
+            conns[cfd].fd = cfd;
+          }
+          continue;
+        }
+        auto ci = conns.find(pf.fd);
+        if (ci == conns.end()) continue;
+        Conn& c = ci->second;
+        if ((pf.revents & POLLOUT) && !flush_tx(c)) {
+          drop_conn(pf.fd);
+          continue;
+        }
+        if (!(pf.revents & (POLLIN | POLLHUP | POLLERR))) continue;
+        uint8_t buf[65536];
+        bool dead = false;
+        for (;;) {
+          ssize_t r = ::recv(pf.fd, buf, sizeof buf, 0);
+          if (r > 0) {
+            c.rx.insert(c.rx.end(), buf, buf + r);
+            continue;
+          }
+          if (r == 0) { dead = true; }
+          else if (errno == EAGAIN || errno == EWOULDBLOCK) {}
+          else if (errno == EINTR) continue;
+          else dead = true;
+          break;
+        }
+        // parse complete frames
+        bool drop = dead;
+        while (!drop && c.rx.size() >= 4) {
+          uint32_t need;
+          std::memcpy(&need, c.rx.data(), 4);
+          need = ntohl(need);
+          if (need > kFrameCap) {
+            const char* e = "frame exceeds 64 MB mailbox cap";
+            send_reply(c, 0, reinterpret_cast<const uint8_t*>(e),
+                       std::strlen(e));
+            drop = true;
+            break;
+          }
+          if (c.rx.size() < 4 + size_t(need)) break;
+          if (!handle_frame(c, c.rx.data() + 4, need)) drop = true;
+          c.rx.erase(c.rx.begin(), c.rx.begin() + 4 + need);
+        }
+        if (drop) drop_conn(pf.fd);
+      }
+    }
+    // teardown
+    std::vector<int> fds;
+    for (auto& kv : conns) fds.push_back(kv.first);
+    for (int fd : fds) ::close(fd);
+    conns.clear();
+    ::close(listen_fd);
+    ::close(wake_r);
+    ::close(wake_w);
+  }
+};
+
+std::mutex g_servers_mu;
+std::unordered_map<long long, Server*> g_servers;
+long long g_next_id = 1;
+
+}  // namespace
+
+extern "C" {
+
+// Start a mailbox server on host:port (port 0 = ephemeral).  Returns a
+// handle >= 1 and writes the bound port to *port_out, or returns -1.
+long long rt_mailbox_server_start(const char* host, int port, int* port_out) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in addr;
+  std::memset(&addr, 0, sizeof addr);
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  if (::inet_pton(AF_INET, host && *host ? host : "127.0.0.1",
+                  &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  if (::bind(fd, reinterpret_cast<struct sockaddr*>(&addr), sizeof addr) < 0 ||
+      ::listen(fd, 128) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  socklen_t alen = sizeof addr;
+  ::getsockname(fd, reinterpret_cast<struct sockaddr*>(&addr), &alen);
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+
+  int pipefd[2];
+  if (::pipe(pipefd) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  fcntl(pipefd[0], F_SETFL, fcntl(pipefd[0], F_GETFL, 0) | O_NONBLOCK);
+
+  auto* s = new Server();
+  s->listen_fd = fd;
+  s->wake_r = pipefd[0];
+  s->wake_w = pipefd[1];
+  s->port = int(ntohs(addr.sin_port));
+  if (port_out) *port_out = s->port;
+  s->thread = std::thread([s] { s->loop(); });
+
+  std::lock_guard<std::mutex> g(g_servers_mu);
+  long long id = g_next_id++;
+  g_servers[id] = s;
+  return id;
+}
+
+int rt_mailbox_server_stop(long long handle) {
+  Server* s = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_servers_mu);
+    auto it = g_servers.find(handle);
+    if (it == g_servers.end()) return -1;
+    s = it->second;
+    g_servers.erase(it);
+  }
+  s->stop_flag = true;
+  char b = 1;
+  ssize_t ignored = ::write(s->wake_w, &b, 1);
+  (void)ignored;
+  s->thread.join();
+  delete s;
+  return 0;
+}
+
+}  // extern "C"

@@ -10,13 +10,26 @@ framework: one process (conventionally host rank 0) runs
 :class:`MailboxServer`; every process — including rank 0 — talks to it
 with :class:`TcpMailbox`.
 
-Wire format: 4-byte big-endian length + pickle.  Trust model matches the
-reference's UCX plane: a private cluster interconnect — do not expose the
-port beyond it (pickle deserializes arbitrary objects).
+Two server backends behind one class, preferring the native one
+(the reference's host plane is native ucp for the same reason):
 
-``Comms`` uses a :class:`TcpMailbox` instead of the process-local queues
-when built with ``coordinator="host:port"`` (or RAFT_TPU_COORD_ADDR); see
-``comms.py``.
+- **native** (``native/hostcomm_server.cpp``): a GIL-free poll(2) loop on
+  its own C++ thread routing opaque payload bytes by binary key — the
+  coordinator keeps serving while this process's Python is busy tracing
+  or blocked in a device sync.
+- **python** (:class:`_PyMailboxServer`): threaded stdlib fallback when
+  the toolchain/.so is unavailable.  ``RAFT_TPU_NATIVE_MAILBOX=0`` forces
+  it.
+
+Wire protocol (both backends, all integers big-endian)::
+
+    request:  u32 len | u8 op (1=put, 2=get) | u16 session_len | session
+              | i64 src | i64 dst | i64 tag | f64 timeout_s | payload
+    reply:    u32 len | u8 status (1=ok, 0=timeout/error) | payload
+
+The SERVER never deserializes payloads (it routes bytes); clients pickle/
+unpickle them.  Trust model matches the reference's UCX plane: a private
+cluster interconnect — do not expose the port beyond it.
 """
 
 from __future__ import annotations
@@ -33,11 +46,18 @@ from typing import Any, Dict, Optional, Tuple
 from raft_tpu.core.error import LogicError
 
 _LEN = struct.Struct(">I")
+_OP_PUT, _OP_GET = 1, 2
+_REQ_HEAD = struct.Struct(">BH")      # op, session_len
+_KEY_TAIL = struct.Struct(">qqq")     # src, dst, tag
+_TIMEOUT = struct.Struct(">d")
 
 
-def _send_msg(sock: socket.socket, obj: Any) -> None:
-    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_LEN.pack(len(data)) + data)
+def _encode_req(op: int, session_b: bytes, src: int, dst: int, tag: int,
+                timeout: float, payload: bytes = b"") -> bytes:
+    body = (_REQ_HEAD.pack(op, len(session_b)) + session_b
+            + _KEY_TAIL.pack(src, dst, tag) + _TIMEOUT.pack(timeout)
+            + payload)
+    return _LEN.pack(len(body)) + body
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -50,25 +70,21 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
-def _recv_msg(sock: socket.socket) -> Any:
+def _recv_reply(sock: socket.socket) -> Tuple[bool, bytes]:
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    return pickle.loads(_recv_exact(sock, n))
+    body = _recv_exact(sock, n)
+    return body[0] == 1, body[1:]
 
 
-class MailboxServer:
-    """Threaded TCP mailbox: PUT appends to a keyed queue, GET blocks until
-    a message for the key arrives (or times out).
+class _PyMailboxServer:
+    """Threaded stdlib fallback server speaking the binary protocol."""
 
-    Runs in-process on daemon threads; ``address`` reports the bound
-    (host, port) so callers can pass it to workers (port 0 → ephemeral).
-    """
-
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str, port: int):
         # key → [Queue, waiter_count].  Puts happen under the lock (Queue.put
         # never blocks) so a drained box can be reaped exactly when it is
         # empty AND unwaited — long-lived coordinators must not accumulate
         # one dead dict entry per (session, src, dst, tag) ever used.
-        boxes: Dict[Tuple, list] = {}
+        boxes: Dict[bytes, list] = {}
         lock = threading.Lock()
 
         def put(key, payload):
@@ -88,27 +104,34 @@ class MailboxServer:
                     if entry[1] == 0 and entry[0].empty():
                         boxes.pop(key, None)
 
+        def reply(sock, ok: bool, payload: bytes = b"") -> None:
+            body = (b"\x01" if ok else b"\x00") + payload
+            sock.sendall(_LEN.pack(len(body)) + body)
+
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 try:
                     while True:
-                        msg = _recv_msg(self.request)
-                        op = msg["op"]
-                        if op == "put":
-                            put(msg["key"], msg["payload"])
-                            _send_msg(self.request, {"ok": True})
-                        elif op == "get":
+                        (n,) = _LEN.unpack(
+                            _recv_exact(self.request, _LEN.size))
+                        f = _recv_exact(self.request, n)
+                        op, slen = _REQ_HEAD.unpack_from(f, 0)
+                        key_end = _REQ_HEAD.size + slen + _KEY_TAIL.size
+                        key = f[_REQ_HEAD.size:key_end]
+                        (timeout,) = _TIMEOUT.unpack_from(f, key_end)
+                        payload = f[key_end + _TIMEOUT.size:]
+                        if op == _OP_PUT:
+                            put(key, payload)
+                            reply(self.request, True)
+                        elif op == _OP_GET:
                             try:
-                                payload = get(msg["key"], msg["timeout"])
-                                _send_msg(self.request,
-                                          {"ok": True, "payload": payload})
+                                got = get(key, timeout)
+                                reply(self.request, True, got)
                             except queue.Empty:
-                                _send_msg(self.request,
-                                          {"ok": False, "error": "timeout"})
+                                reply(self.request, False, b"timeout")
                         else:
-                            _send_msg(self.request,
-                                      {"ok": False, "error": f"bad op {op}"})
-                except (ConnectionError, EOFError, OSError):
+                            reply(self.request, False, b"bad op")
+                except (ConnectionError, EOFError, OSError, struct.error):
                     return
 
         class Server(socketserver.ThreadingTCPServer):
@@ -126,6 +149,42 @@ class MailboxServer:
         self._server.shutdown()
         self._server.server_close()
 
+
+class MailboxServer:
+    """TCP mailbox coordinator: PUT appends to a keyed queue, GET blocks
+    until a message for the key arrives (or times out).
+
+    ``address`` reports the bound (host, port) so callers can pass it to
+    workers (port 0 → ephemeral).  ``backend`` is "native" (C++ poll loop,
+    preferred) or "python" (threaded fallback).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._native_handle = None
+        self._py: Optional[_PyMailboxServer] = None
+        self.backend = "python"
+        if os.environ.get("RAFT_TPU_NATIVE_MAILBOX", "1") != "0":
+            from raft_tpu import native
+
+            started = native.mailbox_server_start(host, port)
+            if started is not None:
+                self._native_handle, bound = started
+                self.address = (host, bound)
+                self.backend = "native"
+                return
+        self._py = _PyMailboxServer(host, port)
+        self.address = self._py.address
+
+    def close(self) -> None:
+        if self._native_handle is not None:
+            from raft_tpu import native
+
+            native.mailbox_server_stop(self._native_handle)
+            self._native_handle = None
+        if self._py is not None:
+            self._py.close()
+            self._py = None
+
     def __enter__(self):
         return self
 
@@ -139,8 +198,9 @@ class TcpMailbox:
     endpoint (ucp_helper.hpp's send/recv handles).
 
     One persistent connection per thread (the server handles each
-    connection on its own thread, so a blocking GET does not stall PUTs
-    from other processes).
+    connection independently, so a blocking GET does not stall PUTs from
+    other processes).  Payloads are pickled client-side; the server routes
+    opaque bytes.
     """
 
     def __init__(self, coordinator: str, session_id: str, rank: int,
@@ -148,6 +208,7 @@ class TcpMailbox:
         host, _, port = coordinator.rpartition(":")
         self._addr = (host or "127.0.0.1", int(port))
         self.session_id = session_id
+        self._session_b = session_id.encode()
         self.rank = rank
         self._local = threading.local()
         self._connect_timeout = connect_timeout
@@ -157,10 +218,11 @@ class TcpMailbox:
         if s is None:
             s = socket.create_connection(self._addr,
                                          timeout=self._connect_timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._local.sock = s
         return s
 
-    def _rpc(self, msg: dict, timeout: float) -> dict:
+    def _rpc(self, req: bytes, timeout: float) -> Tuple[bool, bytes]:
         # The deadline is enforced client-side too (a dead coordinator or a
         # partition without FIN must not hang the clique past the timeout
         # contract); +5s margin lets the server's own queue timeout answer
@@ -168,8 +230,8 @@ class TcpMailbox:
         s = self._sock()
         s.settimeout(timeout + 5.0)
         try:
-            _send_msg(s, msg)
-            return _recv_msg(s)
+            s.sendall(req)
+            return _recv_reply(s)
         except socket.timeout:
             # connection state is now ambiguous (a late reply would
             # desynchronize the framing) — drop it
@@ -184,20 +246,22 @@ class TcpMailbox:
             raise
 
     def put(self, dst: int, tag: int, obj: Any, timeout: float = 60.0) -> None:
-        key = (self.session_id, self.rank, dst, tag)
-        resp = self._rpc({"op": "put", "key": key, "payload": obj}, timeout)
-        if not resp.get("ok"):
-            raise LogicError(f"mailbox put failed: {resp.get('error')}")
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        req = _encode_req(_OP_PUT, self._session_b, self.rank, dst, tag,
+                          timeout, payload)
+        ok, err = self._rpc(req, timeout)
+        if not ok:
+            raise LogicError(f"mailbox put failed: {err.decode(errors='replace')}")
 
     def get(self, src: int, tag: int, timeout: float = 60.0) -> Any:
-        key = (self.session_id, src, self.rank, tag)
-        resp = self._rpc({"op": "get", "key": key, "timeout": timeout},
-                         timeout)
-        if not resp.get("ok"):
+        req = _encode_req(_OP_GET, self._session_b, src, self.rank, tag,
+                          timeout)
+        ok, payload = self._rpc(req, timeout)
+        if not ok:
             raise TimeoutError(
                 f"mailbox get timed out: src={src} tag={tag} "
                 f"session={self.session_id}")
-        return resp["payload"]
+        return pickle.loads(payload)
 
     def close(self) -> None:
         s = getattr(self._local, "sock", None)

@@ -24,18 +24,21 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
+_SOURCES = ("raft_runtime.cpp", "hostcomm_server.cpp")
+
+
 def _build(force: bool = False) -> Optional[Path]:
-    src = _NATIVE_DIR / "raft_runtime.cpp"
+    srcs = [_NATIVE_DIR / s for s in _SOURCES if (_NATIVE_DIR / s).exists()]
     out = _NATIVE_DIR / _LIB_NAME
-    if not src.exists():
+    if not srcs:
         return None
     if not force and out.exists() \
-            and out.stat().st_mtime >= src.stat().st_mtime:
+            and out.stat().st_mtime >= max(s.stat().st_mtime for s in srcs):
         return out
     try:
         subprocess.run(
             ["g++", "-O3", "-std=c++17", "-fPIC", "-shared",
-             "-o", str(out), str(src)],
+             "-o", str(out)] + [str(s) for s in srcs] + ["-lpthread"],
             check=True, capture_output=True, timeout=120)
         return out
     except Exception:
@@ -69,6 +72,11 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.POINTER(ctypes.c_int32), ctypes.c_char_p,
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
         ctypes.c_char_p]
+    lib.rt_mailbox_server_start.restype = ctypes.c_longlong
+    lib.rt_mailbox_server_start.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+    lib.rt_mailbox_server_stop.restype = ctypes.c_int
+    lib.rt_mailbox_server_stop.argtypes = [ctypes.c_longlong]
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -213,3 +221,25 @@ def csr_to_ell_host(indptr, indices, data, r: int
     if rc != 0:
         raise ValueError("csr_to_ell_host: malformed indptr")
     return ell_cols, ell_vals, ov_rows, ov_cols, ov_vals
+
+
+def mailbox_server_start(host: str = "127.0.0.1", port: int = 0
+                         ) -> Optional[Tuple[int, int]]:
+    """Start the native poll-loop mailbox server (native/hostcomm_server.cpp
+    — the UCX-role native host p2p plane).  Returns (handle, bound_port),
+    or None when the native runtime is unavailable (callers keep the
+    threaded Python server)."""
+    lib = _load()
+    if lib is None:
+        return None
+    port_out = ctypes.c_int(0)
+    h = lib.rt_mailbox_server_start(host.encode(), int(port),
+                                    ctypes.byref(port_out))
+    if h < 0:
+        return None
+    return int(h), int(port_out.value)
+
+
+def mailbox_server_stop(handle: int) -> bool:
+    lib = _load()
+    return lib is not None and lib.rt_mailbox_server_stop(int(handle)) == 0
