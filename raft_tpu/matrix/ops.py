@@ -53,6 +53,7 @@ def diagonal(mat):
 
 def set_diagonal(mat, vec):
     """Set the main diagonal (reference ``set_diagonal``)."""
+    mat = jnp.asarray(mat)  # numpy inputs lack .at, like every other op here
     n = min(mat.shape)
     vec = jnp.asarray(vec, mat.dtype)
     return mat.at[jnp.arange(n), jnp.arange(n)].set(vec[:n])
@@ -60,6 +61,7 @@ def set_diagonal(mat, vec):
 
 def matrix_diagonal_inverse(mat):
     """Invert diagonal entries in place (reference ``invert_diagonal``)."""
+    mat = jnp.asarray(mat)
     n = min(mat.shape)
     idx = jnp.arange(n)
     return mat.at[idx, idx].set(1.0 / mat[idx, idx])

@@ -102,3 +102,61 @@ def test_init_and_print(capsys):
     np.testing.assert_array_equal(matrix.fill((2, 2), 7.0), np.full((2, 2), 7.0, np.float32))
     text = matrix.print_matrix(np.array([[1.0, 2.0]]), name="m")
     assert "1 2" in text
+
+
+class TestOpsOracleSweep:
+    """Numpy-oracle sweep over the remaining ops surface (reference
+    matrix tests parameterize sizes/dtypes the same way,
+    test/matrix/*.cu)."""
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    @pytest.mark.parametrize("shape", [(7, 5), (64, 33), (1, 9)])
+    def test_gather_if_matches_masked_gather(self, dtype, shape):
+        from raft_tpu.matrix import ops
+
+        rng = np.random.default_rng(shape[0])
+        m = rng.normal(0, 1, shape).astype(dtype)
+        idx = rng.integers(0, shape[0], 5)
+        stencil = rng.normal(0, 1, 5).astype(dtype)
+        out = np.asarray(ops.gather_if(m, idx, stencil,
+                                       lambda s: s > 0, fallback=-1.0))
+        exp = np.where((stencil > 0)[:, None], m[idx], -1.0)
+        np.testing.assert_allclose(out, exp.astype(dtype))
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    def test_truncate_eye_fill_sqnorm(self, dtype):
+        from raft_tpu.matrix import ops
+
+        rng = np.random.default_rng(1)
+        m = rng.normal(0, 1, (10, 6)).astype(dtype)
+        np.testing.assert_allclose(np.asarray(ops.truncate_rows(m, 4)), m[:4])
+        np.testing.assert_allclose(np.asarray(ops.eye(3, 5, dtype)),
+                                   np.eye(3, 5, dtype=dtype))
+        np.testing.assert_allclose(np.asarray(ops.fill((2, 3), 7.0, dtype)),
+                                   np.full((2, 3), 7.0, dtype))
+        np.testing.assert_allclose(float(ops.sq_norm(m)), (m * m).sum(),
+                                   rtol=1e-5)
+
+    def test_set_diagonal_and_inverse(self):
+        from raft_tpu.matrix import ops
+
+        rng = np.random.default_rng(2)
+        m = rng.normal(0, 1, (5, 5)).astype(np.float32)
+        v = np.arange(1.0, 6.0, dtype=np.float32)
+        out = np.asarray(ops.set_diagonal(m, v))
+        np.testing.assert_allclose(np.diag(out), v)
+        inv = np.asarray(ops.matrix_diagonal_inverse(np.diag(v)))
+        np.testing.assert_allclose(np.diag(inv), 1.0 / v, rtol=1e-6)
+
+    def test_seq_root_ratio_weighted(self):
+        from raft_tpu.matrix import ops
+
+        rng = np.random.default_rng(3)
+        m = np.abs(rng.normal(0, 1, (4, 6))).astype(np.float32) + 0.1
+        np.testing.assert_allclose(np.asarray(ops.seq_root(m)), np.sqrt(m),
+                                   rtol=1e-6)
+        r = np.asarray(ops.ratio(m))
+        np.testing.assert_allclose(r, m / m.sum(), rtol=1e-5)
+        w = np.abs(rng.normal(0, 1, m.shape)).astype(np.float32)  # elementwise
+        wr = np.asarray(ops.weighted_ratio(m, w))
+        np.testing.assert_allclose(wr, m / (m * w).sum(), rtol=1e-5)

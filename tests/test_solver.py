@@ -78,3 +78,57 @@ def test_lap_diag_structure():
     res = solve_lap(cost, epsilon=1e-6)
     assert np.array_equal(np.array(res.row_assignment), np.arange(n))
     assert float(res.objective) == 0.0
+
+
+@pytest.mark.parametrize("n,seed", [(12, 5), (25, 6), (40, 7)])
+def test_lap_vs_scipy_oracle(n, seed):
+    """Optimality vs scipy.optimize.linear_sum_assignment across sizes
+    (the reference validates LAP against brute-force/known-optimal costs,
+    test/linear_assignment.cu)."""
+    from scipy.optimize import linear_sum_assignment
+
+    from raft_tpu.solver import solve_lap
+
+    rng = np.random.default_rng(seed)
+    cost = rng.uniform(0, 100, (n, n)).astype(np.float32)
+    res = solve_lap(cost)
+    rows = np.asarray(res.row_assignment)
+    ri, ci = linear_sum_assignment(cost)
+    opt = cost[ri, ci].sum()
+    got = cost[np.arange(n), rows].sum()
+    assert sorted(rows.tolist()) == list(range(n))  # a permutation
+    assert got <= opt + 1e-2 * n  # epsilon-optimal within the eps bound
+
+
+def test_lap_batched_vs_scipy():
+    from scipy.optimize import linear_sum_assignment
+
+    from raft_tpu.solver import solve_lap
+
+    rng = np.random.default_rng(8)
+    costs = rng.uniform(0, 50, (4, 16, 16)).astype(np.float32)
+    res = solve_lap(costs)
+    rows = np.asarray(res.row_assignment)
+    for b in range(4):
+        ri, ci = linear_sum_assignment(costs[b])
+        opt = costs[b][ri, ci].sum()
+        got = costs[b][np.arange(16), rows[b]].sum()
+        assert got <= opt + 1e-2 * 16
+
+
+def test_lap_adversarial_near_ties():
+    """Costs with many near-ties (the auction's hard case: tiny bid
+    increments) must still produce a valid epsilon-optimal permutation."""
+    from scipy.optimize import linear_sum_assignment
+
+    from raft_tpu.solver import solve_lap
+
+    rng = np.random.default_rng(9)
+    n = 20
+    base = rng.uniform(0, 1, (n, 1)).astype(np.float32)
+    cost = (base + rng.uniform(0, 1e-3, (n, n))).astype(np.float32)
+    res = solve_lap(cost, epsilon=1e-7)
+    rows = np.asarray(res.row_assignment)
+    assert sorted(rows.tolist()) == list(range(n))
+    ri, ci = linear_sum_assignment(cost)
+    assert cost[np.arange(n), rows].sum() <= cost[ri, ci].sum() + 1e-3
