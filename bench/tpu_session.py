@@ -105,6 +105,8 @@ def kmeans_sweep():
     x = jax.device_put(rng.random((100_000, 128), dtype=np.float32))
     c = jax.device_put(rng.random((1024, 128), dtype=np.float32))
 
+    results = []
+
     def run_one(tag, **mcad_kw):
         def em(cc):
             nn = min_cluster_and_distance(x, cc, **mcad_kw)
@@ -115,6 +117,7 @@ def kmeans_sweep():
         try:
             # chained: each timed step consumes the previous centroids
             best = timed_chained(emj, c, lambda cc, out: out, iters=8)
+            results.append((dict(tag), 1.0 / best))
             emit({"stage": "kmeans_sweep", "iter_s": round(1.0 / best, 1),
                   **tag})
         except Exception as e:  # noqa: BLE001 - record and continue
@@ -129,6 +132,26 @@ def kmeans_sweep():
         for prec in ("high", "default"):
             run_one({"batch_samples": bs, "precision": prec},
                     batch_samples=bs, precision=prec)
+
+    # One-glance A/B verdict (VERDICT r2 #6: "decide the Pallas E-step"):
+    # compare like-for-like precision="high" rows.  >10% either way is a
+    # decision; within 10% favors the XLA default (simpler, no env knob).
+    pallas = [r for t, r in results
+              if t.get("engine") == "pallas" and t.get("precision") == "high"]
+    xla = [r for t, r in results
+           if "batch_samples" in t and t.get("precision") == "high"]
+    if pallas and xla:
+        ratio = max(pallas) / max(xla)
+        if ratio > 1.10:
+            rec = "flip default to pallas"
+        elif ratio < 0.90:
+            rec = "keep xla default; delete the pallas knob"
+        else:
+            rec = "parity: keep xla default, document the knob"
+        emit({"stage": "pallas_verdict",
+              "pallas_high_iter_s": round(max(pallas), 1),
+              "xla_best_high_iter_s": round(max(xla), 1),
+              "ratio": round(ratio, 3), "recommendation": rec})
 
 
 def ivf_pq_stages():
