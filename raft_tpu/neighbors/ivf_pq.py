@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from raft_tpu.core.aot import aot, aot_dispatchable
+from raft_tpu.core.aot import _bucket_dim, aot, aot_dispatchable
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.core.logger import traced
@@ -616,6 +616,15 @@ def search(params: SearchParams, index: Index, queries, k: int,
     for bi, q0 in enumerate(range(0, q.shape[0], batch_size_query)):
         q1 = min(q0 + batch_size_query, q.shape[0])
         qb = q[q0:q1]
+        # Shape-bucket the ragged tail batch (pad queries up to the next
+        # power of two, slice results): serving workloads with varying
+        # query counts would otherwise lower+compile one executable per
+        # distinct residue — 20-40 s each on TPU.  Padding costs at most
+        # 2× compute on the tail batch only.
+        n_valid = qb.shape[0]
+        bucket = min(_bucket_dim(n_valid), batch_size_query)
+        if bucket != n_valid:
+            qb = jnp.pad(qb, ((0, bucket - n_valid), (0, 0)))
         if is_ip:
             coarse = -(qb @ index.centers.T)
         else:
@@ -631,6 +640,8 @@ def search(params: SearchParams, index: Index, queries, k: int,
                         params.lut_dtype,
                         params.internal_distance_dtype,
                         index.pq_bits)
+        if n_valid != qb.shape[0]:
+            d, i = d[:n_valid], i[:n_valid]
         if pool:
             handle.get_next_usable_stream(bi).record((d, i))
         out_d.append(d)

@@ -335,3 +335,22 @@ def test_ivf_pq_pca_rotation_requires_divisible_dim():
     with pytest.raises(RaftError, match="pca_balanced"):
         ivf_pq.build(ivf_pq.IndexParams(n_lists=8, pq_dim=8,
                                         rotation_kind="pca_balanced"), x)
+
+
+def test_ivf_pq_search_tail_bucketing_bounds_executables():
+    """Varying query counts must not compile one executable per distinct
+    ragged tail: tails are padded to the next power of two, results
+    sliced (a serving-path compile-storm guard)."""
+    from raft_tpu.neighbors.ivf_pq import _search_batch_aot
+
+    x, q = make_data(n=1500, dim=32, n_queries=80)
+    idx = build(IndexParams(n_lists=16, pq_bits=8, pq_dim=8, seed=3), x)
+    ref_d, ref_i = search(SearchParams(n_probes=8), idx, q[:70], 5,
+                          batch_size_query=64)
+    n0 = _search_batch_aot.cache_size
+    for nq in (69, 67, 66):  # tails 5, 3, 2 -> all bucket to 8
+        d, i = search(SearchParams(n_probes=8), idx, q[:nq], 5,
+                      batch_size_query=64)
+        assert np.asarray(d).shape == (nq, 5)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(ref_i)[:nq])
+    assert _search_batch_aot.cache_size <= n0 + 1  # one bucketed tail exe
