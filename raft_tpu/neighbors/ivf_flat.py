@@ -31,6 +31,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core.aot import _bucket_dim
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.core.logger import traced
@@ -308,11 +309,19 @@ def search(params: SearchParams, index: Index, queries, k: int,
     for q0 in range(0, qf.shape[0], batch_size_query):
         q1 = min(q0 + batch_size_query, qf.shape[0])
         qb = qf[q0:q1]
+        # Bucket the ragged tail batch (pad + slice, see ivf_pq.search):
+        # varying query counts must not compile per distinct residue.
+        n_valid = qb.shape[0]
+        bucket = min(_bucket_dim(n_valid), batch_size_query)
+        if bucket != n_valid:
+            qb = jnp.pad(qb, ((0, bucket - n_valid), (0, 0)))
         # coarse ranking against centroids (reference :1120 linalg::gemm)
         cd = _coarse_distances(qb, index.centers, index.metric)
         _, probes = select_k(cd, n_probes, select_min=True)
         d, i = _scan_probes(qb, probes.astype(jnp.int32), leaves,
                             int(index.metric), int(k), sqrt)
+        if n_valid != qb.shape[0]:
+            d, i = d[:n_valid], i[:n_valid]
         out_d.append(d)
         out_i.append(i)
     d = out_d[0] if len(out_d) == 1 else jnp.concatenate(out_d, axis=0)

@@ -176,3 +176,21 @@ def test_ivf_flat_sequential_extends_with_ids():
     d, i = search(SearchParams(n_probes=16), idx, b[:25], 1)
     np.testing.assert_array_equal(np.asarray(i)[:, 0], ids_b[:25])
     np.testing.assert_allclose(np.asarray(d)[:, 0], 0.0, atol=1e-4)
+
+
+def test_ivf_flat_search_tail_bucketing():
+    """Ragged tail batches pad to a power of two and slice results — same
+    serving-path compile-storm guard as ivf_pq.search."""
+    from raft_tpu.neighbors import ivf_flat
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (2000, 16)).astype(np.float32)
+    q = rng.normal(0, 1, (80, 16)).astype(np.float32)
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=16), x)
+    ref_d, ref_i = ivf_flat.search(ivf_flat.SearchParams(n_probes=8),
+                                   idx, q[:70], 5, batch_size_query=64)
+    for nq in (69, 67, 66):
+        d, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=8),
+                               idx, q[:nq], 5, batch_size_query=64)
+        assert np.asarray(d).shape == (nq, 5)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(ref_i)[:nq])
