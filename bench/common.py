@@ -21,6 +21,49 @@ from typing import Callable, Dict, List, Tuple
 
 _REGISTRY: List[Tuple[str, Callable]] = []
 
+#: Vendor-spec HBM bandwidth per chip generation (GB/s).  No single-chip
+#: bandwidth-bound measurement can exceed its row: any higher reading is a
+#: measurement artifact (the round-2 failure: repeated identical dispatches
+#: were elided/served from a cache, yielding 2136 GB/s on a ~819 GB/s chip).
+#: Shared by bench.py (repo root) and bench.tpu_session — both mark
+#: above-roofline rows ``"suspect": true`` rather than recording them clean.
+HBM_GBPS = {
+    "TPU v2": 700.0,
+    "TPU v3": 900.0,
+    "TPU v4": 1228.0,
+    "TPU v5 lite": 819.0,
+    "TPU v5e": 819.0,
+    "TPU v5": 2765.0,
+    "TPU v5p": 2765.0,
+    "TPU v6 lite": 1640.0,
+    "TPU v6e": 1640.0,
+}
+
+
+def hbm_roofline_gbps():
+    """HBM bandwidth cap for the default device, or None if unknown (CPU)."""
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    for name, bw in HBM_GBPS.items():
+        if kind.lower().startswith(name.lower()):
+            return bw
+    return None
+
+
+def apply_roofline_guard(row, gbps, roofline=None):
+    """Mark *row* ``"suspect": true`` if *gbps* exceeds the device roofline.
+
+    Never record an impossible number as clean: flag it for humans and
+    downstream consumers (BENCH_TPU.md, the judge) alike.  Returns *row*.
+    """
+    if roofline is None:
+        roofline = hbm_roofline_gbps()
+    if roofline is not None and gbps > roofline:
+        row["suspect"] = True
+        row["roofline_gbps"] = roofline
+    return row
+
 
 def case(name: str):
     """Decorator registering a bench case.  The function runs the workload

@@ -3,8 +3,9 @@
     python -m bench.tpu_session [out.jsonl]
 
 Runs, in order of value: the five headline configs (same code as bench.py),
-a k-means E-step batch-size sweep (the 0.78× config's main tuning knob),
-IVF-PQ stage timings (build / coarse / scan), and Lanczos on the ELL path.
+a k-means E-step batch-size sweep + Pallas A/B verdict (the 0.78× config's
+main tuning knob), IVF-PQ stage timings (build / coarse / scan), select_k
+at IVF-scan shapes, Lanczos on the ELL path, and an AOT cold-start stage.
 Appends one JSON line per measurement so a mid-session tunnel loss keeps
 everything recorded so far.
 """
@@ -18,6 +19,17 @@ import time
 import numpy as np
 
 OUT = sys.argv[1] if len(sys.argv) > 1 else "tpu_session_results.jsonl"
+
+# Schema history (each session opens with a {"stage": "session", "schema": N}
+# row so downstream consumers can tell which validity rules apply):
+#   1 — r2 rows: no elision-proof chaining, no roofline guard.  Any v1 row
+#       may be elision-inflated; the r2 pairwise/MNMG rows were struck by
+#       the r3 roofline analysis (see BENCH_TPU.md) and carry
+#       "suspect": true in this file.
+#   2 — r3+: chained data-dependent dispatch (timed_chained), HBM roofline
+#       guard in bench.py marks physically impossible readings "suspect",
+#       select_k microbench stage.
+SCHEMA_VERSION = 2
 
 
 def emit(obj):
@@ -192,6 +204,40 @@ def aot_cold_start_stage():
                         "aot")
 
 
+def select_k_stage():
+    """Top-k selection at IVF-scan shapes (VERDICT r3 #9): the reference
+    keeps three selection engines because selection dominates the IVF scan
+    at large n_probes (topk/warpsort_topk.cuh vs radix_topk.cuh); we claim
+    one `lax.top_k` engine suffices on TPU — these rows measure that claim
+    at the shapes IVF search actually emits.  A large-k collapse here is
+    the trigger for a Pallas bitonic engine."""
+    import jax
+
+    from bench.common import apply_roofline_guard, hbm_roofline_gbps
+    from raft_tpu.matrix import select_k
+
+    roofline = hbm_roofline_gbps()
+    rng = np.random.default_rng(3)
+    nq = 1024
+    for n_cand in (1024, 8192):
+        x0 = jax.device_put(rng.random((nq, n_cand), dtype=np.float32))
+        for k in (10, 100, 1024):
+            if k > n_cand:
+                continue
+            try:
+                best = timed_chained(
+                    lambda v, k=k: select_k(v, k)[0],
+                    x0, lambda v, out: v + 1e-12 * out[0, 0], iters=8)
+                gb = nq * n_cand * 4 / 1e9
+                row = {"stage": "select_k", "nq": nq, "n_cand": n_cand,
+                       "k": k, "us": round(best * 1e6, 1),
+                       "gb_s": round(gb / best, 1)}
+                emit(apply_roofline_guard(row, row["gb_s"], roofline))
+            except Exception as e:  # noqa: BLE001 - record and continue
+                emit({"stage": "select_k", "nq": nq, "n_cand": n_cand,
+                      "k": k, "error": str(e)[:120]})
+
+
 def lanczos_stage():
     import scipy.sparse as sp
 
@@ -218,11 +264,13 @@ def lanczos_stage():
 if __name__ == "__main__":
     import jax
 
-    emit({"stage": "session", "platform": jax.default_backend(),
+    emit({"stage": "session", "schema": SCHEMA_VERSION,
+          "platform": jax.default_backend(),
           "devices": [str(d) for d in jax.devices()]})
     headline()
     kmeans_sweep()
     ivf_pq_stages()
+    select_k_stage()
     lanczos_stage()
     aot_cold_start_stage()
     emit({"stage": "session", "done": True})
