@@ -28,6 +28,11 @@ TPU-first redesign:
 - The random rotation is a QR-orthonormalized Gaussian (dim, rot_dim)
   matrix, applied as one GEMM (the reference multiplies by the same kind
   of matrix in ivf_pq_build).
+
+Supported dataset dtypes mirror the reference's T ∈ {float, int8_t,
+uint8_t} (neighbors/ivf_pq.cuh:62): integer datasets train/encode/search
+in f32 (the reference likewise converts T→float on ingest), and the index
+carries a ``dataset_dtype`` tag enforcing extend/search consistency.
 """
 
 from __future__ import annotations
@@ -145,6 +150,12 @@ class Index:
     metric: DistanceType
     codebook_kind: CodebookKind
     pq_bits: int
+    # Dataset dtype the index was built from — "float32" | "int8" | "uint8"
+    # (reference ivf_pq::index is templated on T ∈ {float, int8_t, uint8_t},
+    # neighbors/ivf_pq.cuh:62).  Codes/codebooks are dtype-independent (all
+    # training happens in f32, as the reference converts T→float on ingest);
+    # the tag enforces that extend()/search() inputs stay consistent.
+    dataset_dtype: str = "float32"
 
     @property
     def n_lists(self) -> int:
@@ -180,12 +191,29 @@ class Index:
         leaves = (self.centers, self.rotation, self.codebooks,
                   self.list_codes, self.list_indices, self.list_sizes,
                   self.phys_sizes, self.chunk_table, self.owner)
-        return leaves, (self.metric, self.codebook_kind, self.pq_bits)
+        return leaves, (self.metric, self.codebook_kind, self.pq_bits,
+                        self.dataset_dtype)
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
         return cls(*leaves, metric=aux[0], codebook_kind=aux[1],
-                   pq_bits=aux[2])
+                   pq_bits=aux[2], dataset_dtype=aux[3])
+
+
+def _ingest_dataset(data) -> Tuple[jnp.ndarray, str]:
+    """Convert a dataset/query matrix to f32 compute form, returning
+    (f32 array, dtype tag).  int8/uint8 are cast directly (same affine
+    handling as ivf_flat: nearest-neighbor ranking is scale-invariant, so
+    no kDivisor rescale is needed); everything else computes in f32, as the
+    reference converts T→float on ingest (ivf_pq_build.cuh trainset copy)."""
+    x = jnp.asarray(data)
+    if x.dtype in (jnp.int8, jnp.uint8):
+        return x.astype(jnp.float32), str(x.dtype)
+    expects(jnp.issubdtype(x.dtype, jnp.floating),
+            f"ivf_pq: unsupported dataset dtype {x.dtype}; the reference "
+            "supports T in {float, int8_t, uint8_t} "
+            "(neighbors/ivf_pq.cuh:62)")
+    return x.astype(jnp.float32), "float32"
 
 
 def _code_bytes(pq_dim: int, pq_bits: int) -> int:
@@ -353,8 +381,13 @@ def _encode(residuals, codebooks, labels, per_cluster: bool):
 @traced("raft_tpu.neighbors.ivf_pq.build")
 @auto_sync_handle
 def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
-    """Train + populate (reference ``ivf_pq::build``, ivf_pq_build.cuh)."""
-    x = jnp.asarray(dataset, jnp.float32)
+    """Train + populate (reference ``ivf_pq::build``, ivf_pq_build.cuh).
+
+    *dataset* may be float32, int8 or uint8 (reference build is templated
+    on T ∈ {float, int8_t, uint8_t}, neighbors/ivf_pq.cuh:62); integer
+    datasets train/encode in f32 and the index remembers the dtype so
+    extend()/search() stay consistent."""
+    x, dataset_dtype = _ingest_dataset(dataset)
     expects(x.ndim == 2, "dataset must be (n, dim)")
     expects(params.metric in _SUPPORTED,
             f"ivf_pq: unsupported metric {params.metric}")
@@ -425,7 +458,8 @@ def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
                  list_codes=list_codes, list_indices=list_indices,
                  list_sizes=list_sizes, phys_sizes=phys_sizes,
                  chunk_table=chunk_table, owner=owner, metric=params.metric,
-                 codebook_kind=params.codebook_kind, pq_bits=params.pq_bits)
+                 codebook_kind=params.codebook_kind, pq_bits=params.pq_bits,
+                 dataset_dtype=dataset_dtype)
 
 
 def extend(index: Index, new_vectors, new_ids=None) -> Index:
@@ -434,7 +468,11 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
     with the trained centers/rotation/codebooks (no retraining, as in the
     reference) and repacks the padded lists at the grown capacity.
     """
-    x = jnp.asarray(new_vectors, jnp.float32)
+    x, new_dtype = _ingest_dataset(new_vectors)
+    expects(new_dtype == index.dataset_dtype,
+            f"extend dtype {new_dtype} != index dataset dtype "
+            f"{index.dataset_dtype} (reference extend is templated on the "
+            "build T, neighbors/ivf_pq.cuh:103)")
     expects(x.ndim == 2 and x.shape[1] == index.dim, "dim mismatch")
     n_new = x.shape[0]
     base = index.size
@@ -469,7 +507,7 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
                  list_indices=list_indices, list_sizes=list_sizes,
                  phys_sizes=phys_sizes, chunk_table=chunk_table, owner=owner,
                  metric=index.metric, codebook_kind=index.codebook_kind,
-                 pq_bits=index.pq_bits)
+                 pq_bits=index.pq_bits, dataset_dtype=index.dataset_dtype)
 
 
 def _search_batch_impl(q, probe_ids, leaves, metric_val: int, k: int,
@@ -593,8 +631,15 @@ def search(params: SearchParams, index: Index, queries, k: int,
 
     Returns (distances [nq, k], indices [nq, k]).  Distances are
     PQ-approximate, as in the reference.
+
+    Query dtype must match the index's build dtype (reference search is
+    templated on the same T); f32 queries are additionally accepted against
+    integer-built indexes since all scoring happens in f32 anyway.
     """
-    q = jnp.asarray(queries, jnp.float32)
+    q, q_dtype = _ingest_dataset(queries)
+    expects(q_dtype in (index.dataset_dtype, "float32"),
+            f"query dtype {q_dtype} != index dataset dtype "
+            f"{index.dataset_dtype}")
     expects(q.ndim == 2 and q.shape[1] == index.dim, "query dim mismatch")
     expects(params.lut_dtype in _LUT_DTYPES,
             f"lut_dtype must be one of {list(_LUT_DTYPES)}")

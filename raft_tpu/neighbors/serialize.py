@@ -80,7 +80,8 @@ def save_ivf_pq(path, index: ivf_pq.Index) -> None:
     """Write an IVF-PQ index to *path* (``.npz``)."""
     aux = {"metric": int(index.metric),
            "codebook_kind": int(index.codebook_kind),
-           "pq_bits": int(index.pq_bits)}
+           "pq_bits": int(index.pq_bits),
+           "dataset_dtype": index.dataset_dtype}
     np.savez(_normalize(path), **_pack("ivf_pq", index, aux))
 
 
@@ -90,4 +91,6 @@ def load_ivf_pq(path) -> ivf_pq.Index:
         **{k: jnp.asarray(v) for k, v in a.items()},
         metric=DistanceType(aux["metric"]),
         codebook_kind=ivf_pq.CodebookKind(aux["codebook_kind"]),
-        pq_bits=aux["pq_bits"])
+        pq_bits=aux["pq_bits"],
+        # pre-r4 archives predate the dtype tag; they were all f32-built
+        dataset_dtype=aux.get("dataset_dtype", "float32"))

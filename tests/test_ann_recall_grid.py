@@ -48,6 +48,22 @@ def _recall(i, ti):
                for a, b in zip(i, ti)) / ti.size
 
 
+def _quantize(x, q, dtype: str):
+    """Affine-map clustered f32 data into the integer dtype's range (the
+    reference's per-dtype test instantiations feed integer-valued data the
+    same way, cpp/test/neighbors/ann_ivf_pq/test_*.cu)."""
+    if dtype == "int8":
+        s = 127.0 / np.abs(x).max()
+        return (np.clip(np.round(x * s), -127, 127).astype(np.int8),
+                np.clip(np.round(q * s), -127, 127).astype(np.int8))
+    if dtype == "uint8":
+        off = -x.min()
+        s = 255.0 / (x.max() + off)
+        return (np.clip(np.round((x + off) * s), 0, 255).astype(np.uint8),
+                np.clip(np.round((q + off) * s), 0, 255).astype(np.uint8))
+    return x, q
+
+
 # (n_rows, dim, pq_bits, n_probes, min_recall) — thresholds leave ~0.05
 # headroom below values measured with the default (auto → pca_balanced)
 # rotation on this data model (the reference's min_recall tables are
@@ -87,6 +103,45 @@ def test_ivf_pq_recall_grid(n_rows, dim, pq_bits, n_probes, min_recall):
         f"pq_bits={pq_bits} n_probes={n_probes}")
 
 
+# Per-dtype IVF-PQ rows (reference builds are templated on T ∈ {float,
+# int8_t, uint8_t}, neighbors/ivf_pq.cuh:62, with per-dtype recall tests
+# cpp/test/neighbors/ann_ivf_pq/test_*.cu).  Gates leave ~0.05 headroom
+# below measured values (64-dim: int8 0.94 / uint8 0.947; 128-dim:
+# int8 0.966 / uint8 0.949 on this data model, pq8 n_probes=50).
+_PQ_DTYPE_GRID_SMALL = [
+    (10_000, 64, "int8", 8, 50, 0.88),
+    (10_000, 64, "uint8", 8, 50, 0.88),
+]
+_PQ_DTYPE_GRID_FULL = _PQ_DTYPE_GRID_SMALL + [
+    (10_000, 128, "int8", 8, 50, 0.90),
+    (10_000, 128, "uint8", 8, 50, 0.90),
+    (100_000, 128, "int8", 8, 50, 0.80),
+    (100_000, 128, "uint8", 8, 50, 0.80),
+]
+
+
+@pytest.mark.parametrize("n_rows,dim,dtype,pq_bits,n_probes,min_recall",
+                         _PQ_DTYPE_GRID_FULL if FULL else _PQ_DTYPE_GRID_SMALL)
+def test_ivf_pq_recall_grid_int_dtypes(n_rows, dim, dtype, pq_bits,
+                                       n_probes, min_recall):
+    n_lists = max(32, n_rows // 500)
+    x, q = _clustered(n_rows, dim, n_clusters=max(20, n_lists),
+                      seed=dim + pq_bits)
+    xs, qs = _quantize(x, q, dtype)
+    idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=n_lists,
+                                          pq_dim=max(4, dim // 4),
+                                          pq_bits=pq_bits, seed=1), xs)
+    assert idx.dataset_dtype == dtype
+    _, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=min(n_probes, n_lists)),
+                         idx, qs, 10)
+    _, ti = knn(xs.astype(np.float32), qs.astype(np.float32), 10,
+                DistanceType.L2Expanded)
+    r = _recall(i, ti)
+    assert r >= min_recall, (
+        f"ivf_pq recall {r:.3f} < {min_recall} at rows={n_rows} dim={dim} "
+        f"dtype={dtype} pq_bits={pq_bits} n_probes={n_probes}")
+
+
 # (n_rows, dim, dtype, n_probes, min_recall) — IVF-Flat stores exact
 # vectors, so recall is limited only by probe coverage (reference
 # ann_ivf_flat.cu thresholds are accordingly higher).
@@ -108,21 +163,12 @@ _FLAT_GRID_FULL = _FLAT_GRID_SMALL + [
 def test_ivf_flat_recall_grid(n_rows, dim, dtype, n_probes, min_recall):
     n_lists = max(32, n_rows // 500)
     x, q = _clustered(n_rows, dim, n_clusters=max(20, n_lists), seed=dim)
-    if dtype == "int8":
-        # int8 affine storage: scale the clustered data into int8 range
-        scale = 127.0 / np.abs(x).max()
-        xs = np.clip(np.round(x * scale), -127, 127).astype(np.int8)
-        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=n_lists), xs)
-        qs = np.clip(np.round(q * scale), -127, 127).astype(np.int8)
-        _, i = ivf_flat.search(
-            ivf_flat.SearchParams(n_probes=min(n_probes, n_lists)), idx, qs, 10)
-        _, ti = knn(xs.astype(np.float32), qs.astype(np.float32), 10,
-                    DistanceType.L2Expanded)
-    else:
-        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=n_lists), x)
-        _, i = ivf_flat.search(
-            ivf_flat.SearchParams(n_probes=min(n_probes, n_lists)), idx, q, 10)
-        _, ti = knn(x, q, 10, DistanceType.L2Expanded)
+    xs, qs = _quantize(x, q, dtype)
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=n_lists), xs)
+    _, i = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=min(n_probes, n_lists)), idx, qs, 10)
+    _, ti = knn(xs.astype(np.float32), qs.astype(np.float32), 10,
+                DistanceType.L2Expanded)
     r = _recall(i, ti)
     assert r >= min_recall, (
         f"ivf_flat recall {r:.3f} < {min_recall} at rows={n_rows} dim={dim} "

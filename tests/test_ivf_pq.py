@@ -354,3 +354,69 @@ def test_ivf_pq_search_tail_bucketing_bounds_executables():
         assert np.asarray(d).shape == (nq, 5)
         np.testing.assert_array_equal(np.asarray(i), np.asarray(ref_i)[:nq])
     assert _search_batch_aot.cache_size <= n0 + 1  # one bucketed tail exe
+
+
+def test_ivf_pq_int_dtype_build_extend_search():
+    """int8/uint8 datasets (reference T template, neighbors/ivf_pq.cuh:62):
+    build tags the dtype, extend enforces it, search accepts the build
+    dtype (or f32), and recall on integer data matches the f32 path's
+    ballpark (the grid test owns the calibrated gates)."""
+    from raft_tpu.core.error import LogicError
+    from raft_tpu.neighbors import ivf_pq
+
+    x, q = make_data(n=3000, dim=32)
+    s = 127.0 / np.abs(x).max()
+    xi = np.clip(np.round(x * s), -127, 127).astype(np.int8)
+    qi = np.clip(np.round(q * s), -127, 127).astype(np.int8)
+
+    idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=40, pq_dim=16, pq_bits=8,
+                                          seed=5), xi)
+    assert idx.dataset_dtype == "int8"
+    # codes/codebooks stay dtype-independent
+    assert idx.list_codes.dtype == np.uint8
+    assert idx.codebooks.dtype == np.float32
+
+    _, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=20), idx, qi, 10)
+    _, ti = knn(xi.astype(np.float32), qi.astype(np.float32), 10,
+                DistanceType.L2Expanded)
+    assert recall(i, np.array(ti)) >= 0.8
+
+    # f32 queries are accepted against an int8-built index
+    _, i32 = ivf_pq.search(ivf_pq.SearchParams(n_probes=20), idx,
+                           qi.astype(np.float32), 10)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i32))
+
+    # extend must match the build dtype
+    idx2 = ivf_pq.extend(idx, xi[:64], np.arange(3000, 3064, dtype=np.int32))
+    assert idx2.size == 3064 and idx2.dataset_dtype == "int8"
+    with pytest.raises(LogicError):
+        ivf_pq.extend(idx, xi[:8].astype(np.float32))
+    with pytest.raises(LogicError):
+        ivf_pq.extend(idx, xi[:8].astype(np.uint8))
+    # uint8 queries on an int8 index are a dtype error too
+    with pytest.raises(LogicError):
+        ivf_pq.search(ivf_pq.SearchParams(n_probes=4), idx,
+                      qi.astype(np.uint8), 10)
+    # dtypes outside the reference's T set are rejected at build
+    with pytest.raises(LogicError):
+        ivf_pq.build(ivf_pq.IndexParams(n_lists=8), xi.astype(np.int32))
+
+
+def test_ivf_pq_int_dtype_serialize_roundtrip(tmp_path):
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.neighbors.serialize import load_ivf_pq, save_ivf_pq
+
+    rng = np.random.default_rng(9)
+    xu = rng.integers(0, 256, (800, 32)).astype(np.uint8)
+    idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=8, pq_dim=8, pq_bits=8,
+                                          seed=3), xu)
+    assert idx.dataset_dtype == "uint8"
+    p = tmp_path / "pq_u8.npz"
+    save_ivf_pq(p, idx)
+    idx2 = load_ivf_pq(p)
+    assert idx2.dataset_dtype == "uint8"
+    sp = ivf_pq.SearchParams(n_probes=4)
+    d1, i1 = ivf_pq.search(sp, idx, xu[:16], 5)
+    d2, i2 = ivf_pq.search(sp, idx2, xu[:16], 5)
+    np.testing.assert_array_equal(np.array(i1), np.array(i2))
+    np.testing.assert_allclose(np.array(d1), np.array(d2), rtol=1e-6)
