@@ -35,24 +35,30 @@ def _machine_fingerprint() -> str:
     features; loading them on a host without those features logs
     "could lead to execution errors such as SIGILL" and can crash.  A
     shared HOME persisted across heterogeneous hosts (observed across
-    build rounds) therefore must not share one cache directory."""
+    build rounds) therefore must not share one cache directory.
+
+    Scoped per machine INSTANCE (/etc/machine-id), not per cpuinfo flag
+    set: two VMs were observed with byte-identical /proc/cpuinfo flags
+    yet different LLVM-detected host features (hypervisor-masked cpuid
+    leaves — e.g. amx-fp8, prefer-no-gather — never appear in cpuinfo),
+    so feature-hash scoping still cross-loaded foreign AOT results."""
     import hashlib
     import platform
 
-    feats = ""
-    try:
-        with open("/proc/cpuinfo") as f:
-            for line in f:
-                # x86 lists ISA extensions under "flags", ARM under "Features"
-                if line.startswith(("flags", "Features")):
-                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
-                    break
-    except OSError:
-        # No /proc/cpuinfo (non-Linux): fall back to per-hostname scoping —
-        # coarser (same host always shares; distinct hosts never do), but
-        # it preserves the no-cross-host-AOT guarantee this exists for.
-        feats = f"host:{platform.node()}"
-    blob = f"{platform.machine()}|{feats}"
+    ident = ""
+    for p in ("/etc/machine-id", "/proc/sys/kernel/random/boot_id"):
+        try:
+            with open(p) as f:
+                ident = f.read().strip()
+            if ident:
+                break
+        except OSError:
+            continue
+    if not ident:
+        # No machine-id (non-Linux): per-hostname scoping — coarser, but
+        # preserves the no-cross-host-AOT guarantee this exists for.
+        ident = f"host:{platform.node()}"
+    blob = f"{platform.machine()}|{ident}"
     return hashlib.sha256(blob.encode()).hexdigest()[:12]
 
 
