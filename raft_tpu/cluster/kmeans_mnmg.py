@@ -118,7 +118,7 @@ def fit(params: KMeansParams, comms: Comms, x, centroids=None) -> KMeansOutput:
     Init: user array, or k-means|| computed on rank data via the
     single-device path (init cost is O(k·dim), negligible vs EM).
     """
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     comms = as_comms(comms)
     x = jnp.asarray(x)
@@ -140,7 +140,7 @@ def fit(params: KMeansParams, comms: Comms, x, centroids=None) -> KMeansOutput:
     local_fit = _fit_program(comms, params.max_iter, float(params.tol),
                              params.metric, bs, bc)
 
-    x_sharded = jax.device_put(x, NamedSharding(comms.mesh, P(comms.axis_name, None)))
+    x_sharded = comms.globalize(x, P(comms.axis_name, None))
     c, inertia, n_iter = comms.run(
         local_fit, x_sharded, centroids,
         in_specs=(P(comms.axis_name, None), P(None, None)),
@@ -164,7 +164,7 @@ def _predict_program(comms: Comms, metric: DistanceType, bs: int, bc: int):
 
 def predict(params: KMeansParams, comms: Comms, x, centroids):
     """Distributed labels + inertia (*comms*: Comms or Handle)."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     comms = as_comms(comms)
     x = jnp.asarray(x)
@@ -175,7 +175,7 @@ def predict(params: KMeansParams, comms: Comms, x, centroids):
     bs, bc = _resolve_batches(params)
     local_predict = _predict_program(comms, params.metric, bs, bc)
 
-    x_sharded = jax.device_put(x, NamedSharding(comms.mesh, P(comms.axis_name, None)))
+    x_sharded = comms.globalize(x, P(comms.axis_name, None))
     labels, inertia = comms.run(
         local_predict, x_sharded, centroids,
         in_specs=(P(comms.axis_name, None), P(None, None)),

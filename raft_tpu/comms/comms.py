@@ -559,11 +559,47 @@ class Comms:
         self._aborted = True
 
     # -- execution helper ----------------------------------------------------
+    @property
+    def is_multiprocess(self) -> bool:
+        """True when the mesh spans devices of more than one OS process
+        (multi-controller SPMD — the reference's multi-node NCCL clique,
+        std_comms.hpp:55-96)."""
+        procs = {d.process_index for d in self.mesh.devices.flat}
+        return len(procs) > 1
+
+    def globalize(self, x, spec):
+        """Place a host-replicated *global* value onto this communicator's
+        mesh with PartitionSpec *spec*.
+
+        Single-process: plain ``device_put``.  Multi-process: every process
+        holds the full value (the SPMD program computed it identically, the
+        standard OPG bootstrap), so each builds its addressable shards from
+        the global coordinates (``make_array_from_callback``) — the
+        device-plane analogue of the reference's per-rank buffer setup in
+        raft-dask (comms.py:414-459).  Arrays already laid out on a
+        multi-process mesh pass through untouched.
+        """
+        from jax.sharding import NamedSharding
+
+        sharding = NamedSharding(self.mesh, spec)
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return x  # already global — never fetch across processes
+        if not self.is_multiprocess:
+            return jax.device_put(x, sharding)
+        xh = np.asarray(x)
+        return jax.make_array_from_callback(xh.shape, sharding,
+                                            lambda idx: xh[idx])
+
     def run(self, fn: Callable, *args, in_specs=None, out_specs=None, **shard_kw):
         """Run *fn* under ``shard_map`` over this communicator's mesh.
 
         Default: every arg sharded along its leading axis; every output
         replicated.  This is the OPG execution model (one shard per device).
+
+        On a multi-process mesh, host-local args (numpy / single-device
+        arrays — assumed identical on every process, as in the OPG model)
+        are globalized onto the mesh first; already-global arrays pass
+        through.
         """
         from jax.sharding import PartitionSpec as P
         from jax import shard_map
@@ -572,6 +608,10 @@ class Comms:
             in_specs = tuple(P(self.axis_name) for _ in args)
         if out_specs is None:
             out_specs = P()
+        if self.is_multiprocess:
+            specs = (in_specs if isinstance(in_specs, (tuple, list))
+                     else (in_specs,) * len(args))
+            args = tuple(self.globalize(a, s) for a, s in zip(args, specs))
         # check_vma=False: grouped collectives are all_gather + masked
         # reductions, which ARE replicated per-group but not provably so to
         # the static varying-axes checker.

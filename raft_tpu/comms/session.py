@@ -54,11 +54,17 @@ class CommsSession:
     multihost: call ``jax.distributed.initialize(**multihost)`` first
       (coordinator_address/num_processes/process_id), then build the mesh
       over global devices.
+    session_id: explicit session id.  REQUIRED to be identical across
+      processes of a multihost session whose host p2p plane (mailbox) is
+      in use — the mailbox scopes messages by session id, so per-process
+      random ids would never rendezvous.  Default: a fresh uuid (the
+      reference's ``Comms`` likewise mints one sessionId and ships it to
+      every worker, comms.py:83).
     """
 
     def __init__(self, n_devices: Optional[int] = None, multihost: Optional[dict] = None,
-                 axis_name: str = "world"):
-        self.session_id = uuid.uuid4().hex  # reference comms.py sessionId
+                 axis_name: str = "world", session_id: Optional[str] = None):
+        self.session_id = session_id or uuid.uuid4().hex  # reference sessionId
         self.axis_name = axis_name
         self._n_devices = n_devices
         self._multihost = multihost
@@ -80,7 +86,13 @@ class CommsSession:
                     f"requested {self._n_devices} devices, have {len(devs)}")
             devs = devs[: self._n_devices]
         mesh = Mesh(np.array(devs), (self.axis_name,))
-        self.comms = build_comms(mesh, self.axis_name, self.session_id)
+        # host_rank/host_world bind the host p2p plane to the real process
+        # topology (single-process: 0/1, preserving local behavior); the
+        # mailbox coordinator itself comes from RAFT_TPU_COORD_ADDR or an
+        # explicit build_comms(coordinator=...) at a lower level.
+        self.comms = build_comms(mesh, self.axis_name, self.session_id,
+                                 host_rank=jax.process_index(),
+                                 host_world=jax.process_count())
         handle = Handle(mesh=mesh)
         handle.set_comms(self.comms)  # reference handle.set_comms (handle.hpp:239)
         st = get_comms_state(self.session_id)

@@ -40,8 +40,16 @@ def _machine_fingerprint() -> str:
     Scoped per machine INSTANCE (/etc/machine-id), not per cpuinfo flag
     set: two VMs were observed with byte-identical /proc/cpuinfo flags
     yet different LLVM-detected host features (hypervisor-masked cpuid
-    leaves — e.g. amx-fp8, prefer-no-gather — never appear in cpuinfo),
-    so feature-hash scoping still cross-loaded foreign AOT results."""
+    leaves never appear in cpuinfo), so feature-hash scoping still
+    cross-loaded foreign AOT results.
+
+    Note: cpu_aot_loader's "Target machine feature +prefer-no-gather is
+    not supported on the host machine" warning is NOT evidence of a
+    cross-host load — it fires even when one host reloads its own cache
+    entry (verified empirically): XLA embeds compile-time pseudo-features
+    (+prefer-no-scatter/+prefer-no-gather tuning flags) that the
+    load-time host-feature check never reports.  Same-host reloads are
+    safe; the scoping here exists for genuinely foreign entries."""
     import hashlib
     import platform
 

@@ -48,7 +48,7 @@ def knn_mnmg(comms, index, queries, k: int,
     (distances [nq, k], global indices [nq, k]) — identical (up to ties)
     to single-device ``knn(index, queries, k)``.
     """
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     comms = as_comms(comms)
     # A split communicator's get_size()/get_rank() are group-local while
@@ -70,8 +70,7 @@ def knn_mnmg(comms, index, queries, k: int,
 
     local = _search_program(comms, int(k), metric, float(metric_arg),
                             rows_per)
-    x_sharded = jax.device_put(
-        x, NamedSharding(comms.mesh, P(comms.axis_name, None)))
+    x_sharded = comms.globalize(x, P(comms.axis_name, None))
     return comms.run(local, x_sharded, q,
                      in_specs=(P(comms.axis_name, None), P(None, None)),
                      out_specs=(P(None, None), P(None, None)))
