@@ -48,9 +48,15 @@ echo "== tests =="
 # Shard per-file across workers when the host has the cores for it (the
 # reference parallelizes via per-family gtest binaries, ci/gpu/build.sh:
 # 106-121; --dist loadfile is the same per-family split).  On small hosts
-# (this round's runner has 1 vCPU) xdist workers would only contend.
+# (this round's runner has 1 vCPU) xdist workers would only contend AND the
+# full serial grid runs 20+ min — gate on the curated fast tier instead
+# (RAFT_TPU_FAST=1; see tests/conftest.py _FAST_TESTS).  Either path prints
+# a per-family duration table.
 NPROC=$(python -c "import os; print(len(os.sched_getaffinity(0)))")
-if [ "${NPROC}" -ge 4 ] && python -c "import xdist" 2>/dev/null; then
+if [ "${RAFT_TPU_FAST:-}" = "1" ] || { [ "${RAFT_TPU_FAST:-}" != "0" ] && [ "${NPROC}" -lt 4 ]; }; then
+  echo "(fast tier: ${NPROC} cores; force the full suite with RAFT_TPU_FAST=0)"
+  RAFT_TPU_FAST=1 python -m pytest tests/ -q
+elif [ "${NPROC}" -ge 4 ] && python -c "import xdist" 2>/dev/null; then
   python -m pytest tests/ -q -n "$((NPROC / 2))" --dist loadfile
 else
   python -m pytest tests/ -q
