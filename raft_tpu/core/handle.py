@@ -10,7 +10,15 @@ equivalents are:
                          dispatch lane that *tracks* in-flight arrays so that
                          ``sync`` has something to wait on
   stream pool          → a pool of such lanes for concurrently dispatched
-                         batched work (reference handle.hpp:88-130)
+                         batched work (reference handle.hpp:88-130).  A
+                         single TPU core executes one program at a time, so
+                         the pool's concurrency is host-dispatch running
+                         ahead of device execution (launch-ahead
+                         pipelining — the same overlap the reference pool
+                         provides for kernel launches), not concurrent
+                         device programs; tests/test_handle_threading.py::
+                         test_stream_pool_batches_overlap_in_flight
+                         measures it
   cublas/cusolver      → nothing to hold: XLA lowers dot/eigh/svd/qr itself
   comms_t slot         → :meth:`Handle.set_comms` / :meth:`get_comms` /
                          :meth:`get_subcomm` (reference handle.hpp:239-262)
@@ -27,7 +35,6 @@ accept a Handle wherever they take a communicator and consume
 from __future__ import annotations
 
 import threading
-import weakref
 from typing import Any, Dict, List, Optional
 
 from raft_tpu.core import interruptible
@@ -40,47 +47,56 @@ class Stream:
     XLA dispatch is stream-ordered per device already; this object exists so
     callers can group work and wait on just that group, like
     ``handle.get_stream()`` / ``handle.sync_stream()`` in the reference.
-    In-flight arrays are held weakly — once garbage collected they no longer
-    need waiting on (their buffers are owned by the runtime).
+
+    Recorded arrays are held with STRONG references until they complete —
+    observed done by :meth:`query` (which prunes) or waited on by
+    :meth:`synchronize` (which clears).  This mirrors the reference stream
+    semantics (work enqueued on a stream pins its resources until the
+    stream is synced) and is what makes the pool's bookkeeping real: the
+    producer's local references die when it returns, while the work is
+    still in flight — weak refs here would silently forget every pending
+    batch (a measured failure: ``sync_stream_pool`` saw zero live work
+    mid-execution).  Callers who pass their own handle own the
+    ``handle.sync()`` (pylibraft convention), which releases the refs.
     """
 
     def __init__(self, name: str = "main"):
         self.name = name
-        # list of weakrefs, NOT a WeakSet: jax ArrayImpl is weakrefable but
-        # unhashable, and WeakSet requires hashability (its add() raises
-        # TypeError, which would silently drop every array)
-        self._inflight: List["weakref.ref"] = []
+        self._inflight: List[Any] = []
         self._lock = threading.Lock()
 
     def record(self, *arrays: Any) -> None:
-        """Note device work whose completion this stream owns."""
+        """Note device work whose completion this stream owns.
+
+        Already-completed entries are pruned on every record, so the
+        strong-ref list is bounded by genuinely in-flight work — a caller
+        looping over record() without ever syncing does not accumulate
+        references to finished buffers."""
         import jax
 
         with self._lock:
+            self._inflight = [a for a in self._inflight
+                              if not getattr(a, "is_ready", lambda: True)()]
             for a in arrays:
                 for leaf in jax.tree_util.tree_leaves(a):
                     if hasattr(leaf, "is_ready"):
-                        try:
-                            self._inflight.append(weakref.ref(leaf))
-                        except TypeError:  # non-weakrefable leaf
-                            pass
-
-    def _live(self) -> List[Any]:
-        return [a for r in self._inflight if (a := r()) is not None]
+                        self._inflight.append(leaf)
 
     def synchronize(self) -> None:
         """Interruptibly wait for all recorded work (reference
         ``handle.sync_stream`` → ``interruptible::synchronize``)."""
         with self._lock:
-            pending = self._live()
+            pending = self._inflight
             self._inflight = []
         interruptible.synchronize(*pending)
 
     def query(self) -> bool:
-        """True if all recorded work has completed (``cudaStreamQuery``-like)."""
+        """True if all recorded work has completed (``cudaStreamQuery``-like).
+        Completed entries are pruned, releasing their references."""
         with self._lock:
-            return all(getattr(a, "is_ready", lambda: True)()
-                       for a in self._live())
+            self._inflight = [a for a in self._inflight
+                              if not getattr(a, "is_ready", lambda: True)()]
+            return not self._inflight
 
 
 class Handle:
