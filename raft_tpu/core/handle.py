@@ -84,11 +84,24 @@ class Stream:
 
     def synchronize(self) -> None:
         """Interruptibly wait for all recorded work (reference
-        ``handle.sync_stream`` → ``interruptible::synchronize``)."""
+        ``handle.sync_stream`` → ``interruptible::synchronize``).
+
+        If the wait is interrupted (cancel from another thread), the
+        still-unfinished entries are restored so a retried sync/query
+        keeps owning them — matching the CUDA pattern of catching the
+        interrupt and syncing again."""
         with self._lock:
             pending = self._inflight
             self._inflight = []
-        interruptible.synchronize(*pending)
+        try:
+            interruptible.synchronize(*pending)
+        except BaseException:
+            with self._lock:
+                self._inflight = [
+                    a for a in pending
+                    if not getattr(a, "is_ready", lambda: True)()
+                ] + self._inflight
+            raise
 
     def query(self) -> bool:
         """True if all recorded work has completed (``cudaStreamQuery``-like).
