@@ -31,58 +31,18 @@ import time
 
 import numpy as np
 
-A100_BASELINE_GBPS = 500.0
 # Engineering estimate for the reference's k-means on A100 at BASELINE
 # config[1] (100k×128 f32, k=1024): the E-step is a 100k×1024×128 fused GEMM
 # (~26 GFLOP @ ~15 TF/s effective) + M-step; ≈ 300 iter/s.
 A100_BASELINE_KMEANS_ITERS = 300.0
 
-M, N, K = 5000, 5000, 50
-
-
-# HBM roofline table + helper live in bench/common.py (shared with
-# bench.tpu_session); both callers mark above-roofline readings "suspect".
-from bench.common import apply_roofline_guard as _apply_roofline_guard  # noqa: E402
-
-
 def bench_pairwise():
-    import jax
+    # one protocol, shared with bench.tpu_session's inline stage — see
+    # bench/common.py:pairwise_headline_row for the chained-dispatch
+    # rationale that used to live here
+    from bench.common import pairwise_headline_row
 
-    from raft_tpu.distance import pairwise_distance
-
-    rng = np.random.default_rng(42)
-    x = jax.device_put(rng.random((M, K), dtype=np.float32))
-    y = jax.device_put(rng.random((N, K), dtype=np.float32))
-
-    @jax.jit
-    def step(xc):
-        d = pairwise_distance(xc, y, "euclidean")
-        # Chain a scalar of the output back into the next input so no two
-        # dispatches are identical: repeated identical dispatches can be
-        # elided / served from a result cache by the runtime (this exact
-        # hazard produced the invalid 2136 GB/s round-2 reading — above the
-        # v5e HBM roofline).  1e-12 on O(1) data leaves the workload
-        # numerically unchanged; the extra (5000,50) add is ~0.2% of bytes.
-        return xc + 1e-12 * d[0, 0], d
-
-    xc, d = step(x)
-    jax.block_until_ready(d)  # warmup/compile
-    n_chain, best = 5, float("inf")
-    for _ in range(4):
-        t0 = time.perf_counter()
-        for _ in range(n_chain):
-            xc, d = step(xc)
-        jax.block_until_ready(d)
-        best = min(best, (time.perf_counter() - t0) / n_chain)
-    nbytes = (M * K + N * K + M * N) * 4
-    gbps = nbytes / best / 1e9
-    result = {
-        "metric": "pairwise_distance_l2sqrt_5000x50_f32",
-        "value": round(gbps, 2),
-        "unit": "GB/s",
-        "vs_baseline": round(gbps / A100_BASELINE_GBPS, 3),
-    }
-    return _apply_roofline_guard(result, gbps)
+    return pairwise_headline_row()
 
 
 def bench_kmeans():
@@ -185,17 +145,11 @@ def bench_ivf_pq():
 
     from raft_tpu.neighbors import ivf_pq, knn
 
-    rng = np.random.default_rng(0)
+    # data model shared with bench/ivf_pq_recall_sweep.py (ONE protocol)
+    from bench.common import ivf_pq_bench_data
+
     n, dim, nq, k = 200_000, 128, 1024, 10
-    rank = 32
-    centers = rng.normal(0, 5, (1000, dim))
-    proj = rng.normal(0, 1, (rank, dim)) / np.sqrt(rank)
-    cid = rng.integers(0, 1000, n)
-    x = (centers[cid] + rng.normal(0, 1, (n, rank)) @ proj
-         + rng.normal(0, 0.05, (n, dim))).astype(np.float32)
-    qid = rng.integers(0, 1000, nq)
-    q = (centers[qid] + rng.normal(0, 1, (nq, rank)) @ proj
-         + rng.normal(0, 0.05, (nq, dim))).astype(np.float32)
+    x, q = ivf_pq_bench_data(n=n, dim=dim, nq=nq)
     index = ivf_pq.build(ivf_pq.IndexParams(n_lists=1000, pq_dim=32,
                                             pq_bits=8, seed=1,
                                             rotation_kind="pca_balanced"), x)
@@ -379,6 +333,13 @@ def main():
             print(line)
             return
         time.sleep(10)
+    if os.environ.get("BENCH_NO_CPU_FALLBACK") == "1":
+        # TPU measurement sessions set this: a platform=cpu row recorded
+        # mid-session has no value there (CPU reference numbers already
+        # exist), and the 1200 s fallback burns scarce tunnel-window time.
+        print(f"bench: platform '{platform}' failed twice; CPU fallback "
+              "disabled (BENCH_NO_CPU_FALLBACK=1)", file=sys.stderr)
+        sys.exit(1)
     print(f"bench: platform '{platform}' failed twice; falling back to CPU",
           file=sys.stderr)
     line = _attempt(_cpu_env(), 1200, "cpu fallback")

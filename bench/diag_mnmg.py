@@ -1,0 +1,19 @@
+"""Standalone runner for the kmeans_mnmg slowdown decomposition.
+
+    python -m bench.diag_mnmg [out.jsonl]
+
+The measurement ladder itself lives in bench.tpu_session.mnmg_diag_stage
+(ONE implementation — it runs as part of the full session too); this
+module just runs that stage by itself for interactive diagnosis.
+Set RAFT_TPU_SESSION_DRYRUN=1 for tiny shapes (CPU rehearsal).
+"""
+
+from bench import tpu_session
+
+
+def main():
+    tpu_session.mnmg_diag_stage()
+
+
+if __name__ == "__main__":
+    main()

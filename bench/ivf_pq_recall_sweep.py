@@ -1,0 +1,99 @@
+"""IVF-PQ operating-point sweep at the 200k bench config (VERDICT r3 #7).
+
+    python -m bench.ivf_pq_recall_sweep [out.jsonl]
+
+The bench gate is recall@10 >= 0.8 at the default operating point
+(n_lists=1000, pq_dim=32, pq_bits=8, n_probes=40 -> measured 0.78 on the
+r3 CPU run).  This sweeps nearby operating points at EQUAL OR LOWER search
+cost — scan fraction (n_probes/n_lists) and code bytes held comparable —
+plus a few cost-raising controls, and emits one JSON row per point with
+measured recall and (when on TPU) QPS, so the default can be re-picked
+from data rather than argument.  Mirrors the reference's recall-gated
+bench ethos (cpp/test/neighbors/ann_ivf_pq.cuh min_recall per config).
+
+Sweep axes:
+  - n_lists x n_probes at fixed 4% scan fraction: finer coarse quantization
+    improves candidate quality at identical scan cost.
+  - pq_dim x pq_bits at fixed 32 code bytes: (32,8) vs (64,4).
+  - n_probes raise (cost control, to see the recall ceiling of the coder).
+"""
+
+import time
+
+import numpy as np
+
+# shared with bench.tpu_session: same out-file argv convention, same
+# append-per-measurement emit
+from bench.tpu_session import OUT, emit  # noqa: F401  (OUT: documented knob)
+# ONE data model + chained timer, shared with bench.py's gated benchmark
+from bench.common import ivf_pq_bench_data, timed_chained
+
+
+def main():
+    import os
+
+    import jax
+
+    from raft_tpu.neighbors import ivf_pq, knn
+
+    platform = jax.default_backend()
+    # SWEEP_N: reduced-scale CPU ranking runs (the relative ordering of
+    # operating points transfers; the winner is confirmed at 200k on TPU).
+    n = int(os.environ.get("SWEEP_N", "200000"))
+    emit({"stage": "ivf_pq_sweep", "platform": platform, "n": n,
+          "begin": True})
+    x, q = ivf_pq_bench_data(n=n)
+    k = 10
+
+    # ground truth once, on a subsample (bench.py's recall-gate protocol)
+    nsub = 256
+    _, ti = knn(x, q[:nsub], k)
+    ti = np.asarray(ti)
+
+    points = [
+        # (n_lists, pq_dim, pq_bits, n_probes)   tag
+        (1000, 32, 8, 40),    # current default — re-measure as anchor
+        (2000, 32, 8, 80),    # same 4% scan fraction, finer coarse space
+        (4000, 32, 8, 160),   # same fraction, finer still
+        (2000, 64, 4, 80),    # same fraction, same 32 B/vec, finer subspaces
+        (1000, 64, 4, 40),    # same cost as default, finer subspaces
+        (1000, 32, 8, 80),    # cost control: 2x probes (recall ceiling probe)
+        (2000, 32, 8, 40),    # HALF the scan cost of default
+    ]
+    for n_lists, pq_dim, pq_bits, n_probes in points:
+        t0 = time.perf_counter()
+        try:
+            index = ivf_pq.build(
+                ivf_pq.IndexParams(n_lists=n_lists, pq_dim=pq_dim,
+                                   pq_bits=pq_bits, seed=1,
+                                   rotation_kind="pca_balanced"), x)
+            jax.block_until_ready(index.list_codes)
+            build_s = time.perf_counter() - t0
+            sp = ivf_pq.SearchParams(n_probes=n_probes)
+            _, i = ivf_pq.search(sp, index, q[:nsub], k)
+            i = np.asarray(i)
+            recall = sum(len(set(a.tolist()) & set(b.tolist()))
+                         for a, b in zip(i, ti)) / ti.size
+            row = {"stage": "ivf_pq_sweep", "n_lists": n_lists,
+                   "pq_dim": pq_dim, "pq_bits": pq_bits,
+                   "n_probes": n_probes,
+                   "scan_frac": round(n_probes / n_lists, 3),
+                   "recall": round(recall, 3),
+                   "build_s": round(build_s, 1)}
+            # QPS only worth recording on the real chip
+            if platform == "tpu":
+                best = timed_chained(
+                    lambda qq, sp=sp: ivf_pq.search(sp, index, qq, k)[0],
+                    jax.device_put(q), lambda qq, d: qq + 1e-12 * d[0, 0],
+                    iters=3)
+                row["qps"] = round(len(q) / best, 1)
+            emit(row)
+        except Exception as e:  # noqa: BLE001 - record and continue
+            emit({"stage": "ivf_pq_sweep", "n_lists": n_lists,
+                  "pq_dim": pq_dim, "pq_bits": pq_bits,
+                  "n_probes": n_probes, "error": str(e)[:160]})
+    emit({"stage": "ivf_pq_sweep", "done": True})
+
+
+if __name__ == "__main__":
+    main()

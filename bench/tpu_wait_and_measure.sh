@@ -7,7 +7,13 @@ cd "$(dirname "$0")/.."
 for i in $(seq 1 "${1:-60}"); do
   if timeout -k 10 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "tpu live (probe $i) — starting session" >&2
-    timeout 7200 python -m bench.tpu_session
+    # 9 h cap, sized to the session's degraded-mode worst case: headline
+    # 5 metrics x 2800 s outer bound (CPU fallback disabled) = 14000 s,
+    # plus ~25 compile-heavy inline-stage programs at the ~10 min/program
+    # a 1-vCPU host serializes XLA:TPU compiles to, plus the 1800 s AOT
+    # stage.  The session appends per-measurement, so even a cap hit
+    # loses nothing recorded.
+    timeout 32400 python -m bench.tpu_session
     exit $?
   fi
   echo "probe $i: tpu unreachable" >&2
