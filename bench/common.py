@@ -86,6 +86,81 @@ def timed_chained(fn, x0, feedback, iters=10):
     return best
 
 
+def make_emitter(out_path):
+    """Append-per-measurement JSONL emitter shared by the TPU session
+    scripts (ONE implementation: a mid-session tunnel loss keeps every row
+    recorded so far; print+flush mirrors rows to the live log)."""
+
+    def emit(obj):
+        line = json.dumps(obj)
+        print(line, flush=True)
+        with open(out_path, "a") as f:
+            f.write(line + "\n")
+
+    return emit
+
+
+def timed_amortized(step, carry0, k_lo=4, k_hi=16, reps=4):
+    """Device-amortized per-iteration time for *step* (carry -> carry).
+
+    Runs ``k`` DATA-DEPENDENT iterations of *step* inside ONE compiled
+    ``lax.fori_loop`` and differences two loop lengths:
+
+        per_iter = (t[k_hi] - t[k_lo]) / (k_hi - k_lo)
+
+    which cancels the per-dispatch overhead exactly.  This is the honest
+    analogue of the reference's stream-synchronized fixture
+    (cpp/bench/common/benchmark.hpp:108): a CUDA bench pays a ~10 us kernel
+    launch per op, while the axon tunnel pays ~15 ms of network RTT per
+    dispatch — per-dispatch timing of any sub-10 ms op therefore measures
+    the tunnel, not the chip (the r4 session's 6.55 GB/s pairwise reading).
+
+    Elision safety: each loop iteration consumes the previous carry (the
+    fori_loop body is sequential by construction), and the outer timed
+    dispatches chain the returned carry into the next call, so no two
+    dispatches are identical.  DCE safety: any buffer whose write should be
+    counted must be PART OF THE CARRY — a loop-carried buffer is fully
+    materialized every iteration because the body computation is compiled
+    once for all trips.
+
+    Returns ``(per_iter_seconds, info)`` where info carries the raw
+    ``t_lo_s``/``t_hi_s`` bests and ``delta_ok`` (False means the delta was
+    at the noise floor and the conservative bound t_hi/k_hi was returned).
+    """
+    import jax
+    from jax import lax
+
+    def loop(k):
+        return jax.jit(
+            lambda c: lax.fori_loop(0, k, lambda i, cc: step(cc), c))
+
+    f_lo, f_hi = loop(k_lo), loop(k_hi)
+    c_lo = f_lo(carry0)
+    jax.block_until_ready(c_lo)  # warmup/compile lo
+    c_hi = f_hi(carry0)
+    jax.block_until_ready(c_hi)  # warmup/compile hi
+    best_lo = best_hi = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        c_lo = f_lo(c_lo)
+        jax.block_until_ready(c_lo)
+        best_lo = min(best_lo, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        c_hi = f_hi(c_hi)
+        jax.block_until_ready(c_hi)
+        best_hi = min(best_hi, time.perf_counter() - t0)
+    info = {"t_lo_s": round(best_lo, 6), "t_hi_s": round(best_hi, 6),
+            "k_lo": k_lo, "k_hi": k_hi}
+    if best_hi <= best_lo:
+        # Noise floor: both dispatches cost the same, so the per-iteration
+        # device time is below measurement resolution.  Return the
+        # conservative upper bound rather than a negative/zero delta.
+        info["delta_ok"] = False
+        return best_hi / k_hi, info
+    info["delta_ok"] = True
+    return (best_hi - best_lo) / (k_hi - k_lo), info
+
+
 def ivf_pq_bench_data(n=200_000, dim=128, nq=1024, rank=32, seed=0):
     """BASELINE config[2]'s data model — cluster centers + LOW-RANK residuals
     (rank 32 embedded in *dim*) + small isotropic noise, the correlated-
@@ -119,11 +194,15 @@ def pairwise_headline_row():
     L2SqrtExpanded, 5000x50 f32 — the ONE protocol, shared by bench.py's
     subprocess path and bench.tpu_session's inline stage.
 
-    Chained (data-dependent) dispatches: a scalar of each output feeds the
-    next input so no two dispatches are identical — repeated identical
-    dispatches can be elided / served from a result cache by the runtime
-    (that hazard produced the invalid above-roofline 2136 GB/s r2 reading).
-    Returns the metric row, roofline-guarded.
+    Headline value = DEVICE-AMORTIZED time (timed_amortized: chained
+    iterations inside one fori_loop, two loop lengths differenced), the
+    honest analogue of the reference's stream-synchronized fixture.  The
+    per-dispatch chained number is also recorded (``dispatch_gbps``): over
+    the axon tunnel it is RTT-bound (~15 ms/dispatch -> 6.55 GB/s in the
+    r4 session) and measures the tunnel, not the chip; on local hardware
+    the two converge.  The distance matrix rides in the loop CARRY so its
+    HBM write is materialized every iteration (DCE-safe — see
+    timed_amortized).  Roofline-guarded either way.
     """
     import jax
     import numpy as np
@@ -136,25 +215,38 @@ def pairwise_headline_row():
     y = jax.device_put(rng.random((n, k), dtype=np.float32))
 
     @jax.jit
-    def step(xc):
-        d = pairwise_distance(xc, y, "euclidean")
-        # 1e-12 on O(1) data: numerically inert, ~0.2% extra bytes
-        return xc + 1e-12 * d[0, 0], d
+    def step(carry):
+        xc, d = carry
+        # 1e-12 on O(1) data: numerically inert; consumes the previous
+        # iteration's d so iterations are sequential and non-identical
+        xc = xc + 1e-12 * d[0, 0]
+        return xc, pairwise_distance(xc, y, "euclidean")
 
-    xc, d = step(x)
-    jax.block_until_ready(d)  # warmup/compile
-    n_chain, best = 5, float("inf")
-    for _ in range(4):
+    d0 = pairwise_distance(x, y, "euclidean")
+    jax.block_until_ready(d0)
+    nbytes = (m * k + n * k + m * n) * 4
+
+    # Per-dispatch chained (the old protocol, kept for transparency).
+    xc, d = x, d0
+    best = float("inf")
+    for _ in range(6):
         t0 = time.perf_counter()
-        for _ in range(n_chain):
-            xc, d = step(xc)
+        xc, d = step((xc, d))
         jax.block_until_ready(d)
-        best = min(best, (time.perf_counter() - t0) / n_chain)
-    gbps = (m * k + n * k + m * n) * 4 / best / 1e9
+        best = min(best, time.perf_counter() - t0)
+    dispatch_gbps = nbytes / best / 1e9
+
+    per_iter, info = timed_amortized(step, (x, d0))
+    gbps = nbytes / per_iter / 1e9
     row = {"metric": "pairwise_distance_l2sqrt_5000x50_f32",
            "value": round(gbps, 2), "unit": "GB/s",
-           "vs_baseline": round(gbps / A100_BASELINE_GBPS, 3)}
-    return apply_roofline_guard(row, gbps)
+           "vs_baseline": round(gbps / A100_BASELINE_GBPS, 3),
+           "timing": "device_amortized",
+           "dispatch_gbps": round(dispatch_gbps, 2), **info}
+    roofline = hbm_roofline_gbps()
+    if roofline is not None and dispatch_gbps > roofline:
+        row["dispatch_suspect"] = True  # same elision class the guard exists for
+    return apply_roofline_guard(row, gbps, roofline)
 
 
 def case(name: str):

@@ -18,15 +18,17 @@ Sweep axes:
   - n_probes raise (cost control, to see the recall ceiling of the coder).
 """
 
+import sys
 import time
 
 import numpy as np
 
-# shared with bench.tpu_session: same out-file argv convention, same
-# append-per-measurement emit
-from bench.tpu_session import OUT, emit  # noqa: F401  (OUT: documented knob)
-# ONE data model + chained timer, shared with bench.py's gated benchmark
-from bench.common import ivf_pq_bench_data, timed_chained
+# ONE data model + amortized timer + emitter, shared with bench.py's gated
+# benchmark and bench.tpu_session (same out-file argv convention)
+from bench.common import ivf_pq_bench_data, make_emitter, timed_amortized
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else "tpu_session_results.jsonl"
+emit = make_emitter(OUT)
 
 
 def main():
@@ -80,13 +82,25 @@ def main():
                    "scan_frac": round(n_probes / n_lists, 3),
                    "recall": round(recall, 3),
                    "build_s": round(build_s, 1)}
-            # QPS only worth recording on the real chip
+            # QPS only worth recording on the real chip — device-amortized
+            # (per-dispatch chained timing is RTT-bound over the axon
+            # tunnel and would rank operating points by tunnel latency,
+            # not scan cost).  Outputs ride in the carry (DCE rule, see
+            # bench.common.timed_amortized).
             if platform == "tpu":
-                best = timed_chained(
-                    lambda qq, sp=sp: ivf_pq.search(sp, index, qq, k)[0],
-                    jax.device_put(q), lambda qq, d: qq + 1e-12 * d[0, 0],
-                    iters=3)
-                row["qps"] = round(len(q) / best, 1)
+                qj = jax.device_put(q)
+
+                def step(carry, sp=sp):
+                    qq, d, _ = carry
+                    qq = qq * (1.0 + 1e-12 * d[0, 0])
+                    nd, ni = ivf_pq.search(sp, index, qq, k)
+                    return qq, nd, ni
+
+                d0, i0 = ivf_pq.search(sp, index, qj, k)
+                per_q, info = timed_amortized(step, (qj, d0, i0),
+                                              k_lo=2, k_hi=8, reps=3)
+                row["qps"] = round(len(q) / per_q, 1)
+                row["timing"] = "device_amortized"
             emit(row)
         except Exception as e:  # noqa: BLE001 - record and continue
             emit({"stage": "ivf_pq_sweep", "n_lists": n_lists,
