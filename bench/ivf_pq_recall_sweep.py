@@ -101,6 +101,7 @@ def main():
                                               k_lo=2, k_hi=8, reps=3)
                 row["qps"] = round(len(q) / per_q, 1)
                 row["timing"] = "device_amortized"
+                row.update(info)  # delta_ok=False marks noise-floor rows
             emit(row)
         except Exception as e:  # noqa: BLE001 - record and continue
             emit({"stage": "ivf_pq_sweep", "n_lists": n_lists,

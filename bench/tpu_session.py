@@ -47,8 +47,8 @@ OUT = sys.argv[1] if len(sys.argv) > 1 else "tpu_session_results.jsonl"
 #       chained iterations inside ONE fori_loop, two loop lengths
 #       differenced, canceling dispatch overhead).  Amortized rows carry
 #       "timing": "device_amortized"; rows without it are per-dispatch and
-#       subject to the schema-2 caveat.  Emitted by this script AND
-#       bench.tpu_session_b.
+#       subject to the schema-2 caveat.  Emitted by this script and
+#       bench.ivf_pq_recall_sweep.
 SCHEMA_VERSION = 3
 
 
@@ -159,9 +159,16 @@ def kmeans_sweep():
 
     # A/B: fused Pallas E-step engine vs XLA (distance tile stays in VMEM).
     # "default" = single-pass bf16 dot, "high" = f32 dot in-kernel.
-    for prec in ("default", "high"):
-        run_one({"engine": "pallas", "precision": prec},
-                engine="pallas", precision=prec)
+    # Gated on the probe stage: when Pallas cannot compile over the tunnel
+    # at all (r4b: remote_compile HTTP 500 on BOTH variants), re-attempting
+    # burns ~1 min of window per doomed compile.
+    if _PALLAS_OK is False:
+        emit({"stage": "kmeans_sweep", "engine": "pallas",
+              "skipped": "pallas_probe failed — see pallas_probe rows"})
+    else:
+        for prec in ("default", "high"):
+            run_one({"engine": "pallas", "precision": prec},
+                    engine="pallas", precision=prec)
     # Each (config) costs TWO remote compiles (k_lo + k_hi loop programs),
     # ~1 min each on the 1-vCPU host — keep the grid lean: precision
     # A/B only at the default batch, batch sweep at precision="high".
@@ -194,6 +201,34 @@ def kmeans_sweep():
               "ratio": round(ratio, 3), "recommendation": rec})
 
 
+def timed_whole_fit(fit_fn, c0, stage, case=None, reps=3):
+    """Shared whole-fit timing harness (ONE protocol for kmeans_fit_stage
+    and mnmg_diag's E/F cases): warmup, then chained RESTARTS near the
+    ORIGINAL start point — chaining the fit's own output would hand the
+    next fit already-converged centroids (it exits after ~1 iteration and
+    the /n_iter normalization inflates iter/s ~20×, as the CPU rehearsal
+    showed).  *fit_fn(c) -> KMeansOutput*; emits iter/s = n_iter / best."""
+    import jax
+
+    tag = {"stage": stage, **({"case": case} if case else {})}
+    try:
+        out = fit_fn(c0)
+        jax.block_until_ready(out.centroids)
+        warmup_n_iter = int(out.n_iter)  # confirm the normalizer is honest
+        best = float("inf")
+        for _ in range(reps):
+            c1 = c0 + 1e-9 * out.centroids[0, 0]  # chained restart
+            t0 = time.perf_counter()
+            out = fit_fn(c1)
+            jax.block_until_ready(out.centroids)
+            best = min(best, time.perf_counter() - t0)
+        emit({**tag, "n_iter": int(out.n_iter),
+              "iter_s": round(int(out.n_iter) / best, 1),
+              "fit_s": round(best, 3), "warmup_n_iter": warmup_n_iter})
+    except Exception as e:  # noqa: BLE001 - record and continue
+        emit({**tag, "error": str(e)[:300]})
+
+
 def kmeans_fit_stage():
     """Single-device while_loop fit (the REAL config[1] algorithm) at bench
     shapes: 20 fixed iterations in one dispatch.  Compare with the
@@ -205,27 +240,19 @@ def kmeans_fit_stage():
     from raft_tpu.cluster import fit as kmeans_fit
 
     n, dim, k = (2_000, 32, 64) if DRYRUN else (100_000, 128, 1024)
-    n_iter = 20
     rng = np.random.default_rng(0)
     x = jax.device_put(rng.random((n, dim), dtype=np.float32))
     c0 = jax.device_put(rng.random((k, dim), dtype=np.float32))
     params = KMeansParams(n_clusters=k, init=InitMethod.Array,
-                          max_iter=n_iter, tol=0.0)
-    try:
-        out = kmeans_fit(params, x, centroids=c0)
-        jax.block_until_ready(out.centroids)
-        best = float("inf")
-        for _ in range(3):
-            c1 = c0 + 1e-9 * out.centroids[0, 0]  # chained restart
-            t0 = time.perf_counter()
-            out = kmeans_fit(params, x, centroids=c1)
-            jax.block_until_ready(out.centroids)
-            best = min(best, time.perf_counter() - t0)
-        emit({"stage": "kmeans_fit", "n_iter": int(out.n_iter),
-              "iter_s": round(int(out.n_iter) / best, 1),
-              "fit_s": round(best, 3)})
-    except Exception as e:  # noqa: BLE001 - record and continue
-        emit({"stage": "kmeans_fit", "error": str(e)[:300]})
+                          max_iter=20, tol=0.0)
+    timed_whole_fit(lambda c: kmeans_fit(params, x, centroids=c), c0,
+                    "kmeans_fit")
+
+
+#: Set by pallas_probe_stage: None = not probed, True = trivial kernel
+#: compiled and ran, False = even the trivial kernel failed (kmeans_sweep
+#: then skips its doomed pallas configs instead of burning window time).
+_PALLAS_OK = None
 
 
 def pallas_probe_stage():
@@ -235,6 +262,7 @@ def pallas_probe_stage():
     kernel, (b) the real fused L2NN kernel at small shape, recording FULL
     error text — distinguishing 'axon cannot run Pallas' from 'our kernel
     breaks the compiler'."""
+    global _PALLAS_OK
     import jax
     import jax.numpy as jnp
 
@@ -249,8 +277,10 @@ def pallas_probe_stage():
             add_one, out_shape=jax.ShapeDtypeStruct((128, 128), jnp.float32)
         )(x)
         jax.block_until_ready(out)
+        _PALLAS_OK = True
         emit({"stage": "pallas_probe", "case": "trivial_add", "ok": True})
     except Exception as e:  # noqa: BLE001 - record and continue
+        _PALLAS_OK = False
         emit({"stage": "pallas_probe", "case": "trivial_add", "ok": False,
               "error": str(e)[:2000]})
 
@@ -320,7 +350,7 @@ def mnmg_diag_stage():
                   "iter_s": round(1.0 / per_iter, 1),
                   "timing": "device_amortized", **info})
         except Exception as e:  # noqa: BLE001 - record and continue
-            emit({"stage": "mnmg_diag", "case": tag, "error": str(e)[:140]})
+            emit({"stage": "mnmg_diag", "case": tag, "error": str(e)[:300]})
 
     rec("B_jit_one_step", lambda cc: em(x, cc), c)
 
@@ -336,7 +366,7 @@ def mnmg_diag_stage():
               "iter_s": round(20 / best, 1)})
     except Exception as e:  # noqa: BLE001 - record and continue
         emit({"stage": "mnmg_diag", "case": "C_jit_fori_x20",
-              "error": str(e)[:140]})
+              "error": str(e)[:300]})
 
     mesh = Mesh(np.array(jax.devices()[:1]), ("world",))
 
@@ -360,30 +390,17 @@ def mnmg_diag_stage():
     params = KMeansParams(n_clusters=k, init=InitMethod.Array, max_iter=20,
                           tol=0.0)
 
-    def full_fit(cc):
-        return kmeans_mnmg.fit(params, comms, xs, centroids=cc)
-
-    # Chain on the START point, restarting near the ORIGINAL random c each
-    # dispatch (chaining the fit's own output would hand the next fit
-    # already-converged centroids — it exits after ~1 iteration and the
-    # /20 normalization inflates iter/s ~20x, as the CPU rehearsal showed).
-    try:
-        out = full_fit(c)
-        jax.block_until_ready(out.centroids)
-        n_iter = int(out.n_iter)  # confirm the /iters normalizer is honest
-        best = float("inf")
-        for _ in range(2):
-            c2 = c + 1e-9 * out.centroids[0, 0]
-            t0 = time.perf_counter()
-            out = full_fit(c2)
-            jax.block_until_ready(out.centroids)
-            best = min(best, time.perf_counter() - t0)
-        emit({"stage": "mnmg_diag", "case": "E_full_fit",
-              "iter_s": round(int(out.n_iter) / best, 1),
-              "n_iter": int(out.n_iter), "warmup_n_iter": n_iter})
-    except Exception as e:  # noqa: BLE001 - record and continue
-        emit({"stage": "mnmg_diag", "case": "E_full_fit",
-              "error": str(e)[:140]})
+    # E: single compiled shard_map(while_loop) program (the 3.03 it/s
+    # r4a reading).  F: host-driven per-iteration step (the reference's
+    # raft-dask shape; tol=0 so the dispatch pipeline never syncs) — the
+    # E-vs-F delta isolates the while_loop program from everything else.
+    # Both through the shared whole-fit harness (timed_whole_fit).
+    timed_whole_fit(lambda cc: kmeans_mnmg.fit(params, comms, xs,
+                                               centroids=cc),
+                    c, "mnmg_diag", case="E_full_fit", reps=2)
+    timed_whole_fit(lambda cc: kmeans_mnmg.fit(params, comms, xs,
+                                               centroids=cc, loop="host"),
+                    c, "mnmg_diag", case="F_host_loop_fit", reps=2)
 
 
 def ivf_pq_stages():

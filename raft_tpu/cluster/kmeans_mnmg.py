@@ -72,6 +72,25 @@ def _cached_program(comms: Comms, key, builder):
     return progs[key]
 
 
+def _step_program(comms: Comms, metric: DistanceType, bs: int, bc: int):
+    """One distributed E+M step as a cached shard_map program: returns
+    (new_centroids, delta_sq, inertia) where delta_sq = ||new - old||² is
+    computed on-device so the host only syncs on it at convergence-check
+    points.  Program identity is cached per (comms, statics) — see
+    :func:`_fit_program` for why."""
+
+    def local_step(x_shard, c):
+        new, _, inertia = compute_new_centroids(x_shard, c, comms,
+                                                metric=metric,
+                                                batch_samples=bs,
+                                                batch_centroids=bc)
+        delta = jnp.sum((new - c) ** 2)
+        return new, delta, inertia
+
+    return _cached_program(comms, ("step", metric, bs, bc),
+                           lambda: local_step)
+
+
 def _fit_program(comms: Comms, max_iter: int, tol: float, metric: DistanceType,
                  bs: int, bc: int):
     """Build the per-shard fit body ONCE per (comms, statics).
@@ -110,17 +129,33 @@ def _fit_program(comms: Comms, max_iter: int, tol: float, metric: DistanceType,
 
 
 @traced("raft_tpu.cluster.kmeans_mnmg.fit")
-def fit(params: KMeansParams, comms: Comms, x, centroids=None) -> KMeansOutput:
+def fit(params: KMeansParams, comms: Comms, x, centroids=None,
+        loop: str = "device", sync_every: int = 8) -> KMeansOutput:
     """Distributed k-means fit over rows sharded across the comms axis.
 
     x: global [n, dim] array (host or device); it is sharded row-wise over
     the mesh.  *comms* may be a Comms or a Handle with comms injected.
     Init: user array, or k-means|| computed on rank data via the
     single-device path (init cost is O(k·dim), negligible vs EM).
+
+    loop:
+      - ``"device"``: the whole EM loop is ONE compiled
+        shard_map(while_loop) program — zero host round trips per fit.
+      - ``"host"``: the host drives one compiled E+M step per iteration —
+        the reference's own MNMG shape (raft-dask/cuML drive per-iteration
+        device kernels + NCCL allreduce from the host,
+        pylibraft cluster/kmeans.pyx:71 ``compute_new_centroids``).
+        Dispatches are issued UNBLOCKED, so they pipeline on the runtime's
+        async queue; the host only syncs on the on-device ``delta`` scalar
+        every *sync_every* iterations (never, when tol == 0).  This is the
+        pattern behind the 437 it/s single-chip k-means bench number and a
+        live cross-check on the while_loop program (BENCH_TPU.md r4 ¶).
     """
     from jax.sharding import PartitionSpec as P
 
     comms = as_comms(comms)
+    expects(loop in ("device", "host"), f"unknown loop mode {loop!r}")
+    expects(sync_every >= 1, f"sync_every must be >= 1, got {sync_every}")
     x = jnp.asarray(x)
     n, dim = x.shape
     nranks = comms.get_size()
@@ -137,16 +172,57 @@ def fit(params: KMeansParams, comms: Comms, x, centroids=None) -> KMeansOutput:
     from raft_tpu.cluster.kmeans import _resolve_batches
 
     bs, bc = _resolve_batches(params)
+    x_sharded = comms.globalize(x, P(comms.axis_name, None))
+    if loop == "host":
+        return _fit_host_loop(params, comms, x_sharded, centroids, bs, bc,
+                              sync_every)
     local_fit = _fit_program(comms, params.max_iter, float(params.tol),
                              params.metric, bs, bc)
-
-    x_sharded = comms.globalize(x, P(comms.axis_name, None))
     c, inertia, n_iter = comms.run(
         local_fit, x_sharded, centroids,
         in_specs=(P(comms.axis_name, None), P(None, None)),
         out_specs=(P(None, None), P(), P()),
     )
     return KMeansOutput(c, inertia, n_iter)
+
+
+def _fit_host_loop(params: KMeansParams, comms: Comms, x_sharded, centroids,
+                   bs: int, bc: int, sync_every: int) -> KMeansOutput:
+    """Host-driven EM (see :func:`fit` loop="host").  Matches the
+    while_loop path's convergence semantics: stop after the first iteration
+    whose centroid movement ||new - old||² <= tol², checked every
+    *sync_every* iterations (each check synchronizes the pipeline, so
+    tol == 0 checks never and runs exactly max_iter iterations)."""
+    from jax.sharding import PartitionSpec as P
+
+    tol2 = float(params.tol) ** 2
+    step = _step_program(comms, params.metric, bs, bc)
+
+    def run_step(c):
+        return comms.run(
+            step, x_sharded, c,
+            in_specs=(P(comms.axis_name, None), P(None, None)),
+            out_specs=(P(None, None), P(), P()),
+        )
+
+    c, inertia = centroids, None
+    n_iter = 0
+    while n_iter < params.max_iter:
+        c, delta, inertia = run_step(c)
+        n_iter += 1
+        if tol2 > 0 and (n_iter % sync_every == 0
+                         or n_iter == params.max_iter):
+            if float(delta) <= tol2:  # pipeline sync point
+                break
+    # final inertia of the RETURNED centroids (the loop's inertia is one
+    # step stale — matches _fit_program's trailing E-step)
+    predict_prog = _predict_program(comms, params.metric, bs, bc)
+    _, inertia = comms.run(
+        predict_prog, x_sharded, c,
+        in_specs=(P(comms.axis_name, None), P(None, None)),
+        out_specs=(P(comms.axis_name), P()),
+    )
+    return KMeansOutput(c, inertia, jnp.asarray(n_iter))
 
 
 def _predict_program(comms: Comms, metric: DistanceType, bs: int, bc: int):

@@ -49,6 +49,48 @@ def test_distributed_ari(comms, blobs):
     assert float(inertia) > 0
 
 
+def test_host_loop_matches_device_loop(comms, blobs):
+    """loop="host" (reference raft-dask shape: host-driven per-iteration
+    step + allreduce) reaches the same fit as the single-program
+    while_loop path."""
+    x, _, centers = blobs
+    params = KMeansParams(n_clusters=4, init=InitMethod.Array, max_iter=50)
+    out_dev = kmeans_mnmg.fit(params, comms, x, centroids=centers)
+    out_host = kmeans_mnmg.fit(params, comms, x, centroids=centers,
+                               loop="host")
+    np.testing.assert_allclose(np.asarray(out_host.centroids),
+                               np.asarray(out_dev.centroids), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(float(out_host.inertia),
+                               float(out_dev.inertia), rtol=1e-4)
+    # host loop checks convergence every sync_every iters, so it may run
+    # up to sync_every-1 extra EM steps past the device loop's stop point
+    assert int(out_dev.n_iter) <= int(out_host.n_iter) \
+        <= int(out_dev.n_iter) + 7
+
+
+def test_host_loop_tol_zero_runs_max_iter(comms, blobs):
+    """tol=0 → no convergence sync points: exactly max_iter iterations
+    (the fully-pipelined mode the MNMG bench exercises)."""
+    x, _, centers = blobs
+    params = KMeansParams(n_clusters=4, init=InitMethod.Array, max_iter=7,
+                          tol=0.0)
+    out = kmeans_mnmg.fit(params, comms, x, centroids=centers, loop="host")
+    assert int(out.n_iter) == 7
+
+
+def test_host_loop_rejects_unknown_mode(comms, blobs):
+    from raft_tpu.core import LogicError
+
+    x, _, centers = blobs
+    with pytest.raises(LogicError):
+        kmeans_mnmg.fit(KMeansParams(n_clusters=4), comms, x,
+                        centroids=centers, loop="pipelined")
+    with pytest.raises(LogicError):
+        kmeans_mnmg.fit(KMeansParams(n_clusters=4), comms, x,
+                        centroids=centers, loop="host", sync_every=0)
+
+
 def test_compute_new_centroids_building_block(comms, blobs):
     """The pylibraft compute_new_centroids equivalent: one E+M step."""
     x, _, centers = blobs
