@@ -162,7 +162,7 @@ def kmeans_sweep():
     # Gated on the probe stage: when Pallas cannot compile over the tunnel
     # at all (r4b: remote_compile HTTP 500 on BOTH variants), re-attempting
     # burns ~1 min of window per doomed compile.
-    if _PALLAS_OK is False:
+    if _PALLAS_OK is False or _PALLAS_FUSED_OK is False:
         emit({"stage": "kmeans_sweep", "engine": "pallas",
               "skipped": "pallas_probe failed — see pallas_probe rows"})
     else:
@@ -249,10 +249,13 @@ def kmeans_fit_stage():
                     "kmeans_fit")
 
 
-#: Set by pallas_probe_stage: None = not probed, True = trivial kernel
-#: compiled and ran, False = even the trivial kernel failed (kmeans_sweep
-#: then skips its doomed pallas configs instead of burning window time).
+#: Set by pallas_probe_stage: None = not probed, True = compiled and ran,
+#: False = failed.  kmeans_sweep skips its pallas configs unless BOTH are
+#: True-ish — its engine runs the fused kernel, so a fused-probe failure
+#: ("our kernel breaks the compiler", the r4b mode) dooms the sweep rows
+#: even when the trivial kernel compiles.
 _PALLAS_OK = None
+_PALLAS_FUSED_OK = None
 
 
 def pallas_probe_stage():
@@ -262,7 +265,7 @@ def pallas_probe_stage():
     kernel, (b) the real fused L2NN kernel at small shape, recording FULL
     error text — distinguishing 'axon cannot run Pallas' from 'our kernel
     breaks the compiler'."""
-    global _PALLAS_OK
+    global _PALLAS_OK, _PALLAS_FUSED_OK
     import jax
     import jax.numpy as jnp
 
@@ -292,9 +295,11 @@ def pallas_probe_stage():
         c = jnp.asarray(rng.random((256, 128), np.float32))
         out = fused_l2_nn_pallas(x, c)
         jax.block_until_ready(out)
+        _PALLAS_FUSED_OK = True
         emit({"stage": "pallas_probe", "case": "fused_l2nn_small",
               "ok": True})
     except Exception as e:  # noqa: BLE001 - record and continue
+        _PALLAS_FUSED_OK = False
         emit({"stage": "pallas_probe", "case": "fused_l2nn_small",
               "ok": False, "error": str(e)[:2000]})
 
@@ -414,7 +419,9 @@ def ivf_pq_stages():
 
     n, dim, nq = (5_000, 32, 128) if DRYRUN else (200_000, 128, 1024)
     x, q = ivf_pq_bench_data(n=n, dim=dim, nq=nq)
-    n_lists = 50 if DRYRUN else 1000
+    # r4 operating point (sweep-picked, recall 0.959 at 200k — bench.py
+    # bench_ivf_pq docstring has the data)
+    n_lists = 50 if DRYRUN else 2000
     pq_dim = 8 if DRYRUN else 32
     t0 = time.perf_counter()
     index = ivf_pq.build(ivf_pq.IndexParams(n_lists=n_lists, pq_dim=pq_dim,

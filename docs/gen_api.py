@@ -98,6 +98,10 @@ def _public_members(mod):
 _SUBMODULES = {
     "neighbors": ["ivf_flat", "ivf_pq", "ball_cover", "ann", "knn_mnmg",
                   "serialize"],
+    # kmeans_mnmg's surface (fit/predict/compute_new_centroids) lives on
+    # the submodule, not the package namespace — without this section the
+    # MNMG API (including fit's loop=/sync_every= knobs) is undocumented.
+    "cluster": ["kmeans_mnmg"],
 }
 
 

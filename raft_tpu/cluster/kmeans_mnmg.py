@@ -205,13 +205,15 @@ def _fit_host_loop(params: KMeansParams, comms: Comms, x_sharded, centroids,
             out_specs=(P(None, None), P(), P()),
         )
 
-    c, inertia = centroids, None
+    c = centroids
     n_iter = 0
     while n_iter < params.max_iter:
-        c, delta, inertia = run_step(c)
+        c, delta, _ = run_step(c)
         n_iter += 1
-        if tol2 > 0 and (n_iter % sync_every == 0
-                         or n_iter == params.max_iter):
+        # checking on the final iteration would be a dead break at the
+        # cost of a pipeline-stalling sync — only interior checkpoints
+        if tol2 > 0 and n_iter % sync_every == 0 \
+                and n_iter < params.max_iter:
             if float(delta) <= tol2:  # pipeline sync point
                 break
     # final inertia of the RETURNED centroids (the loop's inertia is one
