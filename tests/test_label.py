@@ -1,10 +1,44 @@
-"""Label utility tests — counterpart of reference cpp/test/label/*."""
+"""Label utility tests — counterpart of reference cpp/test/label/*
+(label.cu, merge_labels.cu), with a union-find oracle grid replacing the
+reference's handful of fixed cases."""
 
 import numpy as np
+import pytest
 
 
 from raft_tpu import label
 from raft_tpu.matrix import select_k
+
+
+def _merge_labels_oracle(labels_a, labels_b, mask):
+    """Pure-python union-find oracle for merge_labels' contract: nodes
+    sharing a labels_a class are connected; masked nodes sharing a
+    labels_b class are additionally connected; every node receives the
+    minimum labels_a value of its merged component."""
+    n = len(labels_a)
+    parent = list(range(n))
+
+    def find(i):
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]
+            i = parent[i]
+        return i
+
+    def union(i, j):
+        ri, rj = find(i), find(j)
+        if ri != rj:
+            parent[rj] = ri
+
+    first_of_a, first_of_b = {}, {}
+    for i in range(n):
+        union(i, first_of_a.setdefault(labels_a[i], i))
+        if mask[i]:
+            union(i, first_of_b.setdefault(labels_b[i], i))
+    comp_min = {}
+    for i in range(n):
+        r = find(i)
+        comp_min[r] = min(comp_min.get(r, labels_a[i]), labels_a[i])
+    return np.array([comp_min[find(i)] for i in range(n)], np.int32)
 
 
 def test_unique_labels():
@@ -33,6 +67,85 @@ def test_merge_labels():
     out = np.asarray(label.merge_labels(labels_a, labels_b, mask))
     # nodes 0,1 share class a=0; nodes 1,2 share class b=2 → {0,1,2} get 0
     np.testing.assert_array_equal(out, [0, 0, 0, 3])
+
+
+@pytest.mark.parametrize("n,n_classes,mask_frac,seed", [
+    (10, 3, 0.5, 0),
+    (100, 8, 0.3, 1),
+    (100, 8, 0.9, 2),
+    (1000, 40, 0.5, 3),
+    (1000, 5, 0.2, 4),    # few big classes: long merge chains
+    (257, 257, 0.5, 5),   # singleton classes: only the mask connects
+])
+def test_merge_labels_vs_union_find(n, n_classes, mask_frac, seed):
+    """Random grid against the union-find oracle — the reference's
+    merge_labels.cu fixed cases generalized."""
+    rng = np.random.default_rng(seed)
+    labels_a = rng.integers(0, n_classes, n).astype(np.int32)
+    labels_b = rng.integers(0, n_classes, n).astype(np.int32)
+    mask = rng.random(n) < mask_frac
+    out = np.asarray(label.merge_labels(labels_a, labels_b, mask))
+    np.testing.assert_array_equal(out,
+                                  _merge_labels_oracle(labels_a, labels_b,
+                                                       mask))
+
+
+def test_merge_labels_mask_all_false_is_identity():
+    """No masked nodes → labels_b never connects anything → labels_a
+    classes keep their own (already-minimal) label values."""
+    rng = np.random.default_rng(6)
+    labels_a = rng.integers(0, 7, 50).astype(np.int32)
+    out = np.asarray(label.merge_labels(labels_a,
+                                        rng.integers(0, 7, 50).astype(np.int32),
+                                        np.zeros(50, bool)))
+    np.testing.assert_array_equal(out, labels_a)
+
+
+def test_merge_labels_full_chain_collapses():
+    """All-true mask + labels_b chaining every adjacent labels_a class →
+    one component labeled with the global minimum."""
+    # a classes: 0,1,2,3; b connects (0,1),(1,2),(2,3)
+    labels_a = np.array([0, 0, 1, 1, 2, 2, 3, 3], np.int32)
+    labels_b = np.array([9, 4, 4, 5, 5, 6, 6, 9], np.int32)
+    out = np.asarray(label.merge_labels(labels_a, labels_b,
+                                        np.ones(8, bool)))
+    # b=9 ALSO connects nodes 0 and 7 — still one component, min=0
+    np.testing.assert_array_equal(out, np.zeros(8, np.int32))
+
+
+def test_get_unique_labels_unsorted_negative():
+    labels = np.array([3, -1, 7, -1, 3, 0])
+    np.testing.assert_array_equal(label.get_unique_labels(labels),
+                                  [-1, 0, 3, 7])
+
+
+def test_ovr_custom_values():
+    labels = np.array([0, 1, 2, 1])
+    np.testing.assert_array_equal(
+        np.asarray(label.get_ovr_labels(labels, 1, true_val=5, false_val=-5)),
+        [-5, 5, -5, 5])
+
+
+def test_make_monotonic_explicit_uniques_jit_safe():
+    """With unique_labels given, the mapping is jit-traceable (static
+    output shape — the reference's device-side variant)."""
+    import jax
+
+    labels = np.array([10, 30, 10, 20, 30])
+    uniq = np.array([10, 20, 30])
+    out = jax.jit(lambda l: label.make_monotonic(l, unique_labels=uniq))(labels)
+    np.testing.assert_array_equal(np.asarray(out), [0, 2, 0, 1, 2])
+
+
+def test_make_monotonic_native_matches_jnp():
+    """The native C++ host fast path and the jnp searchsorted path agree
+    (native path auto-selected for numpy input when built)."""
+    rng = np.random.default_rng(7)
+    labels = rng.choice([5, -3, 99, 12, 0], size=500).astype(np.int64)
+    via_default = np.asarray(label.make_monotonic(labels))
+    via_jnp = np.asarray(label.make_monotonic(
+        labels, unique_labels=sorted(set(labels.tolist()))))
+    np.testing.assert_array_equal(via_default, via_jnp)
 
 
 def test_select_k():

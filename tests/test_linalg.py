@@ -210,6 +210,139 @@ class TestDecompositions:
         np.testing.assert_allclose(l_up, l_full, atol=1e-10)
 
 
+class TestDecompositionGrids:
+    """Shape/dtype property grids — the reference runs each factorization
+    over parameter grids with per-dtype tolerance gates (cpp/test/linalg/
+    eig.cu, svd.cu, qr.cu, rsvd.cu, lstsq.cu input grids)."""
+
+    TOL = {np.float32: 1e-4, np.float64: 1e-10}
+
+    @pytest.mark.parametrize("n", [2, 8, 33])
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    def test_eig_grid(self, rng, n, dtype):
+        a = rng.standard_normal((n, n))
+        a = (a + a.T).astype(dtype)
+        for eig in (linalg.eig_dc, linalg.eig_jacobi):
+            v, w = eig(a)
+            v, w = np.asarray(v), np.asarray(w)
+            tol = self.TOL[dtype] * n
+            # ascending eigenvalues, orthonormal vectors, A v = w v
+            assert np.all(np.diff(w) >= -tol)
+            np.testing.assert_allclose(v.T @ v, np.eye(n), atol=tol)
+            np.testing.assert_allclose(a @ v, v * w[None, :], atol=tol * 10)
+
+    def test_eig_sel_largest(self, rng):
+        a = rng.standard_normal((12, 12))
+        a = (a + a.T).astype(np.float64)
+        v, w = linalg.eig_sel_dc(a, 4, smallest=False)
+        assert v.shape == (12, 4) and w.shape == (4,)
+        np.testing.assert_allclose(w, np.sort(np.linalg.eigvalsh(a))[-4:],
+                                   atol=1e-9)
+
+    @pytest.mark.parametrize("m,n", [(10, 6), (6, 10), (16, 16), (40, 3)])
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    def test_svd_grid(self, rng, m, n, dtype):
+        """svd_qr and svd_jacobi over tall/wide/square shapes: singular
+        values match numpy, U/V have orthonormal columns, reconstruction
+        holds."""
+        a = rng.standard_normal((m, n)).astype(dtype)
+        s_np = np.linalg.svd(a, compute_uv=False)
+        tol = self.TOL[dtype] * max(m, n) * 10
+        for svd in (linalg.svd_qr, linalg.svd_jacobi):
+            u, s, v = svd(a)
+            u, s, v = np.asarray(u), np.asarray(s), np.asarray(v)
+            k = min(m, n)
+            np.testing.assert_allclose(s, s_np, atol=tol)
+            np.testing.assert_allclose(u.T @ u, np.eye(k), atol=tol)
+            np.testing.assert_allclose(v.T @ v, np.eye(k), atol=tol)
+            np.testing.assert_allclose(linalg.svd_reconstruction(
+                jnp.asarray(u), jnp.asarray(s), jnp.asarray(v)), a, atol=tol)
+
+    def test_svd_vector_flags(self, rng):
+        a = rng.standard_normal((9, 4)).astype(np.float64)
+        u, s, v = linalg.svd_qr(a, gen_left_vec=False, gen_right_vec=False)
+        assert u is None and v is None and s.shape == (4,)
+
+    def test_svd_eig_tall_skinny(self, rng):
+        """svd_eig's Gram-matrix route matches svd_qr on its target shape
+        (tall-skinny), including a rank-deficient case."""
+        a = rng.standard_normal((60, 5)).astype(np.float64)
+        u, s, v = linalg.svd_eig(a)
+        np.testing.assert_allclose(s, np.linalg.svd(a, compute_uv=False),
+                                   atol=1e-8)
+        np.testing.assert_allclose(linalg.svd_reconstruction(u, s, v), a,
+                                   atol=1e-8)
+        # rank-deficient: column 4 = column 0 → smallest singular value 0
+        a[:, 4] = a[:, 0]
+        _, s2, _ = linalg.svd_eig(jnp.asarray(a))
+        assert abs(float(s2[-1])) < 1e-6
+
+    @pytest.mark.parametrize("m,n", [(8, 5), (5, 5), (30, 2)])
+    def test_qr_grid(self, rng, m, n):
+        a = rng.standard_normal((m, n)).astype(np.float64)
+        q = np.asarray(linalg.qr_get_q(a))
+        np.testing.assert_allclose(q.T @ q, np.eye(n), atol=1e-10)
+        # Q spans col(a): projecting a onto Q reproduces a
+        np.testing.assert_allclose(q @ (q.T @ a), a, atol=1e-10)
+
+    def test_rsvd_perc(self, rng):
+        u0 = rng.standard_normal((64, 8))
+        v0 = rng.standard_normal((8, 40))
+        a = (u0 @ v0).astype(np.float64)
+        # 20% of min(64,40)=40 → k=8: exact recovery of the rank-8 matrix
+        u, s, v = linalg.rsvd_perc(a, 0.2, p=5, n_iters=3)
+        assert s.shape == (8,)
+        np.testing.assert_allclose(linalg.svd_reconstruction(u, s, v), a,
+                                   atol=1e-6)
+
+    def test_rsvd_decaying_spectrum(self, rng):
+        """Full-rank matrix with geometric spectrum decay: rsvd's top-k
+        singular values match the exact ones (Halko guarantee regime)."""
+        m, n, k = 50, 40, 6
+        u0 = np.linalg.qr(rng.standard_normal((m, n)))[0]
+        v0 = np.linalg.qr(rng.standard_normal((n, n)))[0]
+        s0 = 2.0 ** -np.arange(n)
+        a = (u0 * s0[None, :]) @ v0.T
+        _, s, _ = linalg.rsvd_fixed_rank(jnp.asarray(a), k=k, p=10, n_iters=3)
+        np.testing.assert_allclose(np.asarray(s), s0[:k], rtol=1e-6)
+
+    def test_lstsq_overdetermined_noisy(self, rng):
+        """With noise, all four engines agree with numpy's least-squares
+        SOLUTION (not the generating weights) — the reference's lstsq.cu
+        checks the same fixed point."""
+        a = rng.standard_normal((50, 7)).astype(np.float64)
+        b = a @ rng.standard_normal(7) + 0.1 * rng.standard_normal(50)
+        w_np = np.linalg.lstsq(a, b, rcond=None)[0]
+        for fn in (linalg.lstsq_svd_qr, linalg.lstsq_svd_jacobi,
+                   linalg.lstsq_eig, linalg.lstsq_qr):
+            np.testing.assert_allclose(np.asarray(fn(a, b)), w_np, atol=1e-8,
+                                       err_msg=str(fn))
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    def test_lstsq_dtype_grid(self, rng, dtype):
+        a = rng.standard_normal((30, 4)).astype(dtype)
+        w_true = rng.standard_normal(4).astype(dtype)
+        b = a @ w_true
+        tol = 1e-3 if dtype == np.float32 else 1e-9
+        for fn in (linalg.lstsq_svd_qr, linalg.lstsq_qr):
+            np.testing.assert_allclose(np.asarray(fn(a, b)), w_true, atol=tol,
+                                       err_msg=str(fn))
+
+    def test_cholesky_r1_update_chain(self, rng):
+        """Growing a Cholesky factor one column at a time from 1x1 to full
+        reproduces the direct factorization at every step (the incremental
+        pattern cholesky_r1_update exists for)."""
+        n = 8
+        a = rng.standard_normal((n, n))
+        a = a @ a.T + n * np.eye(n)
+        l_cur = np.linalg.cholesky(a[:1, :1])
+        for k in range(2, n + 1):
+            l_cur = np.asarray(linalg.cholesky_r1_update(
+                jnp.asarray(l_cur), jnp.asarray(a[:k, k - 1])))
+            np.testing.assert_allclose(l_cur, np.linalg.cholesky(a[:k, :k]),
+                                       atol=1e-9)
+
+
 class TestTranspose:
     def test_transpose(self, rng):
         a = rng.random((3, 5)).astype(np.float32)

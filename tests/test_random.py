@@ -138,3 +138,146 @@ def test_rmat():
     assert dst.min() >= 0 and dst.max() < 2**8
     # skewed distribution: low ids dominate (a=0.57 upper-left)
     assert (src < 2**9).mean() > 0.65
+
+
+# ---------------------------------------------------------------------------
+# Distributional oracles beyond first moments — the reference checks each
+# generator against expected statistics per type/dtype (test/random/rng.cu
+# MeanError grids, rng_int.cu); here each continuous distribution is held
+# to a Kolmogorov–Smirnov test against its exact scipy CDF, which catches
+# shape errors (wrong tails, truncation, transform bugs) that mean/std
+# tolerances cannot.
+# ---------------------------------------------------------------------------
+
+scipy_stats = pytest.importorskip("scipy.stats")
+
+
+@pytest.mark.parametrize(
+    "fn,kwargs,dist,dist_args",
+    [
+        (rrandom.uniform, dict(low=-1.0, high=3.0), "uniform", (-1.0, 4.0)),
+        (rrandom.normal, dict(mu=2.0, sigma=0.5), "norm", (2.0, 0.5)),
+        (rrandom.lognormal, dict(mu=0.2, sigma=0.4), "lognorm",
+         (0.4, 0, np.exp(0.2))),
+        (rrandom.gumbel, dict(mu=1.0, beta=2.0), "gumbel_r", (1.0, 2.0)),
+        (rrandom.logistic, dict(mu=-1.0, scale=0.7), "logistic", (-1.0, 0.7)),
+        (rrandom.exponential, dict(lambda_=2.5), "expon", (0, 1 / 2.5)),
+        (rrandom.rayleigh, dict(sigma=1.5), "rayleigh", (0, 1.5)),
+        (rrandom.laplace, dict(mu=0.5, scale=1.2), "laplace", (0.5, 1.2)),
+    ],
+)
+def test_distribution_ks(fn, kwargs, dist, dist_args):
+    x = np.asarray(fn(RngState(21), (20000,), **kwargs), np.float64)
+    stat, pvalue = scipy_stats.kstest(x, dist, args=dist_args)
+    assert pvalue > 1e-3, (
+        f"{fn.__name__} KS stat {stat:.4f} p={pvalue:.2e} vs {dist}{dist_args}")
+
+
+def test_uniform_int_chi_square():
+    """Every value in [low, high) equally likely (rng_int.cu role)."""
+    low, high, n = 5, 21, 64000
+    x = np.asarray(rrandom.uniform_int(RngState(22), (n,), low, high))
+    counts = np.bincount(x - low, minlength=high - low)
+    expected = n / (high - low)
+    chi2 = ((counts - expected) ** 2 / expected).sum()
+    # 15 dof: P(chi2 > 37.7) ≈ 1e-3
+    assert chi2 < 37.7, f"chi2 {chi2:.1f}, counts {counts}"
+
+
+def test_normal_int_moments():
+    x = np.asarray(rrandom.normal_int(RngState(23), (40000,), 100, 7))
+    assert x.dtype == np.int32
+    assert abs(x.mean() - 100) < 0.5
+    assert abs(x.std() - 7) < 0.5
+
+
+def test_fill_exact():
+    x = np.asarray(rrandom.fill(RngState(24), (7, 3), 2.5))
+    np.testing.assert_array_equal(x, np.full((7, 3), 2.5, np.float32))
+
+
+def test_subsequence_streams_uncorrelated():
+    """Streams drawn from the SAME seed at successive subsequences must be
+    independent (the reference's PhiloxGenerator subsequence contract)."""
+    st = RngState(77)
+    a = np.asarray(rrandom.normal(st, (20000,)))
+    b = np.asarray(rrandom.normal(st, (20000,)))
+    r = np.corrcoef(a, b)[0, 1]
+    assert abs(r) < 0.02, f"successive streams correlate: r={r}"
+
+
+def test_discrete_unnormalized_weights():
+    """Weights need not sum to 1 (reference discrete_rng normalizes)."""
+    w = np.array([2.0, 0.0, 6.0])
+    x = np.asarray(rrandom.discrete(RngState(25), (30000,), w))
+    counts = np.bincount(x, minlength=3) / 30000
+    np.testing.assert_allclose(counts, w / w.sum(), atol=0.02)
+
+
+def test_sample_without_replacement_full_draw():
+    """n_samples == n is exactly a permutation: every item once."""
+    items = np.arange(64)
+    out = np.asarray(rrandom.sample_without_replacement(RngState(26), items, 64))
+    assert sorted(out.tolist()) == list(range(64))
+
+
+def test_sample_without_replacement_zero_weight_excluded():
+    """Zero-weight items can never be drawn while positive-weight items
+    remain (weighted reservoir property)."""
+    items = np.arange(10)
+    w = np.ones(10)
+    w[[2, 5]] = 0.0
+    for seed in range(10):
+        out = np.asarray(rrandom.sample_without_replacement(
+            RngState(seed), items, 8, weights=w))
+        assert 2 not in out and 5 not in out
+
+
+def test_permute_n_only_form():
+    """permute(rng, n=...) returns a bare permutation of arange(n)."""
+    perm = np.asarray(rrandom.permute(RngState(27), n=33))
+    assert sorted(perm.tolist()) == list(range(33))
+
+
+def test_permute_round_trip():
+    """Applying the returned perm to the input reproduces the output, and
+    the inverse perm restores the original (permute.cuh contract)."""
+    x = np.random.default_rng(0).random((40, 5)).astype(np.float32)
+    out, perm = rrandom.permute(RngState(28), x)
+    out, perm = np.asarray(out), np.asarray(perm)
+    np.testing.assert_allclose(out, x[perm])
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(len(perm))
+    np.testing.assert_allclose(out[inv], x)
+
+
+def test_make_blobs_no_shuffle_balanced():
+    """shuffle=False: labels cycle 0..k-1 (the reference's balanced
+    proportions default), and given centers are passed through."""
+    centers = np.array([[0.0, 0.0], [100.0, 100.0]], np.float32)
+    x, labels, c_out = rrandom.make_blobs(
+        RngState(29), 10, 2, centers=centers, cluster_std=0.01, shuffle=False)
+    np.testing.assert_array_equal(np.asarray(labels), np.arange(10) % 2)
+    np.testing.assert_allclose(np.asarray(c_out), centers)
+    np.testing.assert_allclose(np.asarray(x)[1], centers[1], atol=1.0)
+
+
+def test_make_regression_noise_and_shuffle():
+    """noise>0 perturbs y around x@w; shuffle preserves the (x, y) pairing."""
+    x, y, w = rrandom.make_regression(
+        RngState(30), 300, 8, n_informative=8, noise=0.1, coef=True,
+        shuffle=True)
+    resid = np.asarray(y) - np.asarray(x) @ np.asarray(w)
+    assert 0.05 < resid.std() < 0.2  # noise scale honored after shuffling
+
+
+def test_rmat_square_and_theta_normalization():
+    """Square generator form; unnormalized theta is accepted (the
+    reference normalizes per quadrant internally)."""
+    theta = np.array([5.7, 1.9, 1.9, 0.5])  # 10x the usual, unnormalized
+    out, src, dst = rrandom.rmat_rectangular_gen(RngState(31), theta, 6, 6,
+                                                 4000)
+    src, dst = np.asarray(src), np.asarray(dst)
+    assert src.max() < 64 and dst.max() < 64
+    # same skew as the normalized theta
+    assert (src < 32).mean() > 0.65 and (dst < 32).mean() > 0.65
