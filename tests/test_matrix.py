@@ -160,3 +160,76 @@ class TestOpsOracleSweep:
         w = np.abs(rng.normal(0, 1, m.shape)).astype(np.float32)  # elementwise
         wr = np.asarray(ops.weighted_ratio(m, w))
         np.testing.assert_allclose(wr, m / (m * w).sum(), rtol=1e-5)
+
+
+class TestSelectKGrid:
+    """select_k property grid at the shapes/edge cases the reference's
+    three-engine selection family tests cover (matrix/select_k.cuh,
+    topk/warpsort vs radix tests): k extremes, duplicate values, payload
+    carry, both directions, multiple dtypes."""
+
+    @pytest.mark.parametrize("nq,n,k", [(1, 1, 1), (4, 100, 1), (4, 100, 100),
+                                        (16, 257, 7), (3, 1024, 64)])
+    @pytest.mark.parametrize("select_min", [True, False])
+    def test_vs_numpy_sort(self, nq, n, k, select_min):
+        from raft_tpu.matrix import select_k
+
+        rng = np.random.default_rng(nq * 1000 + n + k)
+        x = rng.standard_normal((nq, n)).astype(np.float32)
+        vals, idx = select_k(x, k, select_min=select_min)
+        vals, idx = np.asarray(vals), np.asarray(idx)
+        want = np.sort(x, axis=1)[:, :k] if select_min \
+            else -np.sort(-x, axis=1)[:, :k]
+        np.testing.assert_allclose(vals, want, rtol=1e-6)
+        # returned indices must address the returned values
+        np.testing.assert_allclose(np.take_along_axis(x, idx, axis=1), vals,
+                                   rtol=1e-6)
+
+    def test_duplicate_values_indices_valid(self):
+        """With massive ties the k selected values are still correct and
+        each returned index addresses a matching element (the reference
+        permits any tie order; so do we)."""
+        from raft_tpu.matrix import select_k
+
+        x = np.tile(np.array([[2.0, 1.0, 1.0, 1.0, 3.0]], np.float32),
+                    (3, 1))
+        vals, idx = select_k(x, 3)
+        np.testing.assert_allclose(np.asarray(vals),
+                                   [[1.0, 1.0, 1.0]] * 3)
+        picked = np.take_along_axis(x, np.asarray(idx), axis=1)
+        np.testing.assert_allclose(picked, np.asarray(vals))
+        assert all(len(set(row.tolist())) == 3 for row in np.asarray(idx))
+
+    def test_payload_carry_roundtrip(self):
+        """Custom indices payload rides along (the IVF merge use-case:
+        payload = global ids, values = distances)."""
+        from raft_tpu.matrix import select_k
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((5, 40)).astype(np.float32)
+        payload = rng.integers(0, 10**6, (5, 40)).astype(np.int32)
+        vals, got_payload = select_k(x, 6, indices=payload)
+        order = np.argsort(x, axis=1)[:, :6]
+        np.testing.assert_array_equal(np.asarray(got_payload),
+                                      np.take_along_axis(payload, order,
+                                                         axis=1))
+
+    def test_select_min_max_aliases(self):
+        from raft_tpu.matrix import select_k, select_max_k, select_min_k
+
+        x = np.random.default_rng(1).standard_normal((4, 32)).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(select_min_k(x, 5)[0]),
+                                      np.asarray(select_k(x, 5)[0]))
+        np.testing.assert_array_equal(
+            np.asarray(select_max_k(x, 5)[0]),
+            np.asarray(select_k(x, 5, select_min=False)[0]))
+
+    def test_1d_input_single_query(self):
+        """A 1-D values vector selects along its only axis (lax.top_k
+        semantics) — pinned so a future engine swap keeps the contract."""
+        from raft_tpu.matrix import select_k
+
+        x = np.arange(10, dtype=np.float32)[::-1].copy()
+        vals, idx = select_k(x, 3)
+        np.testing.assert_allclose(np.asarray(vals).ravel(), [0.0, 1.0, 2.0])
+        np.testing.assert_array_equal(np.asarray(idx).ravel(), [9, 8, 7])

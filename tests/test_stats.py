@@ -222,3 +222,136 @@ class TestDtypeSweep:
         got = np.asarray(stats.row_weighted_mean(x, w))
         ref = (x * w).sum(axis=1) / w.sum()
         np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+class TestSklearnOracleGrids:
+    """Random grids against sklearn/scipy reference implementations —
+    stronger than the reference's self-oracles (cpp/test/stats/* compare
+    CUDA kernels against naive CUDA kernels; here the oracle is an
+    independent library)."""
+
+    @pytest.mark.parametrize("n,k,seed", [(50, 2, 0), (500, 7, 1),
+                                          (300, 12, 2)])
+    def test_clustering_comparison_metrics(self, n, k, seed):
+        r = np.random.default_rng(seed)
+        a = r.integers(0, k, n)
+        b = np.where(r.random(n) < 0.3, r.integers(0, k, n), a)  # noisy copy
+        np.testing.assert_allclose(float(stats.adjusted_rand_index(a, b)),
+                                   skm.adjusted_rand_score(a, b), atol=1e-6)
+        np.testing.assert_allclose(float(stats.rand_index(a, b)),
+                                   skm.rand_score(a, b), atol=1e-6)
+        np.testing.assert_allclose(float(stats.mutual_info_score(a, b)),
+                                   skm.mutual_info_score(a, b), atol=1e-6)
+        np.testing.assert_allclose(float(stats.homogeneity_score(a, b)),
+                                   skm.homogeneity_score(a, b), atol=1e-6)
+        np.testing.assert_allclose(float(stats.completeness_score(a, b)),
+                                   skm.completeness_score(a, b), atol=1e-6)
+        np.testing.assert_allclose(float(stats.v_measure(a, b)),
+                                   skm.v_measure_score(a, b), atol=1e-6)
+
+    def test_comparison_metrics_relabel_invariant(self):
+        """Permuting label IDS must not change any comparison metric."""
+        r = np.random.default_rng(3)
+        a = r.integers(0, 5, 200)
+        b = r.integers(0, 5, 200)
+        perm = np.array([3, 0, 4, 1, 2])
+        for fn in (stats.adjusted_rand_index, stats.rand_index,
+                   stats.mutual_info_score, stats.v_measure):
+            np.testing.assert_allclose(float(fn(a, b)), float(fn(perm[a], b)),
+                                       atol=1e-6, err_msg=str(fn))
+
+    def test_perfect_and_independent_labelings(self):
+        a = np.array([0, 0, 1, 1, 2, 2])
+        assert float(stats.adjusted_rand_index(a, a)) == pytest.approx(1.0)
+        assert float(stats.v_measure(a, a)) == pytest.approx(1.0)
+        # independent labels: ARI concentrates near 0 (can be slightly <0)
+        r = np.random.default_rng(4)
+        x, y = r.integers(0, 4, 2000), r.integers(0, 4, 2000)
+        assert abs(float(stats.adjusted_rand_index(x, y))) < 0.05
+
+    @pytest.mark.parametrize("n,k,d,seed", [(80, 3, 4, 0), (200, 6, 8, 1)])
+    def test_silhouette_vs_sklearn(self, n, k, d, seed):
+        from raft_tpu.distance import DistanceType
+
+        r = np.random.default_rng(seed)
+        x = (r.normal(0, 1, (n, d))
+             + 3.0 * r.integers(0, k, n)[:, None]).astype(np.float64)
+        labels = r.integers(0, k, n)
+        want = skm.silhouette_score(x, labels, metric="euclidean")
+        got = float(stats.silhouette_score(
+            x, labels, metric=DistanceType.L2SqrtExpanded))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        # batched path with a batch smaller than n must agree exactly
+        got_b = float(stats.silhouette_score_batched(
+            x, labels, metric=DistanceType.L2SqrtExpanded, batch_size=37))
+        np.testing.assert_allclose(got_b, want, atol=1e-5)
+
+    @pytest.mark.parametrize("n_neighbors", [3, 5, 12])
+    def test_trustworthiness_vs_sklearn(self, n_neighbors):
+        from sklearn.manifold import trustworthiness as sk_trust
+
+        r = np.random.default_rng(5)
+        x = r.normal(0, 1, (120, 10))
+        emb = x[:, :2] + 0.01 * r.normal(0, 1, (120, 2))  # PCA-ish embedding
+        want = sk_trust(x, emb, n_neighbors=n_neighbors)
+        got = float(stats.trustworthiness_score(x, emb,
+                                                n_neighbors=n_neighbors))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_entropy_vs_scipy(self):
+        import scipy.stats as sps
+
+        labels = np.random.default_rng(6).integers(0, 7, 500)
+        p = np.bincount(labels) / len(labels)
+        np.testing.assert_allclose(float(stats.entropy(labels)),
+                                   sps.entropy(p), atol=1e-6)
+
+    def test_kl_divergence_vs_scipy(self):
+        import scipy.stats as sps
+
+        r = np.random.default_rng(7)
+        p = r.random(32)
+        q = r.random(32)
+        p, q = p / p.sum(), q / q.sum()
+        np.testing.assert_allclose(float(stats.kl_divergence(p, q)),
+                                   sps.entropy(p, q), atol=1e-6)
+
+    def test_histogram_grid_vs_numpy(self):
+        r = np.random.default_rng(8)
+        x = r.normal(0, 2, (5000, 3)).astype(np.float32)
+        for n_bins, lo, hi in ((5, -6.0, 6.0), (64, -1.0, 1.0)):
+            h = np.asarray(stats.histogram(x, n_bins, lo, hi))
+            for j in range(3):
+                clipped = np.clip(x[:, j], lo, np.nextafter(hi, lo))
+                want = np.histogram(clipped, bins=n_bins, range=(lo, hi))[0]
+                np.testing.assert_array_equal(h[:, j], want)
+
+    def test_histogram_auto_range(self):
+        """lower/upper omitted: range spans the GLOBAL min/max (reference
+        binner default), every sample lands in some bin."""
+        r = np.random.default_rng(9)
+        x = r.normal(0, 1, (1000, 2))
+        h = np.asarray(stats.histogram(x, 16))
+        assert h.sum() == 2000
+
+    @pytest.mark.parametrize("sample", [True, False])
+    def test_cov_ddof_conventions(self, sample):
+        r = np.random.default_rng(10)
+        x = r.normal(0, 1, (64, 5))
+        got = np.asarray(stats.cov(x, sample=sample))
+        want = np.cov(x.T, ddof=1 if sample else 0)
+        np.testing.assert_allclose(got, want, atol=1e-10)
+
+    def test_regression_metrics_vs_sklearn(self):
+        r = np.random.default_rng(11)
+        y = r.normal(0, 1, 256)
+        yh = y + 0.3 * r.normal(0, 1, 256)
+        np.testing.assert_allclose(float(stats.r2_score(y, yh)),
+                                   skm.r2_score(y, yh), atol=1e-6)
+        mae, mse, medae = stats.regression_metrics(yh, y)
+        np.testing.assert_allclose(mae, skm.mean_absolute_error(y, yh),
+                                   atol=1e-6)
+        np.testing.assert_allclose(mse, skm.mean_squared_error(y, yh),
+                                   atol=1e-6)
+        np.testing.assert_allclose(medae, skm.median_absolute_error(y, yh),
+                                   atol=1e-6)
