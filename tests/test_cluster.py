@@ -273,3 +273,78 @@ class TestEngineResolution:
         monkeypatch.setattr(pallas_fused_l2nn, "is_enabled", lambda: True)
         cluster.min_cluster_and_distance(x, c)
         assert seen == ["xla", "pallas"]
+
+
+class TestLibraryOracles:
+    """sklearn/scipy oracle grids (the reference validates against its own
+    CPU naive kernels; an independent library is a stronger oracle)."""
+
+    def test_kmeans_matches_sklearn_same_init(self):
+        """Identical init array + Lloyd iterations → the same fixed point
+        as sklearn KMeans (algorithm='lloyd', n_init=1)."""
+        from sklearn.cluster import KMeans as SkKMeans
+
+        x, _, centers = make_blobs(RngState(50), 800, 10, n_clusters=6,
+                                   cluster_std=0.8)
+        x, centers = np.asarray(x, np.float64), np.asarray(centers, np.float64)
+        params = KMeansParams(n_clusters=6, init=InitMethod.Array,
+                              max_iter=100, tol=1e-10)
+        ours = cluster.fit(params, x, centroids=centers)
+        sk = SkKMeans(n_clusters=6, init=centers, n_init=1, max_iter=100,
+                      tol=1e-10, algorithm="lloyd").fit(x)
+        np.testing.assert_allclose(float(ours.inertia), sk.inertia_,
+                                   rtol=1e-6)
+        # same partition (up to label permutation)
+        labels, _ = cluster.predict(params, x, ours.centroids)
+        assert float(adjusted_rand_index(np.asarray(labels),
+                                         sk.labels_)) == pytest.approx(1.0)
+
+    def test_plus_plus_init_beats_random(self):
+        """k-means|| seeding lands a materially better starting inertia
+        than uniform-random points on well-separated blobs (the seeding
+        quality property the reference's initKMeansPlusPlus exists for)."""
+        x, _, _ = make_blobs(RngState(51), 2000, 8, n_clusters=16,
+                             cluster_std=0.2)
+        x = np.asarray(x)
+        pp = np.asarray(cluster.init_plus_plus(RngState(1), x, 16, 2.0))
+        r = np.random.default_rng(1)
+        rand_init = x[r.choice(len(x), 16, replace=False)]
+
+        def inertia(c):
+            nn = cluster.min_cluster_and_distance(jnp.asarray(x),
+                                                  jnp.asarray(c))
+            return float(cluster.cluster_cost(nn))
+
+        # ++ seeding should be several times better pre-EM on this data
+        assert inertia(pp) < 0.5 * inertia(rand_init)
+
+    @pytest.mark.parametrize("n,d,seed", [(60, 3, 0), (200, 8, 1),
+                                          (128, 2, 2)])
+    def test_single_linkage_grid_vs_scipy(self, n, d, seed):
+        """Full dendrogram parity with scipy single linkage across a
+        size/dim grid (reference test/cluster/linkage.cu cases)."""
+        import scipy.cluster.hierarchy as sch
+        from scipy.spatial.distance import pdist
+
+        r = np.random.default_rng(seed)
+        x = r.normal(0, 1, (n, d)).astype(np.float64)
+        for n_clusters in (2, 5):
+            out = cluster.single_linkage(x, n_clusters=n_clusters)
+            want = sch.fcluster(sch.linkage(pdist(x), method="single"),
+                                n_clusters, criterion="maxclust")
+            ari = float(adjusted_rand_index(np.asarray(out.labels), want))
+            assert ari == pytest.approx(1.0), f"n_clusters={n_clusters}"
+
+    def test_kmeans_inertia_monotone_in_k(self):
+        """Optimal inertia is non-increasing in k (sanity property the
+        reference checks via its elbow-style test grids)."""
+        x, _, _ = make_blobs(RngState(52), 500, 6, n_clusters=8,
+                             cluster_std=1.0)
+        x = np.asarray(x)
+        prev = np.inf
+        for k in (2, 4, 8, 16):
+            params = KMeansParams(n_clusters=k, max_iter=50, seed=3,
+                                  n_init=3)
+            out = cluster.fit(params, x)
+            assert float(out.inertia) <= prev * 1.001, f"k={k}"
+            prev = float(out.inertia)
