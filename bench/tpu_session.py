@@ -532,6 +532,56 @@ def lanczos_stage():
     emit({"stage": "lanczos", "solves_s": round(1.0 / best, 3)})
 
 
+def _completed_stages():
+    """Stage names with a ``stage_done`` row already in OUT — the resume
+    set for re-armed windows (bench/tpu_wait_and_measure.sh re-runs the
+    session when a window closes mid-way; without resume, every short
+    window would re-measure the compile-heavy early stages and the late
+    stages could stay unreached forever).  A stage that crashed before
+    its ``stage_done`` marker re-runs.  ``RAFT_TPU_SESSION_FORCE=1``
+    ignores the resume set (fresh full session)."""
+    done = set()
+    if os.environ.get("RAFT_TPU_SESSION_FORCE"):
+        return done
+    try:
+        with open(OUT) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if row.get("stage") == "stage_done":
+                    done.add(row.get("name"))
+                elif row.get("stage") == "session" and row.get("done"):
+                    # a full session completed here — later runs (e.g. the
+                    # next round's driver) start fresh, not resumed
+                    done.clear()
+    except FileNotFoundError:
+        pass
+    return done
+
+
+def _restore_pallas_flags():
+    """When pallas_probe_stage is resumed-over, reconstruct its gate
+    globals from the recorded probe rows so kmeans_sweep still skips
+    doomed configs."""
+    global _PALLAS_OK, _PALLAS_FUSED_OK
+    try:
+        with open(OUT) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if row.get("stage") == "pallas_probe":
+                    if row.get("case") == "trivial_add":
+                        _PALLAS_OK = row.get("ok")
+                    elif row.get("case") == "fused_l2nn_small":
+                        _PALLAS_FUSED_OK = row.get("ok")
+    except FileNotFoundError:
+        pass
+
+
 if __name__ == "__main__":
     import jax
 
@@ -549,14 +599,27 @@ if __name__ == "__main__":
     # compile probes (2 cheap compiles that decide whether the sweep's
     # pallas rows can exist at all), the real config[1] while_loop fit,
     # the MNMG layer diagnosis, then the wider grids, then subprocesses.
-    pairwise_stage()
-    pallas_probe_stage()
-    kmeans_fit_stage()
-    mnmg_diag_stage()
-    ivf_pq_stages()
-    lanczos_stage()
-    kmeans_sweep()
-    select_k_stage()
-    headline()
-    aot_cold_start_stage()
+    stages = [
+        ("pairwise", pairwise_stage),
+        ("pallas_probe", pallas_probe_stage),
+        ("kmeans_fit", kmeans_fit_stage),
+        ("mnmg_diag", mnmg_diag_stage),
+        ("ivf_pq", ivf_pq_stages),
+        ("lanczos", lanczos_stage),
+        ("kmeans_sweep", kmeans_sweep),
+        ("select_k", select_k_stage),
+        ("headline", headline),
+        ("aot", aot_cold_start_stage),
+    ]
+    done = _completed_stages()
+    if done:
+        emit({"stage": "session", "resuming": True,
+              "skipping": sorted(done)})
+        if "pallas_probe" in done:
+            _restore_pallas_flags()
+    for name, stage_fn in stages:
+        if name in done:
+            continue
+        stage_fn()
+        emit({"stage": "stage_done", "name": name})
     emit({"stage": "session", "done": True})

@@ -1,9 +1,16 @@
 #!/bin/bash
 # Poll for TPU availability; when the tunnel is live, run the measurement
-# session (bench/tpu_session.py) once and exit.  The axon backend BLOCKS
-# (rather than failing) while the tunnel is down, so the probe runs in a
+# session (bench/tpu_session.py).  The axon backend BLOCKS (rather than
+# failing) while the tunnel is down, so the probe runs in a
 # timeout-guarded subprocess.
+#
+# RE-ARMING (r4): windows are short (~35-45 min observed) and can close
+# mid-session.  If the session did not emit its terminal
+# {"stage": "session", "done": true} row, the loop goes back to probing
+# and runs the session again at the next window — every row is appended
+# per-measurement, so partial windows accumulate instead of being lost.
 cd "$(dirname "$0")/.."
+OUT=tpu_session_results.jsonl
 for i in $(seq 1 "${1:-60}"); do
   if timeout -k 10 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "tpu live (probe $i) — starting session" >&2
@@ -13,10 +20,20 @@ for i in $(seq 1 "${1:-60}"); do
     # a 1-vCPU host serializes XLA:TPU compiles to, plus the 1800 s AOT
     # stage.  The session appends per-measurement, so even a cap hit
     # loses nothing recorded.
-    timeout 32400 python -m bench.tpu_session
-    exit $?
+    pre=$(wc -l < "$OUT" 2>/dev/null || echo 0)
+    timeout 32400 python -m bench.tpu_session "$OUT"
+    rc=$?
+    # Only rows appended by THIS run count — a stale done-row from an
+    # earlier completed session must not mask an incomplete one.
+    if tail -n "+$((pre + 1))" "$OUT" 2>/dev/null \
+        | grep -q '"stage": "session", "done": true'; then
+      echo "session complete (rc=$rc)" >&2
+      exit "$rc"
+    fi
+    echo "session incomplete (rc=$rc) — window likely closed; re-arming" >&2
+  else
+    echo "probe $i: tpu unreachable" >&2
   fi
-  echo "probe $i: tpu unreachable" >&2
   sleep 240
 done
 echo "gave up waiting for tpu" >&2
