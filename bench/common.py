@@ -100,6 +100,21 @@ def make_emitter(out_path):
     return emit
 
 
+def jsonl_rows(path):
+    """Yield parsed rows from a JSONL file, skipping unparsable lines and
+    a missing file — the ONE reader for the session protocol
+    (make_emitter is the one writer)."""
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+    except FileNotFoundError:
+        return
+
+
 def timed_amortized(step, carry0, k_lo=4, k_hi=16, reps=4):
     """Device-amortized per-iteration time for *step* (carry -> carry).
 

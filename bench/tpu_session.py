@@ -88,17 +88,23 @@ def run_subprocess_emit(argv, timeout, stage, env=None, **tag):
             proc.kill()
         proc.wait()
         emit({"stage": stage, "error": "timeout", **tag})
-        return
+        return False
     for line in reversed(out.strip().splitlines()):
         if line.startswith("{"):
             # success rows carry their own metric fields; *tag* labels
             # only the error emissions
             emit({"stage": stage, **json.loads(line)})
-            return
+            return True
     emit({"stage": stage, "error": "no JSON", **tag})
+    return False
 
 
 def headline():
+    """Returns False unless EVERY metric's subprocess emitted a real row —
+    a timeout here usually means the window closed mid-stage, and marking
+    the stage done would permanently skip the headline numbers on every
+    re-armed window (r4 code-review finding)."""
+    ok = True
     env = dict(os.environ)
     # Not-yet-recorded configs first: the tunnel window can close mid-session
     # (it did in r2a AND r2b), and pairwise/kmeans already have live numbers.
@@ -120,8 +126,9 @@ def headline():
         # child.  If we do have to kill bench.py here, its child is a
         # separate session that killpg can't reach — the child's orphan
         # watchdog (bench._orphan_watchdog) reaps it within ~10 s.
-        run_subprocess_emit([sys.executable, "bench.py"], 2800, "headline",
-                            env=dict(env), metric=m)
+        ok = run_subprocess_emit([sys.executable, "bench.py"], 2800,
+                                 "headline", env=dict(env), metric=m) and ok
+    return ok
 
 
 def kmeans_sweep():
@@ -458,8 +465,9 @@ def aot_cold_start_stage():
     matters most (first TPU compiles are 20-40 s).  Children run
     sequentially under a live parent (the r2a-proven headline pattern);
     placed LAST so a wedged bring-up costs only the bounded timeout after
-    everything else is recorded."""
-    run_subprocess_emit([sys.executable, "-m", "bench.bench_aot"], 1800,
+    everything else is recorded.  Returns False on timeout/no-JSON so the
+    stage is retried at the next window (see headline)."""
+    return run_subprocess_emit([sys.executable, "-m", "bench.bench_aot"], 1800,
                         "aot")
 
 
@@ -540,24 +548,21 @@ def _completed_stages():
     stages could stay unreached forever).  A stage that crashed before
     its ``stage_done`` marker re-runs.  ``RAFT_TPU_SESSION_FORCE=1``
     ignores the resume set (fresh full session)."""
+    from bench.common import jsonl_rows
+
     done = set()
-    if os.environ.get("RAFT_TPU_SESSION_FORCE"):
+    if os.environ.get("RAFT_TPU_SESSION_FORCE") or DRYRUN:
+        # DRYRUN rehearsals must always exercise every stage (their whole
+        # point), and must never be steered by — or steer — real session
+        # state.
         return done
-    try:
-        with open(OUT) as f:
-            for line in f:
-                try:
-                    row = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if row.get("stage") == "stage_done":
-                    done.add(row.get("name"))
-                elif row.get("stage") == "session" and row.get("done"):
-                    # a full session completed here — later runs (e.g. the
-                    # next round's driver) start fresh, not resumed
-                    done.clear()
-    except FileNotFoundError:
-        pass
+    for row in jsonl_rows(OUT):
+        if row.get("stage") == "stage_done":
+            done.add(row.get("name"))
+        elif row.get("stage") == "session" and row.get("done"):
+            # a full session completed here — later runs (e.g. the
+            # next round's driver) start fresh, not resumed
+            done.clear()
     return done
 
 
@@ -566,20 +571,14 @@ def _restore_pallas_flags():
     globals from the recorded probe rows so kmeans_sweep still skips
     doomed configs."""
     global _PALLAS_OK, _PALLAS_FUSED_OK
-    try:
-        with open(OUT) as f:
-            for line in f:
-                try:
-                    row = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if row.get("stage") == "pallas_probe":
-                    if row.get("case") == "trivial_add":
-                        _PALLAS_OK = row.get("ok")
-                    elif row.get("case") == "fused_l2nn_small":
-                        _PALLAS_FUSED_OK = row.get("ok")
-    except FileNotFoundError:
-        pass
+    from bench.common import jsonl_rows
+
+    for row in jsonl_rows(OUT):
+        if row.get("stage") == "pallas_probe":
+            if row.get("case") == "trivial_add":
+                _PALLAS_OK = row.get("ok")
+            elif row.get("case") == "fused_l2nn_small":
+                _PALLAS_FUSED_OK = row.get("ok")
 
 
 if __name__ == "__main__":
@@ -611,15 +610,38 @@ if __name__ == "__main__":
         ("headline", headline),
         ("aot", aot_cold_start_stage),
     ]
+    if DRYRUN:
+        # Rehearsals prove the INLINE stages run end-to-end on CPU; the
+        # subprocess stages (bench.py headline, bench_aot) would spend
+        # their full per-metric timeouts attempting the axon backend.
+        stages = [(n, f) for n, f in stages if n not in ("headline", "aot")]
+        emit({"stage": "session", "dryrun_skipping": ["headline", "aot"]})
     done = _completed_stages()
     if done:
         emit({"stage": "session", "resuming": True,
               "skipping": sorted(done)})
         if "pallas_probe" in done:
             _restore_pallas_flags()
+    all_ok = True
     for name, stage_fn in stages:
         if name in done:
             continue
-        stage_fn()
+        # A stage returning False (subprocess stages on timeout/no-JSON —
+        # usually the window closing) is NOT marked done, so a re-armed
+        # window retries it.  Inline stages return None (their failure
+        # mode is hanging on the dead tunnel until the outer timeout
+        # kills the whole session, which also leaves no marker).
+        ok = stage_fn()
+        if DRYRUN:
+            continue  # rehearsals never write resume state
+        if ok is False:
+            all_ok = False
+            continue
         emit({"stage": "stage_done", "name": name})
-    emit({"stage": "session", "done": True})
+    # the terminal done row gates the waiter's exit; suppress it when a
+    # stage failed so bench/tpu_wait_and_measure.sh re-arms
+    if all_ok:
+        emit({"stage": "session", "done": True})
+    else:
+        emit({"stage": "session", "done": False,
+              "note": "stage failures — waiter should re-arm"})

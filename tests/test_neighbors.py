@@ -190,3 +190,85 @@ class TestAnnDispatch:
         # (which is what an unscaled code-unit result would be off by)
         np.testing.assert_allclose(np.asarray(d), np.asarray(dref),
                                    rtol=0.05, atol=10.0)
+
+
+class TestKnnEdgeGrid:
+    """Edge-case grid for the brute-force family (reference
+    cpp/test/neighbors/knn.cu + fused_l2_knn.cu parameter grids)."""
+
+    def test_k_extremes(self):
+        rng = np.random.default_rng(10)
+        index = rng.random((50, 6)).astype(np.float32)
+        queries = rng.random((8, 6)).astype(np.float32)
+        d1, i1 = knn(index, queries, 1)
+        assert d1.shape == (8, 1) and i1.shape == (8, 1)
+        dn, in_ = knn(index, queries, 50)
+        # k == n returns every index exactly once, in ascending distance
+        for row_i, row_d in zip(np.asarray(in_), np.asarray(dn)):
+            assert sorted(row_i.tolist()) == list(range(50))
+            assert np.all(np.diff(row_d) >= -1e-6)
+
+    def test_inner_product_descending(self):
+        """InnerProduct is a similarity: results come back best-first
+        (descending), mirroring the reference's faiss::MetricType
+        handling."""
+        from raft_tpu.distance import DistanceType
+
+        rng = np.random.default_rng(11)
+        index = rng.normal(0, 1, (120, 10)).astype(np.float32)
+        queries = rng.normal(0, 1, (15, 10)).astype(np.float32)
+        d, i = knn(index, queries, 6, DistanceType.InnerProduct)
+        d = np.asarray(d)
+        assert np.all(np.diff(d, axis=1) <= 1e-5)
+        want = queries @ index.T
+        np.testing.assert_allclose(d[:, 0], want.max(axis=1), atol=1e-4)
+
+    def test_batch_boundary_off_by_one(self):
+        """Index/query sizes one off a batch multiple — the classic tiled
+        -scan boundary bug class."""
+        rng = np.random.default_rng(12)
+        index = rng.random((65, 4)).astype(np.float32)   # 64 + 1
+        queries = rng.random((17, 4)).astype(np.float32)  # 16 + 1
+        d1, i1 = knn(index, queries, 3, batch_size_index=64,
+                     batch_size_query=16)
+        d2, i2 = knn(index, queries, 3)
+        # f32 accumulation order differs between tile configurations
+        # (~1e-6 absolute); what must hold is that both pick the same
+        # neighbors and agree on their distances to f32 tolerance
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                                   atol=2e-5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_fused_l2_knn_sqrt_flag(self):
+        rng = np.random.default_rng(13)
+        index = rng.random((90, 7)).astype(np.float32)
+        queries = rng.random((11, 7)).astype(np.float32)
+        d_sq, _ = fused_l2_knn(index, queries, 5, sqrt=False)
+        d_rt, _ = fused_l2_knn(index, queries, 5, sqrt=True)
+        np.testing.assert_allclose(np.sqrt(np.asarray(d_sq)),
+                                   np.asarray(d_rt), atol=1e-5)
+
+    def test_merge_parts_default_translations(self):
+        """Without translations, part-local ids pass through unchanged
+        (the reference's nullptr translations path)."""
+        rng = np.random.default_rng(14)
+        pd = np.sort(rng.random((2, 9, 4)), axis=2).astype(np.float32)
+        pi = rng.integers(0, 100, (2, 9, 4)).astype(np.int32)
+        md, mi = knn_merge_parts(pd, pi, 4)
+        md, mi = np.asarray(md), np.asarray(mi)
+        # merged distances are the global k smallest of the two parts
+        want = np.sort(np.concatenate([pd[0], pd[1]], axis=1), axis=1)[:, :4]
+        np.testing.assert_allclose(md, want, atol=1e-6)
+        # every merged id must exist in the corresponding input rows
+        for q in range(9):
+            assert set(mi[q].tolist()) <= (set(pi[0, q].tolist())
+                                           | set(pi[1, q].tolist()))
+
+    def test_f64_index(self):
+        rng = np.random.default_rng(15)
+        index = rng.random((70, 5))
+        queries = rng.random((9, 5))
+        d, i = knn(index, queries, 4)
+        rd, ri = ref_knn(index, queries, 4)
+        np.testing.assert_allclose(np.asarray(d), rd, atol=1e-10)
+        np.testing.assert_array_equal(np.asarray(i), ri)
