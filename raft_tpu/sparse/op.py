@@ -82,6 +82,11 @@ def _coo_combine_duplicates(coo: COO, combine: str) -> COO:
         # segment_max's -inf fill in empty tail slots is cleared by the
         # out_live mask at the return site.
         vals = jax.ops.segment_max(s.vals, group, num_segments=s.capacity)
+    elif combine == "min":
+        # min over DUPLICATES of the union (an edge present in only one
+        # direction keeps its value) — the reference's coo_symmetrize
+        # takes an arbitrary reduction functor (sparse/linalg/symmetrize.cuh)
+        vals = jax.ops.segment_min(s.vals, group, num_segments=s.capacity)
     else:  # pragma: no cover
         raise ValueError(combine)
     # First-occurrence coordinates per group (all duplicates share them).

@@ -377,3 +377,21 @@ def test_lanczos_triple_degenerate_with_nullspace():
         v = vecs_np[:, i]
         lam = float(v @ (M @ v))
         assert np.linalg.norm(M @ v - lam * v) < 1e-3
+
+
+@pytest.mark.parametrize("n,density,seed", [(30, 0.05, 0), (100, 0.02, 1),
+                                            (200, 0.005, 2)])
+def test_weak_cc_random_grid_vs_scipy(n, density, seed):
+    """Component labels on random graphs vs scipy.sparse.csgraph — same
+    partition (label values are representative-min ids, so compare up to
+    relabeling via ARI == 1)."""
+    from raft_tpu.stats import adjusted_rand_index
+
+    rng = np.random.default_rng(seed)
+    d = sp.random(n, n, density=density, random_state=rng,
+                  format="csr", dtype=np.float32)
+    d = ((d + d.T) > 0).astype(np.float32).tocsr()
+    labels = np.asarray(weak_cc(to_raft(d)))
+    n_comp, want = csgraph.connected_components(d, directed=False)
+    assert len(np.unique(labels)) == n_comp
+    assert float(adjusted_rand_index(labels, want)) == pytest.approx(1.0)
