@@ -98,10 +98,15 @@ def _min_cluster_and_distance(x, centroids, metric: DistanceType,
         bs = min(batch_samples, m)
         nb = -(-m // bs)
         xp = jnp.pad(x, ((0, nb * bs - m), (0, 0)))
-        y_norms = jnp.sum(centroids * centroids, axis=1)
+        # f32 norm accumulation for half inputs (pairwise._row_norms) —
+        # _fused_l2_nn's dot term is f32 for them, and a bf16-drifted norm
+        # against an exact dot flips near-tie argmins
+        from raft_tpu.distance.pairwise import _row_norms
+
+        y_norms = _row_norms(centroids)
 
         def blk(xb):
-            xn = jnp.sum(xb * xb, axis=1)
+            xn = _row_norms(xb)
             val, idx = _fused_l2_nn(xb, centroids, xn, y_norms, False,
                                     min(batch_centroids, centroids.shape[0]),
                                     precision)
@@ -343,8 +348,13 @@ def _fit_main(x, centroids0, weights, metric: DistanceType, max_iter: int,
         inertia = cluster_cost(nn, weights)
         return it + 1, new, inertia, delta
 
-    init = (jnp.asarray(0), centroids0, jnp.asarray(jnp.inf, x.dtype),
-            jnp.asarray(jnp.inf, x.dtype))
+    # inertia carries the E-step value dtype: f32 for half-precision data
+    # (distances accumulate in f32 — pairwise._mxu_dot); delta follows the
+    # centroid dtype
+    inertia_dtype = (jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16)
+                     else x.dtype)
+    init = (jnp.asarray(0), centroids0, jnp.asarray(jnp.inf, inertia_dtype),
+            jnp.asarray(jnp.inf, centroids0.dtype))
     n_iter, centroids, inertia, _ = jax.lax.while_loop(cond, body, init)
     # final E-step for the converged inertia (reference recomputes after loop)
     nn = min_cluster_and_distance(x, centroids, metric, batch_samples, batch_centroids)

@@ -115,8 +115,13 @@ def _fit_program(comms: Comms, max_iter: int, tol: float, metric: DistanceType,
             delta = jnp.sum((new - c) ** 2)
             return it + 1, new, inertia, delta
 
-        init = (jnp.asarray(0), c0, jnp.asarray(jnp.inf, x_shard.dtype),
-                jnp.asarray(jnp.inf, x_shard.dtype))
+        # same dtype rule as kmeans._fit_main: inertia follows the E-step
+        # value dtype (f32 for half-precision data), delta the centroids
+        inertia_dtype = (jnp.float32
+                         if x_shard.dtype in (jnp.bfloat16, jnp.float16)
+                         else x_shard.dtype)
+        init = (jnp.asarray(0), c0, jnp.asarray(jnp.inf, inertia_dtype),
+                jnp.asarray(jnp.inf, c0.dtype))
         n_iter, c, _, _ = jax.lax.while_loop(cond, body, init)
         # final E-step: inertia of the RETURNED centroids (the loop's value
         # is one step stale; matches single-device _fit_main)

@@ -19,6 +19,7 @@ from raft_tpu.core.aot import aot, aot_dispatchable
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.core.kvp import KeyValuePair, kvp_min
+from raft_tpu.distance.pairwise import _HALF_DTYPES, _mxu_dot, _row_norms
 
 _BN = 1024  # column block: y-block (bn × k) + distance block (bm × bn) stay in VMEM
 _BM = 2048  # row block: measured sweet spot on v5e (distance tile ≈ 8 MB)
@@ -60,7 +61,7 @@ def _fused_l2_nn_impl(x, y, x_norms, y_norms, sqrt: bool, block_n: int,
         def step(carry, blk):
             yb, ynb, base = blk
             d = (xnb[:, None] + ynb[None, :]
-                 - 2.0 * jnp.matmul(xb, yb.T, precision=precision))
+                 - 2.0 * _mxu_dot(xb, yb, precision))
             d = jnp.maximum(d, 0.0)
             d = jnp.where(jnp.isfinite(ynb)[None, :], d, jnp.inf)
             blk_arg = jnp.argmin(d, axis=1)
@@ -70,10 +71,16 @@ def _fused_l2_nn_impl(x, y, x_norms, y_norms, sqrt: bool, block_n: int,
             # MinAndDistanceReduceOp)
             return kvp_min(carry, KeyValuePair(key=blk_idx, value=blk_val)), None
 
+        # carry dtype must equal the distance-tile dtype: half-precision
+        # inputs produce f32 tiles (_mxu_dot accumulates in f32 and the
+        # norms are f32 via _row_norms)
+        val_dtype = jnp.result_type(
+            xnb.dtype, yn_blocks.dtype,
+            jnp.float32 if xb.dtype in _HALF_DTYPES else xb.dtype)
         init = KeyValuePair(
             key=jnp.full_like(xb[:, 0], jnp.iinfo(idx_dtype).max,
                               dtype=idx_dtype),
-            value=jnp.full_like(xb[:, 0], jnp.inf),
+            value=jnp.full((xb.shape[0],), jnp.inf, val_dtype),
         )
         best, _ = jax.lax.scan(step, init, (y_blocks, yn_blocks, bases))
         return best.value, best.key
@@ -103,10 +110,14 @@ def fused_l2_nn(x, y, sqrt: bool = False, x_norms=None, y_norms=None,
     x = jnp.asarray(x)
     y = jnp.asarray(y)
     expects(x.shape[1] == y.shape[1], "x and y must share feature dim")
+    # _row_norms accumulates half-precision inputs in f32 (bf16/f16 are
+    # first-class TPU dtypes; the distance epilogue then runs in f32 while
+    # the matmul keeps the half-width input fast path — see
+    # pairwise._mxu_dot, which _fused_l2_nn_impl's dot mirrors).
     if x_norms is None:
-        x_norms = jnp.sum(x * x, axis=1)
+        x_norms = _row_norms(x)
     if y_norms is None:
-        y_norms = jnp.sum(y * y, axis=1)
+        y_norms = _row_norms(y)
     if aot_dispatchable(x, y, x_norms, y_norms):
         val, idx = _fused_l2_nn_aot(x, y, x_norms, y_norms, bool(sqrt),
                                     int(block_n), precision)

@@ -48,7 +48,25 @@ _BN = 512  # col-block
 DEFAULT_PRECISION = "highest"
 
 
+#: dtypes that ride the MXU at double rate and accumulate natively in f32
+_HALF_DTYPES = (jnp.bfloat16, jnp.float16)
+
+
+def _mxu_dot(x, y, precision):
+    """``x @ y.T`` on the MXU.  Half-precision inputs (bf16/f16 — the
+    TPU-native dtypes) keep their fast input path but accumulate into f32
+    (``preferred_element_type`` — the systolic array's native mode), so
+    the epilogue math and the returned distances are f32 rather than
+    round-tripped through the input precision."""
+    if x.dtype in _HALF_DTYPES:
+        return jnp.matmul(x, y.T, precision=precision,
+                          preferred_element_type=jnp.float32)
+    return jnp.matmul(x, y.T, precision=precision)
+
+
 def _row_norms(x, squared: bool = True):
+    if x.dtype in _HALF_DTYPES:
+        x = x.astype(jnp.float32)  # O(n·k) side stats: accumulate exactly
     n = jnp.sum(x * x, axis=1)
     return n if squared else jnp.sqrt(n)
 
@@ -62,7 +80,7 @@ def _l2_expanded(x, y, sqrt: bool, precision=DEFAULT_PRECISION):
     # dist = ||x||^2 + ||y||^2 - 2 x·y, rectified at 0.
     xn = _row_norms(x)
     yn = _row_norms(y)
-    d = xn[:, None] + yn[None, :] - 2.0 * jnp.matmul(x, y.T, precision=precision)
+    d = xn[:, None] + yn[None, :] - 2.0 * _mxu_dot(x, y, precision)
     d = jnp.maximum(d, 0.0)
     return jnp.sqrt(d) if sqrt else d
 
@@ -72,16 +90,20 @@ def _cosine(x, y, precision=DEFAULT_PRECISION):
     xn = _row_norms(x, squared=False)
     yn = _row_norms(y, squared=False)
     denom = jnp.maximum(xn[:, None] * yn[None, :], 1e-30)
-    return 1.0 - jnp.matmul(x, y.T, precision=precision) / denom
+    return 1.0 - _mxu_dot(x, y, precision) / denom
 
 
 def _correlation(x, y, precision=DEFAULT_PRECISION):
     # reference distance/detail/correlation.cuh:124-128:
     # 1 - (k·Σxy − Σx·Σy) / sqrt((kΣx²−(Σx)²)(kΣy²−(Σy)²))
     k = x.shape[1]
-    xs, ys = jnp.sum(x, axis=1), jnp.sum(y, axis=1)
-    x2, y2 = jnp.sum(x * x, axis=1), jnp.sum(y * y, axis=1)
-    numer = k * jnp.matmul(x, y.T, precision=precision) - xs[:, None] * ys[None, :]
+    # row stats in f32 for half inputs (the q = k·x2 − xs² cancellation
+    # amplifies accumulation drift; _row_norms covers x2/y2)
+    xf = x.astype(jnp.float32) if x.dtype in _HALF_DTYPES else x
+    yf = y.astype(jnp.float32) if y.dtype in _HALF_DTYPES else y
+    xs, ys = jnp.sum(xf, axis=1), jnp.sum(yf, axis=1)
+    x2, y2 = _row_norms(x), _row_norms(y)
+    numer = k * _mxu_dot(x, y, precision) - xs[:, None] * ys[None, :]
     q = k * x2 - xs * xs
     r = k * y2 - ys * ys
     denom = jnp.sqrt(jnp.maximum(q[:, None] * r[None, :], 1e-30))
@@ -89,29 +111,34 @@ def _correlation(x, y, precision=DEFAULT_PRECISION):
 
 
 def _inner_product(x, y, precision=DEFAULT_PRECISION):
-    return jnp.matmul(x, y.T, precision=precision)
+    return _mxu_dot(x, y, precision)
 
 
 def _hellinger(x, y, precision=DEFAULT_PRECISION):
     # reference distance/detail/hellinger.cuh: acc = Σ√(x·y); d = √(1−acc),
     # rectified (inputs are probability-like, assumed non-negative).
-    acc = jnp.matmul(jnp.sqrt(jnp.abs(x)), jnp.sqrt(jnp.abs(y)).T, precision=precision)
+    acc = _mxu_dot(jnp.sqrt(jnp.abs(x)), jnp.sqrt(jnp.abs(y)), precision)
     return jnp.sqrt(jnp.maximum(1.0 - acc, 0.0))
 
 
 def _russelrao(x, y, precision=DEFAULT_PRECISION):
     # reference distance/detail/russell_rao.cuh:91: (k − Σxy)/k
     k = x.shape[1]
-    return (k - jnp.matmul(x, y.T, precision=precision)) * (1.0 / k)
+    return (k - _mxu_dot(x, y, precision)) * (1.0 / k)
 
 
 def _kl_divergence(x, y, precision=DEFAULT_PRECISION):
     # reference distance/detail/kl_divergence.cuh:27,81-99:
     # 0.5·Σ x·(log x − log y), with 0·log0 := 0 and log y := 0 where y == 0.
-    x_log = jnp.where(x > 0, jnp.log(jnp.where(x > 0, x, 1.0)), 0.0)
-    y_log = jnp.where(y > 0, jnp.log(jnp.where(y > 0, y, 1.0)), 0.0)
-    row_term = jnp.sum(x * x_log, axis=1)
-    return 0.5 * (row_term[:, None] - jnp.matmul(x, y_log.T, precision=precision))
+    # Half inputs: the Σ x·log x row term accumulates in f32 to match the
+    # f32 matmul term it is differenced against (the y_log operand stays
+    # half-width into the MXU — _mxu_dot accumulates f32).
+    xf = x.astype(jnp.float32) if x.dtype in _HALF_DTYPES else x
+    x_log = jnp.where(xf > 0, jnp.log(jnp.where(xf > 0, xf, 1.0)), 0.0)
+    y_log = jnp.where(y > 0, jnp.log(jnp.where(y > 0, y, 1.0)),
+                      jnp.zeros((), y.dtype))
+    row_term = jnp.sum(xf * x_log, axis=1)
+    return 0.5 * (row_term[:, None] - _mxu_dot(x, y_log, precision))
 
 
 # ---------------------------------------------------------------------------
@@ -128,6 +155,12 @@ def _blocked_reduce(x, y, tile_fn, bm: int = _BM, bn: int = _BN):
     """
     m, k = x.shape
     n = y.shape[0]
+    if x.dtype in _HALF_DTYPES:
+        # keep HBM reads half-width (the bandwidth win) but accumulate the
+        # tile reductions in f32 — the cast fuses into the tile compute
+        inner = tile_fn
+        tile_fn = lambda xi, yj: inner(xi.astype(jnp.float32),  # noqa: E731
+                                       yj.astype(jnp.float32))
     bm = min(bm, max(8, m))
     bn = min(bn, max(128, n))
     mp = -(-m // bm) * bm
@@ -241,6 +274,10 @@ def _try_pallas(x, y, metric: DistanceType):
     """Opt-in Pallas engine for the VPU metrics (see pallas_kernels)."""
     entry = _PALLAS_OPS.get(metric)
     if entry is None:
+        return None
+    if x.dtype in _HALF_DTYPES:
+        # the kernel accumulates in the input dtype; half inputs take the
+        # _blocked_reduce path, which upcasts tiles to f32 in-register
         return None
     from raft_tpu.distance import pallas_kernels as pk
 

@@ -348,3 +348,21 @@ class TestLibraryOracles:
             out = cluster.fit(params, x)
             assert float(out.inertia) <= prev * 1.001, f"k={k}"
             prev = float(out.inertia)
+
+
+def test_kmeans_fit_bf16_data():
+    """bf16 datasets (the TPU-native dtype) fit end-to-end: distances
+    accumulate in f32 (pairwise._mxu_dot), the while_loop carries use the
+    matching dtypes, and the result lands near the f32 fit."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    x64, c64 = rng.random((200, 128)), rng.random((8, 128))
+    params = KMeansParams(n_clusters=8, init=InitMethod.Array, max_iter=20)
+    out_bf = cluster.fit(params, jnp.asarray(x64, jnp.bfloat16),
+                         centroids=jnp.asarray(c64, jnp.bfloat16))
+    out_f32 = cluster.fit(params, x64.astype(np.float32),
+                          centroids=c64.astype(np.float32))
+    assert out_bf.centroids.dtype == jnp.bfloat16
+    assert float(out_bf.inertia) == pytest.approx(float(out_f32.inertia),
+                                                  rel=0.02)

@@ -307,3 +307,71 @@ class TestLayoutSweep:
         ref = fused_l2_nn(x, y)
         out = fused_l2_nn(np.asfortranarray(x), np.asfortranarray(y))
         np.testing.assert_array_equal(np.asarray(out.key), np.asarray(ref.key))
+
+
+class TestHalfPrecisionInputs:
+    """bf16/f16 datasets — the TPU-native dtypes: inputs stay half-width
+    (MXU double-rate, half the HBM traffic) while accumulation and the
+    returned distances are f32 (the systolic array's native accumulate
+    mode via preferred_element_type; VPU tiles upcast in-register)."""
+
+    @pytest.mark.parametrize("dtype_name", ["bfloat16", "float16"])
+    @pytest.mark.parametrize("metric", ["sqeuclidean", "cosine", "l1",
+                                        "chebyshev", "inner_product",
+                                        "correlation"])
+    def test_accumulates_f32(self, dtype_name, metric):
+        import jax.numpy as jnp
+
+        dtype = getattr(jnp, dtype_name)
+        rng = np.random.default_rng(0)
+        x64 = rng.random((60, 32))
+        y64 = rng.random((45, 32))
+        x = jnp.asarray(x64, dtype)
+        y = jnp.asarray(y64, dtype)
+        d = pairwise_distance(x, y, metric)
+        assert d.dtype == jnp.float32, (metric, d.dtype)
+        if metric == "inner_product":
+            want = x64 @ y64.T
+        else:
+            want = scipy_dist.cdist(
+                x64, y64, {"sqeuclidean": "sqeuclidean", "cosine": "cosine",
+                           "l1": "cityblock", "chebyshev": "chebyshev",
+                           "correlation": "correlation"}[metric])
+        # error budget: input rounding only (bf16 ~ 8e-3 relative), not
+        # accumulation drift over k — correlation's cancellation doubles it
+        rel = np.max(np.abs(np.asarray(d, np.float64) - want)) / max(
+            1.0, np.max(np.abs(want)))
+        budget = 0.02 if dtype_name == "bfloat16" else 0.005
+        if metric == "correlation":
+            budget *= 4
+        assert rel < budget, (metric, rel)
+
+    def test_kl_divergence_bf16_probability_rows(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(2)
+        x64 = rng.random((30, 64)) + 0.01
+        y64 = rng.random((25, 64)) + 0.01
+        x64 /= x64.sum(1, keepdims=True)
+        y64 /= y64.sum(1, keepdims=True)
+        d = pairwise_distance(jnp.asarray(x64, jnp.bfloat16),
+                              jnp.asarray(y64, jnp.bfloat16),
+                              "kl_divergence")
+        assert d.dtype == jnp.float32
+        want = 0.5 * np.array([[np.sum(a * (np.log(a) - np.log(b)))
+                                for b in y64] for a in x64])
+        np.testing.assert_allclose(np.asarray(d, np.float64), want,
+                                   atol=5e-3)
+
+    def test_fused_l2_nn_accepts_bf16(self):
+        import jax.numpy as jnp
+
+        from raft_tpu.distance import fused_l2_nn_argmin
+
+        rng = np.random.default_rng(1)
+        x64 = rng.random((128, 16))
+        c64 = rng.random((8, 16))
+        got = np.asarray(fused_l2_nn_argmin(jnp.asarray(x64, jnp.bfloat16),
+                                            jnp.asarray(c64, jnp.bfloat16)))
+        want = np.argmin(scipy_dist.cdist(x64, c64, "sqeuclidean"), axis=1)
+        assert (got == want).mean() > 0.97  # bf16 rounding may flip ties
