@@ -37,6 +37,7 @@ from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.core.logger import traced
 from raft_tpu.cluster import build_hierarchical, min_cluster_and_distance
 from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.distance.pairwise import _HALF_DTYPES, _mxu_dot, _row_norms
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.neighbors._common import (
     empty_result,
@@ -258,25 +259,33 @@ def _scan_probes(queries, probe_ids, index_leaves, metric_val: int, k: int,
     is_ip = metric_val == int(DistanceType.InnerProduct)
     is_cos = metric_val == int(DistanceType.CosineExpanded)
 
+    # Half-precision datasets (bf16/f16 — TPU-native) keep half-width MXU
+    # inputs but accumulate scores in f32 (same contract as
+    # distance.pairwise._mxu_dot): on near-tie candidate sets, bf16 score
+    # rounding measurably costs recall (~0.04 at 2k×32 uniform).
+    acc_t = (jnp.float32 if queries.dtype in _HALF_DTYPES
+             else queries.dtype)
+
     def score_tile(rows):
         data = list_data[rows].astype(queries.dtype)        # (nq, cap, dim)
         dots = jnp.einsum("qd,qcd->qc", queries, data,
-                          preferred_element_type=queries.dtype)
+                          preferred_element_type=acc_t)
         if is_ip:
             return dots
         if is_cos:
             # queries are pre-normalized; normalize stored vectors here
-            xn = jnp.sqrt(jnp.maximum(jnp.sum(data ** 2, axis=-1), 1e-30))
+            xn = jnp.sqrt(jnp.maximum(
+                jnp.sum(data.astype(acc_t) ** 2, axis=-1), 1e-30))
             return 1.0 - dots / xn
-        xn = jnp.sum(data ** 2, axis=-1)
-        qn = jnp.sum(queries ** 2, axis=-1, keepdims=True)
+        xn = jnp.sum(data.astype(acc_t) ** 2, axis=-1)
+        qn = jnp.sum(queries.astype(acc_t) ** 2, axis=-1, keepdims=True)
         return qn + xn - 2.0 * dots
 
     phys_probes = expand_probes(probe_ids, chunk_table,
                                 list_data.shape[0])
     best_d, best_i = scan_probe_lists(phys_probes, score_tile, list_indices,
                                       phys_sizes, k, select_min=not is_ip,
-                                      dtype=queries.dtype)
+                                      dtype=acc_t)
     if sqrt:
         best_d = jnp.sqrt(jnp.maximum(best_d, 0))
     return best_d, best_i
@@ -299,7 +308,9 @@ def search(params: SearchParams, index: Index, queries, k: int,
     expects(k >= 1, "k must be >= 1")
     qf = q.astype(_compute_dtype(q))
     if qf.shape[0] == 0:
-        return empty_result(0, int(k), qf.dtype)
+        # distance dtype matches the non-empty path: f32 for half queries
+        out_t = jnp.float32 if qf.dtype in _HALF_DTYPES else qf.dtype
+        return empty_result(0, int(k), out_t)
     if index.metric == DistanceType.CosineExpanded:
         qf = _normalize_rows(qf)
     sqrt = index.metric == DistanceType.L2SqrtExpanded
@@ -331,18 +342,21 @@ def search(params: SearchParams, index: Index, queries, k: int,
 
 @jax.jit
 def _coarse_l2(q, centers):
-    qn = jnp.sum(q ** 2, axis=1, keepdims=True)
-    cn = jnp.sum(centers ** 2, axis=1)
-    return qn + cn[None, :] - 2.0 * (q @ centers.T)
+    # half inputs: f32 norms + f32-accumulated dot (probe selection
+    # misranks near-tie centroids otherwise — same contract as the fine
+    # scan's acc_t); f32 inputs keep the default-precision matmul
+    qn = _row_norms(q)[:, None]
+    cn = _row_norms(centers)
+    return qn + cn[None, :] - 2.0 * _mxu_dot(q, centers, None)
 
 
 def _coarse_distances(q, centers, metric: DistanceType):
     centers = centers.astype(q.dtype)
     if metric == DistanceType.CosineExpanded:
         centers = _normalize_rows(centers)
-        return -(q @ centers.T)
+        return -_mxu_dot(q, centers, None)
     if metric == DistanceType.InnerProduct:
-        return -(q @ centers.T)
+        return -_mxu_dot(q, centers, None)
     return _coarse_l2(q, centers)
 
 

@@ -194,3 +194,27 @@ def test_ivf_flat_search_tail_bucketing():
                                idx, q[:nq], 5, batch_size_query=64)
         assert np.asarray(d).shape == (nq, 5)
         np.testing.assert_array_equal(np.asarray(i), np.asarray(ref_i)[:nq])
+
+
+def test_ivf_flat_bf16_dataset_recall_near_f32():
+    """bf16 datasets score with f32 accumulation: recall lands within a
+    few points of the f32 index at identical parameters (bf16 scoring
+    without f32 accumulation measured ~0.04 worse on this config)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    x = rng.random((2000, 32)).astype(np.float32)
+    q = rng.random((50, 32)).astype(np.float32)
+    _, iref = knn(x, q, 5)
+
+    def recall(xx, qq):
+        idx = build(IndexParams(n_lists=20), xx)
+        d, i = search(SearchParams(n_probes=8), idx, qq, 5)
+        return d, np.mean([len(set(a.tolist()) & set(b.tolist())) / 5
+                           for a, b in zip(np.asarray(i), np.asarray(iref))])
+
+    _, rec_f32 = recall(x, q)
+    d_bf, rec_bf = recall(jnp.asarray(x, jnp.bfloat16),
+                          jnp.asarray(q, jnp.bfloat16))
+    assert d_bf.dtype == jnp.float32  # scores accumulate in f32
+    assert rec_bf >= rec_f32 - 0.02, (rec_bf, rec_f32)
