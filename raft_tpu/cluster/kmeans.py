@@ -351,8 +351,9 @@ def _fit_main(x, centroids0, weights, metric: DistanceType, max_iter: int,
     # inertia carries the E-step value dtype: f32 for half-precision data
     # (distances accumulate in f32 — pairwise._mxu_dot); delta follows the
     # centroid dtype
-    inertia_dtype = (jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16)
-                     else x.dtype)
+    from raft_tpu.distance.pairwise import accum_dtype
+
+    inertia_dtype = accum_dtype(x.dtype)
     init = (jnp.asarray(0), centroids0, jnp.asarray(jnp.inf, inertia_dtype),
             jnp.asarray(jnp.inf, centroids0.dtype))
     n_iter, centroids, inertia, _ = jax.lax.while_loop(cond, body, init)

@@ -117,9 +117,9 @@ def _fit_program(comms: Comms, max_iter: int, tol: float, metric: DistanceType,
 
         # same dtype rule as kmeans._fit_main: inertia follows the E-step
         # value dtype (f32 for half-precision data), delta the centroids
-        inertia_dtype = (jnp.float32
-                         if x_shard.dtype in (jnp.bfloat16, jnp.float16)
-                         else x_shard.dtype)
+        from raft_tpu.distance.pairwise import accum_dtype
+
+        inertia_dtype = accum_dtype(x_shard.dtype)
         init = (jnp.asarray(0), c0, jnp.asarray(jnp.inf, inertia_dtype),
                 jnp.asarray(jnp.inf, c0.dtype))
         n_iter, c, _, _ = jax.lax.while_loop(cond, body, init)

@@ -19,7 +19,7 @@ from raft_tpu.core.aot import aot, aot_dispatchable
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.core.kvp import KeyValuePair, kvp_min
-from raft_tpu.distance.pairwise import _HALF_DTYPES, _mxu_dot, _row_norms
+from raft_tpu.distance.pairwise import _mxu_dot, _row_norms, accum_dtype
 
 _BN = 1024  # column block: y-block (bn × k) + distance block (bm × bn) stay in VMEM
 _BM = 2048  # row block: measured sweet spot on v5e (distance tile ≈ 8 MB)
@@ -74,9 +74,8 @@ def _fused_l2_nn_impl(x, y, x_norms, y_norms, sqrt: bool, block_n: int,
         # carry dtype must equal the distance-tile dtype: half-precision
         # inputs produce f32 tiles (_mxu_dot accumulates in f32 and the
         # norms are f32 via _row_norms)
-        val_dtype = jnp.result_type(
-            xnb.dtype, yn_blocks.dtype,
-            jnp.float32 if xb.dtype in _HALF_DTYPES else xb.dtype)
+        val_dtype = jnp.result_type(xnb.dtype, yn_blocks.dtype,
+                                    accum_dtype(xb.dtype))
         init = KeyValuePair(
             key=jnp.full_like(xb[:, 0], jnp.iinfo(idx_dtype).max,
                               dtype=idx_dtype),

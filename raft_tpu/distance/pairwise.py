@@ -52,6 +52,14 @@ DEFAULT_PRECISION = "highest"
 _HALF_DTYPES = (jnp.bfloat16, jnp.float16)
 
 
+def accum_dtype(dt):
+    """THE accumulation/output dtype rule for half-precision data: bf16
+    and f16 inputs produce f32 distances/scores/top-k carries everywhere
+    (one policy — distance engines, fused L2 NN, kNN scan, IVF scans,
+    k-means loop carries all consult this)."""
+    return jnp.float32 if dt in _HALF_DTYPES else dt
+
+
 def _mxu_dot(x, y, precision):
     """``x @ y.T`` on the MXU.  Half-precision inputs (bf16/f16 — the
     TPU-native dtypes) keep their fast input path but accumulate into f32

@@ -172,7 +172,9 @@ def knn_query(index: BallCoverIndex, queries, k: int,
     expects(q.ndim == 2 and q.shape[1] == index.dim, "query dim mismatch")
     expects(k >= 1, "k must be >= 1")
     if q.shape[0] == 0:
-        return empty_result(0, int(k), q.dtype)
+        from raft_tpu.distance.pairwise import accum_dtype
+
+        return empty_result(0, int(k), accum_dtype(q.dtype))
     nl = index.n_landmarks
     leaves = (index.landmarks, index.radii, index.list_data,
               index.list_indices, index.list_sizes)

@@ -53,7 +53,12 @@ def _knn_scan(index, queries, k: int, metric: DistanceType,
     bases = (jnp.arange(n_tiles) * tile).astype(jnp.int32)
 
     nq = queries.shape[0]
-    sentinel = jnp.asarray(jnp.inf if select_min else -jnp.inf, queries.dtype)
+    # running top-k carry must match the distance dtype: f32 for
+    # half-precision inputs (pairwise accumulates them in f32)
+    from raft_tpu.distance.pairwise import accum_dtype
+
+    val_dtype = accum_dtype(queries.dtype)
+    sentinel = jnp.asarray(jnp.inf if select_min else -jnp.inf, val_dtype)
 
     def step(carry, xs):
         best_d, best_i = carry
@@ -67,7 +72,7 @@ def _knn_scan(index, queries, k: int, metric: DistanceType,
                                   indices=merged_i)
         return (best_d, best_i), None
 
-    init = (jnp.full((nq, k), sentinel, queries.dtype),
+    init = (jnp.full((nq, k), sentinel, val_dtype),
             jnp.full((nq, k), -1, jnp.int32))
     (best_d, best_i), _ = jax.lax.scan(step, init, (tiles, vtiles, bases))
     return best_d, best_i
@@ -97,9 +102,10 @@ def knn(index, queries, k: int,
     expects(1 <= k <= index.shape[0],
             f"k={k} must be in [1, n_index={index.shape[0]}]")
     if queries.shape[0] == 0:
+        from raft_tpu.distance.pairwise import accum_dtype
         from raft_tpu.neighbors._common import empty_result
 
-        return empty_result(0, int(k), queries.dtype)
+        return empty_result(0, int(k), accum_dtype(queries.dtype))
     tile = min(batch_size_index, index.shape[0])
     # InnerProduct is a similarity: kNN selects the LARGEST values
     # (reference knn_brute_force_faiss.cuh: IP uses a max-selection heap).

@@ -37,7 +37,7 @@ from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.core.logger import traced
 from raft_tpu.cluster import build_hierarchical, min_cluster_and_distance
 from raft_tpu.distance.distance_types import DistanceType
-from raft_tpu.distance.pairwise import _HALF_DTYPES, _mxu_dot, _row_norms
+from raft_tpu.distance.pairwise import _mxu_dot, _row_norms, accum_dtype
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.neighbors._common import (
     empty_result,
@@ -263,8 +263,7 @@ def _scan_probes(queries, probe_ids, index_leaves, metric_val: int, k: int,
     # inputs but accumulate scores in f32 (same contract as
     # distance.pairwise._mxu_dot): on near-tie candidate sets, bf16 score
     # rounding measurably costs recall (~0.04 at 2k×32 uniform).
-    acc_t = (jnp.float32 if queries.dtype in _HALF_DTYPES
-             else queries.dtype)
+    acc_t = accum_dtype(queries.dtype)
 
     def score_tile(rows):
         data = list_data[rows].astype(queries.dtype)        # (nq, cap, dim)
@@ -309,8 +308,7 @@ def search(params: SearchParams, index: Index, queries, k: int,
     qf = q.astype(_compute_dtype(q))
     if qf.shape[0] == 0:
         # distance dtype matches the non-empty path: f32 for half queries
-        out_t = jnp.float32 if qf.dtype in _HALF_DTYPES else qf.dtype
-        return empty_result(0, int(k), out_t)
+        return empty_result(0, int(k), accum_dtype(qf.dtype))
     if index.metric == DistanceType.CosineExpanded:
         qf = _normalize_rows(qf)
     sqrt = index.metric == DistanceType.L2SqrtExpanded

@@ -272,3 +272,17 @@ class TestKnnEdgeGrid:
         rd, ri = ref_knn(index, queries, 4)
         np.testing.assert_allclose(np.asarray(d), rd, atol=1e-10)
         np.testing.assert_array_equal(np.asarray(i), ri)
+
+
+def test_knn_bf16_inputs_f32_distances():
+    """bf16 index/queries: distances come back f32 (pairwise accumulates
+    half inputs in f32; the running top-k carry follows)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(20)
+    x64, q64 = rng.random((300, 16)), rng.random((40, 16))
+    d, i = knn(jnp.asarray(x64, jnp.bfloat16), jnp.asarray(q64, jnp.bfloat16),
+               5, batch_size_index=128)
+    assert d.dtype == jnp.float32
+    ref = np.argsort(cdist(q64, x64), axis=1)[:, :5]
+    assert (np.asarray(i) == ref).mean() > 0.9  # bf16 rounding flips ties
