@@ -91,10 +91,14 @@ def _min_cluster_and_distance(x, centroids, metric: DistanceType,
             # precision="default" — "high" promises bf16x3-quality argmins
             # (zero flips, see module comment), which single-pass bf16
             # does not deliver.
+            from raft_tpu.distance.pairwise import accum_dtype
+
             val, idx = pallas_fused_l2nn.fused_l2_nn_pallas(
                 x, centroids, bf16_dot=(precision == "default"),
                 interpret=pallas_fused_l2nn.interpret_requested())
-            return KeyValuePair(key=idx, value=val.astype(x.dtype))
+            # distances flow in the accumulation dtype (f32 for half data
+            # — the while_loop inertia carry expects it)
+            return KeyValuePair(key=idx, value=val.astype(accum_dtype(x.dtype)))
         bs = min(batch_samples, m)
         nb = -(-m // bs)
         xp = jnp.pad(x, ((0, nb * bs - m), (0, 0)))
@@ -145,7 +149,9 @@ def update_centroids(x, labels, n_clusters: int, sample_weights=None,
     if sample_weights is None:
         sample_weights = jnp.ones((x.shape[0],), x.dtype)
     sums, wsum = _weighted_cluster_sums(x, labels, sample_weights, n_clusters)
-    new = sums / jnp.maximum(wsum, 1e-30)[:, None]
+    # means computed in the accumulation dtype, stored back in the data
+    # dtype (the public contract: centroids share the dataset's dtype)
+    new = (sums / jnp.maximum(wsum, 1e-30)[:, None]).astype(x.dtype)
     if old_centroids is not None:
         new = jnp.where(wsum[:, None] > 0, new, old_centroids)
     return new, wsum
@@ -165,11 +171,18 @@ def _weighted_cluster_sums(x, labels, w, n_clusters: int):
     so it always takes the segment-sum path (measured ~4× over one-hot at
     the same config on the CI host).
     """
+    from raft_tpu.distance.pairwise import accum_dtype
+
     n, d = x.shape
+    # Per-cluster sums over thousands of rows must accumulate in f32 for
+    # half-precision data (accum_dtype policy); the one-hot matmul keeps
+    # half-width MXU inputs via preferred_element_type.
+    acc_t = accum_dtype(x.dtype)
     if jax.default_backend() == "cpu" or n_clusters > 4096 or n < _SUM_CHUNK:
-        wx = x * w[:, None]
+        wx = x.astype(acc_t) * w.astype(acc_t)[:, None]
         sums = jax.ops.segment_sum(wx, labels, num_segments=n_clusters)
-        wsum = jax.ops.segment_sum(w, labels, num_segments=n_clusters)
+        wsum = jax.ops.segment_sum(w.astype(acc_t), labels,
+                                   num_segments=n_clusters)
         return sums, wsum
     nc = n // _SUM_CHUNK
     split = nc * _SUM_CHUNK
@@ -179,10 +192,11 @@ def _weighted_cluster_sums(x, labels, w, n_clusters: int):
         xc, lc, wc = args
         oh = (lc[:, None] == jnp.arange(n_clusters, dtype=lc.dtype)
               ).astype(x.dtype) * wc[:, None]
-        return (s + oh.T @ xc, ws + jnp.sum(oh, axis=0)), None
+        return (s + jnp.matmul(oh.T, xc, preferred_element_type=acc_t),
+                ws + jnp.sum(oh.astype(acc_t), axis=0)), None
 
-    init = (jnp.zeros((n_clusters, d), x.dtype),
-            jnp.zeros((n_clusters,), x.dtype))
+    init = (jnp.zeros((n_clusters, d), acc_t),
+            jnp.zeros((n_clusters,), acc_t))
     (sums, wsum), _ = jax.lax.scan(
         step, init, (x[:split].reshape(nc, _SUM_CHUNK, d),
                      labels[:split].reshape(nc, _SUM_CHUNK),
@@ -190,8 +204,9 @@ def _weighted_cluster_sums(x, labels, w, n_clusters: int):
     if split < n:
         oh = (labels[split:, None] == jnp.arange(n_clusters, dtype=labels.dtype)
               ).astype(x.dtype) * w[split:, None]
-        sums = sums + oh.T @ x[split:]
-        wsum = wsum + jnp.sum(oh, axis=0)
+        sums = sums + jnp.matmul(oh.T, x[split:],
+                                 preferred_element_type=acc_t)
+        wsum = wsum + jnp.sum(oh.astype(acc_t), axis=0)
     return sums, wsum
 
 
@@ -306,7 +321,12 @@ def _pp_program(x, base_key, n_clusters: int, l: int, n_rounds: int,
     # weight candidates by how many points they own (duplicate slots collect
     # zero: argmin ties go to the first occurrence)
     nn = min_cluster_and_distance(x, candidates, metric)
-    counts = jnp.zeros((cap,), x.dtype).at[nn.key].add(1.0)
+    # ownership counts accumulate in f32 for half data (bf16 saturates at
+    # 256: +1 rounds away and the k-means|| weights flatten — accum_dtype
+    # policy)
+    from raft_tpu.distance.pairwise import accum_dtype
+
+    counts = jnp.zeros((cap,), accum_dtype(x.dtype)).at[nn.key].add(1.0)
     return _weighted_kmeans_pp(key_pp, candidates, counts, n_clusters)
 
 

@@ -117,17 +117,24 @@ def _fine_stage(xs, c0, cmask, n_iters: int, adjust_every: int = 2):
         k = c.shape[0]
 
         def body(it, c):
-            d = (jnp.sum(x * x, 1, keepdims=True) + jnp.sum(c * c, 1)[None, :]
-                 - 2.0 * jnp.matmul(x, c.T, precision="high"))
+            # E/M in the accumulation dtype for half data (accum_dtype
+            # policy: f32 norms/distances, f32 one-hot sums/counts via
+            # preferred_element_type; centers stored back in x.dtype)
+            from raft_tpu.distance.pairwise import _mxu_dot, _row_norms, accum_dtype
+
+            acc_t = accum_dtype(x.dtype)
+            d = (_row_norms(x)[:, None] + _row_norms(c)[None, :]
+                 - 2.0 * _mxu_dot(x, c, "high"))
             d = jnp.where(mask[None, :], d, jnp.inf)
             labels = jnp.argmin(d, axis=1)
             dist = jnp.min(d, axis=1)
             oh = (labels[:, None] == jnp.arange(k, dtype=labels.dtype)
                   ).astype(x.dtype)
-            counts = jnp.sum(oh, axis=0)
-            sums = oh.T @ x
+            counts = jnp.sum(oh.astype(acc_t), axis=0)
+            sums = jnp.matmul(oh.T, x, preferred_element_type=acc_t)
             new = jnp.where((counts[:, None] > 0) & mask[:, None],
-                            sums / jnp.maximum(counts, 1)[:, None], c)
+                            (sums / jnp.maximum(counts, 1)[:, None]
+                             ).astype(x.dtype), c)
 
             def do_adjust(c):
                 c2, _ = adjust_centers(c, counts, x, labels, dist, mask=mask)

@@ -57,7 +57,11 @@ def compute_new_centroids(x_shard, centroids, comms: Comms,
     sums = comms.allreduce(sums, ReduceOp.SUM)
     wsum = comms.allreduce(wsum, ReduceOp.SUM)
     inertia = comms.allreduce(inertia, ReduceOp.SUM)
-    new = jnp.where(wsum[:, None] > 0, sums / jnp.maximum(wsum, 1e-30)[:, None],
+    # means in the accumulation dtype, stored back in the centroid dtype
+    # (keeps the while_loop carry and the data dtype consistent for bf16)
+    new = jnp.where(wsum[:, None] > 0,
+                    (sums / jnp.maximum(wsum, 1e-30)[:, None]
+                     ).astype(centroids.dtype),
                     centroids)
     return new, wsum, inertia
 
