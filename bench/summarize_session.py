@@ -1,0 +1,65 @@
+"""Render tpu_session_results.jsonl into a readable summary.
+
+    python -m bench.summarize_session [in.jsonl]
+
+Prints, for the LATEST run of each stage (schema-aware): the headline
+metric rows, the RTT floor, the amortized micro-stage tables, the
+pallas_verdict / pallas_probe outcomes, and the MNMG diag ladder —
+the human view of what the measurement session recorded, kept separate
+from the machine-readable JSONL the rows live in.
+
+Validity keys honored: rows with ``suspect`` are marked INVALID; rows
+without ``timing: device_amortized`` recorded under schema >= 2 on the
+axon tunnel are per-dispatch (RTT-bounded) and marked accordingly.
+"""
+
+import sys
+from collections import defaultdict
+
+from bench.common import jsonl_rows
+
+PATH = sys.argv[1] if len(sys.argv) > 1 else "tpu_session_results.jsonl"
+
+
+def main():
+    schema = 0
+    by_stage = defaultdict(list)
+    for row in jsonl_rows(PATH):
+        if row.get("stage") == "session":
+            if row.get("schema"):
+                schema = row["schema"]
+            continue
+        row["_schema"] = schema
+        by_stage[row.get("stage", "?")].append(row)
+
+    def flag(row):
+        if row.get("suspect"):
+            return " [SUSPECT/INVALID]"
+        if row["_schema"] >= 2 and row.get("timing") != "device_amortized" \
+                and row.get("stage") not in ("headline",) \
+                and "error" not in row:
+            return " [per-dispatch: RTT-bounded]"
+        if row.get("delta_ok") is False:
+            return " [noise-floor bound]"
+        return ""
+
+    if "rtt" in by_stage:
+        r = by_stage["rtt"][-1]
+        print(f"dispatch RTT: min {r.get('dispatch_ms_min')} ms, "
+              f"median {r.get('dispatch_ms_median')} ms")
+    for name in ("headline", "pairwise", "kmeans_fit", "mnmg_diag",
+                 "kmeans_sweep", "pallas_verdict", "pallas_probe",
+                 "ivf_pq", "select_k", "lanczos", "aot"):
+        rows = by_stage.get(name)
+        if not rows:
+            continue
+        print(f"\n== {name} ==")
+        for row in rows[-24:]:
+            body = {k: v for k, v in row.items()
+                    if k not in ("stage", "_schema", "t_lo_s", "t_hi_s",
+                                 "k_lo", "k_hi", "timing")}
+            print(f"  {body}{flag(row)}")
+
+
+if __name__ == "__main__":
+    main()
