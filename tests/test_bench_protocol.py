@@ -1,0 +1,147 @@
+"""Measurement-protocol regression tests (bench.common + bench.tpu_session
+resume machinery) — the validity rules the perf evidence rests on:
+roofline guarding, amortized timing, append-only JSONL, stage/metric
+resume semantics.  These pin behaviors that were previously only proven
+by inline rehearsals before each tunnel window."""
+
+from bench.common import (apply_roofline_guard, jsonl_rows, make_emitter,
+                          timed_amortized)
+
+
+class TestRooflineGuard:
+    def test_flags_impossible_reading(self):
+        row = apply_roofline_guard({"value": 1000.0}, 1000.0, roofline=819.0)
+        assert row["suspect"] is True and row["roofline_gbps"] == 819.0
+
+    def test_passes_physical_reading(self):
+        row = apply_roofline_guard({"value": 500.0}, 500.0, roofline=819.0)
+        assert "suspect" not in row
+
+    def test_unknown_roofline_never_flags(self):
+        row = apply_roofline_guard({"value": 9e9}, 9e9, roofline=None)
+        assert "suspect" not in row
+
+
+class TestTimedAmortized:
+    def test_per_iter_positive_and_chained(self):
+        import jax.numpy as jnp
+
+        calls = []
+
+        def step(c):
+            calls.append(1)
+            return c * 1.0000001 + 1.0
+
+        per_iter, info = timed_amortized(step, jnp.zeros(()), k_lo=2,
+                                         k_hi=6, reps=2)
+        assert per_iter > 0
+        assert info["k_lo"] == 2 and info["k_hi"] == 6
+        # step traces once per loop length (fori_loop body), not per trip
+        assert len(calls) == 2
+
+    def test_noise_floor_returns_conservative_bound(self):
+        """If t_hi <= t_lo (measurement noise), the conservative t_hi/k_hi
+        bound is returned and flagged delta_ok=False — never a negative
+        or zero delta."""
+        import jax.numpy as jnp
+
+        per_iter, info = timed_amortized(lambda c: c + 1.0, jnp.zeros(()),
+                                         k_lo=2, k_hi=4, reps=1)
+        assert per_iter > 0
+        assert isinstance(info["delta_ok"], bool)
+
+
+class TestEmitterAndRows:
+    def test_append_and_skip_bad_lines(self, tmp_path):
+        p = str(tmp_path / "out.jsonl")
+        emit = make_emitter(p)
+        emit({"a": 1})
+        with open(p, "a") as f:
+            f.write("{not json\n")  # torn write mid-crash
+        emit({"b": 2})
+        rows = list(jsonl_rows(p))
+        assert rows == [{"a": 1}, {"b": 2}]
+
+    def test_missing_file_yields_nothing(self, tmp_path):
+        assert list(jsonl_rows(str(tmp_path / "absent.jsonl"))) == []
+
+
+class TestSessionResume:
+    """Stage/metric resume semantics (bench.tpu_session) — a window that
+    closes mid-session must resume where it left off, a completed session
+    must reset, and pre-amortized-protocol rows must not satisfy resume."""
+
+    def _session(self, tmp_path, rows):
+        import bench.tpu_session as s
+
+        path = str(tmp_path / "resume.jsonl")
+        old_out, s.OUT = s.OUT, path
+        emit = make_emitter(path)
+        for r in rows:
+            emit(r)
+        return s, old_out
+
+    def test_stage_markers_and_reset(self, tmp_path):
+        s, old = self._session(tmp_path, [
+            {"stage": "session", "schema": 3},
+            {"stage": "stage_done", "name": "pairwise"},
+            {"stage": "stage_done", "name": "rtt"},
+        ])
+        try:
+            assert s._completed_stages() == {"pairwise", "rtt"}
+            make_emitter(s.OUT)({"stage": "session", "done": True})
+            assert s._completed_stages() == set()
+            # done: False must NOT reset
+            emit = make_emitter(s.OUT)
+            emit({"stage": "stage_done", "name": "lanczos"})
+            emit({"stage": "session", "done": False})
+            assert s._completed_stages() == {"lanczos"}
+        finally:
+            s.OUT = old
+
+    def test_headline_metric_resume_schema_gated(self, tmp_path):
+        s, old = self._session(tmp_path, [
+            {"stage": "session", "schema": 2},
+            {"stage": "headline",
+             "metric": "kmeans_mnmg_iter_100kx128_k1024_f32_1dev",
+             "value": 3.03},
+            {"stage": "session", "schema": 3},
+            {"stage": "headline",
+             "metric": "pairwise_distance_l2sqrt_5000x50_f32",
+             "value": 400.0},
+            {"stage": "headline", "error": "timeout", "metric": "lanczos"},
+            {"stage": "headline",
+             "metric": "ivf_pq_qps_200kx128_recall0.96", "value": 9000.0},
+        ])
+        try:
+            # schema-2 row (pre-amortized protocols) does not count;
+            # error rows do not count
+            assert s._completed_headline_metrics() == {"pairwise", "ivf_pq"}
+            make_emitter(s.OUT)({"stage": "session", "done": True})
+            assert s._completed_headline_metrics() == set()
+        finally:
+            s.OUT = old
+
+    def test_pallas_flags_restored_from_rows(self, tmp_path):
+        s, old = self._session(tmp_path, [
+            {"stage": "pallas_probe", "case": "trivial_add", "ok": True},
+            {"stage": "pallas_probe", "case": "fused_l2nn_small",
+             "ok": False, "error": "HTTP 500"},
+        ])
+        try:
+            s._PALLAS_OK = s._PALLAS_FUSED_OK = None
+            s._restore_pallas_flags()
+            assert s._PALLAS_OK is True and s._PALLAS_FUSED_OK is False
+        finally:
+            s.OUT = old
+            s._PALLAS_OK = s._PALLAS_FUSED_OK = None
+
+    def test_dryrun_ignores_resume_state(self, tmp_path, monkeypatch):
+        s, old = self._session(tmp_path, [
+            {"stage": "stage_done", "name": "pairwise"},
+        ])
+        try:
+            monkeypatch.setattr(s, "DRYRUN", True)
+            assert s._completed_stages() == set()
+        finally:
+            s.OUT = old
