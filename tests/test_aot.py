@@ -187,3 +187,26 @@ def test_eager_call_off_default_device():
     v, i = select_k(jnp.asarray(np.asarray(d)), 3)
     v1, i1 = select_k(jax.device_put(np.asarray(d), jax.devices()[1]), 3)
     np.testing.assert_array_equal(np.asarray(i), np.asarray(i1))
+
+
+def test_aot_cache_keys_distinguish_dtypes():
+    """bf16 and f32 signatures must compile distinct AOT executables and
+    each reuse its own (a dtype-blind key would silently serve the f32
+    executable to bf16 inputs or vice versa)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.distance import pairwise_distance
+    from raft_tpu.distance.pairwise import _distance_aot
+
+    rng = np.random.default_rng(1)
+    x32 = rng.random((48, 8), dtype=np.float32)
+    xbf = jnp.asarray(x32, jnp.bfloat16)
+    n0 = _distance_aot.cache_size
+    d32 = pairwise_distance(x32, x32, "euclidean")
+    assert _distance_aot.cache_size == n0 + 1
+    dbf = pairwise_distance(xbf, xbf, "euclidean")
+    assert _distance_aot.cache_size == n0 + 2  # distinct executable
+    pairwise_distance(xbf, xbf, "euclidean")
+    assert _distance_aot.cache_size == n0 + 2  # ...reused
+    assert d32.dtype == np.float32 and dbf.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(dbf), np.asarray(d32), atol=0.03)
