@@ -67,6 +67,10 @@ def handle():
 # reason (ci/gpu/build.sh:106-121).
 _FAST_TESTS = {
     "test_aot.py::test_public_entry_points_consume_aot",
+    "test_bench_protocol.py::TestRooflineGuard::test_flags_impossible_reading",
+    "test_bench_protocol.py::TestSessionResume::test_stage_markers_and_reset",
+    "test_distance.py::TestHalfPrecisionInputs::test_accumulates_f32",
+    "test_cluster.py::test_kmeans_fit_bf16_data",
     "test_ball_cover.py::test_ball_cover_knn_exact",
     "test_cluster.py::TestKMeansFit::test_fit_blobs_ari",
     "test_cluster.py::TestSingleLinkage::test_labels_match_scipy",
