@@ -366,3 +366,36 @@ def test_kmeans_fit_bf16_data():
     assert out_bf.centroids.dtype == jnp.bfloat16
     assert float(out_bf.inertia) == pytest.approx(float(out_f32.inertia),
                                                   rel=0.02)
+
+
+def test_build_hierarchical_bf16_matches_f32_structure():
+    """Balanced hierarchical build on bf16 data: fine-stage E/M accumulate
+    in f32 (accum_dtype policy), so cluster sizes stay balanced and
+    centers land near the f32 build's."""
+    import jax.numpy as jnp
+
+    x, _, _ = make_blobs(RngState(13), 3000, 16, n_clusters=12,
+                         cluster_std=0.3)
+    x = np.asarray(x)
+    out_f32 = cluster.build_hierarchical(RngState(0), x.astype(np.float32),
+                                         24)
+    out_bf = cluster.build_hierarchical(RngState(0),
+                                        jnp.asarray(x, jnp.bfloat16), 24)
+
+    def centers_sizes(out):
+        if isinstance(out, tuple):
+            return np.asarray(out[0], np.float64), np.asarray(out[1])
+        return np.asarray(out, np.float64), None
+
+    c32, s32 = centers_sizes(out_f32)
+    cbf, sbf = centers_sizes(out_bf)
+    assert cbf.shape == c32.shape
+    # each bf16 center has a nearby f32 center (same partition structure)
+    from scipy.spatial.distance import cdist
+
+    d = cdist(cbf, c32)
+    scale = np.abs(c32).max()
+    assert np.median(d.min(axis=1)) < 0.25 * scale, (
+        np.median(d.min(axis=1)), scale)
+    if s32 is not None:
+        assert int(sbf.sum()) == int(s32.sum()) == 3000
