@@ -80,5 +80,36 @@ def bench_ivf_pq_build():
         "items": _N // 4}
 
 
+@case("neighbors/ivf_flat_extend_1pct")
+def bench_ivf_flat_extend():
+    """Incremental extend of 1% new rows into a built index — must cost
+    ≪ a rebuild (r5: extend appends into free tail slots instead of
+    unpacking/repacking the whole index; compare with
+    neighbors/ivf_flat_build-scale timings)."""
+    from raft_tpu.neighbors import ivf_flat
+
+    n = _N // 4
+    x, _ = _clustered(n + n // 100, 8, _D)
+    xh = np.asarray(x)
+    index = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=max(_LISTS // 4, 8), seed=1), xh[:n])
+    new = xh[n:]
+    return (lambda: ivf_flat.extend(index, new).list_data), {
+        "items": new.shape[0]}
+
+
+@case("neighbors/ivf_flat_rebuild_baseline")
+def bench_ivf_flat_rebuild():
+    """The rebuild the extend row is compared against (same data + 1%)."""
+    from raft_tpu.neighbors import ivf_flat
+
+    n = _N // 4
+    x, _ = _clustered(n + n // 100, 8, _D)
+    xh = np.asarray(x)
+    params = ivf_flat.IndexParams(n_lists=max(_LISTS // 4, 8), seed=1)
+    return (lambda: ivf_flat.build(params, xh).list_data), {
+        "items": xh.shape[0]}
+
+
 if __name__ == "__main__":
     main_for("bench.bench_neighbors")

@@ -92,11 +92,21 @@ def make_emitter(out_path):
     recorded so far; print+flush mirrors rows to the live log)."""
 
     def emit(obj):
+        emit.rows += 1
+        if "error" in obj:
+            emit.errors += 1
         line = json.dumps(obj)
         print(line, flush=True)
         with open(out_path, "a") as f:
             f.write(line + "\n")
 
+    # Running row/error counters: the session's main loop snapshots them
+    # around each inline stage so a stage whose every emitted row was an
+    # error row is retried at the next window instead of being marked
+    # stage_done (r4 advisor finding — the per-config except handlers
+    # swallow failures and return None).
+    emit.rows = 0
+    emit.errors = 0
     return emit
 
 

@@ -1,0 +1,213 @@
+"""CPU-side root-cause attack on the MNMG 100x while_loop gap (VERDICT r4 #2).
+
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+        python -m bench.diag_mnmg_cpu_full [out.jsonl] [n_dev]
+
+Runs the mnmg layer ladder at FULL bench shapes (100k x 128, k=1024) on the
+CPU backend — the r4a live reading (3.03 it/s full fit vs 437 it/s eager
+chain, same chip) was never reproduced or excluded CPU-side.  Cases:
+
+    B   jit(one E+M step)                  — amortized
+    C   jit(fori_loop x20 steps)           — 20 iters/dispatch
+    D   shard_map(one step)+psum, n_dev    — amortized
+    D2  shard_map(fori_loop x20), n_dev    — 20 iters/dispatch
+    E   full kmeans_mnmg.fit (shard_map + while_loop, the 3.03 program)
+    F   kmeans_mnmg.fit loop="host" (per-iteration dispatches)
+    G   single-device kmeans.fit (jit while_loop, no shard_map)
+
+If E ~= B on CPU, the program structure is exonerated here and the gap is
+pinned on the TPU lowering/tunnel runtime (decided by mnmg_diag at the next
+live window).  A big CPU-side drop at D/D2/E names the guilty layer
+directly.
+
+Second half: STRUCTURAL HLO analysis of the while-loop body vs the eager
+step — pad/copy of the [n, dim] dataset inside the loop body, loop nesting
+(lax.map chunking lowers to an inner while), collective form at n_dev=1 —
+the hazards that would multiply per-iteration work 20x inside one program.
+Writes one JSON line per finding (same emitter protocol as tpu_session).
+"""
+
+import sys
+
+import numpy as np
+
+from bench.common import make_emitter, timed_amortized, timed_chained
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/diag_mnmg_cpu_full.jsonl"
+N_DEV = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+
+emit = make_emitter(OUT)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    assert jax.default_backend() == "cpu", (
+        "CPU-side diagnosis must run on the CPU backend (set BOTH "
+        "JAX_PLATFORMS=cpu and PALLAS_AXON_POOL_IPS= — sitecustomize "
+        "re-registers the axon plugin otherwise)")
+
+    from raft_tpu.cluster import (InitMethod, KMeansParams,
+                                  min_cluster_and_distance, update_centroids)
+    from raft_tpu.cluster import fit as kmeans_fit
+    from raft_tpu.cluster import kmeans_mnmg
+    from raft_tpu.cluster.kmeans import _weighted_cluster_sums
+    from raft_tpu.comms import build_comms
+
+    n, dim, k = 100_000, 128, 1024
+    rng = np.random.default_rng(0)
+    x = jax.device_put(rng.random((n, dim), dtype=np.float32))
+    c = jax.device_put(rng.random((k, dim), dtype=np.float32))
+    emit({"stage": "mnmg_cpu_diag", "platform": jax.default_backend(),
+          "n": n, "dim": dim, "k": k, "n_dev": N_DEV})
+
+    def em(xx, cc):
+        nn = min_cluster_and_distance(xx, cc)
+        new, _ = update_centroids(xx, nn.key, k, old_centroids=cc)
+        return new
+
+    def rec_amortized(tag, step, c0, **kw):
+        try:
+            per_iter, info = timed_amortized(step, c0, **kw)
+            emit({"stage": "mnmg_cpu_diag", "case": tag,
+                  "iter_s": round(1.0 / per_iter, 2),
+                  "timing": "device_amortized", **info})
+        except Exception as e:  # noqa: BLE001 - record and continue
+            emit({"stage": "mnmg_cpu_diag", "case": tag, "error": str(e)[:300]})
+
+    def rec_chained20(tag, fn, c0, iters=3):
+        try:
+            best = timed_chained(fn, c0, lambda cc, out: out, iters=iters)
+            emit({"stage": "mnmg_cpu_diag", "case": tag,
+                  "iter_s": round(20 / best, 2)})
+        except Exception as e:  # noqa: BLE001 - record and continue
+            emit({"stage": "mnmg_cpu_diag", "case": tag, "error": str(e)[:300]})
+
+    # --- B / C: plain jit, no mesh ---
+    rec_amortized("B_jit_one_step", lambda cc: em(x, cc), c,
+                  k_lo=2, k_hi=6, reps=2)
+    em20j = jax.jit(lambda cc: jax.lax.fori_loop(0, 20,
+                                                 lambda i, c_: em(x, c_), cc))
+    rec_chained20("C_jit_fori_x20", em20j, c)
+
+    # --- D / D2 / E / F over an n_dev mesh ---
+    mesh = Mesh(np.array(jax.devices()[:N_DEV]), ("world",))
+
+    def em_shard(xx, cc):
+        nn = min_cluster_and_distance(xx, cc)
+        w = jnp.ones_like(nn.value)
+        sums, wsum = _weighted_cluster_sums(xx, nn.key, w, k)
+        sums = jax.lax.psum(sums, "world")
+        wsum = jax.lax.psum(wsum, "world")
+        return jnp.where(wsum[:, None] > 0,
+                         sums / jnp.maximum(wsum, 1e-30)[:, None], cc)
+
+    sm = jax.jit(shard_map(em_shard, mesh=mesh,
+                           in_specs=(P("world", None), P(None, None)),
+                           out_specs=P(None, None), check_vma=False))
+    xs = jax.device_put(x, NamedSharding(mesh, P("world", None)))
+    rec_amortized("D_shardmap_one_step", lambda cc: sm(xs, cc), c,
+                  k_lo=2, k_hi=6, reps=2)
+
+    sm20 = jax.jit(shard_map(
+        lambda xx, cc: jax.lax.fori_loop(0, 20, lambda i, c_: em_shard(xx, c_),
+                                         cc),
+        mesh=mesh, in_specs=(P("world", None), P(None, None)),
+        out_specs=P(None, None), check_vma=False))
+    rec_chained20("D2_shardmap_fori_x20", lambda cc: sm20(xs, cc), c)
+
+    comms = build_comms(mesh)
+    params = KMeansParams(n_clusters=k, init=InitMethod.Array, max_iter=20,
+                          tol=0.0)
+    from bench.tpu_session import timed_whole_fit
+
+    timed_whole_fit(lambda cc: kmeans_mnmg.fit(params, comms, xs,
+                                               centroids=cc),
+                    c, "mnmg_cpu_diag", case="E_full_fit", reps=2)
+    timed_whole_fit(lambda cc: kmeans_mnmg.fit(params, comms, xs,
+                                               centroids=cc, loop="host"),
+                    c, "mnmg_cpu_diag", case="F_host_loop_fit", reps=2)
+    timed_whole_fit(lambda cc: kmeans_fit(params, x, centroids=cc),
+                    c, "mnmg_cpu_diag", case="G_single_dev_while_fit", reps=2)
+
+    hlo_analysis(mesh, xs, x, c, comms, params)
+
+
+def hlo_analysis(mesh, xs, x, c, comms, params):
+    """Structural diff: eager E+M step vs the while_loop fit program.
+
+    Counts, inside vs outside the while body: pads/copies/reshapes of the
+    full [n, dim] dataset, loop nesting depth, dots, and the collective
+    form — each a mechanism that could multiply per-iteration work inside
+    one compiled program.  CPU-optimized HLO (the only backend we can
+    compile for without the chip); structural hazards (op placement, not
+    codegen) are backend-visible here.
+    """
+    import re
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from raft_tpu.cluster import kmeans_mnmg
+
+    def analyzed(tag, hlo):
+        body = {}
+        # while bodies are named computations referenced by while ops
+        n_while = len(re.findall(r"^\s*\S+ = .* while\(", hlo, re.M))
+        for name, metric, pat in (
+                ("dots", "dot", r"= .*\bdot\("),
+                ("pads", "pad", r"= .*\bpad\("),
+                ("copies", "copy", r"= .*\bcopy\("),
+                ("allreduce", "all-reduce", r"= .*\ball-reduce\("),
+                ("dyn_slice", "ds", r"= .*\bdynamic-slice\("),
+                ("transpose", "tr", r"= .*\btranspose\(")):
+            body[name] = len(re.findall(pat, hlo))
+        big = f"100352,{x.shape[1]}"  # padded dataset shape from chunking
+        body["big_pad_ops"] = hlo.count(f"f32[{big}]{{1,0}} pad")
+        emit({"stage": "mnmg_cpu_diag", "case": f"hlo_{tag}",
+              "n_while_ops": n_while, **body, "hlo_lines": hlo.count("\n")})
+        return hlo
+
+    from raft_tpu.cluster import min_cluster_and_distance, update_centroids
+
+    k = c.shape[0]
+
+    def em(xx, cc):
+        nn = min_cluster_and_distance(xx, cc)
+        new, _ = update_centroids(xx, nn.key, k, old_centroids=cc)
+        return new
+
+    try:
+        eager = jax.jit(em).lower(x, c).compile().as_text()
+        analyzed("eager_step", eager)
+    except Exception as e:  # noqa: BLE001 - record and continue
+        emit({"stage": "mnmg_cpu_diag", "case": "hlo_eager_step",
+              "error": str(e)[:300]})
+    try:
+        local_fit = kmeans_mnmg._fit_program(
+            comms, params.max_iter, float(params.tol), params.metric,
+            2048, 1024)
+        from jax import shard_map
+
+        fitp = jax.jit(shard_map(
+            local_fit, mesh=mesh,
+            in_specs=(P("world", None), P(None, None)),
+            out_specs=(P(None, None), P(), P()), check_vma=False))
+        whole = fitp.lower(xs, c).compile().as_text()
+        analyzed("while_fit", whole)
+        # the decisive split: ops INSIDE the while body vs the whole module
+        m = re.search(
+            r"^%?(\S*body\S*) \([^)]*\) -> .*?\{(.*?)^\}", whole,
+            re.M | re.S)
+        if m:
+            analyzed("while_fit_body_only", m.group(2))
+    except Exception as e:  # noqa: BLE001 - record and continue
+        emit({"stage": "mnmg_cpu_diag", "case": "hlo_while_fit",
+              "error": str(e)[:300]})
+
+
+if __name__ == "__main__":
+    main()

@@ -24,9 +24,20 @@ for i in $(seq 1 "${1:-60}"); do
     timeout 32400 python -m bench.tpu_session "$OUT"
     rc=$?
     # Only rows appended by THIS run count — a stale done-row from an
-    # earlier completed session must not mask an incomplete one.
-    if tail -n "+$((pre + 1))" "$OUT" 2>/dev/null \
-        | grep -q '"stage": "session", "done": true'; then
+    # earlier completed session must not mask an incomplete one.  Parse
+    # the rows (not a serialized-substring grep, which silently breaks on
+    # key order/extra fields — r4 advisor finding).
+    if tail -n "+$((pre + 1))" "$OUT" 2>/dev/null | python -c '
+import json, sys
+for line in sys.stdin:
+    try:
+        row = json.loads(line)
+    except ValueError:
+        continue
+    if row.get("stage") == "session" and row.get("done") is True:
+        sys.exit(0)
+sys.exit(1)
+'; then
       echo "session complete (rc=$rc)" >&2
       exit "$rc"
     fi

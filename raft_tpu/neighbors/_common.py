@@ -119,6 +119,96 @@ def pack_lists_chunked(payload, ids, labels, n_lists: int,
             jnp.asarray(chunk_table), jnp.asarray(owner), cap)
 
 
+def extend_lists_chunked(data, idx, list_sizes, chunk_table,
+                         payload_new, ids_new, labels_new):
+    """INCREMENTAL append into chunked padded lists (reference extend
+    semantics, ivf_flat_build.cuh:108 — lists append in place; only lists
+    that overflow grow).
+
+    The r4 full-repack path unpacked EVERY live row, concatenated, and
+    re-sorted the whole index per extend — O(index) host+sort work.  Here
+    new rows fill the free tail slots of each list's last chunk and
+    overflow into fresh physical chunks appended before the reserved dummy
+    row, so the existing payload moves once as a straight device copy
+    (concat) and only the (n_new,) scatter and O(n_lists) table arithmetic
+    are new work.
+
+    Inputs are the pack_lists_chunked state (phys_sizes and owner are
+    recomputed from the table, not taken as inputs — physical rows of a
+    list are not contiguous after an extend) plus the (n_new, …) payload /
+    (n_new,) ids / labels of the rows to add.  Returns the same tuple shape
+    as pack_lists_chunked: (data, idx, phys_sizes, logical_counts,
+    chunk_table, owner, cap).
+    """
+    n_lists, max_chunks = chunk_table.shape
+    cap = data.shape[1]
+    n_phys = data.shape[0] - 1          # last physical row = reserved dummy
+    dummy_old = n_phys
+    n_new = payload_new.shape[0]
+
+    labels_h = np.asarray(labels_new)
+    counts_old = np.asarray(list_sizes).astype(np.int64)
+    added = np.bincount(labels_h, minlength=n_lists).astype(np.int64)
+    counts_total = counts_old + added
+    chunks_old = np.maximum(-(-counts_old // cap), 1)
+    chunks_total = np.maximum(-(-counts_total // cap), 1)
+    added_chunks = chunks_total - chunks_old
+    m = int(added_chunks.sum())
+    dummy_new = n_phys + m
+
+    # --- chunk table: remap old dummy padding, place the m new chunks ---
+    max_chunks2 = max(max_chunks, int(chunks_total.max()) if n_lists else 1)
+    table_h = np.asarray(chunk_table)
+    table2 = np.full((n_lists, max_chunks2), dummy_new, np.int32)
+    table2[:, :max_chunks] = np.where(table_h == dummy_old, dummy_new,
+                                      table_h)
+    if m:
+        new_owner = np.repeat(np.arange(n_lists, dtype=np.int32),
+                              added_chunks)
+        starts_added = np.zeros(n_lists + 1, np.int64)
+        np.cumsum(added_chunks, out=starts_added[1:])
+        ord_within = np.arange(m) - starts_added[new_owner]
+        chunk_ord_new = chunks_old[new_owner] + ord_within
+        table2[new_owner, chunk_ord_new] = (n_phys
+                                            + np.arange(m, dtype=np.int32))
+
+    # --- owner + per-chunk live sizes, recomputed from the table inverse
+    # (physical rows of a list are no longer contiguous after an extend,
+    # so pack_lists_chunked's arange-minus-starts derivation cannot be
+    # reused on repeated extends) ---
+    owner2 = np.zeros(dummy_new + 1, np.int32)
+    phys_sizes2 = np.zeros(dummy_new + 1, np.int32)
+    real = table2 != dummy_new                       # (n_lists, max_chunks2)
+    rows_l, ords = np.nonzero(real)
+    phys_ids = table2[rows_l, ords]
+    owner2[phys_ids] = rows_l.astype(np.int32)
+    phys_sizes2[phys_ids] = np.minimum(
+        cap, np.maximum(0, counts_total[rows_l] - ords * cap)).astype(np.int32)
+
+    # --- payload scatter: new row (label l, rank r) lands at logical
+    # position counts_old[l] + r → (chunk ordinal, slot) → physical row via
+    # the updated table ---
+    tail = payload_new.shape[1:]
+    data2 = jnp.concatenate(
+        [data[:n_phys],
+         jnp.zeros((m + 1, cap) + tail, data.dtype)], axis=0)
+    idx2 = jnp.concatenate(
+        [idx[:n_phys], jnp.full((m + 1, cap), -1, jnp.int32)], axis=0)
+    if n_new:
+        rank = _ranks_within(jnp.asarray(labels_new), n_new, n_lists)
+        pos = jnp.asarray(counts_old, jnp.int32)[labels_new] + rank
+        ci, slot = pos // cap, pos % cap
+        phys = jnp.asarray(table2)[labels_new, ci]
+        flat = phys * cap + slot
+        data2 = data2.reshape((-1,) + tail).at[flat].set(
+            payload_new.astype(data.dtype)).reshape(data2.shape)
+        idx2 = idx2.reshape(-1).at[flat].set(
+            jnp.asarray(ids_new, jnp.int32)).reshape(idx2.shape)
+    return (data2, idx2, jnp.asarray(phys_sizes2),
+            jnp.asarray(counts_total.astype(np.int32)),
+            jnp.asarray(table2), jnp.asarray(owner2), cap)
+
+
 def expand_probes(probe_ids, chunk_table, n_rows: int):
     """(nq, n_probes) logical probes → (nq, budget) physical rows.
 

@@ -42,6 +42,7 @@ from raft_tpu.matrix.select_k import select_k
 from raft_tpu.neighbors._common import (
     empty_result,
     expand_probes,
+    extend_lists_chunked,
     pack_lists_chunked,
     scan_probe_lists,
     subsample_trainset,
@@ -186,8 +187,11 @@ def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
 
 def extend(index: Index, new_vectors, new_ids=None) -> Index:
     """Add vectors to an existing index (reference ``ivf_flat::extend``,
-    ivf_flat_build.cuh:108).  Functional: returns a new Index (repacks the
-    padded lists; the reference reallocates lists likewise)."""
+    ivf_flat_build.cuh:108).  Functional: returns a new Index.  INCREMENTAL
+    (r5): new rows append into each list's free tail slots, only
+    overflowing lists grow a chunk (_common.extend_lists_chunked) — the
+    reference appends to the affected lists the same way; the r4 path
+    unpacked and re-sorted the whole index per extend."""
     xa = jnp.asarray(new_vectors)
     expects(xa.ndim == 2 and xa.shape[1] == index.dim, "dim mismatch")
     n_new = xa.shape[0]
@@ -202,32 +206,26 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
     q = _normalize_rows(xf) if index.metric == DistanceType.CosineExpanded else xf
     labels = _assign_lists(q, index.centers, index.metric)
 
-    # merge with existing live rows (physical rows are owner-labelled via
-    # the chunk table's inverse)
     if base:
-        owner = _owner_of(index.chunk_table, index.list_data.shape[0])
-        old_mask = index.list_indices.reshape(-1) >= 0
-        old_flat_data = index.list_data.reshape(-1, index.dim)[old_mask]
-        old_flat_ids = index.list_indices.reshape(-1)[old_mask]
-        old_labels = jnp.repeat(owner, index.capacity)[old_mask]
-        all_data = jnp.concatenate(
-            [old_flat_data, xa.astype(old_flat_data.dtype)], axis=0)
-        all_ids = jnp.concatenate([old_flat_ids, new_ids])
-        all_labels = jnp.concatenate([old_labels, labels])
+        (data, idx, phys_sizes, sizes, chunk_table, _, _) = \
+            extend_lists_chunked(index.list_data, index.list_indices,
+                                 index.list_sizes, index.chunk_table,
+                                 xa, new_ids, labels)
     else:
-        all_data, all_ids, all_labels = xa, new_ids, labels
-
-    data, idx, phys_sizes, sizes, chunk_table, _, _ = pack_lists_chunked(
-        all_data, all_ids, all_labels, index.n_lists)
+        data, idx, phys_sizes, sizes, chunk_table, _, _ = pack_lists_chunked(
+            xa, new_ids, labels, index.n_lists)
     centers = index.centers
     if index.adaptive_centers:
-        # drift centers toward the mean of their members (reference
-        # ivf_flat_build.cuh extend with adaptive_centers=true)
+        # drift centers toward the member mean (reference ivf_flat_build.cuh
+        # extend with adaptive_centers=true updates centers from accumulated
+        # sums): new = (old·n_old + Σ new members) / n_total — incremental,
+        # no pass over the stored rows
         sums = jax.ops.segment_sum(
-            all_data.astype(centers.dtype), all_labels,
-            num_segments=index.n_lists)
-        cnt = jnp.maximum(sizes.astype(centers.dtype), 1)[:, None]
-        centers = jnp.where(sizes[:, None] > 0, sums / cnt, centers)
+            xa.astype(centers.dtype), labels, num_segments=index.n_lists)
+        n_old = index.list_sizes.astype(centers.dtype)[:, None]
+        n_tot = jnp.maximum(sizes.astype(centers.dtype), 1)[:, None]
+        centers = jnp.where(sizes[:, None] > 0,
+                            (centers * n_old + sums) / n_tot, centers)
     return Index(centers=centers, list_data=data, list_indices=idx,
                  list_sizes=sizes, phys_sizes=phys_sizes,
                  chunk_table=chunk_table, metric=index.metric,

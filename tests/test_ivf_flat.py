@@ -218,3 +218,113 @@ def test_ivf_flat_bf16_dataset_recall_near_f32():
                           jnp.asarray(q, jnp.bfloat16))
     assert d_bf.dtype == jnp.float32  # scores accumulate in f32
     assert rec_bf >= rec_f32 - 0.02, (rec_bf, rec_f32)
+
+
+def test_extend_lists_chunked_matches_full_repack():
+    """Unit oracle for the r5 incremental extend: after any sequence of
+    extends, the chunked state holds exactly the same per-list member sets
+    as a fresh pack of all rows at the same cap, tail slots fill before new
+    chunks, and the reserved dummy row stays empty."""
+    from raft_tpu.neighbors._common import (extend_lists_chunked,
+                                            pack_lists_chunked)
+
+    rng = np.random.default_rng(3)
+    n_lists, dim = 7, 4
+    n0 = 60
+    x0 = rng.normal(0, 1, (n0, dim)).astype(np.float32)
+    lab0 = rng.integers(0, n_lists, n0).astype(np.int32)
+    ids0 = np.arange(n0, dtype=np.int32)
+    state = pack_lists_chunked(x0, ids0, lab0, n_lists, chunk_cap=8)
+    all_x, all_lab, all_ids = [x0], [lab0], [ids0]
+    nxt = n0
+    for n_new in (5, 40, 1, 23):  # tail-fill only, multi-chunk growth, ...
+        xn = rng.normal(0, 1, (n_new, dim)).astype(np.float32)
+        # skew into few lists so single lists overflow across chunks
+        labn = rng.integers(0, max(2, n_lists // 2), n_new).astype(np.int32)
+        idsn = np.arange(nxt, nxt + n_new, dtype=np.int32)
+        nxt += n_new
+        data, idx, phys, sizes, table, owner, cap = state = \
+            extend_lists_chunked(state[0], state[1], state[3], state[4],
+                                 xn, idsn, labn)
+        all_x.append(xn)
+        all_lab.append(labn)
+        all_ids.append(idsn)
+        assert cap == 8
+        catl = np.concatenate(all_lab)
+        cati = np.concatenate(all_ids)
+        catx = np.concatenate(all_x)
+        # logical sizes and physical accounting agree
+        np.testing.assert_array_equal(
+            np.asarray(sizes), np.bincount(catl, minlength=n_lists))
+        assert int(np.asarray(phys).sum()) == cati.size
+        # dummy row (last) is empty and -1-padded
+        assert int(np.asarray(phys)[-1]) == 0
+        np.testing.assert_array_equal(np.asarray(idx)[-1], -1)
+        # per-list member id sets match the labels oracle, and every stored
+        # vector sits at the slot its id says it should
+        idx_h, data_h = np.asarray(idx), np.asarray(data)
+        table_h, owner_h = np.asarray(table), np.asarray(owner)
+        dummy = data_h.shape[0] - 1
+        by_id = {int(i): v for i, v in zip(cati, catx)}
+        for l in range(n_lists):
+            got = []
+            for ci, p in enumerate(table_h[l]):
+                if p == dummy:
+                    continue
+                assert owner_h[p] == l
+                live = idx_h[p][: np.asarray(phys)[p]]
+                assert (idx_h[p][np.asarray(phys)[p]:] == -1).all()
+                got.extend(int(v) for v in live)
+                for slot, rid in enumerate(live):
+                    np.testing.assert_allclose(data_h[p, slot], by_id[rid],
+                                               rtol=1e-6)
+            assert sorted(got) == sorted(cati[catl == l].tolist())
+
+
+def test_ivf_flat_extend_search_matches_rebuild():
+    """Search on an incrementally extended index returns the same ids as a
+    full rebuild over the union (full probes → both are exact)."""
+    rng = np.random.default_rng(11)
+    x = rng.normal(0, 1, (1200, 16)).astype(np.float32)
+    q = rng.normal(0, 1, (40, 16)).astype(np.float32)
+    params = IndexParams(n_lists=16, seed=5)
+    idx = build(params, x[:800])
+    idx = extend(idx, x[800:])
+    assert idx.size == 1200
+    d, i = search(SearchParams(n_probes=16), idx, q, 10)
+    _, ti = knn(x, q, 10, DistanceType.L2Expanded)
+    assert recall(i, np.array(ti)) == 1.0
+
+
+def test_ivf_flat_extend_adaptive_centers():
+    """adaptive_centers=True drifts a list's center toward appended members
+    incrementally: new = (old·n_old + Σnew)/n_total (reference
+    ivf_flat_build.cuh extend updates centers from accumulated sums);
+    lists receiving nothing keep their center."""
+    rng = np.random.default_rng(2)
+    x = rng.normal(0, 1, (600, 8)).astype(np.float32)
+    idx = build(IndexParams(n_lists=8, seed=3, adaptive_centers=True), x)
+    c0 = np.asarray(idx.centers).copy()
+    sizes0 = np.asarray(idx.list_sizes).copy()
+    # extend with rows pinned near one existing center, shifted by +2
+    target = int(np.argmax(sizes0))
+    new = (c0[target] + 2.0
+           + 0.01 * rng.normal(0, 1, (64, 8))).astype(np.float32)
+    idx2 = extend(idx, new)
+    c1 = np.asarray(idx2.centers)
+    sizes1 = np.asarray(idx2.list_sizes)
+    got_new = sizes1 - sizes0
+    for l in range(8):
+        if got_new[l] == 0:
+            np.testing.assert_allclose(c1[l], c0[l], rtol=1e-6)
+    # the receiving lists moved, in the direction of the appended mass
+    moved = np.where(got_new > 0)[0]
+    assert moved.size > 0
+    for l in moved:
+        assert np.linalg.norm(c1[l] - c0[l]) > 1e-4
+    # exact incremental formula on the largest receiver
+    l = moved[np.argmax(got_new[moved])]
+    mask = np.asarray(
+        np.argmin(((new[:, None, :] - c0[None]) ** 2).sum(-1), axis=1)) == l
+    expect = (c0[l] * sizes0[l] + new[mask].sum(0)) / (sizes0[l] + mask.sum())
+    np.testing.assert_allclose(c1[l], expect, rtol=1e-4, atol=1e-5)
