@@ -59,6 +59,12 @@ from bench.common import make_emitter, timed_amortized, timed_chained  # noqa: E
 
 emit = make_emitter(OUT)
 
+# The session IS the Pallas A/B instrument: unlock the r5 experimental
+# gate (the kernels were demoted from user-facing selection after the r4b
+# compile failure; pallas_probe + the sweep's pallas configs are exactly
+# the re-promotion path, so they must stay able to compile them).
+os.environ["RAFT_TPU_PALLAS_EXPERIMENTAL"] = "1"
+
 #: Tiny-shape rehearsal mode: the mandatory pre-window CPU dry-run of the
 #: whole session must finish in minutes on a 1-vCPU host (numbers are
 #: meaningless there — the rehearsal only proves every stage runs

@@ -50,10 +50,12 @@ def min_cluster_and_distance(x, centroids, metric: DistanceType = DistanceType.L
     CosineExpanded; batched over (batch_samples × batch_centroids) tiles.
 
     ``engine``: "xla" (default) or "pallas" (fused Pallas kernel, L2 family
-    only).  ``RAFT_TPU_PALLAS_NN=1`` flips the default.  The env default is
-    resolved here, OUTSIDE the jit cache, so flipping the variable between
-    calls takes effect (an ``engine=None`` cache key would silently keep the
-    first-compiled engine).
+    only — r5: an EXPERIMENTAL scaffold on TPU, where it is known to fail
+    to compile over the axon tunnel; selecting it on a TPU backend requires
+    ``RAFT_TPU_PALLAS_EXPERIMENTAL=1`` alongside ``RAFT_TPU_PALLAS_NN=1``).
+    The env default is resolved here, OUTSIDE the jit cache, so flipping
+    the variable between calls takes effect (an ``engine=None`` cache key
+    would silently keep the first-compiled engine).
     """
     if engine is None:
         from raft_tpu.distance import pallas_fused_l2nn
@@ -65,6 +67,19 @@ def min_cluster_and_distance(x, centroids, metric: DistanceType = DistanceType.L
             f"engine='pallas' supports only the L2 metric family, got {metric}")
     elif engine not in ("xla", "pallas"):
         raise ValueError(f"unknown engine {engine!r}; expected 'xla' or 'pallas'")
+    if engine == "pallas":
+        from raft_tpu.distance import pallas_fused_l2nn
+
+        # r5 demotion: the Pallas kernel failed to compile on the only real
+        # TPU path ever exercised (axon tunnel, BENCH_TPU.md r4b), so the
+        # compiled-TPU route needs the explicit experimental flag.  Off-TPU
+        # the kernel runs under the interpreter (CI numerics) — allowed.
+        if (jax.default_backend() == "tpu"
+                and not pallas_fused_l2nn.experimental_unlocked()):
+            raise ValueError(
+                "engine='pallas' is an experimental scaffold on TPU: the "
+                "kernel failed to compile on the real device (BENCH_TPU.md "
+                "r4b). Set RAFT_TPU_PALLAS_EXPERIMENTAL=1 to probe it.")
     return _min_cluster_and_distance(x, centroids, metric=metric,
                                      batch_samples=batch_samples,
                                      batch_centroids=batch_centroids,
@@ -364,18 +379,20 @@ def _fit_main(x, centroids0, weights, metric: DistanceType, max_iter: int,
         nn = min_cluster_and_distance(x, centroids, metric, batch_samples,
                                       batch_centroids)
         new, _ = update_centroids(x, nn.key, k, weights, centroids)
-        delta = jnp.sum((new - centroids) ** 2)
+        delta = jnp.sum((new.astype(acc) - centroids.astype(acc)) ** 2)
         inertia = cluster_cost(nn, weights)
         return it + 1, new, inertia, delta
 
     # inertia carries the E-step value dtype: f32 for half-precision data
-    # (distances accumulate in f32 — pairwise._mxu_dot); delta follows the
-    # centroid dtype
+    # (distances accumulate in f32 — pairwise._mxu_dot); delta ALSO
+    # accumulates in f32 — a bf16 sum over k·dim tiny squared terms drops
+    # everything below sum·2⁻⁸, making the tol check unreliable (r4
+    # advisor finding)
     from raft_tpu.distance.pairwise import accum_dtype
 
-    inertia_dtype = accum_dtype(x.dtype)
-    init = (jnp.asarray(0), centroids0, jnp.asarray(jnp.inf, inertia_dtype),
-            jnp.asarray(jnp.inf, centroids0.dtype))
+    acc = accum_dtype(x.dtype)
+    init = (jnp.asarray(0), centroids0, jnp.asarray(jnp.inf, acc),
+            jnp.asarray(jnp.inf, acc))
     n_iter, centroids, inertia, _ = jax.lax.while_loop(cond, body, init)
     # final E-step for the converged inertia (reference recomputes after loop)
     nn = min_cluster_and_distance(x, centroids, metric, batch_samples, batch_centroids)

@@ -84,11 +84,16 @@ def _step_program(comms: Comms, metric: DistanceType, bs: int, bc: int):
     :func:`_fit_program` for why."""
 
     def local_step(x_shard, c):
+        from raft_tpu.distance.pairwise import accum_dtype
+
         new, _, inertia = compute_new_centroids(x_shard, c, comms,
                                                 metric=metric,
                                                 batch_samples=bs,
                                                 batch_centroids=bc)
-        delta = jnp.sum((new - c) ** 2)
+        # delta in the accumulation dtype: bf16 would drop terms below
+        # sum·2⁻⁸ over k·dim addends, breaking the tol check (r4 advisor)
+        acc = accum_dtype(c.dtype)
+        delta = jnp.sum((new.astype(acc) - c.astype(acc)) ** 2)
         return new, delta, inertia
 
     return _cached_program(comms, ("step", metric, bs, bc),
@@ -116,16 +121,18 @@ def _fit_program(comms: Comms, max_iter: int, tol: float, metric: DistanceType,
                                                     metric=metric,
                                                     batch_samples=bs,
                                                     batch_centroids=bc)
-            delta = jnp.sum((new - c) ** 2)
+            delta = jnp.sum((new.astype(acc) - c.astype(acc)) ** 2)
             return it + 1, new, inertia, delta
 
         # same dtype rule as kmeans._fit_main: inertia follows the E-step
-        # value dtype (f32 for half-precision data), delta the centroids
+        # value dtype (f32 for half-precision data), and delta ALSO
+        # accumulates in f32 (bf16 drops terms below sum·2⁻⁸ over k·dim
+        # addends — r4 advisor finding)
         from raft_tpu.distance.pairwise import accum_dtype
 
-        inertia_dtype = accum_dtype(x_shard.dtype)
-        init = (jnp.asarray(0), c0, jnp.asarray(jnp.inf, inertia_dtype),
-                jnp.asarray(jnp.inf, c0.dtype))
+        acc = accum_dtype(x_shard.dtype)
+        init = (jnp.asarray(0), c0, jnp.asarray(jnp.inf, acc),
+                jnp.asarray(jnp.inf, acc))
         n_iter, c, _, _ = jax.lax.while_loop(cond, body, init)
         # final E-step: inertia of the RETURNED centroids (the loop's value
         # is one step stale; matches single-device _fit_main)

@@ -13,10 +13,16 @@ XLA materialize each (bm, k) distance block to HBM before the argmin
 reduces it — ~2× the matmul's own HBM traffic on the k-means E-step.
 Here the (bm, bn) distance tile never leaves VMEM.
 
-Opt-in via ``RAFT_TPU_PALLAS_NN=1`` (or ``engine="pallas"``) until the
-tpu_session A/B sweep (bench/tpu_session.py) confirms the win on real
-hardware; numerics are validated against the jnp path in
-tests/test_pallas_kernels.py via interpret mode.
+Status (r5): DOCUMENTED SCAFFOLD, not a user-selectable engine.  On the
+only real-TPU path ever exercised (the axon tunnel, r4b session) this
+kernel FAILED TO COMPILE (``remote_compile HTTP 500: tpu_compile_helper
+subprocess exit code 1``), so selecting it on a TPU backend now requires
+``RAFT_TPU_PALLAS_EXPERIMENTAL=1`` in addition to ``RAFT_TPU_PALLAS_NN=1``
+/ ``engine="pallas"`` — the measurement session sets it for the
+pallas_probe/A-B stages (bench/tpu_session.py), which remain armed to
+re-promote the kernel if a future window shows it compiling AND winning
+the sweep.  Numerics stay validated against the jnp path in
+tests/test_pallas_kernels.py via interpret mode (CPU).
 """
 
 from __future__ import annotations
@@ -106,12 +112,20 @@ def fused_l2_nn_pallas(x, y, bm: int = _BM, bn: int = _BN,
     return val[:m], idx[:m]
 
 
+def experimental_unlocked() -> bool:
+    """r5 demotion gate: compiling this kernel on a TPU backend is known
+    to fail over the axon tunnel (module docstring) — the experimental
+    env var is the explicit acknowledgement the caller is probing that."""
+    return os.environ.get("RAFT_TPU_PALLAS_EXPERIMENTAL", "") == "1"
+
+
 def is_enabled() -> bool:
-    """Env opt-in, gated on a real TPU backend: on CPU the kernel would run
-    under the Pallas interpreter — orders of magnitude slower than the XLA
-    engine it replaces.  (Explicit ``engine="pallas"`` bypasses this gate
-    for tests.)"""
+    """Env opt-in, gated on a real TPU backend AND the experimental flag
+    (r5: the kernel is a scaffold until a live A/B re-promotes it).  On
+    CPU the kernel would run under the Pallas interpreter — orders of
+    magnitude slower than the XLA engine it replaces."""
     return (os.environ.get("RAFT_TPU_PALLAS_NN", "") == "1"
+            and experimental_unlocked()
             and jax.default_backend() == "tpu")
 
 

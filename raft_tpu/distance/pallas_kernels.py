@@ -105,7 +105,12 @@ def _pairwise_pallas(x, y, op: str, p: float = 2.0, bm: int = _BM,
 
 
 def is_enabled(k: int = 0) -> bool:
+    # r5 demotion gate (see pallas_fused_l2nn.experimental_unlocked):
+    # Pallas failed to compile on the only real-TPU path exercised, so
+    # the compiled route needs the explicit experimental acknowledgement.
     if not os.environ.get("RAFT_TPU_PALLAS"):
+        return False
+    if os.environ.get("RAFT_TPU_PALLAS_EXPERIMENTAL", "") != "1":
         return False
     if k and k > _MAX_K:
         return False

@@ -85,7 +85,17 @@ class BallCoverIndex:
 
 
 def _tile_distance(q, data, metric: DistanceType):
-    """Distances from queries (nq, dim) to gathered tiles (nq, cap, dim)."""
+    """Distances from queries (nq, dim) to gathered tiles (nq, cap, dim).
+
+    Half-precision inputs are upcast so scores accumulate in f32
+    (pairwise.accum_dtype policy, same as brute_force/ivf_flat — r4
+    advisor finding: the nq==0 path already returned accum_dtype, and the
+    certificate's exactness promise needs full-precision scores anyway)."""
+    from raft_tpu.distance.pairwise import accum_dtype
+
+    acc = accum_dtype(q.dtype)
+    q = q.astype(acc)
+    data = data.astype(acc)
     if metric == DistanceType.Haversine:
         dlat = q[:, None, 0] - data[:, :, 0]
         dlon = q[:, None, 1] - data[:, :, 1]
@@ -149,10 +159,12 @@ def _probe_pass(index_leaves, queries, k: int, n_probe: int, metric_val: int):
     def score_tile(lists):
         return _tile_distance(queries, list_data[lists], metric)
 
+    from raft_tpu.distance.pairwise import accum_dtype
+
     best_d, best_i = scan_probe_lists(probe_order.astype(jnp.int32),
                                       score_tile, list_indices, list_sizes,
                                       k, select_min=True,
-                                      dtype=queries.dtype)
+                                      dtype=accum_dtype(queries.dtype))
     # certificate: lower bound of every unprobed landmark vs k-th distance
     probed = jnp.zeros((nq, nl), bool).at[
         jnp.arange(nq)[:, None], probe_order].set(True)

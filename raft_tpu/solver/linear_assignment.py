@@ -60,10 +60,16 @@ def _auction_phase(benefit, prices, eps, max_rounds):
         row_to_col, col_to_row, prices, rounds = state
         unassigned = row_to_col < 0                       # (n,)
         value = benefit - prices[None, :]                  # (n, n)
-        # per-row best and second-best values
-        top2, top2_idx = jax.lax.top_k(value, 2)
-        best_j = top2_idx[:, 0]
-        bid_amount = prices[best_j] + (top2[:, 0] - top2[:, 1]) + eps
+        # per-row best and second-best values (n=1 has no second-best:
+        # the bid is price + eps, and top_k(…, 2) would be ill-formed)
+        if n >= 2:
+            top2, top2_idx = jax.lax.top_k(value, 2)
+            best_j = top2_idx[:, 0]
+            gap = top2[:, 0] - top2[:, 1]
+        else:
+            best_j = jnp.zeros((n,), jnp.int32)
+            gap = jnp.zeros((n,), benefit.dtype)
+        bid_amount = prices[best_j] + gap + eps
         # Each column takes the highest bid among unassigned bidders.
         bid = jnp.where(unassigned[:, None] &
                         (jnp.arange(n)[None, :] == best_j[:, None]),
@@ -96,31 +102,61 @@ def _solve_single(cost, final_eps: float, scaling_factor: float,
     benefit = -cost                     # min-cost ↔ max-benefit
     spread = jnp.maximum(jnp.max(cost) - jnp.min(cost),
                          jnp.asarray(1.0, cost.dtype))
+    # Effective ε is floored at a multiple of the price scale's ULP: with
+    # exact cost ties the bid increment is exactly ε, and an ε below
+    # ULP(price) leaves `price + ε == price` in f32 — the evicted duplicate
+    # re-bids identically forever and the phase stalls at its round cap
+    # (observed: duplicate-row costs at ε=1e-7, price scale ~10).  The
+    # optimality guarantee degrades gracefully to |primal − dual| ≤ n·ε_eff.
+    eps_eff = jnp.maximum(jnp.asarray(final_eps, cost.dtype),
+                          spread * 8 * jnp.finfo(cost.dtype).eps)
+
     # phase schedule: eps_0 = spread/2, shrink by scaling_factor until
-    # <= final_eps.  The count must be static for while_loop-free scan.
+    # <= eps_eff.  The count must be static for while_loop-free scan.
     def phase(carry, _):
         prices, eps, done = carry
         _, _, new_prices = _auction_phase(benefit, prices, eps,
                                           max_rounds_per_phase)
         prices = jnp.where(done, prices, new_prices)
-        next_eps = jnp.maximum(eps / scaling_factor,
-                               jnp.asarray(final_eps, cost.dtype))
-        new_done = done | (eps <= final_eps)
+        next_eps = jnp.maximum(eps / scaling_factor, eps_eff)
+        new_done = done | (eps <= eps_eff)
         return (prices, next_eps, new_done), None
 
-    # number of phases needed: log_{sf}(spread/(2*final_eps)) + 1; bound it
-    # statically by assuming spread/final_eps <= 1e9.
+    # number of phases needed: log_{sf}(spread/(2·eps_eff)) + 1.  The ULP
+    # floor bounds eps0/eps_eff at 1/(16·eps_machine) — ~5e5 for f32 but
+    # ~3e14 for f64 — so the static bound is derived from the cost dtype,
+    # not a fixed constant.
     import math
-    n_phases = max(1, int(math.ceil(math.log(1e9) / math.log(scaling_factor))))
+    max_ratio = 1.0 / (16 * float(jnp.finfo(cost.dtype).eps))
+    n_phases = 1 + max(1, int(math.ceil(math.log(max_ratio)
+                                        / math.log(scaling_factor))))
     eps0 = spread / 2
     (prices, _, _), _ = jax.lax.scan(
         phase, (jnp.zeros((n,), cost.dtype), eps0,
                 jnp.asarray(False)), None, length=n_phases)
-    # Final phase at final_eps with the settled prices — its assignment is
-    # ε-optimal (|primal − dual| ≤ n·ε).
-    r2c, c2r, prices = _auction_phase(benefit, prices,
-                                      jnp.asarray(final_eps, cost.dtype),
+    # Final phase at eps_eff with the settled prices — its assignment is
+    # ε-optimal (|primal − dual| ≤ n·ε_eff).
+    r2c, c2r, prices = _auction_phase(benefit, prices, eps_eff,
                                       max_rounds_per_phase)
+    # Completion guarantee: the reference always returns a permutation.  If
+    # the final phase hit its round cap with rows still unassigned (only
+    # reachable on adversarial tie structures), assign each leftover row to
+    # its best FREE column in row order — among sub-ε ties this loses
+    # nothing, and it restores the permutation invariant every caller
+    # relies on.
+    inf = jnp.asarray(jnp.finfo(benefit.dtype).max, benefit.dtype)
+
+    def complete(i, carry):
+        r2c_, c2r_, free = carry
+        need = r2c_[i] < 0
+        v = jnp.where(free, benefit[i] - prices, -inf)
+        j = jnp.argmax(v).astype(jnp.int32)
+        r2c_ = jnp.where(need, r2c_.at[i].set(j), r2c_)
+        c2r_ = jnp.where(need, c2r_.at[j].set(i), c2r_)
+        free = jnp.where(need, free.at[j].set(False), free)
+        return r2c_, c2r_, free
+
+    r2c, c2r, _ = jax.lax.fori_loop(0, n, complete, (r2c, c2r, c2r < 0))
     safe = jnp.clip(r2c, 0, n - 1)
     objective = jnp.sum(jnp.take_along_axis(cost, safe[:, None], axis=1)[:, 0])
     # duals: v = prices, u_i = max_j (benefit_ij − v_j) (complementary
@@ -142,8 +178,13 @@ def solve_lap(costs, epsilon: float = 1e-6, scaling_factor: float = 8.0,
 
     *costs* is (batch, n, n) or (n, n).  *epsilon* is the optimality
     tolerance (reference ctor's ``epsilon``): the returned assignment's
-    objective is within ``n·epsilon`` of optimal; for integer costs pass
-    ``epsilon < 1/n`` to get the exact optimum.
+    objective is within ``n·ε_eff`` of optimal, where
+    ``ε_eff = max(epsilon, spread · 8 · eps_machine(dtype))`` — the floor
+    keeps bid increments above the ULP of the price scale (below it the
+    auction stalls on exact cost ties; f32 at spread 1e6 floors ε at ~1).
+    For integer costs pass ``epsilon < 1/n`` to get the exact optimum,
+    provided the floor itself stays below 1/n (true whenever
+    ``spread · n ≲ 1e6`` in f32; use f64 costs beyond that).
     """
     costs = jnp.asarray(costs)
     squeeze = costs.ndim == 2

@@ -368,6 +368,29 @@ def test_kmeans_fit_bf16_data():
                                                   rel=0.02)
 
 
+def test_kmeans_bf16_tol_convergence_uses_f32_delta():
+    """The tol check's centroid-movement delta accumulates in f32 even for
+    bf16 centroids (r4 advisor finding: a bf16 sum over k*dim tiny squared
+    terms drops everything below sum*2^-8, so the loop could run to
+    max_iter or stop early unpredictably).  On well-separated clusters the
+    bf16 fit must early-stop like the f32 fit does."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    centers = 10.0 * rng.random((8, 64))
+    x64 = centers[rng.integers(0, 8, 400)] + 0.01 * rng.random((400, 64))
+    c0 = centers + 0.05 * rng.random((8, 64))
+    params = KMeansParams(n_clusters=8, init=InitMethod.Array, max_iter=50,
+                          tol=1e-3)
+    out_f32 = cluster.fit(params, x64.astype(np.float32),
+                          centroids=c0.astype(np.float32))
+    out_bf = cluster.fit(params, jnp.asarray(x64, jnp.bfloat16),
+                         centroids=jnp.asarray(c0, jnp.bfloat16))
+    assert int(out_f32.n_iter) < 50
+    # early convergence within a couple of iterations of the f32 fit
+    assert int(out_bf.n_iter) <= int(out_f32.n_iter) + 3
+
+
 def test_build_hierarchical_bf16_matches_f32_structure():
     """Balanced hierarchical build on bf16 data: fine-stage E/M accumulate
     in f32 (accum_dtype policy), so cluster sizes stay balanced and

@@ -106,6 +106,127 @@ def fn_mc(x):
 
 check("multicast/participant-ring", int(comms.run(fn_mc, np.zeros(N, np.float32))) == 1)
 
+# deepest and shallowest pow2 splits: 16 groups of 2 (one ppermute round)
+# and the 1x32 split (split == world; butterfly depth 5)
+for gsize in (2, 32):
+    sub = comms.comm_split([r // gsize for r in range(N)])
+
+    def fn(x, sub=sub, gsize=gsize):
+        r = comms.get_global_rank()
+        base = (r // gsize) * gsize
+        s = sub.allreduce(r.astype(jnp.float32))
+        exp = (base * gsize + gsize * (gsize - 1) // 2).astype(jnp.float32)
+        ok = s == exp
+        g = sub.allgather(r.astype(jnp.float32)[None])
+        ok &= jnp.all(g.ravel() == base.astype(jnp.float32)
+                      + jnp.arange(gsize, dtype=jnp.float32))
+        return comms.allreduce(ok.astype(jnp.int32), ReduceOp.MIN)
+
+    check(f"split{gsize}x{N // gsize}/allreduce+allgather",
+          int(comms.run(fn, np.zeros(N, np.float32))) == 1)
+
+# ring sendrecv at 32: forward shift, reverse shift, disjoint pair swap
+def fn_ring(x):
+    r = comms.get_global_rank().astype(jnp.float32)
+    fwd = comms.device_sendrecv(r, [(i, (i + 1) % N) for i in range(N)])
+    rev = comms.device_sendrecv(r, [(i, (i - 1) % N) for i in range(N)])
+    ok = fwd == (comms.get_global_rank() - 1) % N
+    ok &= rev == (comms.get_global_rank() + 1) % N
+    swap = comms.device_sendrecv(r, [(0, 31), (31, 0)])
+    me = comms.get_global_rank()
+    ok &= jnp.where(me == 0, swap == 31.0,
+                    jnp.where(me == 31, swap == 0.0, swap == 0.0))
+    return comms.allreduce(ok.astype(jnp.int32), ReduceOp.MIN)
+
+check("sendrecv/ring+reverse+pairswap",
+      int(comms.run(fn_ring, np.zeros(N, np.float32))) == 1)
+
+# allgatherv at 32 with ragged counts: padded shards come back exact
+counts = [(r % 5) for r in range(N)]
+
+def fn_agv(x, counts=counts):
+    r = comms.get_global_rank()
+    cnt = jnp.asarray(counts, jnp.int32)[r]
+    mine = jnp.where(jnp.arange(4) < cnt, r.astype(jnp.float32) + 1, 0.0)
+    gathered, _ = comms.allgatherv(mine, counts, pad_to=4)
+    exp = jnp.where(jnp.arange(4)[None, :] < jnp.asarray(counts)[:, None],
+                    jnp.arange(N, dtype=jnp.float32)[:, None] + 1, 0.0)
+    ok = jnp.all(gathered == exp)
+    return comms.allreduce(ok.astype(jnp.int32), ReduceOp.MIN)
+
+check("allgatherv/ragged-counts-pad4",
+      int(comms.run(fn_agv, np.zeros(N, np.float32))) == 1)
+
+# ---- failure / misuse paths (reference: API misuse asserts + ncclCommAbort
+# propagation, std_comms.hpp) -------------------------------------------
+from raft_tpu.core.error import LogicError
+
+# unequal-group allgather must raise: output shape is group-size-dependent,
+# unexpressible in one SPMD program
+sub_uneq = comms.comm_split([r // 5 for r in range(N)])  # 6 groups of 5 + 2
+
+def fn_bad_ag(x):
+    return sub_uneq.allgather(comms.get_global_rank().astype(jnp.float32)[None])
+
+try:
+    comms.run(fn_bad_ag, np.zeros(N, np.float32))
+    check("raise/unequal-group-allgather", False)
+except LogicError as e:
+    check("raise/unequal-group-allgather", "equal-sized groups" in str(e))
+
+# unequal-group reducescatter: same static-shape constraint
+def fn_bad_rs(x):
+    return sub_uneq.reducescatter(jnp.ones((10,)))
+
+try:
+    comms.run(fn_bad_rs, np.zeros(N, np.float32))
+    check("raise/unequal-group-reducescatter", False)
+except LogicError:
+    check("raise/unequal-group-reducescatter", True)
+
+# reducescatter length not divisible by group size
+sub8 = comms.comm_split([r // 8 for r in range(N)])
+
+def fn_bad_rs2(x):
+    return sub8.reducescatter(jnp.ones((9,)))
+
+try:
+    comms.run(fn_bad_rs2, np.zeros(N, np.float32))
+    check("raise/reducescatter-indivisible", False)
+except LogicError:
+    check("raise/reducescatter-indivisible", True)
+
+# allgatherv pad_to smaller than a shard
+try:
+    comms.run(lambda x: comms.allgatherv(jnp.ones((5,)), [5] * N, pad_to=4)[0],
+              np.zeros(N, np.float32))
+    check("raise/allgatherv-pad-too-small", False)
+except LogicError:
+    check("raise/allgatherv-pad-too-small", True)
+
+# comm_split color vector of the wrong length / with coverage gaps
+try:
+    comms.comm_split([0] * (N - 1))
+    check("raise/split-bad-length", False)
+except LogicError:
+    check("raise/split-bad-length", True)
+
+# abort propagation: ABORT is sticky on the aborted communicator and
+# isolated from the world communicator (per-clique, as ncclCommAbort)
+from raft_tpu.comms.comms_types import Status
+
+sub_ab = comms.comm_split([r // 4 for r in range(N)])
+assert sub_ab.sync_stream() == Status.SUCCESS
+sub_ab.abort()
+check("abort/sticky-on-aborted-clique",
+      sub_ab.sync_stream() == Status.ABORT
+      and sub_ab.sync_stream() == Status.ABORT)
+check("abort/world-unaffected", comms.sync_stream() == Status.SUCCESS)
+# device work still syncs fine through the healthy communicator
+arr = comms.run(lambda x: comms.allreduce(x), np.ones(N, np.float32))
+check("abort/world-collectives-still-run",
+      comms.sync_stream(arr) == Status.SUCCESS and float(arr[0]) == N)
+
 print("SCALE32 DONE failures=%d" % len(failures), flush=True)
 raise SystemExit(1 if failures else 0)
 """

@@ -142,3 +142,206 @@ def test_native_csr_to_ell_matches_numpy():
     dense[rows[mask], cols[mask]] = vals[mask]
     dense[ovr, ovc] = ovv
     np.testing.assert_allclose(dense, g.toarray(), rtol=1e-6)
+
+
+# ---- ABI edge cases (r5): empty inputs, invariant-violating inputs,
+# overflow accounting, dtype width coverage ----
+
+
+@requires_native
+def test_build_dendrogram_rejects_non_forest():
+    """An edge stream with a cycle (re-merging already-joined roots)
+    violates the sorted-MST invariant; the C side must return nonzero and
+    the binding must raise rather than write garbage."""
+    src = np.array([0, 1, 0], np.int32)
+    dst = np.array([1, 2, 2], np.int32)   # 0-1, 1-2, then 0-2 closes a cycle
+    w = np.array([0.1, 0.2, 0.3], np.float32)
+    with pytest.raises(ValueError, match="forest"):
+        native.agglomerative.build_dendrogram(src, dst, w)
+
+
+@requires_native
+def test_build_dendrogram_self_loop_is_cycle():
+    src = np.array([0, 1], np.int32)
+    dst = np.array([1, 1], np.int32)      # self-loop: ra == rb immediately
+    w = np.array([0.1, 0.2], np.float32)
+    with pytest.raises(ValueError, match="forest"):
+        native.agglomerative.build_dendrogram(src, dst, w)
+
+
+@requires_native
+def test_build_dendrogram_single_edge():
+    """Minimal forest: 2 points, 1 edge."""
+    children, deltas, sizes = native.agglomerative.build_dendrogram(
+        np.array([0], np.int32), np.array([1], np.int32),
+        np.array([0.5], np.float32))
+    np.testing.assert_array_equal(children, [[0, 1]])
+    np.testing.assert_array_equal(sizes, [2])
+
+
+@requires_native
+def test_extract_flattened_bad_n_clusters():
+    children, _, _ = native.agglomerative.build_dendrogram(
+        np.array([0, 2], np.int32), np.array([1, 0], np.int32),
+        np.array([0.1, 0.2], np.float32))
+    for bad in (0, -1, 4):   # valid range is 1..n (= 3)
+        with pytest.raises(ValueError, match="n_clusters"):
+            native.agglomerative.extract_flattened_clusters(children, bad, 3)
+
+
+@requires_native
+def test_extract_flattened_boundary_n_clusters():
+    """k=1 (all merged) and k=n (nothing merged) are legal boundaries."""
+    children, _, _ = native.agglomerative.build_dendrogram(
+        np.array([0, 2], np.int32), np.array([1, 0], np.int32),
+        np.array([0.1, 0.2], np.float32))
+    all_one = native.agglomerative.extract_flattened_clusters(children, 1, 3)
+    np.testing.assert_array_equal(all_one, [0, 0, 0])
+    singletons = native.agglomerative.extract_flattened_clusters(children, 3, 3)
+    np.testing.assert_array_equal(np.sort(singletons), [0, 1, 2])
+
+
+@requires_native
+def test_make_monotonic_empty_and_single():
+    out, k = native.make_monotonic_host(np.array([], np.int32))
+    assert out.shape == (0,) and k == 0
+    out, k = native.make_monotonic_host(np.array([42], np.int32))
+    np.testing.assert_array_equal(out, [0])
+    assert k == 1
+
+
+@requires_native
+def test_make_monotonic_negative_and_extreme_labels():
+    """int32 extremes must not overflow the dense relabeling."""
+    labels = np.array([2**31 - 1, -2**31, 0, 2**31 - 1], np.int32)
+    out, k = native.make_monotonic_host(labels)
+    np.testing.assert_array_equal(out, [2, 0, 1, 2])
+    assert k == 3
+
+
+@requires_native
+def test_coo_canonicalize_empty():
+    r, c, v = native.coo_canonicalize_host(
+        np.array([], np.int32), np.array([], np.int32),
+        np.array([], np.float64))
+    assert r.shape == (0,) and c.shape == (0,) and v.shape == (0,)
+
+
+@requires_native
+def test_coo_canonicalize_all_cancel():
+    """Every duplicate group sums to zero → empty canonical form."""
+    rows = np.array([1, 1, 0, 0], np.int32)
+    cols = np.array([2, 2, 3, 3], np.int32)
+    vals = np.array([5.0, -5.0, 1.25, -1.25])
+    r, c, v = native.coo_canonicalize_host(rows, cols, vals)
+    assert r.shape == (0,)
+
+
+@requires_native
+def test_coo_canonicalize_keep_zeros():
+    rows = np.array([1, 1], np.int32)
+    cols = np.array([2, 2], np.int32)
+    vals = np.array([5.0, -5.0])
+    r, c, v = native.coo_canonicalize_host(rows, cols, vals,
+                                           drop_zeros=False)
+    np.testing.assert_array_equal(r, [1])
+    np.testing.assert_allclose(v, [0.0])
+
+
+@requires_native
+def test_csr_to_ell_overflow_accounting_exact():
+    """The overflow arrays must hold EXACTLY sum(max(nnz_row - r, 0))
+    entries, in row order, with the in-row tail beyond r."""
+    import scipy.sparse as sps
+
+    indptr = np.array([0, 5, 5, 7], np.int64)       # rows: 5, 0, 2 nnz
+    indices = np.array([0, 1, 2, 3, 4, 1, 2], np.int32)
+    data = np.arange(7, dtype=np.float32) + 1
+    r = 2
+    cols, vals, ovr, ovc, ovv = native.csr_to_ell_host(indptr, indices,
+                                                       data, r)
+    assert ovr.shape == (3,)                        # row0 spills 5-2=3
+    np.testing.assert_array_equal(ovr, [0, 0, 0])
+    np.testing.assert_array_equal(ovc, [2, 3, 4])
+    np.testing.assert_allclose(ovv, [3.0, 4.0, 5.0])
+    np.testing.assert_array_equal(cols[0], [0, 1])
+    np.testing.assert_array_equal(cols[1], [0, 0])  # empty row zero-padded
+    np.testing.assert_allclose(vals[1], [0.0, 0.0])
+    # reconstruct == original
+    dense = np.zeros((3, 5), np.float32)
+    for i in range(3):
+        for j in range(r):
+            if vals[i, j] != 0:
+                dense[i, cols[i, j]] = vals[i, j]
+    dense[ovr, ovc] = ovv
+    ref = sps.csr_matrix((data, indices, indptr), shape=(3, 5)).toarray()
+    np.testing.assert_allclose(dense, ref)
+
+
+@requires_native
+def test_csr_to_ell_malformed_indptr_raises():
+    indptr = np.array([0, 3, 2, 4], np.int64)       # decreasing: e < s
+    indices = np.zeros(4, np.int32)
+    data = np.zeros(4, np.float32)
+    with pytest.raises(ValueError, match="indptr"):
+        native.csr_to_ell_host(indptr, indices, data, 2)
+
+
+@requires_native
+def test_csr_to_ell_empty_matrix():
+    cols, vals, ovr, ovc, ovv = native.csr_to_ell_host(
+        np.array([0], np.int64), np.array([], np.int32),
+        np.array([], np.float32), 4)
+    assert cols.shape == (0, 4) and ovr.shape == (0,)
+
+
+@requires_native
+def test_csr_to_ell_dtype_widths():
+    """Bytewise value copy must be exact for 2-, 4- and 8-byte dtypes
+    (one symbol serves every dtype via elem_size)."""
+    import scipy.sparse as sps
+
+    rng = np.random.default_rng(3)
+    g64 = sps.random(50, 60, density=0.1, format="csr", dtype=np.float64,
+                     random_state=4)
+    for dtype in (np.float32, np.float64):
+        g = g64.astype(dtype)
+        r = 4
+        cols, vals, ovr, ovc, ovv = native.csr_to_ell_host(
+            g.indptr.astype(np.int64), g.indices, g.data, r)
+        assert vals.dtype == dtype and ovv.dtype == dtype
+        dense = np.zeros(g.shape, dtype)
+        rows = np.repeat(np.arange(g.shape[0]), r).reshape(-1, r)
+        mask = vals != 0
+        dense[rows[mask], cols[mask]] = vals[mask]
+        dense[ovr, ovc] = ovv
+        np.testing.assert_array_equal(dense, g.toarray())
+    # f16 (2-byte path) via hand-built CSR — scipy.sparse has no float16
+    indptr = np.array([0, 3, 3, 5], np.int64)
+    indices = np.array([4, 0, 2, 1, 3], np.int32)
+    data = np.array([1.5, -2.25, 0.5, 3.0, 0.125], np.float16)
+    cols, vals, ovr, ovc, ovv = native.csr_to_ell_host(indptr, indices,
+                                                       data, 2)
+    assert vals.dtype == np.float16 and ovv.dtype == np.float16
+    np.testing.assert_array_equal(vals[0], data[:2])
+    np.testing.assert_array_equal(ovv, data[2:3])       # row0 spills 1
+    np.testing.assert_array_equal(vals[2], data[3:5])
+
+
+@requires_native
+def test_dendrogram_chain_vs_scipy_order():
+    """A pathological chain (every merge extends one cluster) keeps exact
+    scipy agreement — sizes must be 2, 3, ..., n."""
+    from scipy.cluster.hierarchy import linkage
+
+    n = 30
+    x = np.arange(n, dtype=np.float32)[:, None] ** 1.1  # strictly spreading
+    from raft_tpu.cluster.single_linkage import build_sorted_mst
+
+    src, dst, w = build_sorted_mst(x)
+    children, deltas, sizes = native.agglomerative.build_dendrogram(
+        np.array(src), np.array(dst), np.array(w))
+    ref = linkage(x.astype(np.float64), method="single")
+    np.testing.assert_allclose(np.sort(deltas), np.sort(ref[:, 2]),
+                               atol=1e-4)
+    np.testing.assert_array_equal(sizes, np.arange(2, n + 1))

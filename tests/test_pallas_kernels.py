@@ -1,53 +1,140 @@
-"""Pallas VPU-engine pairwise kernel vs the jnp engine (interpret mode —
-the CPU-CI analogue of the reference's naive-kernel oracles)."""
+"""Pallas kernel validation (interpret mode — the CPU-CI analogue of the
+reference's naive-kernel oracles, pairwise_distance_base.cuh tests).
+
+These kernels are r5 scaffolds (they failed to compile on the only real
+TPU path exercised — BENCH_TPU.md r4b), which makes their interpret-mode
+contracts the ONLY continuously-verified property: the grids here cover
+every op × blocking × shape class, the epilogue contracts the callers
+rely on, tie-breaking, padding neutrality, and the experimental gating.
+"""
 
 import numpy as np
 import pytest
 from scipy.spatial.distance import cdist
 
-from raft_tpu.distance.pallas_kernels import pairwise_accumulate
+from raft_tpu.distance.pallas_kernels import (
+    _MAX_K,
+    _OPS,
+    _pairwise_pallas,
+    pairwise_accumulate,
+)
+
+_SCIPY = {
+    "l1": "cityblock",
+    "l2": "sqeuclidean",
+    "linf": "chebyshev",
+    "canberra": "canberra",
+}
 
 
-@pytest.mark.parametrize("op,scipy_metric,finalize", [
-    ("l1", "cityblock", None),
-    ("l2", "sqeuclidean", None),
-    ("linf", "chebyshev", None),
-    ("canberra", "canberra", None),
+# ---------------------------------------------------------------- op grids
+
+
+@pytest.mark.parametrize("op,scipy_metric", sorted(_SCIPY.items()))
+@pytest.mark.parametrize("m,n,k", [
+    (40, 70, 19),    # nothing aligned
+    (1, 1, 1),       # degenerate single pair
+    (129, 5, 33),    # tall x, tiny y (row-pad + col-pad together)
+    (3, 260, 8),     # tiny x, wide y (forces multiple col blocks)
 ])
-def test_pallas_accumulate_matches_scipy(op, scipy_metric, finalize):
-    rng = np.random.default_rng(0)
-    x = rng.random((40, 19)).astype(np.float32)
-    y = rng.random((70, 19)).astype(np.float32)
+def test_pallas_accumulate_matches_scipy(op, scipy_metric, m, n, k):
+    rng = np.random.default_rng(abs(hash((op, m, n, k))) % 2**31)
+    x = rng.random((m, k)).astype(np.float32)
+    y = rng.random((n, k)).astype(np.float32)
     out = np.array(pairwise_accumulate(x, y, op, interpret=True))
     ref = cdist(x.astype(np.float64), y.astype(np.float64), scipy_metric)
     np.testing.assert_allclose(out, ref, atol=1e-4)
 
 
-def test_pallas_lp_and_hamming():
+def test_pallas_ops_table_is_fully_covered():
+    """Every op in the kernel's dispatch table has a grid or contract test
+    in this file — a new op without an oracle fails here."""
+    assert set(_OPS) == {"l1", "l2", "linf", "lp", "hamming", "canberra"}
+
+
+@pytest.mark.parametrize("p", [0.5, 1.5, 3.0, 4.0])
+def test_pallas_lp_epilogue_contract(p):
+    """The kernel returns the RAW power sum; the caller's ^(1/p) epilogue
+    (pairwise.py fin_op split) must reproduce Minkowski for any p."""
     rng = np.random.default_rng(1)
     x = rng.random((25, 10)).astype(np.float32)
     y = rng.random((30, 10)).astype(np.float32)
-    out = np.array(pairwise_accumulate(x, y, "lp", p=3.0, interpret=True))
-    ref = cdist(x.astype(np.float64), y.astype(np.float64), "minkowski", p=3.0)
-    np.testing.assert_allclose(out ** (1.0 / 3.0), ref, atol=1e-4)
-    xi = (rng.random((20, 12)) < 0.5).astype(np.float32)
-    yi = (rng.random((22, 12)) < 0.5).astype(np.float32)
+    out = np.array(pairwise_accumulate(x, y, "lp", p=p, interpret=True))
+    ref = cdist(x.astype(np.float64), y.astype(np.float64), "minkowski", p=p)
+    np.testing.assert_allclose(out ** (1.0 / p), ref, atol=1e-3)
+
+
+def test_pallas_hamming_epilogue_contract():
+    """The kernel accumulates the mismatch COUNT; /k is the caller's
+    epilogue (reference hamming fin_op)."""
+    rng = np.random.default_rng(2)
+    k = 12
+    xi = (rng.random((20, k)) < 0.5).astype(np.float32)
+    yi = (rng.random((22, k)) < 0.5).astype(np.float32)
     out = np.array(pairwise_accumulate(xi, yi, "hamming", interpret=True))
-    ref = cdist(xi, yi, "hamming") * 12  # accumulate = count, mean is epilogue
+    ref = cdist(xi, yi, "hamming")
+    np.testing.assert_allclose(out / k, ref, atol=1e-5)
+    # count-valued output is integral
+    np.testing.assert_allclose(out, np.round(out), atol=1e-6)
+
+
+def test_pallas_l2_sqrt_epilogue_contract():
+    """sqrt of the accumulated unexpanded L2 == euclidean (the L2Sqrt
+    epilogue the dispatcher fuses outside the kernel)."""
+    rng = np.random.default_rng(3)
+    x = rng.random((31, 9)).astype(np.float32)
+    y = rng.random((17, 9)).astype(np.float32)
+    out = np.array(pairwise_accumulate(x, y, "l2", interpret=True))
+    ref = cdist(x.astype(np.float64), y.astype(np.float64), "euclidean")
+    np.testing.assert_allclose(np.sqrt(out), ref, atol=1e-4)
+
+
+def test_pallas_canberra_zero_coordinate_convention():
+    """0/0 coordinates contribute 0 (reference canberra guard) — the
+    padding-neutrality property the kernel's no-mask design relies on."""
+    x = np.array([[0.0, 1.0, 0.0], [0.0, 0.0, 0.0]], np.float32)
+    y = np.array([[0.0, 0.0, 2.0], [0.0, 3.0, 0.0]], np.float32)
+    out = np.array(pairwise_accumulate(x, y, "canberra", interpret=True))
+    ref = cdist(x.astype(np.float64), y.astype(np.float64), "canberra")
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+# ----------------------------------------------------- blocking invariance
+
+
+@pytest.mark.parametrize("op", sorted(_OPS))
+@pytest.mark.parametrize("bm,bn", [(32, 128), (8, 256)])
+def test_pallas_blocking_invariance(op, bm, bn):
+    """Results are independent of the (bm, bn) tiling for every op — the
+    grid revisit/merge logic cannot leak tile boundaries."""
+    rng = np.random.default_rng(4)
+    x = rng.random((150, 7)).astype(np.float32)
+    y = rng.random((260, 7)).astype(np.float32)
+    if op == "hamming":
+        x = (x < 0.5).astype(np.float32)
+        y = (y < 0.5).astype(np.float32)
+    ref = np.array(_pairwise_pallas(x, y, op, 3.0, bm=128, bn=128,
+                                    interpret=True))
+    out = np.array(_pairwise_pallas(x, y, op, 3.0, bm=bm, bn=bn,
+                                    interpret=True))
     np.testing.assert_allclose(out, ref, atol=1e-5)
 
 
-def test_pallas_blocking_invariance():
-    rng = np.random.default_rng(2)
-    x = rng.random((150, 7)).astype(np.float32)
-    y = rng.random((260, 7)).astype(np.float32)
-    from raft_tpu.distance.pallas_kernels import _pairwise_pallas
+def test_pallas_output_dtype_follows_input():
+    rng = np.random.default_rng(5)
+    x = rng.random((12, 6)).astype(np.float32)
+    y = rng.random((9, 6)).astype(np.float32)
+    out = pairwise_accumulate(x, y, "l1", interpret=True)
+    assert out.dtype == np.float32
+    assert out.shape == (12, 9)
 
-    a = np.array(_pairwise_pallas(x, y, "l1", 2.0, bm=128, bn=128,
-                                  interpret=True))
-    b = np.array(_pairwise_pallas(x, y, "l1", 2.0, bm=32, bn=128,
-                                  interpret=True))
-    np.testing.assert_allclose(a, b, atol=1e-5)
+
+# --------------------------------------------------------- fused L2 NN
+
+
+def _fused_ref(x, y):
+    d = cdist(x.astype(np.float64), y.astype(np.float64), "sqeuclidean")
+    return d.min(axis=1), d.argmin(axis=1)
 
 
 def test_fused_l2_nn_pallas_matches_jnp():
@@ -68,6 +155,85 @@ def test_fused_l2_nn_pallas_matches_jnp():
                                atol=1e-3)
 
 
+@pytest.mark.parametrize("m,k,bm,bn", [
+    (64, 5, 64, 512),     # fewer centroids than one block
+    (100, 3, 32, 1),      # single-centroid blocks exercise the j-merge
+    (7, 33, 256, 512),    # everything smaller than the blocks
+    (257, 129, 64, 64),   # multi-block on both grid axes
+])
+def test_fused_l2_nn_pallas_shape_grid(m, k, bm, bn):
+    """Cross-block running-min merge is exact for every grid shape class
+    (the revisited-output merge is the part the reference does with
+    atomics, fused_l2_nn.cuh:132 — wrong merges show up as off-by-one
+    block indices)."""
+    from raft_tpu.distance.pallas_fused_l2nn import fused_l2_nn_pallas
+
+    rng = np.random.default_rng(m * 1000 + k)
+    x = rng.normal(0, 1, (m, 16)).astype(np.float32)
+    y = rng.normal(0, 1, (k, 16)).astype(np.float32)
+    val, idx = fused_l2_nn_pallas(x, y, bm=bm, bn=bn, bf16_dot=False,
+                                  interpret=True)
+    rv, ri = _fused_ref(x, y)
+    np.testing.assert_array_equal(np.asarray(idx), ri)
+    np.testing.assert_allclose(np.asarray(val), rv, atol=1e-3)
+
+
+def test_fused_l2_nn_pallas_first_block_wins_ties_across_blocks():
+    """A centroid duplicated across different COLUMN BLOCKS must resolve
+    to the lower index (strict < merge): the cross-block analogue of the
+    jnp argmin's first-wins rule."""
+    from raft_tpu.distance.pallas_fused_l2nn import fused_l2_nn_pallas
+
+    rng = np.random.default_rng(8)
+    y = rng.normal(0, 1, (64, 8)).astype(np.float32)
+    y[60] = y[3]                       # duplicates land in different blocks
+    x = np.repeat(y[3][None, :], 5, 0).astype(np.float32)
+    val, idx = fused_l2_nn_pallas(x, y, bm=8, bn=16,
+                                  bf16_dot=False, interpret=True)
+    np.testing.assert_array_equal(np.asarray(idx), 3)
+    np.testing.assert_allclose(np.asarray(val), 0.0, atol=1e-5)
+
+
+def test_fused_l2_nn_pallas_self_match():
+    """Querying the centroid set against itself: every row's NN is itself
+    at distance ~0 (catches any off-by-one in the block index offset)."""
+    from raft_tpu.distance.pallas_fused_l2nn import fused_l2_nn_pallas
+
+    rng = np.random.default_rng(9)
+    y = rng.normal(0, 3, (90, 12)).astype(np.float32)
+    val, idx = fused_l2_nn_pallas(y, y, bm=32, bn=32, bf16_dot=False,
+                                  interpret=True)
+    np.testing.assert_array_equal(np.asarray(idx), np.arange(90))
+    np.testing.assert_allclose(np.asarray(val), 0.0, atol=1e-4)
+
+
+def test_fused_l2_nn_pallas_bf16_dot_on_separated_data():
+    """bf16_dot=True keeps exact argmins when clusters are separated well
+    beyond bf16 rounding (the precision="default" contract the k-means
+    wiring maps it to)."""
+    from raft_tpu.distance.pallas_fused_l2nn import fused_l2_nn_pallas
+
+    rng = np.random.default_rng(10)
+    y = (10.0 * rng.normal(0, 1, (32, 16))).astype(np.float32)
+    labels = rng.integers(0, 32, 200)
+    x = (y[labels] + 0.01 * rng.normal(0, 1, (200, 16))).astype(np.float32)
+    _, idx = fused_l2_nn_pallas(x, y, bm=64, bn=16, bf16_dot=True,
+                                interpret=True)
+    np.testing.assert_array_equal(np.asarray(idx), labels)
+
+
+def test_fused_l2_nn_pallas_d_cap():
+    from raft_tpu.distance.pallas_fused_l2nn import (_MAX_D,
+                                                     fused_l2_nn_pallas)
+
+    x = np.zeros((4, _MAX_D + 1), np.float32)
+    with pytest.raises(ValueError, match="fused_l2_nn_pallas"):
+        fused_l2_nn_pallas(x, x, interpret=True)
+
+
+# ------------------------------------------------- engine wiring + gating
+
+
 def test_min_cluster_and_distance_pallas_engine():
     """engine="pallas" routes the k-means E-step through the fused kernel
     with identical assignments (interpret mode auto-selected off-TPU)."""
@@ -84,3 +250,45 @@ def test_min_cluster_and_distance_pallas_engine():
     np.testing.assert_array_equal(np.asarray(out.key), np.asarray(base.key))
     np.testing.assert_allclose(np.asarray(out.value), np.asarray(base.value),
                                atol=1e-3)
+
+
+def test_pallas_engine_value_dtype_is_accum_dtype():
+    """Half-precision data through the pallas engine still yields f32
+    distances (the while_loop inertia carry contract)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.cluster import min_cluster_and_distance
+
+    rng = np.random.default_rng(6)
+    x = jnp.asarray(rng.normal(0, 1, (64, 16)), jnp.bfloat16)
+    c = jnp.asarray(rng.normal(0, 1, (8, 16)), jnp.bfloat16)
+    out = min_cluster_and_distance(x, c, engine="pallas")
+    assert out.value.dtype == jnp.float32
+
+
+def test_pallas_is_enabled_requires_experimental_flag(monkeypatch):
+    """r5 demotion: the env opt-ins alone may NOT enable either kernel —
+    the experimental flag is the explicit acknowledgement of the known
+    TPU compile failure (BENCH_TPU.md r4b)."""
+    from raft_tpu.distance import pallas_fused_l2nn, pallas_kernels
+
+    monkeypatch.setenv("RAFT_TPU_PALLAS_NN", "1")
+    monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+    monkeypatch.delenv("RAFT_TPU_PALLAS_EXPERIMENTAL", raising=False)
+    assert not pallas_fused_l2nn.is_enabled()
+    assert not pallas_kernels.is_enabled()
+    # with the flag, the remaining gate is the backend (False on CPU CI)
+    monkeypatch.setenv("RAFT_TPU_PALLAS_EXPERIMENTAL", "1")
+    import jax
+
+    expected = jax.default_backend() == "tpu"
+    assert pallas_fused_l2nn.is_enabled() == expected
+    assert pallas_kernels.is_enabled() == expected
+
+
+def test_pallas_kernels_max_k_gate(monkeypatch):
+    from raft_tpu.distance import pallas_kernels
+
+    monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+    monkeypatch.setenv("RAFT_TPU_PALLAS_EXPERIMENTAL", "1")
+    assert not pallas_kernels.is_enabled(k=_MAX_K + 1)

@@ -132,3 +132,140 @@ def test_lap_adversarial_near_ties():
     assert sorted(rows.tolist()) == list(range(n))
     ri, ci = linear_sum_assignment(cost)
     assert cost[np.arange(n), rows].sum() <= cost[ri, ci].sum() + 1e-3
+
+
+# ---- degenerate / duplicate-cost grids (r5: the auction's tie and
+# degeneracy cases vs the scipy oracle — reference test/linear_assignment.cu
+# validates against known-optimal structured costs) ----
+
+
+def _assert_eps_optimal(cost, res, slack):
+    n = cost.shape[0]
+    rows = np.asarray(res.row_assignment)
+    assert sorted(rows.tolist()) == list(range(n))
+    ri, ci = linear_sum_assignment(cost.astype(np.float64))
+    assert cost[np.arange(n), rows].sum() <= cost[ri, ci].sum() + slack
+
+
+def test_lap_all_equal_costs():
+    """Fully degenerate: every permutation is optimal; the auction must
+    still terminate with a valid permutation at the exact objective."""
+    n = 16
+    cost = np.full((n, n), 7.5, np.float32)
+    res = solve_lap(cost, epsilon=1e-6)
+    rows = np.asarray(res.row_assignment)
+    assert sorted(rows.tolist()) == list(range(n))
+    np.testing.assert_allclose(float(res.objective), 7.5 * n, rtol=1e-6)
+
+
+def test_lap_duplicate_rows_and_columns():
+    """Duplicated rows/columns create continuum ties — any optimum is
+    acceptable but the objective must match scipy's."""
+    rng = np.random.default_rng(10)
+    n = 18
+    cost = rng.uniform(0, 10, (n, n)).astype(np.float32)
+    cost[7] = cost[3]          # duplicate rows
+    cost[:, 11] = cost[:, 2]   # duplicate columns
+    res = solve_lap(cost, epsilon=1e-7)
+    _assert_eps_optimal(cost, res, 1e-3)
+
+
+def test_lap_rank_one_cost():
+    """cost = u·vᵀ is totally degenerate after dual reduction (u_i + v_j
+    shifts make all entries equal) — a classic auction stress case."""
+    rng = np.random.default_rng(11)
+    n = 14
+    u = rng.uniform(1, 2, n).astype(np.float32)
+    v = rng.uniform(1, 2, n).astype(np.float32)
+    cost = np.outer(u, v).astype(np.float32)
+    res = solve_lap(cost, epsilon=1e-7)
+    _assert_eps_optimal(cost, res, 1e-3)
+
+
+def test_lap_negative_costs():
+    rng = np.random.default_rng(12)
+    n = 20
+    cost = rng.uniform(-50, 50, (n, n)).astype(np.float32)
+    res = solve_lap(cost, epsilon=1e-6)
+    _assert_eps_optimal(cost, res, 1e-2 * n)
+
+
+def test_lap_extreme_dynamic_range():
+    """Entries spanning 1e-3..1e6: epsilon scaling must not lose the small
+    entries' ordering entirely."""
+    rng = np.random.default_rng(13)
+    n = 12
+    cost = (rng.uniform(0, 1e-3, (n, n))
+            + np.where(rng.random((n, n)) < 0.3, 1e6, 0.0)).astype(np.float32)
+    # keep at least one cheap entry per row/col: zero diagonal
+    np.fill_diagonal(cost, 0.0)
+    res = solve_lap(cost, epsilon=1e-4)
+    rows = np.asarray(res.row_assignment)
+    assert sorted(rows.tolist()) == list(range(n))
+    # optimal assignment avoids every 1e6 entry (diagonal is free)
+    assert cost[np.arange(n), rows].sum() < 1.0
+
+
+@pytest.mark.parametrize("n", [1, 2, 3])
+def test_lap_minimal_sizes(n):
+    rng = np.random.default_rng(14 + n)
+    cost = rng.uniform(0, 1, (n, n)).astype(np.float32)
+    res = solve_lap(cost, epsilon=1e-8)
+    _assert_eps_optimal(cost, res, 1e-4)
+
+
+def test_lap_permutation_cost_exact():
+    """0/1 cost with a unique zero per row/col: the planted permutation is
+    the unique optimum and must be recovered EXACTLY."""
+    rng = np.random.default_rng(17)
+    n = 30
+    perm = rng.permutation(n)
+    cost = np.ones((n, n), np.float32)
+    cost[np.arange(n), perm] = 0.0
+    res = solve_lap(cost, epsilon=1.0 / (2 * n))
+    np.testing.assert_array_equal(np.asarray(res.row_assignment), perm)
+    assert float(res.objective) == 0.0
+
+
+def test_lap_toeplitz_chain_reassignment():
+    """cost[i,j] = |i-j| forces long reassignment chains in the auction
+    (each row's best item is contested by its neighbours)."""
+    n = 24
+    i = np.arange(n)
+    cost = np.abs(i[:, None] - i[None, :]).astype(np.float32)
+    res = solve_lap(cost, epsilon=1.0 / (2 * n))
+    # identity is the unique integer optimum at objective 0
+    np.testing.assert_array_equal(np.asarray(res.row_assignment), i)
+    assert float(res.objective) == 0.0
+
+
+def test_lap_batched_mixed_degenerate():
+    """A batch mixing degenerate and generic matrices: per-slice optimality
+    must hold independently (the vmapped phases share iteration counts)."""
+    rng = np.random.default_rng(18)
+    n = 16
+    costs = np.stack([
+        np.full((n, n), 1.0, np.float32),                      # all ties
+        rng.uniform(0, 1, (n, n)).astype(np.float32),          # generic
+        np.outer(np.ones(n), rng.uniform(0, 1, n)).astype(np.float32),
+    ])
+    res = solve_lap(costs, epsilon=1e-7)
+    for b in range(3):
+        rows = np.asarray(res.row_assignment[b])
+        assert sorted(rows.tolist()) == list(range(n))
+        ri, ci = linear_sum_assignment(costs[b].astype(np.float64))
+        assert (costs[b][np.arange(n), rows].sum()
+                <= costs[b][ri, ci].sum() + 1e-3)
+
+
+def test_lap_dual_feasibility_on_degenerate():
+    """ε-complementary slackness holds even when ties are everywhere."""
+    n = 10
+    cost = np.full((n, n), 3.0, np.float32)
+    lap = LinearAssignmentProblem(size=n, batchsize=1, epsilon=1e-7)
+    lap.solve(cost[None])
+    u = np.array(lap.get_row_dual_vector(0))
+    v = np.array(lap.get_col_dual_vector(0))
+    assert np.all(u[:, None] + v[None, :] <= cost + 1e-4)
+    assert float(lap.get_dual_objective_value(0)) <= \
+        float(lap.get_primal_objective_value(0)) + 1e-4
