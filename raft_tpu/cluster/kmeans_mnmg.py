@@ -144,6 +144,51 @@ def _fit_program(comms: Comms, max_iter: int, tol: float, metric: DistanceType,
                            lambda: local_fit)
 
 
+def _fit_program_fori(comms: Comms, max_iter: int, tol: float,
+                      metric: DistanceType, bs: int, bc: int):
+    """while_loop-free fit body: a STATIC-trip ``fori_loop`` over max_iter
+    with post-convergence updates masked out.
+
+    Rationale: the r5 CPU diagnosis (BENCH_TPU.md) exonerated the
+    shard_map(while_loop) program structure at full bench shapes, pinning
+    the live 100× MNMG slowdown on the TPU lowering or tunnel runtime —
+    and a data-dependent ``while`` cond is the one structural element a
+    TPU runtime cannot pipeline past (it must decide, on device, whether
+    to run another trip).  This variant gives the session's next window a
+    shippable A/B: identical semantics (same EM math, same tol stopping
+    point recorded in n_iter) at the cost of always executing max_iter
+    loop bodies, each a no-op ``where`` after convergence.
+    """
+
+    def local_fit(x_shard, c0):
+        from raft_tpu.distance.pairwise import accum_dtype
+
+        acc = accum_dtype(x_shard.dtype)
+
+        def body(_, state):
+            # lean carry (n_iter, c, live): inertia/delta are not carried —
+            # nothing reads them (live gates on step_delta; the final
+            # inertia is recomputed after the loop, as in the while path)
+            n_iter, c, live = state
+            new, _, _ = compute_new_centroids(
+                x_shard, c, comms, metric=metric, batch_samples=bs,
+                batch_centroids=bc)
+            step_delta = jnp.sum((new.astype(acc) - c.astype(acc)) ** 2)
+            c = jnp.where(live, new, c)
+            n_iter = n_iter + live.astype(n_iter.dtype)
+            live = live & (step_delta > tol * tol)
+            return n_iter, c, live
+
+        init = (jnp.asarray(0), c0, jnp.asarray(True))
+        n_iter, c, _ = jax.lax.fori_loop(0, max_iter, body, init)
+        nn = min_cluster_and_distance(x_shard, c, metric, bs, bc)
+        inertia = comms.allreduce(jnp.sum(nn.value), ReduceOp.SUM)
+        return c, inertia, n_iter
+
+    return _cached_program(comms, ("fit_fori", max_iter, tol, metric, bs, bc),
+                           lambda: local_fit)
+
+
 @traced("raft_tpu.cluster.kmeans_mnmg.fit")
 def fit(params: KMeansParams, comms: Comms, x, centroids=None,
         loop: str = "device", sync_every: int = 8) -> KMeansOutput:
@@ -157,6 +202,12 @@ def fit(params: KMeansParams, comms: Comms, x, centroids=None,
     loop:
       - ``"device"``: the whole EM loop is ONE compiled
         shard_map(while_loop) program — zero host round trips per fit.
+      - ``"fori"``: same single compiled program but with a STATIC-trip
+        fori_loop (post-convergence steps masked out) — the A/B candidate
+        for the live while_loop slowdown (BENCH_TPU.md r5 ¶): a
+        data-dependent while cond is the one structural element the r5
+        CPU diagnosis could not exonerate on the TPU runtime.  Costs
+        exactly max_iter loop bodies.
       - ``"host"``: the host drives one compiled E+M step per iteration —
         the reference's own MNMG shape (raft-dask/cuML drive per-iteration
         device kernels + NCCL allreduce from the host,
@@ -170,7 +221,8 @@ def fit(params: KMeansParams, comms: Comms, x, centroids=None,
     from jax.sharding import PartitionSpec as P
 
     comms = as_comms(comms)
-    expects(loop in ("device", "host"), f"unknown loop mode {loop!r}")
+    expects(loop in ("device", "fori", "host"),
+            f"unknown loop mode {loop!r}")
     expects(sync_every >= 1, f"sync_every must be >= 1, got {sync_every}")
     x = jnp.asarray(x)
     n, dim = x.shape
@@ -192,8 +244,9 @@ def fit(params: KMeansParams, comms: Comms, x, centroids=None,
     if loop == "host":
         return _fit_host_loop(params, comms, x_sharded, centroids, bs, bc,
                               sync_every)
-    local_fit = _fit_program(comms, params.max_iter, float(params.tol),
-                             params.metric, bs, bc)
+    builder = _fit_program_fori if loop == "fori" else _fit_program
+    local_fit = builder(comms, params.max_iter, float(params.tol),
+                        params.metric, bs, bc)
     c, inertia, n_iter = comms.run(
         local_fit, x_sharded, centroids,
         in_specs=(P(comms.axis_name, None), P(None, None)),

@@ -81,8 +81,11 @@ _FAST_TESTS = {
     "test_distance.py::test_vs_scipy",
     "test_handle_threading.py::test_handle_through_cluster_and_neighbors",
     "test_ivf_flat.py::test_ivf_flat_recall",
+    "test_ivf_flat.py::test_extend_lists_chunked_matches_full_repack",
     "test_ivf_pq.py::test_ivf_pq_recall_pq_bits",
     "test_kmeans_mnmg.py::test_distributed_matches_single_device",
+    "test_kmeans_mnmg.py::test_fori_loop_matches_device_loop",
+    "test_pallas_kernels.py::test_pallas_is_enabled_requires_experimental_flag",
     "test_label.py::test_make_monotonic",
     "test_label.py::test_select_k",
     "test_linalg.py::TestDecompositions::test_svd",

@@ -69,6 +69,26 @@ def test_host_loop_matches_device_loop(comms, blobs):
         <= int(out_dev.n_iter) + 7
 
 
+def test_fori_loop_matches_device_loop(comms, blobs):
+    """loop="fori" (static-trip fori_loop with masked post-convergence
+    updates — the r5 while_loop A/B candidate) is SEMANTICALLY IDENTICAL
+    to the while_loop path: same centroids, same inertia, same recorded
+    n_iter stopping point."""
+    x, _, centers = blobs
+    params = KMeansParams(n_clusters=4, init=InitMethod.Array, max_iter=50,
+                          tol=1e-4)
+    out_dev = kmeans_mnmg.fit(params, comms, x, centroids=centers)
+    out_fori = kmeans_mnmg.fit(params, comms, x, centroids=centers,
+                               loop="fori")
+    np.testing.assert_allclose(np.asarray(out_fori.centroids),
+                               np.asarray(out_dev.centroids), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(float(out_fori.inertia),
+                               float(out_dev.inertia), rtol=1e-5)
+    assert int(out_fori.n_iter) == int(out_dev.n_iter)
+    assert int(out_fori.n_iter) < 50  # converged before the static bound
+
+
 def test_host_loop_tol_zero_runs_max_iter(comms, blobs):
     """tol=0 → no convergence sync points: exactly max_iter iterations
     (the fully-pipelined mode the MNMG bench exercises)."""
