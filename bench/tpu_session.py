@@ -65,6 +65,18 @@ emit = make_emitter(OUT)
 # the re-promotion path, so they must stay able to compile them).
 os.environ["RAFT_TPU_PALLAS_EXPERIMENTAL"] = "1"
 
+# Persistent XLA executable cache for the INLINE stages (r5): XLA:TPU
+# compiles are host-cpu-bound (~minutes per program on this 1-vCPU host)
+# and windows are ~35-45 min — without the cache, every re-armed window
+# re-pays every inline compile from scratch; with it, a resumed session's
+# already-compiled programs load in seconds.  The subprocess stages
+# (bench.py, bench_aot) already enable it internally.  Routed through the
+# guarded wrapper: honors RAFT_TPU_NO_PERSISTENT_CACHE=1 and never
+# clobbers a user-configured jax_compilation_cache_dir.
+from raft_tpu.core.aot import _ensure_persistent_cache  # noqa: E402
+
+_ensure_persistent_cache()
+
 #: Tiny-shape rehearsal mode: the mandatory pre-window CPU dry-run of the
 #: whole session must finish in minutes on a 1-vCPU host (numbers are
 #: meaningless there — the rehearsal only proves every stage runs

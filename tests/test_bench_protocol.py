@@ -145,3 +145,93 @@ class TestSessionResume:
             assert s._completed_stages() == set()
         finally:
             s.OUT = old
+
+
+class TestEmitterErrorAccounting:
+    """r5: the session main loop snapshots emit.rows/emit.errors around
+    each inline stage and refuses to mark a stage done when every row it
+    emitted was an error row (the per-config handlers swallow failures)."""
+
+    def test_counters_track_rows_and_errors(self, tmp_path):
+        from bench.common import make_emitter
+
+        emit = make_emitter(str(tmp_path / "out.jsonl"))
+        assert emit.rows == 0 and emit.errors == 0
+        emit({"stage": "x", "value": 1})
+        emit({"stage": "x", "error": "boom"})
+        emit({"stage": "y", "error": "boom2"})
+        assert emit.rows == 3
+        assert emit.errors == 2
+
+    def test_all_errors_detection_window(self, tmp_path):
+        """The exact predicate the main loop applies: rows>0 and
+        errors==rows within the stage's snapshot window."""
+        from bench.common import make_emitter
+
+        emit = make_emitter(str(tmp_path / "out.jsonl"))
+        emit({"stage": "warmup", "value": 0})          # before the stage
+        r0, e0 = emit.rows, emit.errors
+        emit({"stage": "s", "error": "a"})
+        emit({"stage": "s", "error": "b"})
+        rows, errs = emit.rows - r0, emit.errors - e0
+        assert rows == 2 and errs == rows              # -> stage NOT done
+        r0, e0 = emit.rows, emit.errors
+        emit({"stage": "t", "error": "a"})
+        emit({"stage": "t", "ok": 1})
+        rows, errs = emit.rows - r0, emit.errors - e0
+        assert errs < rows                             # -> stage done
+
+
+class TestRecordedRowsStayFlagged:
+    """Repo-state regression for VERDICT r4 #4: every measurement-bearing
+    row in the committed session results that the dispatch-RTT analysis
+    invalidated must stay inline-flagged — a consumer reading rows without
+    the schema-history comment must never see a clean invalid number."""
+
+    def test_no_clean_rtt_bound_rows(self):
+        import os
+
+        from bench.common import jsonl_rows
+
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tpu_session_results.jsonl")
+        rows = list(jsonl_rows(path))
+        assert rows, "committed session results missing"
+        schema = 0
+        for row in rows:
+            if row.get("stage") == "session" and row.get("schema"):
+                schema = row["schema"]
+            # schema-2 era: any sub-10 ms per-dispatch measurement row is
+            # RTT-bound (see bench/tpu_session.py schema history)
+            if schema == 2 and row.get("stage") == "kmeans_sweep" \
+                    and "iter_s" in row:
+                assert row.get("suspect") is True, row
+            if schema == 2 and row.get("stage") == "pairwise" \
+                    and "value" in row:
+                assert row.get("suspect") is True, row
+
+    def test_wait_script_parses_done_row(self):
+        """The waiter's completion check must be key-order/extra-field
+        insensitive (r4 advisor finding: the old literal grep broke if any
+        field preceded "done")."""
+        import os
+        import subprocess
+        import sys
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = open(os.path.join(root, "bench",
+                                   "tpu_wait_and_measure.sh")).read()
+        # extract the embedded python parser between the quotes
+        start = script.index("python -c '") + len("python -c '")
+        end = script.index("'", start)
+        parser = script[start:end]
+        for line, ok in [
+            ('{"stage": "session", "note": "x", "done": true}\n', True),
+            ('{"done": true, "stage": "session"}\n', True),
+            ('{"stage": "session", "done": false}\n', False),
+            ('{"stage": "stage_done", "done": true}\n', False),
+            ('not json\n{"stage": "session", "done": true}\n', True),
+        ]:
+            rc = subprocess.run([sys.executable, "-c", parser],
+                                input=line, text=True).returncode
+            assert (rc == 0) == ok, (line, rc)

@@ -161,3 +161,89 @@ def test_stream_pool_batches_overlap_in_flight():
         f"substantial work outstanding after dispatch (sync {t_sync:.3f}s "
         f"vs dispatch {t_dispatch:.3f}s) but zero batches tracked in "
         "flight — the pool lost its work")
+
+
+def test_concurrent_threads_distinct_handles(data):
+    """Each thread owns a Handle (the reference's one-handle-per-thread
+    convention, DEVELOPER_GUIDE.md:11): concurrent dispatch of different
+    ops must produce exactly the single-threaded results."""
+    import threading
+
+    results = {}
+    errors = []
+
+    def worker(tid):
+        try:
+            h = Handle()
+            d = pairwise_distance(data, data[: 8 * (tid + 1)], "euclidean",
+                                  handle=h)
+            h.sync()
+            results[tid] = np.asarray(d)
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append((tid, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for tid, got in results.items():
+        ref = pairwise_distance(data, data[: 8 * (tid + 1)], "euclidean")
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-5,
+                                   atol=1e-5)
+
+
+def test_concurrent_threads_shared_handle_stream_pool(data):
+    """A single Handle with a stream pool used from several threads: the
+    per-stream in-flight records must not lose or corrupt work (the pool
+    holds strong refs; sync drains everything)."""
+    import threading
+
+    h = Handle(n_streams=4)
+    outs = [None] * 4
+
+    def worker(tid):
+        s = h.get_stream_from_stream_pool(tid)
+        d = pairwise_distance(data[: 16 * (tid + 1)], data, "cityblock")
+        s.record(d)                      # this lane owns the work
+        outs[tid] = d
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    h.sync_stream_pool()
+    for tid, d in enumerate(outs):
+        assert d is not None
+        ref = pairwise_distance(data[: 16 * (tid + 1)], data, "cityblock")
+        np.testing.assert_allclose(np.asarray(d), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_interruptible_registry_is_per_thread(data):
+    """The cancellation token registry keys on thread id (reference
+    interruptible.hpp's per-thread token store): tokens fetched on two
+    threads are distinct objects."""
+    import threading
+
+    from raft_tpu.core import interruptible
+
+    tokens = {}
+    # both workers must be ALIVE at get_token() time: thread ids are reused
+    # after a thread dies, which would hand worker 1 worker 0's cached token
+    gate = threading.Barrier(2, timeout=30)
+
+    def worker(tid):
+        gate.wait()
+        tokens[tid] = interruptible.get_token()
+        gate.wait()
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert tokens[0] is not tokens[1]
+    assert interruptible.get_token() not in (tokens[0], tokens[1])

@@ -188,3 +188,90 @@ def test_knn_mnmg_k_exceeds_shard_rejected(comms):
     x = rng.normal(0, 1, (n, 4)).astype(np.float32)
     with pytest.raises(RaftError, match="rows per shard"):
         knn_mnmg(comms, x, x[:4], 9)
+
+
+def test_fori_loop_bf16_matches_device_bf16(comms, blobs):
+    """loop="fori" keeps the half-precision contract: bf16 data, f32 delta
+    accumulation, identical stopping point vs the while path."""
+    x, _, centers = blobs
+    params = KMeansParams(n_clusters=4, init=InitMethod.Array, max_iter=40,
+                          tol=1e-3)
+    xb = jnp.asarray(x, jnp.bfloat16)
+    cb = jnp.asarray(centers, jnp.bfloat16)
+    out_dev = kmeans_mnmg.fit(params, comms, xb, centroids=cb)
+    out_fori = kmeans_mnmg.fit(params, comms, xb, centroids=cb, loop="fori")
+    assert out_fori.centroids.dtype == jnp.bfloat16
+    assert out_fori.inertia.dtype == jnp.float32
+    assert int(out_fori.n_iter) == int(out_dev.n_iter)
+    np.testing.assert_allclose(
+        np.asarray(out_fori.centroids, np.float32),
+        np.asarray(out_dev.centroids, np.float32), rtol=1e-2, atol=1e-2)
+
+
+def test_fori_tol_zero_matches_device_semantics(comms, blobs):
+    """tol=0 means `delta > 0`: both single-program loops stop at an exact
+    fixed point (unlike loop="host", which never syncs at tol=0 and runs
+    max_iter — test_host_loop_tol_zero_runs_max_iter)."""
+    x, _, centers = blobs
+    params = KMeansParams(n_clusters=4, init=InitMethod.Array, max_iter=7,
+                          tol=0.0)
+    out_dev = kmeans_mnmg.fit(params, comms, x, centroids=centers)
+    out = kmeans_mnmg.fit(params, comms, x, centroids=centers, loop="fori")
+    assert int(out.n_iter) == int(out_dev.n_iter) <= 7
+
+
+def test_predict_matches_fit_labels_across_loops(comms, blobs):
+    """predict() on the fitted centroids yields identical labels whichever
+    loop produced them, and inertia equals the fit's trailing E-step."""
+    x, _, centers = blobs
+    params = KMeansParams(n_clusters=4, init=InitMethod.Array, max_iter=30)
+    outs = {m: kmeans_mnmg.fit(params, comms, x, centroids=centers, loop=m)
+            for m in ("device", "fori", "host")}
+    ref_labels = None
+    for mode, out in outs.items():
+        labels, inertia = kmeans_mnmg.predict(params, comms, x,
+                                              out.centroids)
+        assert labels.shape == (x.shape[0],)
+        np.testing.assert_allclose(float(inertia), float(out.inertia),
+                                   rtol=1e-4)
+        if ref_labels is None:
+            ref_labels = np.asarray(labels)
+        else:
+            # same blobs, same init: all three loops converge to the same
+            # partition
+            from raft_tpu.stats import adjusted_rand_index as ari
+            assert float(ari(jnp.asarray(ref_labels), labels)) == 1.0
+
+
+def test_compute_new_centroids_weighted(comms, blobs):
+    """sample_weights reweight the M-step mean (pylibraft
+    compute_new_centroids signature parity): doubling a shard-constant
+    weight must leave centroids unchanged, and weighting one cluster's
+    rows pulls its centroid toward the weighted mean."""
+    from jax.sharding import PartitionSpec as P
+
+    x, _, centers = blobs
+    n = x.shape[0]
+    xs = comms.globalize(jnp.asarray(x), P(comms.axis_name, None))
+    c0 = jnp.asarray(centers)
+
+    def step(xx, cc, w_mode):
+        if w_mode == "uniform2":
+            w = 2.0 * jnp.ones(xx.shape[0], xx.dtype)
+        else:
+            w = jnp.ones(xx.shape[0], xx.dtype)
+        new, wsum, _ = kmeans_mnmg.compute_new_centroids(
+            xx, cc, comms, sample_weights=w)
+        return new, wsum
+
+    unw = comms.run(lambda xx, cc: step(xx, cc, "ones"), xs, c0,
+                    in_specs=(P(comms.axis_name, None), P(None, None)),
+                    out_specs=(P(None, None), P()))
+    dbl = comms.run(lambda xx, cc: step(xx, cc, "uniform2"), xs, c0,
+                    in_specs=(P(comms.axis_name, None), P(None, None)),
+                    out_specs=(P(None, None), P()))
+    np.testing.assert_allclose(np.asarray(unw[0]), np.asarray(dbl[0]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(dbl[1]),
+                               2.0 * np.asarray(unw[1]), rtol=1e-6)
+    assert float(jnp.sum(unw[1])) == pytest.approx(n)
