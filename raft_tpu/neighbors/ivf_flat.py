@@ -182,6 +182,10 @@ def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
                   adaptive_centers=params.adaptive_centers)
     if params.add_data_on_build:
         index = extend(index, x, ids)
+    else:
+        expects(ids is None,
+                "ids were passed but add_data_on_build=False stores no "
+                "rows — pass them to extend() instead")
     return index
 
 

@@ -93,6 +93,10 @@ class IndexParams:
     pq_dim: int = 0          # 0 → heuristic (ivf_pq_build calc_pq_dim)
     codebook_kind: CodebookKind = CodebookKind.PER_SUBSPACE
     force_random_rotation: bool = False
+    # Train the model on *dataset* but store no rows (reference
+    # ``ann::index_params::add_data_on_build``, ann_common.h — rows are
+    # then added by extend()); ivf_flat.IndexParams has the same knob.
+    add_data_on_build: bool = True
     # "auto" (the default): "pca_balanced" whenever pq_dim | dim, else
     # "default".  "default" = identity, or random when forced /
     # rot_dim != dim.  "pca_balanced" = parametric OPQ-style rotation —
@@ -458,14 +462,25 @@ def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
         codebooks = _train_codebooks_subspace(k_cb, resid, pq_dim, k,
                                               params.kmeans_n_iters)
 
-    # 5) encode + bit-pack + scatter into lists
-    codes = _encode(resid, codebooks, labels,
-                    params.codebook_kind == CodebookKind.PER_CLUSTER)
-    packed = _pack_codes(codes, params.pq_bits)
-    if ids is None:
-        ids = jnp.arange(n, dtype=jnp.int32)
+    # 5) encode + bit-pack + scatter into lists (skipped entirely with
+    # add_data_on_build=False: the trained model is kept, rows come later
+    # via extend — reference ann::index_params::add_data_on_build)
+    if params.add_data_on_build:
+        codes = _encode(resid, codebooks, labels,
+                        params.codebook_kind == CodebookKind.PER_CLUSTER)
+        packed = _pack_codes(codes, params.pq_bits)
+        if ids is None:
+            ids = jnp.arange(n, dtype=jnp.int32)
+        else:
+            ids = jnp.asarray(ids, jnp.int32)
     else:
-        ids = jnp.asarray(ids, jnp.int32)
+        expects(ids is None,
+                "ids were passed but add_data_on_build=False stores no "
+                "rows — pass them to extend() instead")
+        packed = jnp.zeros((0, _code_bytes(pq_dim, params.pq_bits)),
+                           jnp.uint8)
+        ids = jnp.zeros((0,), jnp.int32)
+        labels = jnp.zeros((0,), jnp.int32)
     (list_codes, list_indices, phys_sizes, list_sizes, chunk_table,
      owner, _) = pack_lists_chunked(packed, ids, labels, n_lists)
     return Index(centers=centers, rotation=rotation, codebooks=codebooks,

@@ -471,3 +471,49 @@ def test_ivf_pq_repeated_extend_exact_codes():
     _, i2 = search(SearchParams(n_probes=40), idx, x[2400:2432], 1)
     hit = np.mean(np.asarray(i2)[:, 0] == np.arange(2400, 2432))
     assert hit >= 0.9
+
+
+def test_ivf_pq_serialize_roundtrip_after_extend(tmp_path):
+    """save → load → search equality must hold for an INCREMENTALLY
+    extended index (r5: extend leaves non-contiguous per-list chunk
+    layouts that serialization must capture exactly)."""
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.neighbors.serialize import load_ivf_pq, save_ivf_pq
+
+    x, q = make_data(n=2500)
+    idx = build(IndexParams(n_lists=30, pq_bits=8, pq_dim=16, seed=9),
+                x[:2000])
+    idx = ivf_pq.extend(idx, x[2000:])
+    p = str(tmp_path / "pq_ext.idx")
+    save_ivf_pq(p, idx)
+    idx2 = load_ivf_pq(p)
+    d1, i1 = search(SearchParams(n_probes=15), idx, q, 10)
+    d2, i2 = search(SearchParams(n_probes=15), idx2, q, 10)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-6)
+
+
+def test_ivf_pq_full_probe_order_invariance():
+    """With ONE trained model (add_data_on_build=False — reference
+    ann::index_params knob, r5 parity addition), full-probe search results
+    must be identical whether the rows arrived in one extend or three:
+    chunk layout is an implementation detail the scores may not leak."""
+    from raft_tpu.neighbors import ivf_pq
+
+    x, q = make_data(n=2000)
+    params = IndexParams(n_lists=20, pq_bits=8, pq_dim=16, seed=4,
+                         add_data_on_build=False)
+    trained = build(params, x)
+    assert trained.size == 0
+    one = ivf_pq.extend(trained, x)
+    three = ivf_pq.extend(trained, x[:800])
+    three = ivf_pq.extend(three, x[800:1500],
+                          np.arange(800, 1500, dtype=np.int32))
+    three = ivf_pq.extend(three, x[1500:],
+                          np.arange(1500, 2000, dtype=np.int32))
+    sp = SearchParams(n_probes=20)
+    d1, i1 = search(sp, one, q, 10)
+    d3, i3 = search(sp, three, q, 10)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i3))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d3), rtol=1e-5,
+                               atol=1e-5)
