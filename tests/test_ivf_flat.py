@@ -328,3 +328,54 @@ def test_ivf_flat_extend_adaptive_centers():
         np.argmin(((new[:, None, :] - c0[None]) ** 2).sum(-1), axis=1)) == l
     expect = (c0[l] * sizes0[l] + new[mask].sum(0)) / (sizes0[l] + mask.sum())
     np.testing.assert_allclose(c1[l], expect, rtol=1e-4, atol=1e-5)
+
+
+def test_ivf_flat_int8_extend_incremental():
+    """int8 storage + r5 incremental extend: appended rows keep the int8
+    dtype in the lists and exact full-probe search."""
+    rng = np.random.default_rng(21)
+    x = rng.integers(-100, 100, (1200, 16)).astype(np.int8)
+    idx = build(IndexParams(n_lists=16, seed=2), x[:900])
+    assert idx.list_data.dtype == np.int8
+    idx = extend(idx, x[900:])
+    assert idx.list_data.dtype == np.int8 and idx.size == 1200
+    # query BOTH the build rows and the appended rows (the incremental
+    # append path is the thing under test)
+    for lo in (40, 950):
+        q = x[lo:lo + 20]
+        d, i = search(SearchParams(n_probes=16), idx, q, 1)
+        hit = np.mean(np.asarray(i)[:, 0] == np.arange(lo, lo + 20))
+        assert hit >= 0.9, lo  # integer data can have exact duplicates
+
+
+def test_ivf_flat_cosine_extend_assigns_by_direction():
+    """CosineExpanded + extend: assignment normalizes the new rows, so a
+    scaled copy of an indexed vector lands in the same list and is its own
+    nearest neighbour by cosine distance."""
+    rng = np.random.default_rng(22)
+    x = rng.normal(0, 1, (800, 12)).astype(np.float32)
+    idx = build(IndexParams(n_lists=8, seed=4,
+                            metric=DistanceType.CosineExpanded), x)
+    scaled = 7.5 * x[:30]  # same directions, different norms
+    idx2 = extend(idx, scaled, new_ids=np.arange(800, 830, dtype=np.int32))
+    # direct membership check: the scaled copy must land in the SAME list
+    # as its original (extend normalizes before assignment) — asserted on
+    # the stored ids, not through a search that probes every list
+    ids = np.asarray(idx2.list_indices)
+    # map physical row -> logical list via the chunk table
+    table = np.asarray(idx2.chunk_table)
+    phys_to_list = {}
+    for l in range(table.shape[0]):
+        for p in table[l]:
+            phys_to_list[int(p)] = l
+    id_to_list = {}
+    for phys in range(ids.shape[0]):
+        for v in ids[phys]:
+            if v >= 0:
+                id_to_list[int(v)] = phys_to_list.get(phys)
+    for qi in range(30):
+        assert id_to_list[800 + qi] == id_to_list[qi], qi
+    # and with FEWER probes than lists, the scaled copy is still found
+    d, i = search(SearchParams(n_probes=2), idx2, x[:30], 2)
+    for row, qi in zip(np.asarray(i), range(30)):
+        assert set(row.tolist()) == {qi, 800 + qi}, (qi, row)
