@@ -322,7 +322,13 @@ def kmeans_fit_stage():
     params = KMeansParams(n_clusters=k, init=InitMethod.Array,
                           max_iter=20, tol=0.0)
     timed_whole_fit(lambda c: kmeans_fit(params, x, centroids=c), c0,
-                    "kmeans_fit")
+                    "kmeans_fit", case="while")
+    # the r5 fix candidate: same fit, static-trip fori program —
+    # while-vs-fori ON CONFIG[1] decides whether the while lowering is
+    # what separates 437 it/s (eager chain) from the fit program
+    timed_whole_fit(lambda c: kmeans_fit(params, x, centroids=c,
+                                         loop="fori"), c0,
+                    "kmeans_fit", case="fori")
 
 
 #: Set by pallas_probe_stage: None = not probed, True = compiled and ran,

@@ -399,6 +399,48 @@ def _fit_main(x, centroids0, weights, metric: DistanceType, max_iter: int,
     return centroids, cluster_cost(nn, weights), n_iter
 
 
+@functools.partial(jax.jit, static_argnames=("metric", "max_iter", "tol",
+                                             "batch_samples",
+                                             "batch_centroids"))
+def _fit_main_fori(x, centroids0, weights, metric: DistanceType,
+                   max_iter: int, tol: float, batch_samples: int,
+                   batch_centroids: int):
+    """while_loop-free `_fit_main`: a STATIC-trip fori_loop over max_iter
+    with post-convergence updates masked out — identical semantics (same
+    EM math, same recorded n_iter stopping point) at the cost of always
+    executing max_iter loop bodies.
+
+    Exists for the same reason as ``kmeans_mnmg._fit_program_fori``: the
+    r5 CPU diagnosis exonerated the compiled program structure for the
+    live while_loop slowdown (BENCH_TPU.md), leaving the data-dependent
+    ``while`` cond as the one structural suspect a TPU runtime cannot
+    pipeline past; the measurement session A/Bs both forms on-chip
+    (kmeans_fit stage) so config[1]'s fix candidate ships with its
+    measurement.  Select via ``fit(..., loop="fori")``.
+    """
+    from raft_tpu.distance.pairwise import accum_dtype
+
+    k = centroids0.shape[0]
+    acc = accum_dtype(x.dtype)
+
+    def body(_, state):
+        n_iter, centroids, live = state
+        nn = min_cluster_and_distance(x, centroids, metric, batch_samples,
+                                      batch_centroids)
+        new, _ = update_centroids(x, nn.key, k, weights, centroids)
+        delta = jnp.sum((new.astype(acc) - centroids.astype(acc)) ** 2)
+        centroids = jnp.where(live, new, centroids)
+        n_iter = n_iter + live.astype(n_iter.dtype)
+        live = live & (delta > tol * tol)
+        return n_iter, centroids, live
+
+    init = (jnp.asarray(0), centroids0, jnp.asarray(True))
+    n_iter, centroids, _ = jax.lax.fori_loop(0, max_iter, body, init)
+    nn = min_cluster_and_distance(x, centroids, metric, batch_samples,
+                                  batch_centroids)
+    return centroids, cluster_cost(nn, weights), n_iter
+
+
 def _resolve_batches(params: KMeansParams):
     bc = params.batch_centroids if params.batch_centroids > 0 else max(
         1024, params.n_clusters)
@@ -408,12 +450,16 @@ def _resolve_batches(params: KMeansParams):
 @traced("raft_tpu.cluster.kmeans.fit")
 @auto_sync_handle
 def fit(params: KMeansParams, x, sample_weights=None, centroids=None,
-        handle=None) -> KMeansOutput:
+        handle=None, loop: str = "while") -> KMeansOutput:
     """Full k-means fit (reference cluster/kmeans.cuh:85 ``fit``):
     init (++/random/user array) → EM to convergence; best of n_init runs.
 
     *handle*: optional :class:`raft_tpu.core.Handle` (reference calling
-    convention, handle_t first arg); outputs are recorded on its stream."""
+    convention, handle_t first arg); outputs are recorded on its stream.
+    *loop*: ``"while"`` (default — EM in a ``lax.while_loop``) or
+    ``"fori"`` (static-trip masked-update variant, see
+    :func:`_fit_main_fori`)."""
+    expects(loop in ("while", "fori"), f"unknown loop mode {loop!r}")
     x = jnp.asarray(x)
     expects(x.ndim == 2, "x must be [n_samples, n_features]")
     expects(params.n_clusters <= x.shape[0], "n_clusters must be <= n_samples")
@@ -438,8 +484,9 @@ def fit(params: KMeansParams, x, sample_weights=None, centroids=None,
             c0 = init_plus_plus(rng, x, params.n_clusters,
                                 params.oversampling_factor,
                                 metric=params.metric)
-        c, inertia, n_iter = _fit_main(x, c0, weights, params.metric,
-                                       params.max_iter, params.tol, bs, bc)
+        fit_prog = _fit_main_fori if loop == "fori" else _fit_main
+        c, inertia, n_iter = fit_prog(x, c0, weights, params.metric,
+                                      params.max_iter, params.tol, bs, bc)
         if best is None or float(inertia) < float(best.inertia):
             best = KMeansOutput(c, inertia, n_iter)
     return best

@@ -391,6 +391,26 @@ def test_kmeans_bf16_tol_convergence_uses_f32_delta():
     assert int(out_bf.n_iter) <= int(out_f32.n_iter) + 3
 
 
+def test_kmeans_fit_fori_matches_while():
+    """fit(loop="fori") (r5: static-trip masked-update program — the
+    config[1] while_loop A/B candidate) is semantically identical to the
+    default while_loop fit: same centroids, inertia, and n_iter."""
+    rng = np.random.default_rng(12)
+    centers = 8.0 * rng.random((6, 24))
+    x = (centers[rng.integers(0, 6, 600)]
+         + 0.05 * rng.random((600, 24))).astype(np.float32)
+    params = KMeansParams(n_clusters=6, init=InitMethod.Array, max_iter=40,
+                          tol=1e-4)
+    w = cluster.fit(params, x, centroids=centers.astype(np.float32))
+    f = cluster.fit(params, x, centroids=centers.astype(np.float32),
+                    loop="fori")
+    assert int(f.n_iter) == int(w.n_iter) < 40
+    np.testing.assert_allclose(np.asarray(f.centroids),
+                               np.asarray(w.centroids), rtol=1e-6)
+    np.testing.assert_allclose(float(f.inertia), float(w.inertia),
+                               rtol=1e-6)
+
+
 def test_build_hierarchical_bf16_matches_f32_structure():
     """Balanced hierarchical build on bf16 data: fine-stage E/M accumulate
     in f32 (accum_dtype policy), so cluster sizes stay balanced and

@@ -372,7 +372,9 @@ def test_ivf_flat_cosine_extend_assigns_by_direction():
     for phys in range(ids.shape[0]):
         for v in ids[phys]:
             if v >= 0:
-                id_to_list[int(v)] = phys_to_list.get(phys)
+                # KeyError here = ids written to a physical row the chunk
+                # table does not own — fail loudly, not vacuously
+                id_to_list[int(v)] = phys_to_list[phys]
     for qi in range(30):
         assert id_to_list[800 + qi] == id_to_list[qi], qi
     # and with FEWER probes than lists, the scaled copy is still found
