@@ -286,3 +286,58 @@ def test_knn_bf16_inputs_f32_distances():
     assert d.dtype == jnp.float32
     ref = np.argsort(cdist(q64, x64), axis=1)[:, :5]
     assert (np.asarray(i) == ref).mean() > 0.9  # bf16 rounding flips ties
+
+
+def test_ivf_k_exceeds_candidates_pads_with_sentinels():
+    """k larger than the live candidate count returns (-1, +inf) padding
+    after all real neighbours — the reference's empty-slot convention —
+    for both IVF indexes and for under-probed searches."""
+    from raft_tpu.neighbors import ivf_flat, ivf_pq
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (20, 8)).astype(np.float32)
+    q = rng.normal(0, 1, (3, 8)).astype(np.float32)
+
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=4), x)
+    d, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=4), idx, q, 30)
+    i, d = np.asarray(i), np.asarray(d)
+    assert i.shape == (3, 30)
+    for row_i, row_d in zip(i, d):
+        n_valid = (row_i >= 0).sum()
+        assert n_valid == 20                      # every real row found
+        assert (row_i[n_valid:] == -1).all()
+        assert np.isinf(row_d[n_valid:]).all()
+        assert (np.diff(row_d[:n_valid]) >= -1e-6).all()  # sorted prefix
+
+    # under-probing: real results first, sentinels after
+    d, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=1), idx, q, 10)
+    i, d = np.asarray(i), np.asarray(d)
+    for row_i, row_d in zip(i, d):
+        n_valid = (row_i >= 0).sum()
+        assert 0 < n_valid <= 10
+        assert (row_i[n_valid:] == -1).all() and np.isinf(row_d[n_valid:]).all()
+
+    pqi = ivf_pq.build(ivf_pq.IndexParams(n_lists=4, pq_dim=4, pq_bits=8), x)
+    d, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=4), pqi, q, 30)
+    i, d = np.asarray(i), np.asarray(d)
+    for row_i, row_d in zip(i, d):                # full convention, as flat
+        n_valid = (row_i >= 0).sum()
+        assert n_valid == 20
+        assert sorted(row_i[:n_valid].tolist()) == list(range(20))
+        assert (row_i[n_valid:] == -1).all()
+        assert np.isinf(row_d[n_valid:]).all()
+        assert (np.diff(row_d[:n_valid]) >= -1e-6).all()
+
+
+def test_ivf_duplicate_rows_all_retrievable():
+    """An index of identical rows returns each id exactly once per query
+    (ties must not drop or duplicate candidates)."""
+    from raft_tpu.neighbors import ivf_flat
+
+    x = np.zeros((10, 8), np.float32)
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=8), x)
+    q = 100.0 + np.zeros((2, 8), np.float32)
+    d, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=8), idx, q, 10)
+    i = np.asarray(i)
+    for row in i:
+        assert sorted(row.tolist()) == list(range(10))
