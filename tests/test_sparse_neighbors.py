@@ -223,3 +223,28 @@ def test_single_linkage_knn_graph_mode():
     assert len(np.unique(labels[:20])) == 1
     assert len(np.unique(labels[20:])) == 1
     assert labels[0] != labels[20]
+
+
+def test_sparse_distance_empty_rows_conventions():
+    """Rows with no stored entries (explicitly zero rows) follow the
+    dense-engine conventions: L2/Jaccard self-distance 0, cosine distance
+    of a zero vector defined as 1 (no NaNs anywhere)."""
+    import scipy.sparse as sp
+
+    from raft_tpu.distance import DistanceType
+    from raft_tpu.sparse import CSR
+    from raft_tpu.sparse.distance import pairwise_distance as spd
+
+    g = sp.random(6, 10, density=0.3, format="csr", dtype=np.float32,
+                  random_state=0)
+    gl = g.tolil()
+    gl[2] = 0
+    ge = gl.tocsr()
+    ge.eliminate_zeros()
+    a = CSR(ge.indptr, ge.indices, ge.data, ge.shape)
+    for metric, self_d in ((DistanceType.L2Expanded, 0.0),
+                           (DistanceType.JaccardExpanded, 0.0),
+                           (DistanceType.CosineExpanded, 1.0)):
+        d = np.asarray(spd(a, a, metric))
+        assert not np.isnan(d).any(), metric
+        assert d[2, 2] == pytest.approx(self_d, abs=1e-6), metric
