@@ -19,10 +19,26 @@ import jax.numpy as jnp
 
 
 def merge_labels(labels_a, labels_b, mask):
+    """Labels are NODE IDS: every labels_a value, and every labels_b value
+    at a masked position, must lie in [0, n) (the reference kernel indexes
+    its propagation array by label value, merge_labels.cuh — the same
+    precondition).  Violations raise on concrete inputs; under tracing the
+    check is skipped (data-dependent), so jit callers own the contract."""
     labels_a = jnp.asarray(labels_a).astype(jnp.int32)
     labels_b = jnp.asarray(labels_b).astype(jnp.int32)
     mask = jnp.asarray(mask).astype(bool)
     n = labels_a.shape[0]
+    from raft_tpu.core.aot import is_tracer
+    from raft_tpu.core.error import expects
+
+    if n and not is_tracer(labels_a, labels_b, mask):
+        # silent clipping here would MERGE unrelated out-of-range classes
+        # into one bucket (r5 finding) — fail loudly instead
+        expects(bool((labels_a >= 0).all() & (labels_a < n).all()),
+                f"merge_labels: labels_a values must be node ids in [0, {n})")
+        expects(not bool(jnp.any(mask & ((labels_b < 0) | (labels_b >= n)))),
+                f"merge_labels: masked labels_b values must be node ids in "
+                f"[0, {n})")
     big = jnp.asarray(n, jnp.int32)  # sentinel larger than any valid label
     lb_safe = jnp.clip(labels_b, 0, n - 1)
 

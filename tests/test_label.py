@@ -104,12 +104,13 @@ def test_merge_labels_mask_all_false_is_identity():
 def test_merge_labels_full_chain_collapses():
     """All-true mask + labels_b chaining every adjacent labels_a class →
     one component labeled with the global minimum."""
-    # a classes: 0,1,2,3; b connects (0,1),(1,2),(2,3)
+    # a classes: 0,1,2,3; b connects (0,1),(1,2),(2,3) — b values are node
+    # ids in [0, n), per the r5-enforced precondition
     labels_a = np.array([0, 0, 1, 1, 2, 2, 3, 3], np.int32)
-    labels_b = np.array([9, 4, 4, 5, 5, 6, 6, 9], np.int32)
+    labels_b = np.array([7, 4, 4, 5, 5, 6, 6, 7], np.int32)
     out = np.asarray(label.merge_labels(labels_a, labels_b,
                                         np.ones(8, bool)))
-    # b=9 ALSO connects nodes 0 and 7 — still one component, min=0
+    # b=7 ALSO connects nodes 0 and 7 — still one component, min=0
     np.testing.assert_array_equal(out, np.zeros(8, np.int32))
 
 
@@ -167,3 +168,101 @@ def test_select_k_payload():
     payload = np.array([[30, 10, 20]])
     vals, idx = select_k(x, 2, indices=payload)
     np.testing.assert_array_equal(np.asarray(idx), [[10, 20]])
+
+
+# ---- r5 depth: sklearn/behavioral oracles for the classlabels family ----
+
+
+def test_ovr_matches_sklearn_label_binarizer():
+    """One-vs-rest columns match sklearn's LabelBinarizer for every class
+    (reference label.cu's getOvrLabels cases, generalized)."""
+    from sklearn.preprocessing import LabelBinarizer
+
+    rng = np.random.default_rng(0)
+    labels = rng.choice([2, 7, 11, 30], 200).astype(np.int32)
+    lb = LabelBinarizer()
+    ref = lb.fit_transform(labels)          # (n, n_classes), column order =
+    for col, cls in enumerate(lb.classes_):  # sorted classes
+        got = np.asarray(label.get_ovr_labels(labels, int(cls)))
+        np.testing.assert_array_equal(got, ref[:, col])
+
+
+def test_make_monotonic_matches_sklearn_label_encoder():
+    from sklearn.preprocessing import LabelEncoder
+
+    rng = np.random.default_rng(1)
+    labels = rng.choice([-5, 0, 3, 1000, 2**20], 300).astype(np.int32)
+    got = np.asarray(label.make_monotonic(labels))
+    ref = LabelEncoder().fit_transform(labels)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_make_monotonic_one_based():
+    labels = np.array([9, 3, 9, 7], np.int32)
+    got = np.asarray(label.make_monotonic(labels, zero_based=False))
+    np.testing.assert_array_equal(got, [3, 1, 3, 2])
+
+
+def test_make_monotonic_single_class_and_singleton():
+    np.testing.assert_array_equal(
+        np.asarray(label.make_monotonic(np.full(7, 42, np.int32))),
+        np.zeros(7))
+    np.testing.assert_array_equal(
+        np.asarray(label.make_monotonic(np.array([-3], np.int32))), [0])
+
+
+def test_merge_labels_chain_vs_star_topology():
+    """Two adversarial propagation shapes at the same component count: a
+    chain a0-a1-...  linked pairwise through labels_b (forces the longest
+    propagation distance) and a star (everything linked through one hub).
+    Both must collapse to the minimum label of the whole component.
+    Labels are node ids in [0, n) — the documented precondition."""
+    n = 64
+    # chain: a-labels pair consecutive nodes (i//2 pairs), b-labels pair
+    # with offset 1 — union of both = one long path
+    la = (np.arange(n) // 2).astype(np.int32) * 2 + 1   # in-range, sparse
+    lb_ = ((np.arange(n) + 1) // 2).astype(np.int32)
+    mask = np.ones(n, bool)
+    got = np.asarray(label.merge_labels(la, lb_, mask))
+    np.testing.assert_array_equal(got, np.full(n, 1))
+    # star: all b-labels equal → one component through the hub
+    la2 = np.arange(n).astype(np.int32)
+    got2 = np.asarray(label.merge_labels(la2, np.zeros(n, np.int32), mask))
+    np.testing.assert_array_equal(got2, np.zeros(n))
+    # cross-check both shapes against the union-find oracle
+    np.testing.assert_array_equal(got, _merge_labels_oracle(la, lb_, mask))
+    np.testing.assert_array_equal(
+        got2, _merge_labels_oracle(la2, np.zeros(n, np.int32), mask))
+
+
+def test_merge_labels_respects_mask_boundaries():
+    """Unmasked nodes keep their own a-component even when their b-label
+    would bridge two components (the mask is the reference's core
+    semantics, merge_labels.cuh)."""
+    la = np.array([0, 0, 1, 1, 2, 2], np.int32)
+    lb_ = np.array([4, 4, 4, 5, 5, 5], np.int32)
+    mask = np.array([True, True, False, False, True, True])
+    got = np.asarray(label.merge_labels(la, lb_, mask))
+    # b connects {0,1} (class 4) and {4,5} (class 5); nodes 2,3 unmasked →
+    # component {0,1} stays 0, {2,3} stays 1, {4,5} stays 2
+    np.testing.assert_array_equal(got, [0, 0, 1, 1, 2, 2])
+    oracle = _merge_labels_oracle(la, lb_, mask)
+    np.testing.assert_array_equal(got, oracle)
+
+
+def test_merge_labels_rejects_out_of_range_node_ids():
+    """r5 finding: out-of-range labels used to be silently CLIPPED into a
+    shared bucket, merging unrelated classes.  Concrete inputs now raise."""
+    from raft_tpu.core import LogicError
+
+    la = np.array([0, 0, 1, 1, 2, 2], np.int32)
+    mask = np.ones(6, bool)
+    with pytest.raises(LogicError, match="labels_b"):
+        label.merge_labels(la, np.array([7, 7, 7, 8, 8, 8], np.int32), mask)
+    with pytest.raises(LogicError, match="labels_a"):
+        label.merge_labels(la * 3 + 5, la, mask)
+    # out-of-range b at UNMASKED positions is fine (never read)
+    lb_ = np.array([0, 0, 99, 99, 1, 1], np.int32)
+    m2 = np.array([True, True, False, False, True, True])
+    out = np.asarray(label.merge_labels(la, lb_, m2))
+    np.testing.assert_array_equal(out, _merge_labels_oracle(la, lb_, m2))
