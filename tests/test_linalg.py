@@ -366,3 +366,17 @@ class TestReviewRegressions:
         pos = np.ones((3, 4), np.float32) * 5
         out = linalg.reduce(pos, Apply.ALONG_COLUMNS, reduce_op=jnp.minimum)
         np.testing.assert_allclose(out, [5, 5, 5])
+
+
+def test_svd_jacobi_rank_deficient_tail_is_zero():
+    """Jacobi SVD on an exactly rank-2 matrix returns (near-)zero trailing
+    singular values — no spurious mass from the rotation sweeps."""
+    from raft_tpu.linalg.decompositions import svd_jacobi
+
+    rng = np.random.default_rng(1)
+    m = rng.normal(0, 1, (8, 8)).astype(np.float32)
+    rank2 = m[:, :2] @ rng.normal(0, 1, (2, 8)).astype(np.float32)
+    u, s, v = svd_jacobi(rank2)
+    s = np.asarray(s)
+    assert (s[:2] > 1e-3).all()
+    np.testing.assert_allclose(s[2:], 0.0, atol=1e-4)

@@ -281,3 +281,15 @@ def test_rmat_square_and_theta_normalization():
     assert src.max() < 64 and dst.max() < 64
     # same skew as the normalized theta
     assert (src < 32).mean() > 0.65 and (dst < 32).mean() > 0.65
+
+
+def test_degenerate_distribution_params():
+    """sigma=0 normal collapses to the mean; uniform with lo==hi is
+    constant — degenerate parameters must not NaN or crash."""
+    import raft_tpu.random.rng as rngmod
+    from raft_tpu.random import RngState
+
+    out = np.asarray(rngmod.normal(RngState(0), (16,), 2.5, 0.0))
+    np.testing.assert_allclose(out, 2.5, atol=1e-6)
+    out = np.asarray(rngmod.uniform(RngState(0), (16,), 3.0, 3.0))
+    np.testing.assert_allclose(out, 3.0, atol=1e-6)

@@ -355,3 +355,22 @@ class TestSklearnOracleGrids:
                                    atol=1e-6)
         np.testing.assert_allclose(medae, skm.median_absolute_error(y, yh),
                                    atol=1e-6)
+
+
+def test_silhouette_all_singletons_is_zero():
+    """Every point its own cluster: per-sample silhouette is DEFINED as 0
+    for singleton clusters (Rousseeuw's convention; sklearn raises here,
+    the reference's batched kernel returns the 0 convention)."""
+    from raft_tpu.stats import silhouette_score
+
+    x = np.random.default_rng(3).normal(0, 1, (30, 4)).astype(np.float32)
+    assert float(silhouette_score(x, np.arange(30, dtype=np.int32), 30)) == 0.0
+
+
+def test_trustworthiness_identity_embedding_is_one():
+    """Embedding == input preserves every neighbourhood: score exactly 1
+    (sklearn oracle agrees)."""
+    from raft_tpu.stats import trustworthiness_score
+
+    x = np.random.default_rng(3).normal(0, 1, (30, 4)).astype(np.float32)
+    assert float(trustworthiness_score(x, x, 5)) == pytest.approx(1.0)
