@@ -375,3 +375,26 @@ class TestHalfPrecisionInputs:
                                             jnp.asarray(c64, jnp.bfloat16)))
         want = np.argmin(scipy_dist.cdist(x64, c64, "sqeuclidean"), axis=1)
         assert (got == want).mean() > 0.97  # bf16 rounding may flip ties
+
+
+def test_gram_matrix_sklearn_oracles():
+    """All four kernels vs sklearn.metrics.pairwise on the same params."""
+    from sklearn.metrics.pairwise import (linear_kernel, polynomial_kernel,
+                                          rbf_kernel, sigmoid_kernel)
+
+    from raft_tpu.distance import KernelParams, KernelType, gram_matrix
+
+    rng = np.random.default_rng(6)
+    x = rng.normal(0, 1, (40, 9)).astype(np.float32)
+    y = rng.normal(0, 1, (25, 9)).astype(np.float32)
+    cases = [
+        (KernelParams(KernelType.LINEAR), linear_kernel(x, y)),
+        (KernelParams(KernelType.POLYNOMIAL, degree=3, gamma=0.5, coef0=1.0),
+         polynomial_kernel(x, y, degree=3, gamma=0.5, coef0=1.0)),
+        (KernelParams(KernelType.RBF, gamma=0.7), rbf_kernel(x, y, gamma=0.7)),
+        (KernelParams(KernelType.TANH, gamma=0.2, coef0=0.4),
+         sigmoid_kernel(x, y, gamma=0.2, coef0=0.4)),
+    ]
+    for params, ref in cases:
+        got = np.asarray(gram_matrix(x, y, params))
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)

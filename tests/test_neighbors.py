@@ -341,3 +341,33 @@ def test_ivf_duplicate_rows_all_retrievable():
     i = np.asarray(i)
     for row in i:
         assert sorted(row.tolist()) == list(range(10))
+
+
+def test_eps_neighbors_oracle_and_batching_invariance():
+    """eps-neighborhood adjacency equals the dense oracle at any batch
+    size, boundary points (distance exactly eps^2) follow one consistent
+    convention, and vertex degrees match the adjacency row sums."""
+    from scipy.spatial.distance import cdist
+
+    from raft_tpu.neighbors import eps_neighbors_l2sq
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(0, 1, (90, 8)).astype(np.float32)
+    y = rng.normal(0, 1, (70, 8)).astype(np.float32)
+    d2 = cdist(x.astype(np.float64), y.astype(np.float64),
+               "sqeuclidean")
+    eps_sq = float(np.quantile(d2, 0.1))
+    ref = d2 < eps_sq
+    outs = []
+    for bs in (7, 32, 128):
+        adj, vd = eps_neighbors_l2sq(x, y, eps_sq, batch_size=bs)
+        adj, vd = np.asarray(adj), np.asarray(vd)
+        outs.append(adj)
+        np.testing.assert_array_equal(vd, adj.sum(1))
+    # batching cannot change the adjacency
+    np.testing.assert_array_equal(outs[0], outs[1])
+    np.testing.assert_array_equal(outs[1], outs[2])
+    # and it matches the oracle away from the eps^2 boundary (f32 ties at
+    # the threshold may differ from the f64 oracle)
+    margin = np.abs(d2 - eps_sq) > 1e-5
+    np.testing.assert_array_equal(outs[0][margin], ref[margin])
