@@ -260,3 +260,19 @@ def test_traced_decorator_preserves_semantics():
     assert op(1, b=3) == 4
     assert op.__name__ == "op" and "survives" in op.__doc__
     assert calls == [(1, 3)]
+
+
+def test_util_product_of_cartesian_grid():
+    """util.itertools.product_of: named cartesian grid used by the prewarm
+    instantiation registry — order within each axis is preserved."""
+    from raft_tpu.util.itertools import product_of
+
+    grid = product_of(a=[1, 2], b=["x"], c=[True, False])
+    assert len(grid) == 4
+    assert {frozenset(d.items()) for d in grid} == {
+        frozenset({("a", 1), ("b", "x"), ("c", True)}.__iter__()),
+        frozenset({("a", 1), ("b", "x"), ("c", False)}),
+        frozenset({("a", 2), ("b", "x"), ("c", True)}),
+        frozenset({("a", 2), ("b", "x"), ("c", False)}),
+    }
+    assert product_of() in ([], [{}])  # degenerate grid is well-defined
