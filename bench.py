@@ -14,7 +14,8 @@ an engineering estimate of the reference on A100 for this config (epilogue-
 dominated: ~100 MB output at ~200 µs end-to-end).  vs_baseline is
 value / estimate, where ≥0.8 meets the north-star target.
 
-Select a metric with BENCH_METRIC=pairwise|kmeans|kmeans_mnmg|ivf_pq|lanczos.
+Select a metric with
+BENCH_METRIC=pairwise|kmeans|kmeans_mnmg|ivf_pq|lanczos|knn_bruteforce.
 
 Robust bring-up (the round-1 failure was an unguarded TPU backend init):
 the measurement runs in a *child* process under a watchdog.  The parent
@@ -35,6 +36,11 @@ import numpy as np
 # config[1] (100k×128 f32, k=1024): the E-step is a 100k×1024×128 fused GEMM
 # (~26 GFLOP @ ~15 TF/s effective) + M-step; ≈ 300 iter/s.
 A100_BASELINE_KMEANS_ITERS = 300.0
+
+# Engineering estimate for the reference's brute-force kNN (fused L2 +
+# warp-select) on A100 at the knn_bruteforce config — see
+# bench_knn_bruteforce's docstring for the arithmetic.
+A100_BASELINE_KNN_QPS = 1_000_000.0
 
 def bench_pairwise():
     # one protocol, shared with bench.tpu_session's inline stage — see
@@ -208,6 +214,39 @@ def bench_ivf_pq():
     }
 
 
+def bench_knn_bruteforce():
+    """Brute-force kNN queries/s on the fused tiled scan (100k×64 f32,
+    1024 queries, k=10, L2Sqrt) — the substrate under knn_mnmg,
+    ball_cover, IVF refinement and single-linkage, tracked from the
+    fused-scan PR forward.
+
+    Chained per-dispatch timing (bench.common.timed_chained): each timed
+    search consumes a scalar of the previous result so no two dispatches
+    are identical (the r2 elision hazard).  The A100 baseline is an
+    engineering estimate: the distance GEMM is 2·n·nq·dim ≈ 13 GFLOP per
+    dispatch at ~15 TF/s effective → ~0.9 ms → ~1.2M qps; call it 1M with
+    selection overhead.
+    """
+    import jax
+
+    from bench.common import timed_chained
+    from raft_tpu.neighbors import knn
+
+    n, dim, nq, k = 100_000, 64, 1024, 10
+    rng = np.random.default_rng(0)
+    x = jax.device_put(rng.random((n, dim), dtype=np.float32))
+    q = jax.device_put(rng.random((nq, dim), dtype=np.float32))
+    best = timed_chained(lambda qq: knn(x, qq, k), q,
+                         lambda qq, out: qq + 1e-12 * out[0][0, 0], iters=5)
+    qps = nq / best
+    return {
+        "metric": f"knn_bruteforce_{n // 1000}kx{dim}_q{nq}_k{k}_f32",
+        "value": round(qps, 1),
+        "unit": "qps",
+        "vs_baseline": round(qps / A100_BASELINE_KNN_QPS, 3),
+    }
+
+
 def bench_lanczos():
     """BASELINE config[3]: Lanczos smallest-eigenpairs on a sparse graph."""
     import scipy.sparse as sp
@@ -249,7 +288,7 @@ def bench_lanczos():
 
 _METRICS = {"pairwise": bench_pairwise, "kmeans": bench_kmeans,
             "kmeans_mnmg": bench_kmeans_mnmg, "ivf_pq": bench_ivf_pq,
-            "lanczos": bench_lanczos}
+            "lanczos": bench_lanczos, "knn_bruteforce": bench_knn_bruteforce}
 
 
 def _orphan_watchdog():

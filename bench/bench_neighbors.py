@@ -45,6 +45,24 @@ def bench_bf_knn():
         "flops": 2 * (_N // 4) * _NQ * _D}
 
 
+@case("neighbors/knn_merge_parts")
+def bench_knn_merge_parts():
+    """Sorted-run fold merge of sharded per-part top-k results (the
+    knn_mnmg hot path after the allgather) — O(n_parts·k²) comparisons
+    instead of re-sorting n_parts·k candidates."""
+    import jax
+
+    from raft_tpu.neighbors import knn_merge_parts
+
+    n_parts, k = 8, 32
+    rng = np.random.default_rng(0)
+    pd = jax.device_put(np.sort(
+        rng.random((n_parts, _NQ, k)), axis=2).astype(np.float32))
+    pi = jax.device_put(
+        rng.integers(0, 10**6, (n_parts, _NQ, k)).astype(np.int32))
+    return (lambda: knn_merge_parts(pd, pi, k)[1]), {"items": _NQ}
+
+
 @case("neighbors/ivf_flat_search")
 def bench_ivf_flat():
     from raft_tpu.neighbors import ivf_flat

@@ -19,7 +19,7 @@ from raft_tpu.core.aot import aot, aot_dispatchable
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.core.kvp import KeyValuePair, kvp_min
-from raft_tpu.distance.pairwise import _mxu_dot, _row_norms, accum_dtype
+from raft_tpu.distance.pairwise import _l2_expanded, _row_norms, accum_dtype
 
 _BN = 1024  # column block: y-block (bn × k) + distance block (bm × bn) stay in VMEM
 _BM = 2048  # row block: measured sweet spot on v5e (distance tile ≈ 8 MB)
@@ -60,9 +60,11 @@ def _fused_l2_nn_impl(x, y, x_norms, y_norms, sqrt: bool, block_n: int,
 
         def step(carry, blk):
             yb, ynb, base = blk
-            d = (xnb[:, None] + ynb[None, :]
-                 - 2.0 * _mxu_dot(xb, yb, precision))
-            d = jnp.maximum(d, 0.0)
+            # ONE L2 epilogue implementation with hoisted per-row stats
+            # (distance.pairwise._l2_expanded): the row/column norms are
+            # computed once outside the scan and threaded in as xs.
+            d = _l2_expanded(xb, yb, sqrt=False, precision=precision,
+                             xn=xnb, yn=ynb)
             d = jnp.where(jnp.isfinite(ynb)[None, :], d, jnp.inf)
             blk_arg = jnp.argmin(d, axis=1)
             blk_val = jnp.min(d, axis=1)

@@ -81,36 +81,53 @@ def _row_norms(x, squared: bool = True):
 
 # ---------------------------------------------------------------------------
 # MXU engine: metric = epilogue(x @ f(y).T, row/col statistics)
+#
+# Every epilogue accepts its per-row statistics precomputed (*xn*/*yn*,
+# correlation's (Σx, Σx²) pair): tiled pipelines — the brute-force kNN
+# scan, fused L2 NN, IVF coarse ranking — compute query stats once per
+# batch and index stats once per scan instead of once per scan STEP.
+# :func:`metric_stats` / :func:`distance_with_stats` are the generic
+# surface over this.
 # ---------------------------------------------------------------------------
 
-def _l2_expanded(x, y, sqrt: bool, precision=DEFAULT_PRECISION):
+def _l2_expanded(x, y, sqrt: bool, precision=DEFAULT_PRECISION,
+                 xn=None, yn=None):
     # reference distance/detail/euclidean.cuh (euclideanAlgo1):
     # dist = ||x||^2 + ||y||^2 - 2 x·y, rectified at 0.
-    xn = _row_norms(x)
-    yn = _row_norms(y)
+    if xn is None:
+        xn = _row_norms(x)
+    if yn is None:
+        yn = _row_norms(y)
     d = xn[:, None] + yn[None, :] - 2.0 * _mxu_dot(x, y, precision)
     d = jnp.maximum(d, 0.0)
     return jnp.sqrt(d) if sqrt else d
 
 
-def _cosine(x, y, precision=DEFAULT_PRECISION):
+def _cosine(x, y, precision=DEFAULT_PRECISION, xn=None, yn=None):
     # reference distance/detail/cosine.cuh: 1 - x·y / (||x|| ||y||)
-    xn = _row_norms(x, squared=False)
-    yn = _row_norms(y, squared=False)
+    # (xn/yn are UNSQUARED row norms)
+    if xn is None:
+        xn = _row_norms(x, squared=False)
+    if yn is None:
+        yn = _row_norms(y, squared=False)
     denom = jnp.maximum(xn[:, None] * yn[None, :], 1e-30)
     return 1.0 - _mxu_dot(x, y, precision) / denom
 
 
-def _correlation(x, y, precision=DEFAULT_PRECISION):
+def _corr_row_stats(x):
+    """(Σx, Σx²) per row — correlation's hoistable statistics, accumulated
+    in f32 for half inputs (the k·x2 − xs² cancellation amplifies drift)."""
+    xf = x.astype(jnp.float32) if x.dtype in _HALF_DTYPES else x
+    return jnp.sum(xf, axis=1), _row_norms(x)
+
+
+def _correlation(x, y, precision=DEFAULT_PRECISION, x_stats=None,
+                 y_stats=None):
     # reference distance/detail/correlation.cuh:124-128:
     # 1 - (k·Σxy − Σx·Σy) / sqrt((kΣx²−(Σx)²)(kΣy²−(Σy)²))
     k = x.shape[1]
-    # row stats in f32 for half inputs (the q = k·x2 − xs² cancellation
-    # amplifies accumulation drift; _row_norms covers x2/y2)
-    xf = x.astype(jnp.float32) if x.dtype in _HALF_DTYPES else x
-    yf = y.astype(jnp.float32) if y.dtype in _HALF_DTYPES else y
-    xs, ys = jnp.sum(xf, axis=1), jnp.sum(yf, axis=1)
-    x2, y2 = _row_norms(x), _row_norms(y)
+    xs, x2 = _corr_row_stats(x) if x_stats is None else x_stats
+    ys, y2 = _corr_row_stats(y) if y_stats is None else y_stats
     numer = k * _mxu_dot(x, y, precision) - xs[:, None] * ys[None, :]
     q = k * x2 - xs * xs
     r = k * y2 - ys * ys
@@ -338,6 +355,70 @@ def _dispatch(x, y, metric: DistanceType, metric_arg: float):
     raise LogicError(f"metric {metric.name} is not supported for dense inputs "
                      "(reference parity: JaccardExpanded/DiceExpanded are "
                      "sparse-only; Precomputed is a sentinel)")
+
+
+# ---------------------------------------------------------------------------
+# epilogue-level API: hoisted per-row statistics for tiled pipelines
+# ---------------------------------------------------------------------------
+
+#: metrics whose epilogue consumes hoistable per-row statistics
+STATS_METRICS = (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+                 DistanceType.CosineExpanded, DistanceType.CorrelationExpanded)
+
+
+def metric_stats(x, metric: DistanceType) -> jnp.ndarray:
+    """Per-row epilogue statistics of *x* for *metric* as an (n, s) array.
+
+    The column layout is the private contract with
+    :func:`distance_with_stats`: squared norms (s=1) for the L2 metrics,
+    unsquared norms (s=1) for cosine, (Σx, Σx²) (s=2) for correlation,
+    and s=0 for every other metric (nothing to hoist — the pipeline then
+    recomputes the metric from the raw rows each tile, which is what the
+    non-expanded metrics require anyway).  Half-precision inputs produce
+    f32 statistics (:func:`accum_dtype` policy).
+
+    Tiled consumers (the brute-force kNN scan, IVF coarse ranking) call
+    this once per query batch and once per index scan, then thread the
+    tile slices through their ``lax.scan`` as xs — the loop body never
+    recomputes them (the role of the reference fused kernel's preloaded
+    row-norm registers, distance/detail/fused_l2_nn.cuh:132).
+    """
+    metric = DistanceType(metric)
+    if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
+        return _row_norms(x)[:, None]
+    if metric == DistanceType.CosineExpanded:
+        return _row_norms(x, squared=False)[:, None]
+    if metric == DistanceType.CorrelationExpanded:
+        xs, x2 = _corr_row_stats(x)
+        return jnp.stack([xs, x2], axis=1)
+    return jnp.zeros((x.shape[0], 0), accum_dtype(x.dtype))
+
+
+def distance_with_stats(x, y, metric: DistanceType, metric_arg: float = 2.0,
+                        x_stats=None, y_stats=None):
+    """Trace-level :func:`distance` accepting :func:`metric_stats` outputs.
+
+    For the ``STATS_METRICS`` the epilogue consumes the precomputed
+    statistics instead of rederiving them from the rows; any other metric
+    (or ``None``/width-0 stats) falls through to the full computation.
+    No AOT/jit dispatch of its own — callers embed this inside their
+    compiled scan.
+    """
+    metric = DistanceType(metric)
+
+    def col(s, j):
+        return None if s is None or s.shape[1] == 0 else s[:, j]
+
+    if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
+        return _l2_expanded(x, y, sqrt=metric == DistanceType.L2SqrtExpanded,
+                            xn=col(x_stats, 0), yn=col(y_stats, 0))
+    if metric == DistanceType.CosineExpanded:
+        return _cosine(x, y, xn=col(x_stats, 0), yn=col(y_stats, 0))
+    if metric == DistanceType.CorrelationExpanded:
+        xs = None if col(x_stats, 0) is None else (x_stats[:, 0], x_stats[:, 1])
+        ys = None if col(y_stats, 0) is None else (y_stats[:, 0], y_stats[:, 1])
+        return _correlation(x, y, x_stats=xs, y_stats=ys)
+    return _dispatch(x, y, metric, float(metric_arg))
 
 
 # The eager public path dispatches via an AOT executable cache (reference

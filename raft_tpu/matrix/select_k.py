@@ -4,31 +4,193 @@ Counterpart of reference spatial/knn/detail/topk.cuh:65-80 (``select_topk``
 dispatcher) with its three engines — warp-sort bitonic
 (topk/warpsort_topk.cuh), radix top-k (topk/radix_topk.cuh), and FAISS
 block-select.  TPUs have no warps; ``jax.lax.top_k`` lowers to an efficient
-sort-based selection XLA schedules on the VPU, and the engine distinction
-collapses.  The dispatcher keeps the reference's signature (select_min,
-optional input indices payload).
+selection XLA schedules on the VPU, and the engine distinction collapses.
+The dispatcher keeps the reference's signature (select_min, optional input
+indices payload).
+
+Two structures beyond the plain dispatcher (the reference's warp-sort
+engine plays both roles in hardware):
+
+- **Block-extremum candidate filter** for wide rows: split the row into
+  ``_FILTER_BLOCK``-wide blocks, take each block's extremum (a cheap
+  reduction XLA fuses into the producer's epilogue), run top-k over the
+  n_blocks extrema to pick k candidate BLOCKS, gather those k·block
+  elements and top-k them.  Exact: a block holding any of the stable
+  top-k must rank in the top-k blocks by extremum (each better-ranked
+  block contributes an element that precedes it in stable order), and
+  stability survives because selected blocks are re-sorted into index
+  order before the final selection.  The full row never flows through
+  the top-k heap — only n/block extrema plus k·block candidates.
+- :func:`merge_sorted_runs` — merge two already-sorted top-k runs into
+  the best k of their union in O(k²) vectorized comparisons (no re-sort),
+  the running-merge primitive under the brute-force kNN scan, the IVF
+  probe scans, and ``knn_merge_parts``.
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.aot import aot, aot_dispatchable, is_tracer
 
+#: candidate-filter block width (32 lanes: the reduce fuses into the
+#: producer epilogue and the gathered candidate set stays k·32 wide)
+_FILTER_BLOCK = 32
+#: rows at least this wide take the filtered path
+_FILTER_MIN_N = 4096
+#: k above this falls back to the single top-k (the candidate set and the
+#: block-extrema row would approach the input width)
+_FILTER_MAX_K = 128
+
+
+def _worst_value(dtype, select_min: bool):
+    """The value that loses every comparison (padding filler)."""
+    if jnp.issubdtype(dtype, jnp.inexact):
+        return jnp.inf if select_min else -jnp.inf
+    info = jnp.iinfo(dtype)
+    return info.max if select_min else info.min
+
+
+def _top_k_filtered(values, k: int, select_min: bool
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Stable exact top-k; wide rows go through the block-extremum filter.
+
+    Returns (vals, positions) sorted best-first.  Bit-identical to the
+    plain stable ``lax.top_k`` (ties → lowest position): block selection
+    is stable, selected blocks are re-sorted into index order, and row
+    padding sits at the very end of the last block, so it loses every
+    tie against real entries.
+    """
+    n = values.shape[-1]
+    c = _FILTER_BLOCK
+    nb = -(-n // c)
+    worst = _worst_value(values.dtype, select_min)
+    inexact = jnp.issubdtype(values.dtype, jnp.inexact)
+
+    def sanitize(v):
+        # NaN ranks as the WORST value (ties with ±inf broken by
+        # position), matching merge_sorted_runs' ordering: a NaN
+        # propagating into a block extremum would otherwise exclude the
+        # whole block — and with it real top-k candidates — from the
+        # candidate set.  Selection runs on sanitized views; returned
+        # values gather from the raw input, so selected NaN slots (fewer
+        # than k real candidates) still come back as NaN.
+        return jnp.where(jnp.isnan(v), worst, v) if inexact else v
+
+    if n < _FILTER_MIN_N or k > _FILTER_MAX_K or k > nb // 2:
+        clean = sanitize(values)
+        if select_min:
+            _, pos = jax.lax.top_k(-clean, k)
+        else:
+            _, pos = jax.lax.top_k(clean, k)
+        return jnp.take_along_axis(values, pos, axis=-1), pos
+    lead = values.shape[:-1]
+    if nb * c != n:
+        cfg = [(0, 0)] * (values.ndim - 1) + [(0, nb * c - n)]
+        values = jnp.pad(values, cfg, constant_values=worst)
+    blocks = values.reshape(lead + (nb, c))
+    # the wide input's block reduce IGNORES NaN via an fmin/fmax reduce
+    # computation (a jnp.min would propagate NaN into the extremum and
+    # exclude the whole block, silently dropping real candidates that
+    # share a block with one NaN; a where-sanitized copy of the wide
+    # input measurably costs a full extra pass).  An all-NaN block
+    # reduces to the init = worst and is excluded — its NaNs can only
+    # matter when a row has fewer than k non-NaN entries, where NaN
+    # ordering among returned tail slots is unspecified anyway.
+    if inexact:
+        fex = jnp.fmin if select_min else jnp.fmax
+        bext = jax.lax.reduce(blocks, jnp.asarray(worst, blocks.dtype),
+                              fex, [blocks.ndim - 1])
+    else:
+        bext = (jnp.min if select_min else jnp.max)(blocks, axis=-1)
+    if select_min:
+        # min-orientation: only the TINY (…, nb) extrema row is negated
+        # for lax.top_k — the wide input never pays a negation pass
+        _, bidx = jax.lax.top_k(-bext, k)
+    else:
+        _, bidx = jax.lax.top_k(bext, k)
+    bidx = jnp.sort(bidx, axis=-1)          # index order → stable ties
+    cand = jnp.take_along_axis(blocks, bidx[..., None], axis=-2)
+    cand = cand.reshape(lead + (k * c,))
+    if select_min:
+        _, ci = jax.lax.top_k(-sanitize(cand), k)
+    else:
+        _, ci = jax.lax.top_k(sanitize(cand), k)
+    pos = jnp.take_along_axis(bidx, ci // c, axis=-1) * c + ci % c
+    return jnp.take_along_axis(cand, ci, axis=-1), pos
+
 
 def _select_k_impl(values, k: int, select_min: bool):
-    if select_min:
-        vals, idx = jax.lax.top_k(-values, k)
-        return -vals, idx
-    return jax.lax.top_k(values, k)
+    return _top_k_filtered(values, k, select_min)
 
 
 def _select_k_payload_impl(values, indices, k: int, select_min: bool):
     vals, idx = _select_k_impl(values, k, select_min)
     return vals, jnp.take_along_axis(indices, idx, axis=-1)
+
+
+def _merge_sorted_runs_impl(a_vals, a_idx, b_vals, b_idx, k: int,
+                            select_min: bool):
+    """Merge two per-row SORTED runs into the best k of their union.
+
+    Each element's merged rank is its own position plus the count of
+    elements of the other run that beat it (run *a* wins ties — with run a
+    holding the earlier/lower-id candidates this reproduces a stable
+    full sort exactly).  Ranks are unique, so each output slot has at
+    most one source element; the output is built with GATHERS (slot →
+    source position via k×k equality masks), not scatters — CPU/TPU
+    gathers are cheap where scatters serialize.  Slots past the union
+    keep the sentinel/-1 (the kNN empty-slot convention).
+    """
+    ka = a_vals.shape[-1]
+    kb = b_vals.shape[-1]
+    if jnp.issubdtype(a_vals.dtype, jnp.inexact):
+        # comparison keys rank NaN EQUAL to the worst value (±inf), ties
+        # by run/position — the same preorder select_k's filtered path
+        # uses, so every select_k output is a valid run here even when a
+        # NaN sits positionally before a real ±inf.  Plain comparisons
+        # are all-false around NaN, which would collide merged ranks and
+        # silently drop real candidates; a STRICTLY-after-inf NaN order
+        # would instead reject runs like [nan, inf].  Output values
+        # gather from the raw runs, so NaN entries survive as NaN.
+        worst = _worst_value(a_vals.dtype, select_min)
+        a_key = jnp.where(jnp.isnan(a_vals), worst, a_vals)
+        b_key = jnp.where(jnp.isnan(b_vals), worst, b_vals)
+    else:
+        a_key, b_key = a_vals, b_vals
+    av = a_key[..., :, None]                                    # (…, ka, 1)
+    bv = b_key[..., None, :]                                    # (…, 1, kb)
+    if select_min:
+        beats_a = bv < av                                       # (…, ka, kb)
+        beats_b = av <= bv
+    else:
+        beats_a = bv > av
+        beats_b = av >= bv
+    rank_a = (jnp.arange(ka, dtype=jnp.int32)
+              + jnp.sum(beats_a, axis=-1, dtype=jnp.int32))
+    rank_b = (jnp.arange(kb, dtype=jnp.int32)
+              + jnp.sum(beats_b, axis=-2, dtype=jnp.int32))
+    slots = jnp.arange(k, dtype=jnp.int32)
+    eq_a = rank_a[..., :, None] == slots                        # (…, ka, k)
+    eq_b = rank_b[..., :, None] == slots                        # (…, kb, k)
+    is_a = jnp.any(eq_a, axis=-2)
+    is_b = jnp.any(eq_b, axis=-2)
+    src_a = jnp.argmax(eq_a, axis=-2).astype(jnp.int32)
+    src_b = jnp.argmax(eq_b, axis=-2).astype(jnp.int32)
+    sentinel = jnp.asarray(_worst_value(a_vals.dtype, select_min),
+                           a_vals.dtype)
+    out_v = jnp.where(is_a, jnp.take_along_axis(a_vals, src_a, axis=-1),
+                      jnp.where(is_b,
+                                jnp.take_along_axis(b_vals, src_b, axis=-1),
+                                sentinel))
+    out_i = jnp.where(is_a, jnp.take_along_axis(a_idx, src_a, axis=-1),
+                      jnp.where(is_b,
+                                jnp.take_along_axis(b_idx, src_b, axis=-1),
+                                jnp.asarray(-1, a_idx.dtype)))
+    return out_v, out_i
 
 
 # Eager calls dispatch AOT-cached executables (precompiled-libs role, see
@@ -38,6 +200,8 @@ _select_k_aot = aot(_select_k_impl, static_argnums=(1, 2))
 _select_k_payload_aot = aot(_select_k_payload_impl, static_argnums=(2, 3))
 _select_k_jit = jax.jit(_select_k_impl, static_argnums=(1, 2))
 _select_k_payload_jit = jax.jit(_select_k_payload_impl, static_argnums=(2, 3))
+_merge_aot = aot(_merge_sorted_runs_impl, static_argnums=(4, 5))
+_merge_jit = jax.jit(_merge_sorted_runs_impl, static_argnums=(4, 5))
 
 
 def select_k(values, k: int, select_min: bool = True, indices=None
@@ -46,7 +210,10 @@ def select_k(values, k: int, select_min: bool = True, indices=None
 
     Returns (out_values [..., k], out_indices [..., k]).  If *indices* is
     given it is a payload gathered alongside (the reference's ``inV``/``inK``
-    pair); otherwise positions are returned.
+    pair); otherwise positions are returned.  Output rows are SORTED
+    best-first (ascending for select_min) with ties at the lowest
+    position first — a contract :func:`merge_sorted_runs` consumers rely
+    on.
     """
     values = jnp.asarray(values)
     k = int(k)
@@ -63,6 +230,43 @@ def select_k(values, k: int, select_min: bool = True, indices=None
         return fn(values, indices, k, select_min)
     fn = _select_k_aot if aot_dispatchable(values) else _select_k_jit
     return fn(values, k, select_min)
+
+
+def merge_sorted_runs(a_vals, a_idx, b_vals, b_idx, k: Optional[int] = None,
+                      select_min: bool = True
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Best k of two SORTED top-k runs in O(k²) comparisons — no re-sort.
+
+    *a_vals*/*b_vals* are (..., ka)/(..., kb) runs sorted best-first
+    (ascending for *select_min*, descending otherwise — i.e.
+    :func:`select_k` outputs); *a_idx*/*b_idx* are their id payloads.
+    Returns (vals [..., k], ids [..., k]) sorted best-first; *k* defaults
+    to ka.  Ties keep run *a*'s elements first — with run a holding the
+    earlier candidates (the running carry of a tile scan, or the
+    lower-numbered part) the merge reproduces a stable full sort bit for
+    bit.  Slots past the union's length get sentinel distance and id -1
+    (the empty-slot convention of the kNN scans).
+
+    This is the reference's ``knn_merge_parts`` / warp-sort queue-merge
+    step (neighbors/brute_force.cuh:76): two sorted k-runs merge in O(k²)
+    vectorized comparisons, vs re-sorting k + tile candidates per scan
+    step.
+
+    NaN values rank EQUAL to the worst value (±inf) with ties broken by
+    run/position — the same preorder :func:`select_k` uses — so any
+    select_k output is a valid input run; NaN entries come back as NaN.
+    """
+    a_vals = jnp.asarray(a_vals)
+    b_vals = jnp.asarray(b_vals)
+    a_idx = jnp.asarray(a_idx)
+    b_idx = jnp.asarray(b_idx)
+    k = int(a_vals.shape[-1] if k is None else k)
+    select_min = bool(select_min)
+    args = (a_vals, a_idx, b_vals, b_idx)
+    if is_tracer(*args):
+        return _merge_sorted_runs_impl(*args, k, select_min)
+    fn = _merge_aot if aot_dispatchable(*args) else _merge_jit
+    return fn(*args, k, select_min)
 
 
 def select_min_k(values, k: int, indices=None):

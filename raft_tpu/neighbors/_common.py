@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from raft_tpu.matrix.select_k import select_k
+from raft_tpu.matrix.select_k import merge_sorted_runs, select_k
 
 
 def _ranks_within(labels, n: int, n_lists: int):
@@ -250,6 +250,7 @@ def scan_probe_lists(probe_ids, score_tile: Callable, list_indices,
     nq = probe_ids.shape[0]
     cap = list_indices.shape[1]
     sentinel = jnp.asarray(jnp.inf if select_min else -jnp.inf, dtype)
+    kk = min(k, cap)
 
     def step(carry, probe_col):
         best_d, best_i = carry
@@ -258,10 +259,12 @@ def scan_probe_lists(probe_ids, score_tile: Callable, list_indices,
         sizes = list_sizes[probe_col]
         live = jnp.arange(cap)[None, :] < sizes[:, None]
         d = jnp.where(live, d, sentinel)
-        merged_d = jnp.concatenate([best_d, d], axis=1)
-        merged_i = jnp.concatenate([best_i, ids], axis=1)
-        return select_k(merged_d, k, select_min=select_min,
-                        indices=merged_i), None
+        # partial top-k of this probe tile, then an O(k²) sorted-run merge
+        # into the running top-k (the brute-force scan's primitive) —
+        # instead of re-sorting (k + cap) concatenated candidates per step
+        tile_d, tile_i = select_k(d, kk, select_min=select_min, indices=ids)
+        return merge_sorted_runs(best_d, best_i, tile_d, tile_i, k=k,
+                                 select_min=select_min), None
 
     init = (jnp.full((nq, k), sentinel, dtype),
             jnp.full((nq, k), -1, jnp.int32))

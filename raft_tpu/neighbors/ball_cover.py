@@ -90,7 +90,16 @@ def _tile_distance(q, data, metric: DistanceType):
     Half-precision inputs are upcast so scores accumulate in f32
     (pairwise.accum_dtype policy, same as brute_force/ivf_flat — r4
     advisor finding: the nq==0 path already returned accum_dtype, and the
-    certificate's exactness promise needs full-precision scores anyway)."""
+    certificate's exactness promise needs full-precision scores anyway).
+
+    The L2 branch is the DIRECT Σ(q−x)² form, not the expanded
+    ||q||²+||x||²−2⟨q,x⟩ trick the other scans use: a (q, c) tile pair is
+    a batched matvec (no shared MXU matmul to exploit), the flop cost is
+    the same, and the expanded form's cancellation noise (~1e-7 squared,
+    ≈5e-4 after sqrt) is NOT exactly 0 on self-pairs unless XLA happens
+    to fuse the norms into the epilogue — an accident this module's
+    exactness certificate must not depend on (measured:
+    test_ball_cover_all_knn broke when a consumer change shifted fusion)."""
     from raft_tpu.distance.pairwise import accum_dtype
 
     acc = accum_dtype(q.dtype)
@@ -103,13 +112,8 @@ def _tile_distance(q, data, metric: DistanceType):
              jnp.cos(q[:, None, 0]) * jnp.cos(data[:, :, 0]) *
              jnp.sin(dlon / 2) ** 2)
         return 2.0 * jnp.arcsin(jnp.sqrt(jnp.clip(h, 0.0, 1.0)))
-    # precision='highest': this module promises EXACT results and its
-    # certificate compares these values against full-precision landmark
-    # bounds — TPU bf16-default matmuls would silently break exactness.
-    dots = jnp.einsum("qd,qcd->qc", q, data, precision="highest")
-    qn = jnp.sum(q ** 2, axis=-1, keepdims=True)
-    xn = jnp.sum(data ** 2, axis=-1)
-    return jnp.sqrt(jnp.maximum(qn + xn - 2.0 * dots, 0.0))
+    diff = q[:, None, :] - data
+    return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
 
 
 def build_index(x, metric: DistanceType = DistanceType.L2SqrtExpanded,
@@ -156,10 +160,15 @@ def _probe_pass(index_leaves, queries, k: int, n_probe: int, metric_val: int):
     ql = _pairwise(queries, landmarks, metric, 2.0)        # (nq, nl)
     _, probe_order = jax.lax.top_k(-ql, n_probe)           # nearest first
 
+    from raft_tpu.distance.pairwise import accum_dtype
+
+    # NB: unlike brute_force/ivf_flat, nothing is hoisted here —
+    # _tile_distance scores with the direct Σ(q−x)² form, which has no
+    # per-row statistics to hoist and keeps self-pair distances exactly 0
+    # (the expanded-form alternative measurably broke the exactness
+    # promise; see _tile_distance's docstring).
     def score_tile(lists):
         return _tile_distance(queries, list_data[lists], metric)
-
-    from raft_tpu.distance.pairwise import accum_dtype
 
     best_d, best_i = scan_probe_lists(probe_order.astype(jnp.int32),
                                       score_tile, list_indices, list_sizes,

@@ -12,8 +12,26 @@ Counterpart of reference ``neighbors/brute_force.cuh:76,144``
   distance-epilogue fusion XLA performs plays the role of the reference's
   hand-fused kernel, and HBM traffic stays O(tiles) not O(m·n).
 
-Indices returned are int32 (padded index rows get ``inf`` distance and are
-never selected while n ≥ k live rows exist).
+The scan is a FUSED PIPELINE (the three costs the reference's hand-fused
+kernel avoids, avoided here too):
+
+1. invariant per-row statistics (row norms etc.) are HOISTED out of the
+   loop — query stats once per batch, index stats once per scan, threaded
+   through the scan as xs (``distance.pairwise.metric_stats``) instead of
+   recomputed by every step's pairwise call;
+2. each step folds its tile via partial top-k + a SORTED-RUN MERGE of
+   O(k²) vectorized comparisons (``matrix.select_k.merge_sorted_runs``)
+   instead of re-sorting (k + tile) concatenated candidates, and tile
+   ids stay a broadcast off the step base (no (nq, tile) id
+   materialization);
+3. ragged query batches are PADDED to the bucketed batch shape
+   (``core.aot._bucket_dim``) and sliced after, so the scan executable
+   compiles once per bucket signature, not once per remainder shape.
+
+Indices returned are int32; ``global_id_offset`` past the int32 range
+promotes them to int64 (requires ``jax_enable_x64``).  The index is never
+padded (the ragged tail is its own scan-free step) — only query batches
+pad, and their extra rows are sliced off before returning.
 """
 
 from __future__ import annotations
@@ -24,11 +42,13 @@ from typing import Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core.aot import _bucket_dim
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.distance.distance_types import DISTANCE_TYPES, DistanceType
-from raft_tpu.distance.pairwise import distance as _pairwise
-from raft_tpu.matrix.select_k import select_k
+from raft_tpu.matrix.select_k import merge_sorted_runs, select_k
+
+_INT32_MAX = 2**31 - 1
 
 
 def _resolve_metric(metric) -> DistanceType:
@@ -43,38 +63,71 @@ def _resolve_metric(metric) -> DistanceType:
 def _knn_scan(index, queries, k: int, metric: DistanceType,
               metric_arg: float, tile: int, select_min: bool):
     """Running top-k over index tiles: never materializes (m, n)."""
-    n = index.shape[0]
-    n_tiles = max(1, -(-n // tile))
-    pad = n_tiles * tile - n
-    padded = jnp.pad(index, ((0, pad), (0, 0)))
-    valid = jnp.arange(n_tiles * tile) < n
-    tiles = padded.reshape(n_tiles, tile, index.shape[1])
-    vtiles = valid.reshape(n_tiles, tile)
-    bases = (jnp.arange(n_tiles) * tile).astype(jnp.int32)
+    from raft_tpu.distance.pairwise import (accum_dtype, distance_with_stats,
+                                            metric_stats)
+
+    # sqrt is monotone: scan + select on SQUARED L2, root only the final
+    # (nq, k) — the per-tile (nq, tile) sqrt pass disappears.  Returned
+    # distances are bit-identical to the root-then-select reference path;
+    # ties are resolved on the squared values, which distinguish pairs
+    # f32 sqrt would collapse (strictly sharper tie-breaking, but an
+    # exact-index comparison against a rooted-path selection can differ
+    # on such near-ties).
+    defer_sqrt = metric == DistanceType.L2SqrtExpanded
+    scan_metric = DistanceType.L2Expanded if defer_sqrt else metric
+
+    n, dim = index.shape
+    # No index padding and no per-step validity mask: the scan covers the
+    # full tiles and the ragged tail folds in as one extra unrolled step.
+    # A masking `where` between the epilogue and the tile select measurably
+    # blocks XLA from fusing the select's block-extremum reduce into the
+    # distance epilogue (~50% per-step cost on CPU); keeping every scanned
+    # tile all-real sidesteps the mask entirely.
+    n_full = n // tile
+    rem = n - n_full * tile
+
+    # hoisted invariant statistics: query stats once per batch, index
+    # stats once per scan; the scan body consumes the per-tile slice as xs
+    q_stats = metric_stats(queries, scan_metric)
+    i_stats = metric_stats(index, scan_metric)
 
     nq = queries.shape[0]
     # running top-k carry must match the distance dtype: f32 for
     # half-precision inputs (pairwise accumulates them in f32)
-    from raft_tpu.distance.pairwise import accum_dtype
-
     val_dtype = accum_dtype(queries.dtype)
     sentinel = jnp.asarray(jnp.inf if select_min else -jnp.inf, val_dtype)
 
-    def step(carry, xs):
+    def fold(carry, tile_x, tile_stats, base, width):
         best_d, best_i = carry
-        tile_x, tile_valid, base = xs
-        d = _pairwise(queries, tile_x, metric, metric_arg)
-        d = jnp.where(tile_valid[None, :], d, sentinel)
-        ids = (base + jnp.arange(tile, dtype=jnp.int32))[None, :].repeat(nq, 0)
-        merged_d = jnp.concatenate([best_d, d], axis=1)
-        merged_i = jnp.concatenate([best_i, ids], axis=1)
-        best_d, best_i = select_k(merged_d, k, select_min=select_min,
-                                  indices=merged_i)
-        return (best_d, best_i), None
+        d = distance_with_stats(queries, tile_x, scan_metric, metric_arg,
+                                q_stats, tile_stats).astype(val_dtype)
+        # partial top-k of this tile (block-extremum candidate filter),
+        # positions broadcast off the base — then an O(k²)-comparison
+        # merge of two sorted runs; the carry (earlier tiles = lower ids)
+        # wins ties, reproducing a stable full sort exactly
+        tile_d, pos = select_k(d, min(k, width), select_min=select_min)
+        tile_i = base + pos.astype(jnp.int32)
+        return merge_sorted_runs(best_d, best_i, tile_d, tile_i, k=k,
+                                 select_min=select_min)
 
-    init = (jnp.full((nq, k), sentinel, val_dtype),
-            jnp.full((nq, k), -1, jnp.int32))
-    (best_d, best_i), _ = jax.lax.scan(step, init, (tiles, vtiles, bases))
+    carry = (jnp.full((nq, k), sentinel, val_dtype),
+             jnp.full((nq, k), -1, jnp.int32))
+    if n_full:
+        tiles = index[:n_full * tile].reshape(n_full, tile, dim)
+        t_stats = i_stats[:n_full * tile].reshape(n_full, tile, -1)
+        bases = (jnp.arange(n_full) * tile).astype(jnp.int32)
+
+        def step(carry, xs):
+            tile_x, tile_stats, base = xs
+            return fold(carry, tile_x, tile_stats, base, tile), None
+
+        carry, _ = jax.lax.scan(step, carry, (tiles, t_stats, bases))
+    if rem:
+        carry = fold(carry, index[n_full * tile:], i_stats[n_full * tile:],
+                     jnp.int32(n_full * tile), rem)
+    best_d, best_i = carry
+    if defer_sqrt:
+        best_d = jnp.sqrt(best_d)
     return best_d, best_i
 
 
@@ -82,7 +135,7 @@ def _knn_scan(index, queries, k: int, metric: DistanceType,
 def knn(index, queries, k: int,
         metric: Union[str, DistanceType] = DistanceType.L2SqrtExpanded,
         metric_arg: float = 2.0, *,
-        batch_size_index: int = 8192,
+        batch_size_index: int = 16384,
         batch_size_query: int = 4096,
         global_id_offset: int = 0,
         handle=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -92,7 +145,9 @@ def knn(index, queries, k: int,
     spatial/knn/detail/knn_brute_force_faiss.cuh:332-353) with the same
     ``translations``-style *global_id_offset* for sharded indexes.
 
-    Returns (distances [nq, k], indices [nq, k] int32).
+    Returns (distances [nq, k], indices [nq, k] int32 — int64 when
+    *global_id_offset* pushes ids past int32, which requires
+    ``jax_enable_x64``).
     """
     index = jnp.asarray(index)
     queries = jnp.asarray(queries)
@@ -110,17 +165,37 @@ def knn(index, queries, k: int,
     # InnerProduct is a similarity: kNN selects the LARGEST values
     # (reference knn_brute_force_faiss.cuh: IP uses a max-selection heap).
     select_min = metric != DistanceType.InnerProduct
+    bs = int(batch_size_query)
     out_d, out_i = [], []
-    for q0 in range(0, queries.shape[0], batch_size_query):
-        q1 = min(q0 + batch_size_query, queries.shape[0])
-        d, i = _knn_scan(index, queries[q0:q1], int(k), metric,
-                         float(metric_arg), int(tile), select_min)
+    for q0 in range(0, queries.shape[0], bs):
+        q1 = min(q0 + bs, queries.shape[0])
+        qb = queries[q0:q1]
+        n_valid = q1 - q0
+        # Bucket the ragged tail batch (pad + slice, same policy as
+        # ivf_flat/ivf_pq.search): one compiled scan per bucket signature
+        # instead of one per remainder shape.
+        bucket = min(_bucket_dim(n_valid), bs)
+        if bucket != n_valid:
+            qb = jnp.pad(qb, ((0, bucket - n_valid), (0, 0)))
+        d, i = _knn_scan(index, qb, int(k), metric, float(metric_arg),
+                         int(tile), select_min)
+        if bucket != n_valid:
+            d, i = d[:n_valid], i[:n_valid]
         out_d.append(d)
         out_i.append(i)
     d = out_d[0] if len(out_d) == 1 else jnp.concatenate(out_d, axis=0)
     i = out_i[0] if len(out_i) == 1 else jnp.concatenate(out_i, axis=0)
     if global_id_offset:
-        i = i + jnp.int32(global_id_offset)
+        expects(global_id_offset >= 0, "global_id_offset must be >= 0")
+        if int(global_id_offset) + index.shape[0] - 1 > _INT32_MAX:
+            # int64-safe sharded-id handling: ids past 2^31 must not
+            # silently wrap (knn_mnmg shards past 2^31 rows land here)
+            expects(bool(jax.config.jax_enable_x64),
+                    f"global_id_offset={global_id_offset} pushes ids past "
+                    "int32; enable jax_enable_x64 for int64 ids")
+            i = i.astype(jnp.int64) + jnp.asarray(global_id_offset, jnp.int64)
+        else:
+            i = i + jnp.int32(global_id_offset)
     return d, i
 
 
@@ -151,6 +226,15 @@ def knn_merge_parts(part_distances, part_indices, k: Optional[int] = None,
     *translations* offsets each part's local ids into the global id space.
     *metric* must match the per-part searches: InnerProduct results are
     similarities and merge with max-selection.
+
+    Part rows must be SORTED best-first — the contract every ``knn``/
+    ``select_k`` output satisfies, and the same precondition the
+    reference's block-select merge has.  The merge is a fold of
+    ``matrix.select_k.merge_sorted_runs`` over parts: O(n_parts·k²)
+    vectorized comparisons instead of re-sorting n_parts·k candidates.
+    When *k* exceeds the per-part width, candidates whose distance equals
+    the sentinel (±inf) may come back with id -1 in the padded slots;
+    within the per-part width every real candidate keeps its id.
     """
     select_min = _resolve_metric(metric) != DistanceType.InnerProduct
     d = jnp.asarray(part_distances)
@@ -160,12 +244,33 @@ def knn_merge_parts(part_distances, part_indices, k: Optional[int] = None,
     n_parts, nq, in_k = d.shape
     if k is None:
         k = in_k
+    k = int(k)
     expects(k <= n_parts * in_k, "k larger than total candidates")
     if translations is not None:
         expects(len(translations) == n_parts,
                 "need one translation per part")
         t = jnp.asarray(translations, i.dtype).reshape(n_parts, 1, 1)
         i = i + t
-    merged_d = jnp.moveaxis(d, 0, 1).reshape(nq, n_parts * in_k)
-    merged_i = jnp.moveaxis(i, 0, 1).reshape(nq, n_parts * in_k)
-    return select_k(merged_d, int(k), select_min=select_min, indices=merged_i)
+    # Seed the fold from part 0 (not a sentinel carry): a sentinel init
+    # would tie-beat REAL candidates sitting at the sentinel value (±inf
+    # distances are legal in parts — masked/padded select_k outputs) and
+    # replace their ids with -1.  Only when k > in_k does part 0 need
+    # sentinel padding, where that residual tie edge is documented above.
+    if in_k >= k:
+        init = (d[0, :, :k], i[0, :, :k])
+    else:
+        sentinel = jnp.asarray(jnp.inf if select_min else -jnp.inf, d.dtype)
+        init = (jnp.concatenate(
+                    [d[0], jnp.full((nq, k - in_k), sentinel, d.dtype)], 1),
+                jnp.concatenate(
+                    [i[0], jnp.full((nq, k - in_k), -1, i.dtype)], 1))
+    if n_parts == 1:
+        return init
+
+    def step(carry, part):
+        pd, pi = part
+        return merge_sorted_runs(carry[0], carry[1], pd, pi, k=k,
+                                 select_min=select_min), None
+
+    (md, mi), _ = jax.lax.scan(step, init, (d[1:], i[1:]))
+    return md, mi

@@ -37,7 +37,8 @@ from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.core.logger import traced
 from raft_tpu.cluster import build_hierarchical, min_cluster_and_distance
 from raft_tpu.distance.distance_types import DistanceType
-from raft_tpu.distance.pairwise import _mxu_dot, _row_norms, accum_dtype
+from raft_tpu.distance.pairwise import (_l2_expanded, _mxu_dot, _row_norms,
+                                        accum_dtype)
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.neighbors._common import (
     empty_result,
@@ -266,6 +267,11 @@ def _scan_probes(queries, probe_ids, index_leaves, metric_val: int, k: int,
     # distance.pairwise._mxu_dot): on near-tie candidate sets, bf16 score
     # rounding measurably costs recall (~0.04 at 2k×32 uniform).
     acc_t = accum_dtype(queries.dtype)
+    # hoisted invariant statistic: query sq-norms once per batch — a scan
+    # constant, not recomputed by every probe step's score_tile; ONE
+    # norm/upcast policy (_row_norms accumulates half inputs in f32,
+    # matching acc_t)
+    q_sq = _row_norms(queries)[:, None].astype(acc_t)
 
     def score_tile(rows):
         data = list_data[rows].astype(queries.dtype)        # (nq, cap, dim)
@@ -279,8 +285,7 @@ def _scan_probes(queries, probe_ids, index_leaves, metric_val: int, k: int,
                 jnp.sum(data.astype(acc_t) ** 2, axis=-1), 1e-30))
             return 1.0 - dots / xn
         xn = jnp.sum(data.astype(acc_t) ** 2, axis=-1)
-        qn = jnp.sum(queries.astype(acc_t) ** 2, axis=-1, keepdims=True)
-        return qn + xn - 2.0 * dots
+        return q_sq + xn - 2.0 * dots
 
     phys_probes = expand_probes(probe_ids, chunk_table,
                                 list_data.shape[0])
@@ -344,10 +349,9 @@ def search(params: SearchParams, index: Index, queries, k: int,
 def _coarse_l2(q, centers):
     # half inputs: f32 norms + f32-accumulated dot (probe selection
     # misranks near-tie centroids otherwise — same contract as the fine
-    # scan's acc_t); f32 inputs keep the default-precision matmul
-    qn = _row_norms(q)[:, None]
-    cn = _row_norms(centers)
-    return qn + cn[None, :] - 2.0 * _mxu_dot(q, centers, None)
+    # scan's acc_t); f32 inputs keep the default-precision matmul.
+    # ONE L2 epilogue implementation: distance.pairwise._l2_expanded.
+    return _l2_expanded(q, centers, sqrt=False, precision=None)
 
 
 def _coarse_distances(q, centers, metric: DistanceType):

@@ -52,6 +52,7 @@ from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.core.logger import traced
 from raft_tpu.cluster import build_hierarchical, min_cluster_and_distance
 from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.distance.pairwise import _l2_expanded, _row_norms
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.neighbors._common import (
     empty_result,
@@ -681,6 +682,9 @@ def search(params: SearchParams, index: Index, queries, k: int,
     leaves = (index.centers, index.rotation, index.codebooks,
               index.list_codes, index.list_indices, index.phys_sizes,
               index.chunk_table, index.owner)
+    # hoisted invariant statistic: coarse-center sq-norms once per search,
+    # not once per query batch (distance.pairwise.metric_stats contract)
+    center_sq = None if is_ip else _row_norms(index.centers)
     out_d, out_i = [], []
     # Batched dispatch over query blocks: each AOT/jit dispatch is ASYNC, so
     # successive batches overlap dispatch with execution — the TPU analogue
@@ -704,9 +708,10 @@ def search(params: SearchParams, index: Index, queries, k: int,
         if is_ip:
             coarse = -(qb @ index.centers.T)
         else:
-            coarse = (jnp.sum(qb ** 2, 1, keepdims=True)
-                      + jnp.sum(index.centers ** 2, 1)[None, :]
-                      - 2.0 * qb @ index.centers.T)
+            # shared hoisted-stats L2 epilogue (default-precision matmul,
+            # as before — coarse ranking tolerates it)
+            coarse = _l2_expanded(qb, index.centers, sqrt=False,
+                                  precision=None, yn=center_sq)
         _, probes = select_k(coarse, n_probes, select_min=True)
         batch_fn = (_search_batch_aot if aot_dispatchable(qb, probes, leaves)
                     else _search_batch)

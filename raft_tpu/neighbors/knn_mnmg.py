@@ -67,6 +67,15 @@ def knn_mnmg(comms, index, queries, k: int,
     expects(k <= rows_per,
             "k must not exceed rows per shard (each rank contributes k "
             "candidates)")
+    # global ids are rank·rows_per + local in int32 inside the shard
+    # program: bound the id space so a sharded index past 2^31 rows fails
+    # loudly instead of silently wrapping (the single-device knn's
+    # global_id_offset path promotes to int64; a shard_map program cannot
+    # without x64, so enforce the bound here)
+    expects(n - 1 <= 2**31 - 1,
+            f"global id space ({n} rows) exceeds int32 — shard the index "
+            "across more hosts or search parts explicitly via knn with "
+            "global_id_offset (int64 ids under jax_enable_x64)")
 
     local = _search_program(comms, int(k), metric, float(metric_arg),
                             rows_per)

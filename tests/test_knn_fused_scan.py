@@ -1,0 +1,224 @@
+"""Fused-scan kNN pipeline properties (the PR-1 tentpole): sorted-run
+merge oracle tests, hoisted-stats scan vs the full-matrix reference path
+(bit-identical on tie-free data), query-batch padding, and int64-safe
+global id offsets.
+
+Reference analogue: cpp/test/neighbors/knn.cu + fused_l2_knn.cu check the
+fused kernel against the materialized-matrix path the same way.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.distance import DistanceType, pairwise_distance
+from raft_tpu.matrix import merge_sorted_runs, select_k
+from raft_tpu.neighbors import knn
+
+
+def _merge_oracle(a_vals, a_idx, b_vals, b_idx, k, select_min):
+    """Host oracle: stable merge preferring run a on ties."""
+    out_v, out_i = [], []
+    for av, ai, bv, bi in zip(a_vals, a_idx, b_vals, b_idx):
+        cat_v = np.concatenate([av, bv])
+        cat_i = np.concatenate([ai, bi])
+        order = np.argsort(cat_v if select_min else -cat_v, kind="stable")
+        out_v.append(cat_v[order][:k])
+        out_i.append(cat_i[order][:k])
+    return np.stack(out_v), np.stack(out_i)
+
+
+class TestMergeSortedRuns:
+    @pytest.mark.parametrize("ka,kb,k", [(5, 5, 5), (7, 3, 7), (3, 8, 6),
+                                         (1, 1, 1), (4, 4, 8)])
+    @pytest.mark.parametrize("select_min", [True, False])
+    def test_vs_stable_merge_oracle(self, ka, kb, k, select_min):
+        rng = np.random.default_rng(ka * 100 + kb * 10 + k)
+        a = np.sort(rng.random((6, ka)).astype(np.float32), axis=1)
+        b = np.sort(rng.random((6, kb)).astype(np.float32), axis=1)
+        if not select_min:
+            a, b = -a, -b
+        ai = rng.integers(0, 1000, (6, ka)).astype(np.int32)
+        bi = rng.integers(0, 1000, (6, kb)).astype(np.int32)
+        v, i = merge_sorted_runs(a, ai, b, bi, k=k, select_min=select_min)
+        rv, ri = _merge_oracle(a, ai, b, bi, k, select_min)
+        n_real = min(k, ka + kb)
+        np.testing.assert_array_equal(np.asarray(v)[:, :n_real],
+                                      rv[:, :n_real])
+        np.testing.assert_array_equal(np.asarray(i)[:, :n_real],
+                                      ri[:, :n_real])
+        # slots past the union get sentinel / -1 (the empty-slot convention)
+        if n_real < k:
+            pad_v = np.asarray(v)[:, n_real:]
+            assert np.all(np.isinf(pad_v))
+            assert np.all((pad_v > 0) == select_min)
+            assert np.all(np.asarray(i)[:, n_real:] == -1)
+
+    def test_ties_prefer_run_a(self):
+        """Run a's elements win ties — the property that makes the scan's
+        running merge reproduce a stable full sort (earlier tiles = lower
+        ids = run a)."""
+        a = np.array([[1.0, 2.0, 3.0]], np.float32)
+        b = np.array([[1.0, 2.0, 3.0]], np.float32)
+        ai = np.array([[10, 11, 12]], np.int32)
+        bi = np.array([[20, 21, 22]], np.int32)
+        v, i = merge_sorted_runs(a, ai, b, bi, k=4)
+        np.testing.assert_array_equal(np.asarray(v), [[1.0, 1.0, 2.0, 2.0]])
+        np.testing.assert_array_equal(np.asarray(i), [[10, 20, 11, 21]])
+
+    def test_nan_orders_worst_and_drops_nothing(self):
+        """NaN candidates sort after every real value (±inf included) and
+        never displace finite candidates — plain comparisons are all-false
+        around NaN, which would collide merged ranks and silently drop
+        real neighbors (a shard containing one NaN row must not eat a
+        real result in knn_merge_parts)."""
+        a = np.array([[0.1, 0.5, np.nan]], np.float32)
+        b = np.array([[0.2, 0.3, 0.4]], np.float32)
+        ai = np.array([[10, 11, 12]], np.int32)
+        bi = np.array([[20, 21, 22]], np.int32)
+        v, i = merge_sorted_runs(a, ai, b, bi, k=3)
+        np.testing.assert_array_equal(np.asarray(i), [[10, 20, 21]])
+        np.testing.assert_allclose(np.asarray(v), [[0.1, 0.2, 0.3]])
+        # among NaNs: run a first; after every finite/inf value
+        a2 = np.array([[1.0, np.inf, np.nan]], np.float32)
+        b2 = np.array([[2.0, np.nan, np.nan]], np.float32)
+        v, i = merge_sorted_runs(a2, np.array([[0, 1, 2]], np.int32),
+                                 b2, np.array([[5, 6, 7]], np.int32), k=6)
+        np.testing.assert_array_equal(np.asarray(i), [[0, 5, 1, 2, 6, 7]])
+
+    def test_matches_select_k_over_concat(self):
+        """merge(sorted runs) ≡ select_k(concat) on tie-free data — the
+        exact substitution the scan makes."""
+        rng = np.random.default_rng(3)
+        a = np.sort(rng.random((9, 6)).astype(np.float32), axis=1)
+        b = np.sort(rng.random((9, 6)).astype(np.float32), axis=1)
+        ai = np.arange(54, dtype=np.int32).reshape(9, 6)
+        bi = (100 + np.arange(54, dtype=np.int32)).reshape(9, 6)
+        mv, mi = merge_sorted_runs(a, ai, b, bi, k=6)
+        sv, si = select_k(np.concatenate([a, b], axis=1), 6,
+                          indices=np.concatenate([ai, bi], axis=1))
+        np.testing.assert_array_equal(np.asarray(mv), np.asarray(sv))
+        np.testing.assert_array_equal(np.asarray(mi), np.asarray(si))
+
+
+_METRICS = [
+    pytest.param(DistanceType.L2SqrtExpanded, id="l2sqrt"),
+    pytest.param(DistanceType.CosineExpanded, id="cosine"),
+    pytest.param(DistanceType.InnerProduct, id="inner_product"),
+    pytest.param(DistanceType.L1, id="l1"),
+]
+
+
+class TestFusedScanVsFullMatrix:
+    """The acceptance property: the fused scan (hoisted stats + partial
+    top-k + sorted-run merge, multiple tiles AND padded query batches) is
+    bit-identical to the full-matrix pairwise_distance + select_k path on
+    tie-free data — both pipelines run the same per-element epilogue, so
+    even the distances must agree exactly, not just to tolerance."""
+
+    def _data(self, dtype, seed=0, n=300, nq=45, dim=16):
+        rng = np.random.default_rng(seed)
+        x = jnp.asarray(rng.random((n, dim)), dtype)
+        q = jnp.asarray(rng.random((nq, dim)), dtype)
+        return x, q
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("metric", _METRICS)
+    def test_bit_identical(self, metric, dtype):
+        k = 10
+        x, q = self._data(dtype)
+        select_min = metric != DistanceType.InnerProduct
+        # full-matrix reference: one pairwise call + one stable select
+        full = pairwise_distance(q, x, metric)
+        rd, ri = select_k(full, k, select_min=select_min)
+        rd, ri = np.asarray(rd), np.asarray(ri)
+        # tie-free precondition (guaranteed for continuous data at these
+        # seeds; assert so a silent tie can never weaken the test)
+        assert all(len(np.unique(row[np.isfinite(row)])) == k
+                   for row in rd), "test data must be tie-free"
+        # fused scan, forced through multiple index tiles and a ragged
+        # (padded) query batch
+        d, i = knn(x, q, k, metric, batch_size_index=64,
+                   batch_size_query=32)
+        np.testing.assert_array_equal(np.asarray(i), ri)
+        np.testing.assert_array_equal(np.asarray(d), rd)
+
+    @pytest.mark.parametrize("metric", _METRICS)
+    def test_tiling_invariant(self, metric):
+        """Any (batch_size_index, batch_size_query) pair produces the
+        same results as the single-tile scan."""
+        k = 7
+        x, q = self._data(jnp.float32, seed=1)
+        select_min = metric != DistanceType.InnerProduct
+        d0, i0 = knn(x, q, k, metric)
+        for bi, bq in [(64, 45), (100, 7), (300, 16)]:
+            d, i = knn(x, q, k, metric, batch_size_index=bi,
+                       batch_size_query=bq)
+            np.testing.assert_array_equal(np.asarray(i), np.asarray(i0)), \
+                (bi, bq, select_min)
+            np.testing.assert_array_equal(np.asarray(d), np.asarray(d0))
+
+
+class TestQueryBatchPadding:
+    def test_ragged_tail_shares_bucket_executable(self):
+        """Remainder batches pad to the bucketed shape: two different
+        remainders in the same bucket must NOT trace a second scan
+        executable (the recompile-per-residue cost the padding removes)."""
+        from raft_tpu.neighbors.brute_force import _knn_scan
+
+        rng = np.random.default_rng(2)
+        x = rng.random((100, 8)).astype(np.float32)
+        base = _knn_scan._cache_size()
+        knn(x, rng.random((33, 8)).astype(np.float32), 3,
+            batch_size_query=32)  # full batch (32) + remainder 1 → pad 8
+        grew = _knn_scan._cache_size() - base
+        assert grew >= 1
+        knn(x, rng.random((36, 8)).astype(np.float32), 3,
+            batch_size_query=32)  # remainder 4 → same bucket of 8
+        assert _knn_scan._cache_size() - base == grew
+
+    def test_padded_tail_results_match_unbatched(self):
+        rng = np.random.default_rng(4)
+        x = rng.random((120, 8)).astype(np.float32)
+        q = rng.random((33, 8)).astype(np.float32)
+        d1, i1 = knn(x, q, 5)
+        d2, i2 = knn(x, q, 5, batch_size_query=32)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+
+
+class TestGlobalIdOffset:
+    def test_small_offset_stays_int32(self):
+        rng = np.random.default_rng(5)
+        x = rng.random((50, 4)).astype(np.float32)
+        q = rng.random((6, 4)).astype(np.float32)
+        d0, i0 = knn(x, q, 3)
+        d, i = knn(x, q, 3, global_id_offset=1000)
+        assert np.asarray(i).dtype == np.int32
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(i0) + 1000)
+
+    def test_offset_past_int32_requires_x64(self):
+        """Ids past 2^31 must fail loudly (or go int64 under x64), never
+        silently wrap — the knn_mnmg sharded-id hazard."""
+        from raft_tpu.core.error import RaftError
+
+        rng = np.random.default_rng(6)
+        x = rng.random((20, 4)).astype(np.float32)
+        q = rng.random((3, 4)).astype(np.float32)
+        if jax.config.jax_enable_x64:
+            _, i = knn(x, q, 2, global_id_offset=2**31)
+            assert np.asarray(i).dtype == np.int64
+            assert np.asarray(i).min() >= 2**31
+        else:
+            with pytest.raises(RaftError, match="int32"):
+                knn(x, q, 2, global_id_offset=2**31)
+
+    def test_negative_offset_rejected(self):
+        from raft_tpu.core.error import RaftError
+
+        rng = np.random.default_rng(7)
+        x = rng.random((10, 4)).astype(np.float32)
+        with pytest.raises(RaftError, match=">= 0"):
+            knn(x, x[:2], 2, global_id_offset=-5)
