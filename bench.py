@@ -52,20 +52,36 @@ def bench_pairwise():
 
 
 def bench_kmeans():
-    """BASELINE config[1]: k-means EM iterations/sec, 100k×128 f32, k=1024."""
+    """BASELINE config[1]: k-means EM iterations/sec, 100k×128 f32, k=1024.
+
+    Reports the FUSED single-pass EM iteration by default (PR 2:
+    fused_em_step — one HBM read of x per iteration, M-step partials in the
+    E-step scan's carry); ``RAFT_TPU_FUSED_EM=0`` reproduces the pre-PR
+    two-pass loop (separate E-step labels pass + M-step re-read) for the
+    A/B — the row carries a "fused" field saying which ran.
+    """
     import jax
 
-    from raft_tpu.cluster import min_cluster_and_distance, update_centroids
+    from raft_tpu.cluster import (centroids_from_sums, fused_em_enabled,
+                                  fused_em_step, min_cluster_and_distance,
+                                  update_centroids)
 
     rng = np.random.default_rng(0)
     x = jax.device_put(rng.random((100_000, 128), dtype=np.float32))
     c = jax.device_put(rng.random((1024, 128), dtype=np.float32))
+    fused = fused_em_enabled()
 
-    @jax.jit
-    def em_iter(c):
-        nn = min_cluster_and_distance(x, c)
-        new, _ = update_centroids(x, nn.key, 1024, old_centroids=c)
-        return new
+    if fused:
+        @jax.jit
+        def em_iter(c):
+            p = fused_em_step(x, c)
+            return centroids_from_sums(p.sums, p.weights, c, x.dtype)
+    else:
+        @jax.jit
+        def em_iter(c):
+            nn = min_cluster_and_distance(x, c)
+            new, _ = update_centroids(x, nn.key, 1024, old_centroids=c)
+            return new
 
     # Chained (data-dependent) iterations: repeated identical dispatches can
     # be elided/cached by the runtime and under-/over-count.
@@ -82,6 +98,7 @@ def bench_kmeans():
         "value": round(ips, 2),
         "unit": "iter/s",
         "vs_baseline": round(ips / A100_BASELINE_KMEANS_ITERS, 3),
+        "fused": fused,
     }
 
 

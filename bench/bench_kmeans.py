@@ -43,6 +43,24 @@ def bench_em_iter():
     return (lambda: em(c)), {"flops": 2 * 2 * _N * _K * _D}
 
 
+@case("kmeans/em_iter_fused")
+def bench_em_iter_fused():
+    """PR 2 single-pass EM step (fused_em_step): one read of x per
+    iteration vs kmeans/em_iter's two passes — the config[1] A/B."""
+    import jax
+
+    from raft_tpu.cluster import centroids_from_sums, fused_em_step
+
+    x, c, _ = _data()
+
+    @jax.jit
+    def em(c):
+        p = fused_em_step(x, c)
+        return centroids_from_sums(p.sums, p.weights, c, x.dtype)
+
+    return (lambda: em(c)), {"flops": 2 * 2 * _N * _K * _D}
+
+
 @case("kmeans/estep")
 def bench_estep():
     from raft_tpu.cluster import min_cluster_and_distance

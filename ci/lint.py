@@ -8,6 +8,12 @@ high-signal subset with stdlib ast/tokenize:
   * lines over 100 columns
   * bare `except:` clauses
   * f-strings with no placeholders
+  * raw ``jax.ops.segment_sum`` anywhere in raft_tpu/ outside
+    linalg/reduce.py — keyed reductions must go through the
+    reduce_rows_by_key / reduce_cols_by_key engine (which picks the MXU
+    one-hot path when profitable) or reduce.segment_sum; the ivf_pq
+    codebook M-step silently missing the one-hot path (PR 2) is exactly
+    the regression class this catches
 
 Exit code 1 on any finding.  Run: ``python ci/lint.py [paths...]``.
 """
@@ -39,6 +45,22 @@ def check_file(path: pathlib.Path):
         tree = ast.parse(src)
     except SyntaxError as e:
         return [(e.lineno or 0, f"syntax error: {e.msg}")]
+
+    # raw scatter segment-sums are quarantined in linalg/reduce.py (its
+    # wrapper + the one-hot engine are the blessed routes) — library code
+    # only; bench/ keeps raw calls for the engine A/B microbenches
+    posix = path.as_posix()
+    if "raft_tpu/" in posix and not posix.endswith("linalg/reduce.py"):
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Attribute)
+                    and node.attr == "segment_sum"
+                    and isinstance(node.value, ast.Attribute)
+                    and node.value.attr == "ops"
+                    and "noqa" not in lines[node.lineno - 1]):
+                findings.append((node.lineno,
+                                 "raw jax.ops.segment_sum outside "
+                                 "linalg/reduce.py — use "
+                                 "raft_tpu.linalg.reduce helpers"))
 
     # format specs are themselves JoinedStr nodes — exclude them from the
     # placeholder check

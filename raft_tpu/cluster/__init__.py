@@ -3,19 +3,25 @@
 
 from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams  # noqa: F401
 from raft_tpu.cluster.kmeans import (  # noqa: F401
+    EMPartials,
     KMeans,
     KMeansOutput,
+    centroids_from_sums,
     cluster_cost,
     fit,
     fit_predict,
+    fused_em_enabled,
+    fused_em_step,
     init_plus_plus,
     init_random,
     kmeans_plus_plus,
     min_cluster_and_distance,
+    pack_em_partials,
     predict,
     sample_centroids,
     shuffle_and_gather,
     transform,
+    unpack_em_partials,
     update_centroids,
 )
 from raft_tpu.cluster.kmeans_balanced import (  # noqa: F401

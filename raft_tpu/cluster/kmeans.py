@@ -6,11 +6,15 @@ with impls mirroring cluster/detail/kmeans.cuh (init via scalable k-means||
 cluster/detail/kmeans_common.cuh (``minClusterAndDistanceCompute`` :341,
 ``sampleCentroids`` :213, ``shuffleAndGather`` :307).
 
-TPU-first: the E-step rides :func:`raft_tpu.distance.fused_l2_nn` (MXU tile +
-fused argmin, batched over ``batch_samples`` row blocks); the M-step is a
-segment-sum (reduce_rows_by_key); the EM loop is a ``lax.while_loop`` so the
-whole fit is ONE XLA program with no per-iteration host sync (the reference
-syncs inertia to host every iteration — reference kmeans.cuh:470-505).
+TPU-first: each EM iteration of the fit loop is ONE fused pass over x
+(:func:`fused_em_step` — the E-step's fused-L2-NN argmin and the M-step's
+MXU one-hot partials accumulate in the same ``lax.scan`` carry, so x is
+read from HBM once per iteration and no (n,) label array materializes;
+``RAFT_TPU_FUSED_EM=0`` restores the two-pass E/M split, and the unfused
+:func:`min_cluster_and_distance` remains the predict/final-labels path);
+the EM loop is a ``lax.while_loop`` so the whole fit is ONE XLA program
+with no per-iteration host sync (the reference syncs inertia to host every
+iteration — reference kmeans.cuh:470-505).  Design note: docs/fused_em.md.
 """
 
 from __future__ import annotations
@@ -57,6 +61,17 @@ def min_cluster_and_distance(x, centroids, metric: DistanceType = DistanceType.L
     the variable between calls takes effect (an ``engine=None`` cache key
     would silently keep the first-compiled engine).
     """
+    engine = _resolve_engine(engine, metric)
+    return _min_cluster_and_distance(x, centroids, metric=metric,
+                                     batch_samples=batch_samples,
+                                     batch_centroids=batch_centroids,
+                                     precision=precision, engine=engine)
+
+
+def _resolve_engine(engine: Optional[str], metric: DistanceType) -> str:
+    """Resolve/validate the E-step engine knob (shared by the unfused
+    :func:`min_cluster_and_distance` and :func:`fused_em_step`) — env
+    defaults resolved OUTSIDE any jit cache, see the caller docstrings."""
     if engine is None:
         from raft_tpu.distance import pallas_fused_l2nn
 
@@ -80,10 +95,7 @@ def min_cluster_and_distance(x, centroids, metric: DistanceType = DistanceType.L
                 "engine='pallas' is an experimental scaffold on TPU: the "
                 "kernel failed to compile on the real device (BENCH_TPU.md "
                 "r4b). Set RAFT_TPU_PALLAS_EXPERIMENTAL=1 to probe it.")
-    return _min_cluster_and_distance(x, centroids, metric=metric,
-                                     batch_samples=batch_samples,
-                                     batch_centroids=batch_centroids,
-                                     precision=precision, engine=engine)
+    return engine
 
 
 # k-means E-steps default to "high" (bf16x3) matmul precision: measured ~2x
@@ -161,68 +173,283 @@ def update_centroids(x, labels, n_clusters: int, sample_weights=None,
     """
     x = jnp.asarray(x)
     labels = jnp.asarray(labels)
-    if sample_weights is None:
-        sample_weights = jnp.ones((x.shape[0],), x.dtype)
+    # sample_weights=None stays None: the unweighted engine path skips the
+    # weight multiplies (wsum is then the plain member count, as before)
     sums, wsum = _weighted_cluster_sums(x, labels, sample_weights, n_clusters)
-    # means computed in the accumulation dtype, stored back in the data
-    # dtype (the public contract: centroids share the dataset's dtype)
-    new = (sums / jnp.maximum(wsum, 1e-30)[:, None]).astype(x.dtype)
+    return centroids_from_sums(sums, wsum, old_centroids, x.dtype), wsum
+
+
+def centroids_from_sums(sums, wsum, old_centroids, dtype):
+    """Weighted means from M-step partials, with the empty-cluster
+    keep-previous-centroid fallback (reference update_centroids epilogue).
+    Shared by the two-pass M-step, the fused EM fit loops, and the MNMG
+    post-allreduce update.  Means are computed in the accumulation dtype
+    and stored back in *dtype* (the public contract: centroids share the
+    dataset's dtype)."""
+    new = (sums / jnp.maximum(wsum, 1e-30)[:, None]).astype(dtype)
     if old_centroids is not None:
         new = jnp.where(wsum[:, None] > 0, new, old_centroids)
-    return new, wsum
+    return new
 
 
 _SUM_CHUNK = 8192
 
 
-def _weighted_cluster_sums(x, labels, w, n_clusters: int):
-    """Per-cluster weighted sums + weights (reduce_rows_by_key's role).
+def _mstep_tile_partials(xb, labels, w, n_clusters: int, one_hot: bool,
+                         acc_t):
+    """(Σ w·x, Σ w) of ONE row tile keyed by *labels* — the M-step partial
+    shared by the chunked two-pass M-step and the fused EM scan epilogue.
 
-    TPUs have no fast scatter-add, so for moderate k the segment-sum is
-    recast as a chunked one-hot matmul riding the MXU (measured ~5× over
-    the scatter lowering on v5e at 100k×128, k=1024; bench/bench_kmeans.py
-    ``mstep`` entry reproduces); large k falls back to segment_sum where the
-    one-hot would dominate memory.  CPU has no MXU and a fine scatter-add,
-    so it always takes the segment-sum path (measured ~4× over one-hot at
-    the same config on the CI host).
+    Engine per ``linalg.reduce.use_one_hot_engine``: dense one-hot matmul
+    on the MXU (half-width inputs, f32 accumulation via
+    ``preferred_element_type``) or a scatter segment-sum (CPU / huge k).
+    *labels* may use the value ``n_clusters`` as a discard slot for padding
+    rows (zero one-hot row; dropped by the scatter).  *w* may be None
+    (unweighted: skips the weight multiply — on the scatter engine that
+    saves materializing a weighted copy of the tile)."""
+    from raft_tpu.linalg.reduce import one_hot_by_key, segment_sum
+
+    if one_hot:
+        oh = one_hot_by_key(labels, n_clusters, xb.dtype, w)
+        return (jnp.matmul(oh.T, xb, preferred_element_type=acc_t),
+                jnp.sum(oh.astype(acc_t), axis=0))
+    if w is None:
+        return (segment_sum(xb.astype(acc_t), labels, n_clusters),
+                segment_sum(jnp.ones(xb.shape[:1], acc_t), labels,
+                            n_clusters))
+    return (segment_sum(xb.astype(acc_t) * w.astype(acc_t)[:, None],
+                        labels, n_clusters),
+            segment_sum(w.astype(acc_t), labels, n_clusters))
+
+
+def _weighted_cluster_sums(x, labels, w, n_clusters: int):
+    """Per-cluster weighted sums + weights (reduce_rows_by_key's role),
+    chunked so the one-hot never exceeds (_SUM_CHUNK, k).
+
+    Engine selection lives in ``linalg.reduce.use_one_hot_engine`` (the
+    repo-wide backend/k heuristic); per-tile partials in
+    :func:`_mstep_tile_partials`.
     """
     from raft_tpu.distance.pairwise import accum_dtype
+    from raft_tpu.linalg.reduce import use_one_hot_engine
 
     n, d = x.shape
     # Per-cluster sums over thousands of rows must accumulate in f32 for
     # half-precision data (accum_dtype policy); the one-hot matmul keeps
     # half-width MXU inputs via preferred_element_type.
     acc_t = accum_dtype(x.dtype)
-    if jax.default_backend() == "cpu" or n_clusters > 4096 or n < _SUM_CHUNK:
-        wx = x.astype(acc_t) * w.astype(acc_t)[:, None]
-        sums = jax.ops.segment_sum(wx, labels, num_segments=n_clusters)
-        wsum = jax.ops.segment_sum(w.astype(acc_t), labels,
-                                   num_segments=n_clusters)
-        return sums, wsum
+    one_hot = use_one_hot_engine(n_clusters)
+    if not one_hot or n <= _SUM_CHUNK:
+        return _mstep_tile_partials(x, labels, w, n_clusters, one_hot, acc_t)
     nc = n // _SUM_CHUNK
     split = nc * _SUM_CHUNK
 
     def step(carry, args):
         s, ws = carry
         xc, lc, wc = args
-        oh = (lc[:, None] == jnp.arange(n_clusters, dtype=lc.dtype)
-              ).astype(x.dtype) * wc[:, None]
-        return (s + jnp.matmul(oh.T, xc, preferred_element_type=acc_t),
-                ws + jnp.sum(oh.astype(acc_t), axis=0)), None
+        ds, dw = _mstep_tile_partials(xc, lc, wc, n_clusters, True, acc_t)
+        return (s + ds, ws + dw), None
 
     init = (jnp.zeros((n_clusters, d), acc_t),
             jnp.zeros((n_clusters,), acc_t))
     (sums, wsum), _ = jax.lax.scan(
         step, init, (x[:split].reshape(nc, _SUM_CHUNK, d),
                      labels[:split].reshape(nc, _SUM_CHUNK),
-                     w[:split].reshape(nc, _SUM_CHUNK)))
+                     None if w is None else w[:split].reshape(nc, _SUM_CHUNK)))
     if split < n:
-        oh = (labels[split:, None] == jnp.arange(n_clusters, dtype=labels.dtype)
-              ).astype(x.dtype) * w[split:, None]
-        sums = sums + jnp.matmul(oh.T, x[split:],
-                                 preferred_element_type=acc_t)
-        wsum = wsum + jnp.sum(oh.astype(acc_t), axis=0)
+        ds, dw = _mstep_tile_partials(x[split:], labels[split:],
+                                      None if w is None else w[split:],
+                                      n_clusters, True, acc_t)
+        sums, wsum = sums + ds, wsum + dw
     return sums, wsum
+
+
+# ---------------------------------------------------------------------------
+# fused EM step: ONE pass over x per iteration (tentpole of PR 2)
+# ---------------------------------------------------------------------------
+
+def fused_em_enabled() -> bool:
+    """RAFT_TPU_FUSED_EM env gate (default ON).  ``RAFT_TPU_FUSED_EM=0``
+    reproduces the pre-PR two-pass EM loop (E-step labels pass + separate
+    M-step re-read of x) — the A/B the bench kmeans metric reports against.
+    Resolved at call time, OUTSIDE the jit caches (same rationale as the
+    pallas engine gate in :func:`min_cluster_and_distance`)."""
+    import os
+
+    return os.environ.get("RAFT_TPU_FUSED_EM", "1") != "0"
+
+
+class EMPartials(NamedTuple):
+    """Per-iteration EM accumulators: exactly the k·d + k + 1 numbers the
+    M-step and convergence bookkeeping need (the MNMG packed-allreduce
+    payload — see :func:`pack_em_partials`)."""
+
+    sums: jnp.ndarray     # (k, d) Σ w·x per cluster, accumulation dtype
+    weights: jnp.ndarray  # (k,)   Σ w per cluster
+    inertia: jnp.ndarray  # ()     Σ w·min_dist² (this iteration's cost)
+    labels: Optional[jnp.ndarray] = None     # (n,) only when requested
+    distances: Optional[jnp.ndarray] = None  # (n,) only when requested
+
+
+def pack_em_partials(p: EMPartials) -> jnp.ndarray:
+    """Flatten (sums, weights, inertia) into ONE (k·d + k + 1,) vector —
+    the MNMG wire format: one fused allreduce per EM iteration instead of
+    three (sums / counts / inertia) collective launches."""
+    return jnp.concatenate([p.sums.reshape(-1), p.weights,
+                            p.inertia.reshape(1)])
+
+
+def unpack_em_partials(packed, n_clusters: int, dim: int) -> EMPartials:
+    """Inverse of :func:`pack_em_partials` (labels never ride the wire)."""
+    kd = n_clusters * dim
+    return EMPartials(sums=packed[:kd].reshape(n_clusters, dim),
+                      weights=packed[kd:kd + n_clusters],
+                      inertia=packed[kd + n_clusters])
+
+
+def _fused_em_scan(x, centroids, weights, metric: DistanceType,
+                   batch_samples: int, batch_centroids: int, precision: str,
+                   engine: str, return_labels: bool) -> EMPartials:
+    """ONE ``lax.scan`` over row tiles of x whose carry accumulates the
+    fused-L2-NN argmin AND the M-step partials — x is read from HBM exactly
+    once per EM iteration, and the one-hot contraction consumes each tile's
+    argmin while the tile is still live in cache/VMEM (the two-pass loop
+    re-read all of x to rebuild the one-hot from cold labels).
+
+    Trace-level (callers jit); carry layout ((k, d) sums, (k,) weights,
+    () inertia) in the accumulation dtype.  Per-tile E-step: the
+    deferred-row-norm tile hook :func:`raft_tpu.distance.fused_l2_nn.
+    l2_nn_tile` for the L2 family, a hoisted-stats
+    ``distance_with_stats`` + argmin for every other metric.  Per-tile
+    M-step: :func:`_mstep_tile_partials` (one-hot MXU matmul / scatter per
+    the linalg engine heuristic).  ``engine="pallas"`` composes instead of
+    forking: the experimental Pallas kernel produces the labels whole-array
+    and the partials run chunked over them (not single-pass — it is a
+    scaffold, see min_cluster_and_distance).
+
+    Padding rows of the ragged final tile are discarded by weight-0
+    (weighted) or by the ``n_clusters`` discard label + masked distance
+    (unweighted), so they touch neither the sums nor the inertia.
+    """
+    from raft_tpu.distance.fused_l2_nn import l2_nn_blocks, l2_nn_tile
+    from raft_tpu.distance.pairwise import (_row_norms, accum_dtype,
+                                            distance_with_stats,
+                                            metric_stats)
+    from raft_tpu.linalg.reduce import use_one_hot_engine
+
+    m, dim = x.shape
+    k = centroids.shape[0]
+    acc_t = accum_dtype(x.dtype)
+    if engine == "pallas":
+        from raft_tpu.distance import pallas_fused_l2nn
+
+        val, idx = pallas_fused_l2nn.fused_l2_nn_pallas(
+            x, centroids, bf16_dot=(precision == "default"),
+            interpret=pallas_fused_l2nn.interpret_requested())
+        val = val.astype(acc_t)
+        sums, wsum = _weighted_cluster_sums(x, idx, weights, k)
+        inertia = jnp.sum(val if weights is None else val * weights)
+        return EMPartials(sums, wsum, inertia,
+                          idx if return_labels else None,
+                          val if return_labels else None)
+    backend = jax.default_backend()
+    one_hot = use_one_hot_engine(k)
+    # CPU: the index-carrying argmin reduce wants the two-stage window form
+    # (fused_l2_nn._block_argmin), and small tiles pay scan-step + scatter
+    # re-init overhead — grow the row tile (bounded so the (bs, k) distance
+    # tile stays ≤ 128 MB).  TPU keeps the VMEM-tuned batch_samples.
+    window = 32 if backend == "cpu" else 0
+    bs = batch_samples
+    if backend == "cpu":
+        bs = max(bs, min(1 << 14, (1 << 25) // max(k, 1)))
+    bs = min(bs, m)
+    nb = -(-m // bs)
+    pad = nb * bs - m
+    xp = x if pad == 0 else jnp.pad(x, ((0, pad), (0, 0)))
+    wp = None if weights is None else (
+        weights if pad == 0 else jnp.pad(weights, (0, pad)))
+    bases = (jnp.arange(nb) * bs).astype(jnp.int32)
+    if metric in _L2_METRICS:
+        y_blocks, yn_blocks, ybases = l2_nn_blocks(
+            centroids, _row_norms(centroids), min(batch_centroids, k),
+            align=max(window, 1))
+        y_stats = None
+    else:
+        y_stats = metric_stats(centroids, metric)
+    iota = jnp.arange(bs, dtype=jnp.int32)
+
+    def step(carry, args):
+        sums, wsum, inertia = carry
+        xb, wb, base = args
+        if metric in _L2_METRICS:
+            val, idx = l2_nn_tile(xb, y_blocks, yn_blocks, ybases,
+                                  precision, window)
+        else:
+            d = distance_with_stats(xb, centroids, metric, 2.0,
+                                    metric_stats(xb, metric), y_stats)
+            idx = jnp.argmin(d, axis=1).astype(jnp.int32)
+            val = jnp.take_along_axis(d, idx[:, None], axis=1)[:, 0]
+            val = val.astype(acc_t)
+        ys = (idx, val) if return_labels else None
+        if wb is None and pad:
+            # unweighted ragged tail: discard-slot label + zeroed distance
+            valid = base + iota < m
+            idx = jnp.where(valid, idx, k)
+            val = jnp.where(valid, val, 0.0)
+        ds, dw = _mstep_tile_partials(xb, idx, wb, k, one_hot, acc_t)
+        dcost = jnp.sum(val) if wb is None else jnp.sum(val * wb)
+        return (sums + ds, wsum + dw, inertia + dcost), ys
+
+    init = (jnp.zeros((k, dim), acc_t), jnp.zeros((k,), acc_t),
+            jnp.zeros((), acc_t))
+    (sums, wsum, inertia), ys = jax.lax.scan(
+        step, init, (xp.reshape(nb, bs, dim),
+                     None if wp is None else wp.reshape(nb, bs), bases))
+    labels = dists = None
+    if return_labels:
+        labels = ys[0].reshape(-1)[:m]
+        dists = ys[1].reshape(-1)[:m]
+    return EMPartials(sums, wsum, inertia, labels, dists)
+
+
+@functools.partial(jax.jit, static_argnames=("metric", "batch_samples",
+                                             "batch_centroids", "precision",
+                                             "engine", "return_labels"))
+def _fused_em_step(x, centroids, weights, metric: DistanceType,
+                   batch_samples: int, batch_centroids: int, precision: str,
+                   engine: str, return_labels: bool) -> EMPartials:
+    return _fused_em_scan(x, centroids, weights, metric, batch_samples,
+                          batch_centroids, precision, engine, return_labels)
+
+
+def fused_em_step(x, centroids, sample_weights=None,
+                  metric: DistanceType = DistanceType.L2Expanded,
+                  batch_samples: int = 2048, batch_centroids: int = 1024,
+                  precision: str = "high", engine: Optional[str] = None,
+                  return_labels: bool = False) -> EMPartials:
+    """One EM iteration's accumulators in a single pass over x.
+
+    Returns :class:`EMPartials`; combine with :func:`centroids_from_sums`
+    for the M-step means (``fit`` does exactly that inside its loop), or
+    :func:`pack_em_partials` for the MNMG single-allreduce payload.  Same
+    ``engine``/``precision`` knobs as :func:`min_cluster_and_distance`
+    (env defaults resolved here, outside the jit cache).
+    ``return_labels=True`` additionally emits the per-row (label, distance)
+    pair from the same pass — for consumers like the balancing EM that
+    need them anyway (no second read of x).
+
+    On the CPU backend ``batch_samples`` is a LOWER bound: row tiles are
+    grown to ≥16k rows (capped so the (rows, k) distance tile stays
+    ≤ 128 MB) because small tiles pay scan-step + scatter re-init overhead
+    there (see :func:`_fused_em_scan`).  TPU honors the knob exactly (it
+    is VMEM-tuned).
+    """
+    x = jnp.asarray(x)
+    centroids = jnp.asarray(centroids)
+    engine = _resolve_engine(engine, metric)
+    return _fused_em_step(x, centroids, sample_weights, metric,
+                          batch_samples, batch_centroids, precision, engine,
+                          return_labels)
 
 
 def cluster_cost(min_distances, sample_weights=None):
@@ -359,28 +586,47 @@ class KMeansOutput(NamedTuple):
     labels: Optional[jnp.ndarray] = None
 
 
+def _em_body(x, centroids, weights, metric: DistanceType, batch_samples: int,
+             batch_centroids: int, fused: bool, engine: str, acc):
+    """One EM iteration → (new_centroids, inertia, delta²) — shared by the
+    while/fori fit loops.  ``fused``: single-pass :func:`_fused_em_scan`
+    (x read once; the (n,) label array never materializes); otherwise the
+    pre-PR two-pass E-step + M-step re-read (``RAFT_TPU_FUSED_EM=0``)."""
+    k = centroids.shape[0]
+    if fused:
+        p = _fused_em_scan(x, centroids, weights, metric, batch_samples,
+                           batch_centroids, "high", engine, False)
+        new = centroids_from_sums(p.sums, p.weights, centroids, x.dtype)
+        inertia = p.inertia
+    else:
+        nn = min_cluster_and_distance(x, centroids, metric, batch_samples,
+                                      batch_centroids)
+        new, _ = update_centroids(x, nn.key, k, weights, centroids)
+        inertia = cluster_cost(nn, weights)
+    delta = jnp.sum((new.astype(acc) - centroids.astype(acc)) ** 2)
+    return new, inertia, delta
+
+
 # Jitted as a whole (tol included in the statics: it only appears in the
 # while_loop cond, and a handful of distinct tols per process is cheaper
 # than threading it as a traced operand).  Statics match the reference's
 # compile-time template parameters.
 @functools.partial(jax.jit, static_argnames=("metric", "max_iter", "tol",
                                              "batch_samples",
-                                             "batch_centroids"))
+                                             "batch_centroids", "fused",
+                                             "engine"))
 def _fit_main(x, centroids0, weights, metric: DistanceType, max_iter: int,
-              tol: float, batch_samples: int, batch_centroids: int):
-    k = centroids0.shape[0]
-
+              tol: float, batch_samples: int, batch_centroids: int,
+              fused: bool = False, engine: str = "xla"):
     def cond(state):
         it, _, _, delta = state
         return (it < max_iter) & (delta > tol * tol)
 
     def body(state):
         it, centroids, _, _ = state
-        nn = min_cluster_and_distance(x, centroids, metric, batch_samples,
-                                      batch_centroids)
-        new, _ = update_centroids(x, nn.key, k, weights, centroids)
-        delta = jnp.sum((new.astype(acc) - centroids.astype(acc)) ** 2)
-        inertia = cluster_cost(nn, weights)
+        new, inertia, delta = _em_body(x, centroids, weights, metric,
+                                       batch_samples, batch_centroids,
+                                       fused, engine, acc)
         return it + 1, new, inertia, delta
 
     # inertia carries the E-step value dtype: f32 for half-precision data
@@ -401,10 +647,12 @@ def _fit_main(x, centroids0, weights, metric: DistanceType, max_iter: int,
 
 @functools.partial(jax.jit, static_argnames=("metric", "max_iter", "tol",
                                              "batch_samples",
-                                             "batch_centroids"))
+                                             "batch_centroids", "fused",
+                                             "engine"))
 def _fit_main_fori(x, centroids0, weights, metric: DistanceType,
                    max_iter: int, tol: float, batch_samples: int,
-                   batch_centroids: int):
+                   batch_centroids: int, fused: bool = False,
+                   engine: str = "xla"):
     """while_loop-free `_fit_main`: a STATIC-trip fori_loop over max_iter
     with post-convergence updates masked out — identical semantics (same
     EM math, same recorded n_iter stopping point) at the cost of always
@@ -416,19 +664,19 @@ def _fit_main_fori(x, centroids0, weights, metric: DistanceType,
     ``while`` cond as the one structural suspect a TPU runtime cannot
     pipeline past; the measurement session A/Bs both forms on-chip
     (kmeans_fit stage) so config[1]'s fix candidate ships with its
-    measurement.  Select via ``fit(..., loop="fori")``.
+    measurement.  Select via ``fit(..., loop="fori")``.  Takes the same
+    ``fused`` single-pass EM body as the while form (both loop forms
+    ship it — the live A/B session compares them).
     """
     from raft_tpu.distance.pairwise import accum_dtype
 
-    k = centroids0.shape[0]
     acc = accum_dtype(x.dtype)
 
     def body(_, state):
         n_iter, centroids, live = state
-        nn = min_cluster_and_distance(x, centroids, metric, batch_samples,
-                                      batch_centroids)
-        new, _ = update_centroids(x, nn.key, k, weights, centroids)
-        delta = jnp.sum((new.astype(acc) - centroids.astype(acc)) ** 2)
+        new, _, delta = _em_body(x, centroids, weights, metric,
+                                 batch_samples, batch_centroids, fused,
+                                 engine, acc)
         centroids = jnp.where(live, new, centroids)
         n_iter = n_iter + live.astype(n_iter.dtype)
         live = live & (delta > tol * tol)
@@ -450,7 +698,8 @@ def _resolve_batches(params: KMeansParams):
 @traced("raft_tpu.cluster.kmeans.fit")
 @auto_sync_handle
 def fit(params: KMeansParams, x, sample_weights=None, centroids=None,
-        handle=None, loop: str = "while") -> KMeansOutput:
+        handle=None, loop: str = "while",
+        fused: Optional[bool] = None) -> KMeansOutput:
     """Full k-means fit (reference cluster/kmeans.cuh:85 ``fit``):
     init (++/random/user array) → EM to convergence; best of n_init runs.
 
@@ -458,13 +707,20 @@ def fit(params: KMeansParams, x, sample_weights=None, centroids=None,
     convention, handle_t first arg); outputs are recorded on its stream.
     *loop*: ``"while"`` (default — EM in a ``lax.while_loop``) or
     ``"fori"`` (static-trip masked-update variant, see
-    :func:`_fit_main_fori`)."""
+    :func:`_fit_main_fori`).
+    *fused*: single-pass EM iterations (:func:`fused_em_step` — one HBM
+    read of x per iteration); ``None`` consults :func:`fused_em_enabled`
+    (RAFT_TPU_FUSED_EM, default on), ``False`` forces the pre-PR two-pass
+    loop."""
     expects(loop in ("while", "fori"), f"unknown loop mode {loop!r}")
     x = jnp.asarray(x)
     expects(x.ndim == 2, "x must be [n_samples, n_features]")
     expects(params.n_clusters <= x.shape[0], "n_clusters must be <= n_samples")
+    if fused is None:
+        fused = fused_em_enabled()
+    engine = _resolve_engine(None, params.metric)
     if sample_weights is None:
-        weights = jnp.ones((x.shape[0],), x.dtype)
+        weights = None  # unweighted engine fast path (≡ all-ones weights)
     else:
         # normalize to sum to n_samples (reference detail/kmeans.cuh fit)
         w = jnp.asarray(sample_weights, x.dtype)
@@ -486,7 +742,8 @@ def fit(params: KMeansParams, x, sample_weights=None, centroids=None,
                                 metric=params.metric)
         fit_prog = _fit_main_fori if loop == "fori" else _fit_main
         c, inertia, n_iter = fit_prog(x, c0, weights, params.metric,
-                                      params.max_iter, params.tol, bs, bc)
+                                      params.max_iter, params.tol, bs, bc,
+                                      fused=fused, engine=engine)
         if best is None or float(inertia) < float(best.inertia):
             best = KMeansOutput(c, inertia, n_iter)
     return best

@@ -64,18 +64,36 @@ def adjust_centers(centers, counts, x, labels, distances, threshold: float = _AD
 
 
 @functools.partial(jax.jit, static_argnames=("n_clusters", "n_iters", "metric",
-                                             "adjust_every"))
+                                             "adjust_every", "fused",
+                                             "engine"))
 def _em_program(x, centers0, n_clusters: int, n_iters: int,
-                metric: DistanceType, adjust_every: int):
-    """The full balancing-EM loop as one compiled program (one dispatch)."""
+                metric: DistanceType, adjust_every: int,
+                fused: bool = False, engine: str = "xla"):
+    """The full balancing-EM loop as one compiled program (one dispatch).
+
+    ``fused``: each iteration is ONE pass over x (kmeans._fused_em_scan) —
+    the M-step partials accumulate in the E-step scan's carry, and the
+    (labels, distances) that ``adjust_centers`` consumes ride out of the
+    same pass as scan outputs (the two-pass form re-read all of x to
+    rebuild them)."""
+    from raft_tpu.cluster.kmeans import _fused_em_scan, centroids_from_sums
 
     def body(it, centers):
-        nn = min_cluster_and_distance(x, centers, metric)
-        centers, counts = update_centroids(x, nn.key, n_clusters,
+        if fused:
+            p = _fused_em_scan(x, centers, None, metric, 2048, 1024,
+                               "high", engine, bool(adjust_every))
+            counts = p.weights
+            new = centroids_from_sums(p.sums, counts, centers, x.dtype)
+            labels, dists = p.labels, p.distances
+        else:
+            nn = min_cluster_and_distance(x, centers, metric)
+            labels, dists = nn.key, nn.value
+            new, counts = update_centroids(x, labels, n_clusters,
                                            old_centroids=centers)
+        centers = new
         if adjust_every:
             def do_adjust(c):
-                c2, _ = adjust_centers(c, counts, x, nn.key, nn.value)
+                c2, _ = adjust_centers(c, counts, x, labels, dists)
                 return c2
 
             centers = jax.lax.cond(it % adjust_every == adjust_every - 1,
@@ -91,6 +109,7 @@ def build_clusters(rng: RngState, x, n_clusters: int, n_iters: int = 20,
     """Train ``n_clusters`` balanced centers on x (reference
     ann_kmeans_balanced.cuh:626 ``build_clusters`` + :699
     ``balancing_em_iters``)."""
+    from raft_tpu.cluster.kmeans import _resolve_engine, fused_em_enabled
     from raft_tpu.random.rng import sample_without_replacement
 
     x = jnp.asarray(x)
@@ -99,7 +118,9 @@ def build_clusters(rng: RngState, x, n_clusters: int, n_iters: int = 20,
     if centers.shape[0] < n_clusters:  # tiny inputs: repeat rows
         reps = -(-n_clusters // centers.shape[0])
         centers = jnp.tile(centers, (reps, 1))[:n_clusters]
-    return _em_program(x, centers, n_clusters, n_iters, metric, adjust_every)
+    return _em_program(x, centers, n_clusters, n_iters, metric, adjust_every,
+                       fused=fused_em_enabled(),
+                       engine=_resolve_engine(None, metric))
 
 
 @functools.partial(jax.jit, static_argnames=("n_iters", "adjust_every"))
@@ -216,5 +237,8 @@ def build_hierarchical(rng: RngState, x, n_clusters: int, n_iters: int = 20,
         [fine[b, :quota[m]] for b, m in enumerate(live)])[:n_clusters])
 
     # global balancing passes over the full dataset — one compiled program
+    from raft_tpu.cluster.kmeans import _resolve_engine, fused_em_enabled
+
     return _em_program(x, centers, n_clusters, max(2, n_iters // 4), metric,
-                       adjust_every=1)
+                       adjust_every=1, fused=fused_em_enabled(),
+                       engine=_resolve_engine(None, metric))

@@ -8,10 +8,13 @@ block exposed as ``pylibraft.cluster.kmeans.compute_new_centroids``
 cpp/src/distance/update_centroids.cuh).
 
 Here the same pattern over a mesh: rows sharded along the comms axis,
-E-step per shard (fused L2 NN), psum-allreduce of sums/counts over ICI,
+single-pass fused E+M partials per shard (kmeans._fused_em_scan — one HBM
+read of the shard per iteration), then ONE psum-allreduce of the packed
+(k·d + k + 1) carry over ICI (kmeans.pack_em_partials wire format;
+``RAFT_TPU_FUSED_EM=0`` restores the pre-PR sums/counts/inertia triple),
 identical M-step on every rank.  The full fit is one jitted shard_map
 program with the EM loop inside a ``lax.while_loop`` — zero host round
-trips per iteration.
+trips per iteration.  Design note: docs/fused_em.md.
 """
 
 from __future__ import annotations
@@ -33,25 +36,54 @@ from raft_tpu.distance.distance_types import DistanceType
 
 def compute_new_centroids(x_shard, centroids, comms: Comms,
                           sample_weights=None, metric=DistanceType.L2Expanded,
-                          batch_samples: int = 2048, batch_centroids: int = 1024):
+                          batch_samples: int = 2048, batch_centroids: int = 1024,
+                          fused=None, engine=None):
     """One distributed E+M step on this rank's shard — the MNMG-composable
     building block (pylibraft ``compute_new_centroids``).
 
     Must run inside the comms' shard_map context.  *comms* may be a Comms
     or a Handle with comms injected.  Returns
-    (new_centroids, weight_per_cluster, local_inertia_sum).
+    (new_centroids, weight_per_cluster, global_inertia_sum).
+
+    *fused* (None → :func:`raft_tpu.cluster.kmeans.fused_em_enabled`):
+    the shard's E+M partials come from the single-pass fused EM scan (one
+    HBM read of the shard) and the per-iteration collective collapses from
+    three allreduces (sums / counts / inertia) into ONE fused allreduce of
+    the packed (k·d + k + 1) carry — see kmeans.pack_em_partials for the
+    wire format.  ``fused=False`` keeps the pre-PR three-collective shape.
+    *engine* takes the same values as :func:`kmeans.min_cluster_and_distance`.
+
+    CAUTION: the ``fused=None``/``engine=None`` env defaults are resolved
+    when this body is TRACED.  Inside a cached ``comms.run`` step closure
+    the first-trace value sticks (``comms.run``'s jit cache is keyed on
+    callable identity) — flipping ``RAFT_TPU_FUSED_EM`` between runs of
+    the same closure will NOT retrace.  Pass ``fused``/``engine``
+    explicitly (as :func:`fit` does, resolving them outside its program
+    cache) when A/B-ing the two forms.
     """
     comms = as_comms(comms)
-    from raft_tpu.cluster.kmeans import _weighted_cluster_sums
+    from raft_tpu.cluster import kmeans as _km
 
     k = centroids.shape[0]
+    if fused is None:
+        fused = _km.fused_em_enabled()
+    if fused:
+        engine = _km._resolve_engine(engine, metric)
+        p = _km._fused_em_scan(x_shard, centroids, sample_weights, metric,
+                               batch_samples, batch_centroids, "high",
+                               engine, False)
+        packed = comms.allreduce(_km.pack_em_partials(p), ReduceOp.SUM)
+        p = _km.unpack_em_partials(packed, k, x_shard.shape[1])
+        new = _km.centroids_from_sums(p.sums, p.weights, centroids,
+                                      centroids.dtype)
+        return new, p.weights, p.inertia
     nn = min_cluster_and_distance(x_shard, centroids, metric, batch_samples,
                                   batch_centroids)
     w = sample_weights if sample_weights is not None else jnp.ones_like(nn.value)
     # Same chunked one-hot MXU contraction as the single-device M-step
     # (kmeans._weighted_cluster_sums) — the scatter segment-sum lowering it
     # replaces was measured ~5× slower on v5e (see that docstring).
-    sums, wsum = _weighted_cluster_sums(x_shard, nn.key, w, k)
+    sums, wsum = _km._weighted_cluster_sums(x_shard, nn.key, w, k)
     inertia = jnp.sum(nn.value * w)
     # the OPG allreduce (reference: comms.allreduce on per-cluster sums)
     sums = comms.allreduce(sums, ReduceOp.SUM)
@@ -76,7 +108,8 @@ def _cached_program(comms: Comms, key, builder):
     return progs[key]
 
 
-def _step_program(comms: Comms, metric: DistanceType, bs: int, bc: int):
+def _step_program(comms: Comms, metric: DistanceType, bs: int, bc: int,
+                  fused: bool = False, engine: str = "xla"):
     """One distributed E+M step as a cached shard_map program: returns
     (new_centroids, delta_sq, inertia) where delta_sq = ||new - old||² is
     computed on-device so the host only syncs on it at convergence-check
@@ -89,19 +122,20 @@ def _step_program(comms: Comms, metric: DistanceType, bs: int, bc: int):
         new, _, inertia = compute_new_centroids(x_shard, c, comms,
                                                 metric=metric,
                                                 batch_samples=bs,
-                                                batch_centroids=bc)
+                                                batch_centroids=bc,
+                                                fused=fused, engine=engine)
         # delta in the accumulation dtype: bf16 would drop terms below
         # sum·2⁻⁸ over k·dim addends, breaking the tol check (r4 advisor)
         acc = accum_dtype(c.dtype)
         delta = jnp.sum((new.astype(acc) - c.astype(acc)) ** 2)
         return new, delta, inertia
 
-    return _cached_program(comms, ("step", metric, bs, bc),
+    return _cached_program(comms, ("step", metric, bs, bc, fused, engine),
                            lambda: local_step)
 
 
 def _fit_program(comms: Comms, max_iter: int, tol: float, metric: DistanceType,
-                 bs: int, bc: int):
+                 bs: int, bc: int, fused: bool = False, engine: str = "xla"):
     """Build the per-shard fit body ONCE per (comms, statics).
 
     ``comms.run``'s jit cache is keyed on callable identity; a fresh closure
@@ -120,7 +154,9 @@ def _fit_program(comms: Comms, max_iter: int, tol: float, metric: DistanceType,
             new, _, inertia = compute_new_centroids(x_shard, c, comms,
                                                     metric=metric,
                                                     batch_samples=bs,
-                                                    batch_centroids=bc)
+                                                    batch_centroids=bc,
+                                                    fused=fused,
+                                                    engine=engine)
             delta = jnp.sum((new.astype(acc) - c.astype(acc)) ** 2)
             return it + 1, new, inertia, delta
 
@@ -140,12 +176,14 @@ def _fit_program(comms: Comms, max_iter: int, tol: float, metric: DistanceType,
         inertia = comms.allreduce(jnp.sum(nn.value), ReduceOp.SUM)
         return c, inertia, n_iter
 
-    return _cached_program(comms, ("fit", max_iter, tol, metric, bs, bc),
+    return _cached_program(comms, ("fit", max_iter, tol, metric, bs, bc,
+                                   fused, engine),
                            lambda: local_fit)
 
 
 def _fit_program_fori(comms: Comms, max_iter: int, tol: float,
-                      metric: DistanceType, bs: int, bc: int):
+                      metric: DistanceType, bs: int, bc: int,
+                      fused: bool = False, engine: str = "xla"):
     """while_loop-free fit body: a STATIC-trip ``fori_loop`` over max_iter
     with post-convergence updates masked out.
 
@@ -172,7 +210,7 @@ def _fit_program_fori(comms: Comms, max_iter: int, tol: float,
             n_iter, c, live = state
             new, _, _ = compute_new_centroids(
                 x_shard, c, comms, metric=metric, batch_samples=bs,
-                batch_centroids=bc)
+                batch_centroids=bc, fused=fused, engine=engine)
             step_delta = jnp.sum((new.astype(acc) - c.astype(acc)) ** 2)
             c = jnp.where(live, new, c)
             n_iter = n_iter + live.astype(n_iter.dtype)
@@ -185,13 +223,15 @@ def _fit_program_fori(comms: Comms, max_iter: int, tol: float,
         inertia = comms.allreduce(jnp.sum(nn.value), ReduceOp.SUM)
         return c, inertia, n_iter
 
-    return _cached_program(comms, ("fit_fori", max_iter, tol, metric, bs, bc),
+    return _cached_program(comms, ("fit_fori", max_iter, tol, metric, bs,
+                                   bc, fused, engine),
                            lambda: local_fit)
 
 
 @traced("raft_tpu.cluster.kmeans_mnmg.fit")
 def fit(params: KMeansParams, comms: Comms, x, centroids=None,
-        loop: str = "device", sync_every: int = 8) -> KMeansOutput:
+        loop: str = "device", sync_every: int = 8,
+        fused=None) -> KMeansOutput:
     """Distributed k-means fit over rows sharded across the comms axis.
 
     x: global [n, dim] array (host or device); it is sharded row-wise over
@@ -217,12 +257,27 @@ def fit(params: KMeansParams, comms: Comms, x, centroids=None,
         every *sync_every* iterations (never, when tol == 0).  This is the
         pattern behind the 437 it/s single-chip k-means bench number and a
         live cross-check on the while_loop program (BENCH_TPU.md r4 ¶).
+
+    fused (None → kmeans.fused_em_enabled(), i.e. RAFT_TPU_FUSED_EM):
+    single-pass fused EM per shard with ONE packed allreduce per iteration
+    (see :func:`compute_new_centroids`); False keeps the pre-PR two-pass /
+    three-collective iteration.  Both it and the E-step engine
+    (RAFT_TPU_PALLAS_NN gate, same resolution as the single-device fit)
+    are resolved here, outside the program cache, so flipping the env
+    vars between fits takes effect.
     """
     from jax.sharding import PartitionSpec as P
 
     comms = as_comms(comms)
     expects(loop in ("device", "fori", "host"),
             f"unknown loop mode {loop!r}")
+    if fused is None:
+        from raft_tpu.cluster.kmeans import fused_em_enabled
+
+        fused = fused_em_enabled()
+    from raft_tpu.cluster.kmeans import _resolve_engine
+
+    engine = _resolve_engine(None, params.metric)
     expects(sync_every >= 1, f"sync_every must be >= 1, got {sync_every}")
     x = jnp.asarray(x)
     n, dim = x.shape
@@ -243,10 +298,10 @@ def fit(params: KMeansParams, comms: Comms, x, centroids=None,
     x_sharded = comms.globalize(x, P(comms.axis_name, None))
     if loop == "host":
         return _fit_host_loop(params, comms, x_sharded, centroids, bs, bc,
-                              sync_every)
+                              sync_every, fused, engine)
     builder = _fit_program_fori if loop == "fori" else _fit_program
     local_fit = builder(comms, params.max_iter, float(params.tol),
-                        params.metric, bs, bc)
+                        params.metric, bs, bc, fused, engine)
     c, inertia, n_iter = comms.run(
         local_fit, x_sharded, centroids,
         in_specs=(P(comms.axis_name, None), P(None, None)),
@@ -256,7 +311,8 @@ def fit(params: KMeansParams, comms: Comms, x, centroids=None,
 
 
 def _fit_host_loop(params: KMeansParams, comms: Comms, x_sharded, centroids,
-                   bs: int, bc: int, sync_every: int) -> KMeansOutput:
+                   bs: int, bc: int, sync_every: int,
+                   fused: bool = False, engine: str = "xla") -> KMeansOutput:
     """Host-driven EM (see :func:`fit` loop="host").  Matches the
     while_loop path's convergence semantics: stop after the first iteration
     whose centroid movement ||new - old||² <= tol², checked every
@@ -265,7 +321,7 @@ def _fit_host_loop(params: KMeansParams, comms: Comms, x_sharded, centroids,
     from jax.sharding import PartitionSpec as P
 
     tol2 = float(params.tol) ** 2
-    step = _step_program(comms, params.metric, bs, bc)
+    step = _step_program(comms, params.metric, bs, bc, fused, engine)
 
     def run_step(c):
         return comms.run(

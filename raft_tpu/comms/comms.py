@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import queue
 import threading
+from collections import Counter
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
@@ -95,6 +96,13 @@ class Comms:
         self._host_rank = host_rank  # used by the host p2p plane
         self._aborted = False
         self._run_cache: dict = {}
+        # Trace-time collective-call counter: collectives are staged into
+        # compiled programs, so this counts how many collective LAUNCHES a
+        # traced program contains (one increment per allreduce/bcast/... in
+        # the traced body), not per-execution events.  Tests use it to pin
+        # payload shapes — e.g. fused MNMG k-means issues exactly ONE
+        # allreduce per EM iteration (tests/test_kmeans_mnmg.py).
+        self.collective_calls: Counter = Counter()
         # Host p2p plane: TCP mailbox (cross-process, ucp_helper.hpp role)
         # when a coordinator address is configured, else process-local
         # queues.  RAFT_TPU_COORD_ADDR is the ambient default.
@@ -265,6 +273,7 @@ class Comms:
 
     def allreduce(self, x, op: ReduceOp = ReduceOp.SUM):
         """reference comms_t::allreduce (core/comms.hpp:322)."""
+        self.collective_calls["allreduce"] += 1
         if self.groups is None:
             if op == ReduceOp.PROD:
                 # no pprod primitive: exp∘psum∘log is invalid for ≤0
@@ -278,6 +287,7 @@ class Comms:
 
         Grouped path: mask to the root's contribution, then the O(group)
         ring/butterfly allreduce — traffic O(group)·|x|, not O(world)."""
+        self.collective_calls["bcast"] += 1
         if self.groups is None:
             return self._gather_all(x)[root]
         x = jnp.asarray(x)
@@ -303,6 +313,7 @@ class Comms:
         After s forward rotations this rank holds the shard of the member
         s positions behind it, so the stacked parts are rolled into
         position order with a traced take."""
+        self.collective_calls["allgather"] += 1
         if self.groups is None:
             return self._gather_all(x)
         expects(self._group_size is not None,
@@ -369,6 +380,7 @@ class Comms:
     def reducescatter(self, x, op: ReduceOp = ReduceOp.SUM):
         """reference comms_t::reducescatter (core/comms.hpp:481): reduce then
         scatter equal chunks; x's leading dim must be divisible by size."""
+        self.collective_calls["reducescatter"] += 1
         if self.groups is not None:
             expects(self._group_size is not None,
                     "reducescatter requires equal-sized groups (chunk shapes "
@@ -600,7 +612,13 @@ class Comms:
         through.
         """
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+
+        try:  # jax ≥ 0.7 top-level name / kwarg
+            from jax import shard_map
+            vma_kw = "check_vma"
+        except ImportError:  # 0.4.x: experimental home, check_rep kwarg
+            from jax.experimental.shard_map import shard_map
+            vma_kw = "check_rep"
 
         if in_specs is None:
             in_specs = tuple(P(self.axis_name) for _ in args)
@@ -610,10 +628,13 @@ class Comms:
             specs = (in_specs if isinstance(in_specs, (tuple, list))
                      else (in_specs,) * len(args))
             args = tuple(self.globalize(a, s) for a, s in zip(args, specs))
-        # check_vma=False: grouped collectives are all_gather + masked
-        # reductions, which ARE replicated per-group but not provably so to
-        # the static varying-axes checker.
-        shard_kw.setdefault("check_vma", False)
+        # replication/varying-axes checker OFF: grouped collectives are
+        # all_gather + masked reductions, which ARE replicated per-group but
+        # not provably so to the static checker (check_vma on jax ≥ 0.7,
+        # check_rep on 0.4.x).
+        if "check_vma" in shard_kw and vma_kw != "check_vma":
+            shard_kw[vma_kw] = shard_kw.pop("check_vma")
+        shard_kw.setdefault(vma_kw, False)
         # Cache the jitted wrapper: jit caches are keyed by callable identity,
         # so rebuilding shard_map(fn) per call would retrace every time.
         cache_key = (fn, str(in_specs), str(out_specs), str(sorted(shard_kw.items())))

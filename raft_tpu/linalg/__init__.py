@@ -32,11 +32,14 @@ from raft_tpu.linalg.reduce import (  # noqa: F401
     mean_squared_error,
     norm,
     normalize,
+    one_hot_by_key,
     reduce,
     reduce_cols_by_key,
     reduce_rows_by_key,
     row_norm,
+    segment_sum,
     strided_reduction,
+    use_one_hot_engine,
 )
 from raft_tpu.linalg.blas import axpy, dot, gemm, gemv, transpose  # noqa: F401
 from raft_tpu.linalg.matrix_vector import (  # noqa: F401

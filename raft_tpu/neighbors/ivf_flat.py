@@ -225,8 +225,10 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
         # extend with adaptive_centers=true updates centers from accumulated
         # sums): new = (old·n_old + Σ new members) / n_total — incremental,
         # no pass over the stored rows
-        sums = jax.ops.segment_sum(
-            xa.astype(centers.dtype), labels, num_segments=index.n_lists)
+        from raft_tpu.linalg.reduce import reduce_rows_by_key
+
+        sums = reduce_rows_by_key(xa.astype(centers.dtype), labels,
+                                  index.n_lists)
         n_old = index.list_sizes.astype(centers.dtype)[:, None]
         n_tot = jnp.maximum(sizes.astype(centers.dtype), 1)[:, None]
         centers = jnp.where(sizes[:, None] > 0,

@@ -304,21 +304,24 @@ def _lloyd_kmeans(key, data, k: int, iters: int):
 
     data: (n, d) → centers (k, d).  The reference trains PQ codebooks with
     the same balanced-kmeans machinery; plain Lloyd on residual subvectors
-    converges equally well here and vmaps cleanly over codebooks.
+    converges equally well here and vmaps cleanly over codebooks.  E/M ride
+    the shared cluster primitives: the M-step goes through
+    ``kmeans.update_centroids`` → ``_weighted_cluster_sums``, which picks
+    the MXU one-hot engine on accelerators (~5× over the raw segment-sum
+    this previously lowered to — see that docstring) and the scatter on
+    CPU; the E-step shares the hoisted-epilogue ``_l2_expanded``.
     """
+    from raft_tpu.cluster.kmeans import update_centroids
+    from raft_tpu.distance.pairwise import _l2_expanded
+
     n = data.shape[0]
     sel = jax.random.choice(key, n, (k,), replace=n < k)
     centers = data[sel]
 
     def step(centers, _):
-        d = (jnp.sum(data ** 2, 1, keepdims=True)
-             + jnp.sum(centers ** 2, 1)[None, :]
-             - 2.0 * data @ centers.T)
-        labels = jnp.argmin(d, axis=1)
-        sums = jax.ops.segment_sum(data, labels, num_segments=k)
-        cnt = jnp.bincount(labels, length=k).astype(data.dtype)
-        new = jnp.where(cnt[:, None] > 0, sums / jnp.maximum(cnt, 1)[:, None],
-                        centers)
+        d = _l2_expanded(data, centers, sqrt=False, precision="high")
+        labels = jnp.argmin(d, axis=1).astype(jnp.int32)
+        new, _ = update_centroids(data, labels, k, old_centroids=centers)
         return new, None
 
     centers, _ = jax.lax.scan(step, centers, None, length=iters)

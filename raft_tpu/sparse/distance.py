@@ -37,6 +37,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from raft_tpu.linalg.reduce import segment_sum
 import numpy as np
 
 from raft_tpu.core.error import expects
@@ -135,7 +136,7 @@ def pairwise_distance(x: CSR, y: CSR, metric: DistanceType = DistanceType.L2Expa
 
 def _seg_sum(v, rows, nrows):
     # one extra segment collects padding rows; sliced off
-    return jax.ops.segment_sum(v, rows, num_segments=nrows + 1)[:nrows]
+    return segment_sum(v, rows, nrows + 1)[:nrows]
 
 
 def _row_stats(rows, vals, nrows):
@@ -256,7 +257,7 @@ def _compressed_tile(xr, xc, xv, yr, yc, yv, metric: DistanceType, p: float,
     if metric == DistanceType.Linf:
         base = _dense._blocked_reduce(xd, yd, _dense._tile_linf)
         corr = jax.ops.segment_max(
-            jnp.where(y_out, jnp.abs(yv), 0.0), yr, num_segments=by + 1)[:by]
+            jnp.where(y_out, jnp.abs(yv), 0.0), yr, by + 1)[:by]
         return jnp.maximum(base, corr[None, :])
     if metric == DistanceType.LpUnexpanded:
         pair = lambda a, b: jnp.power(jnp.abs(a - b), p)  # noqa: E731

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from raft_tpu.linalg.reduce import segment_sum
 
 from raft_tpu.core.error import expects
 from raft_tpu.sparse.types import COO, CSR
@@ -33,7 +34,7 @@ def spmv(csr: CSR, x) -> jnp.ndarray:
     x = jnp.asarray(x)
     expects(x.shape[0] == csr.shape[1], "spmv: dimension mismatch")
     prod = csr.data * x[csr.indices]
-    return jax.ops.segment_sum(prod, csr.row_ids(), num_segments=csr.shape[0])
+    return segment_sum(prod, csr.row_ids(), csr.shape[0])
 
 
 @jax.tree_util.register_pytree_node_class
@@ -115,8 +116,8 @@ def ell_spmv(ell: EllHybrid, x) -> jnp.ndarray:
     x = jnp.asarray(x)
     y = jnp.sum(ell.vals * x[ell.cols], axis=1)
     if ell.ov_rows.shape[0]:
-        y = y + jax.ops.segment_sum(ell.ov_vals * x[ell.ov_cols], ell.ov_rows,
-                                    num_segments=ell.shape[0])
+        y = y + segment_sum(ell.ov_vals * x[ell.ov_cols], ell.ov_rows,
+                                    ell.shape[0])
     return y
 
 
@@ -156,7 +157,7 @@ def spmm(csr: CSR, b) -> jnp.ndarray:
     b = jnp.asarray(b)
     expects(b.shape[0] == csr.shape[1], "spmm: dimension mismatch")
     prod = csr.data[:, None] * b[csr.indices, :]
-    return jax.ops.segment_sum(prod, csr.row_ids(), num_segments=csr.shape[0])
+    return segment_sum(prod, csr.row_ids(), csr.shape[0])
 
 
 def csr_degree(csr: CSR) -> jnp.ndarray:
@@ -175,11 +176,11 @@ def row_normalize(csr: CSR, norm: str = "l1") -> CSR:
     sparse/linalg/norm.cuh ``csr_row_normalize_l1`` / ``_max``)."""
     rows = csr.row_ids()
     if norm == "l1":
-        denom = jax.ops.segment_sum(jnp.abs(csr.data), rows,
-                                    num_segments=csr.shape[0])
+        denom = segment_sum(jnp.abs(csr.data), rows,
+                                    csr.shape[0])
     elif norm == "max":
         denom = jax.ops.segment_max(csr.data, rows,
-                                    num_segments=csr.shape[0])
+                                    csr.shape[0])
     else:
         raise ValueError(f"unknown norm {norm!r}")
     denom = jnp.where(denom != 0, denom, 1)
@@ -254,10 +255,10 @@ def weak_cc(g: CSR) -> jnp.ndarray:
         # Weak connectivity ignores direction: propagate the min label both
         # ways along every edge...
         pulled = jax.ops.segment_min(
-            jnp.where(g.mask(), color[cols_safe], n), rows, num_segments=n)
+            jnp.where(g.mask(), color[cols_safe], n), rows, n)
         pushed = jax.ops.segment_min(
             jnp.where(g.mask(), color[rows_safe], n),
-            jnp.where(g.mask(), g.indices, n), num_segments=n)
+            jnp.where(g.mask(), g.indices, n), n)
         new = jnp.minimum(color, jnp.minimum(pulled, pushed))
         # ...then pointer-jump through the current labels.
         new = new[jnp.clip(new, 0, n - 1)]
@@ -298,7 +299,7 @@ def laplacian(adj: CSR, normalized: bool = False) -> CSR:
     """
     n = adj.shape[0]
     expects(adj.shape[0] == adj.shape[1], "laplacian: matrix must be square")
-    deg = jax.ops.segment_sum(adj.data, adj.row_ids(), num_segments=n)
+    deg = segment_sum(adj.data, adj.row_ids(), n)
     ca = csr_to_coo(adj)
     live = ca.mask()
     if normalized:

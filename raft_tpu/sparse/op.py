@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from raft_tpu.linalg.reduce import segment_sum
 
 from raft_tpu.sparse.types import COO, CSR
 
@@ -77,22 +78,22 @@ def _coo_combine_duplicates(coo: COO, combine: str) -> COO:
     group = jnp.where(live, group, s.capacity)
     n_groups = jnp.sum(is_new, dtype=jnp.int32)
     if combine == "sum":
-        vals = jax.ops.segment_sum(s.vals, group, num_segments=s.capacity)
+        vals = segment_sum(s.vals, group, s.capacity)
     elif combine == "max":
         # segment_max's -inf fill in empty tail slots is cleared by the
         # out_live mask at the return site.
-        vals = jax.ops.segment_max(s.vals, group, num_segments=s.capacity)
+        vals = jax.ops.segment_max(s.vals, group, s.capacity)
     elif combine == "min":
         # min over DUPLICATES of the union (an edge present in only one
         # direction keeps its value) — the reference's coo_symmetrize
         # takes an arbitrary reduction functor (sparse/linalg/symmetrize.cuh)
-        vals = jax.ops.segment_min(s.vals, group, num_segments=s.capacity)
+        vals = jax.ops.segment_min(s.vals, group, s.capacity)
     else:  # pragma: no cover
         raise ValueError(combine)
     # First-occurrence coordinates per group (all duplicates share them).
     rows = jnp.full((s.capacity,), s.shape[0], jnp.int32).at[group].min(
         s.rows, mode="drop")
-    cols = jax.ops.segment_min(s.cols, group, num_segments=s.capacity)
+    cols = jax.ops.segment_min(s.cols, group, s.capacity)
     out_live = jnp.arange(s.capacity) < n_groups
     return COO(jnp.where(out_live, rows, s.shape[0]),
                jnp.where(out_live, cols, 0),

@@ -29,6 +29,7 @@ from typing import Callable, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+from raft_tpu.linalg.reduce import segment_sum
 import numpy as np
 
 from raft_tpu.core.error import expects
@@ -54,10 +55,10 @@ def _gershgorin_upper(csr: CSR) -> jnp.ndarray:
     """Upper bound on eigenvalues: max_i (a_ii + Σ_{j≠i} |a_ij|)."""
     rows = csr.row_ids()
     n = csr.shape[0]
-    absrow = jax.ops.segment_sum(jnp.abs(csr.data), rows, num_segments=n)
+    absrow = segment_sum(jnp.abs(csr.data), rows, n)
     is_diag = (csr.indices == jnp.clip(rows, 0, n - 1)) & csr.mask()
-    diag = jax.ops.segment_sum(jnp.where(is_diag, csr.data, 0), rows,
-                               num_segments=n)
+    diag = segment_sum(jnp.where(is_diag, csr.data, 0), rows,
+                               n)
     return jnp.max(diag + (absrow - jnp.abs(diag)))
 
 

@@ -26,8 +26,9 @@ def degrees(adj: CSR) -> jnp.ndarray:
 
     Use this directly when only degrees are needed — the operator builders
     below also pay the one-time ELL conversion."""
-    return jax.ops.segment_sum(adj.data, adj.row_ids(),
-                               num_segments=adj.shape[0])
+    from raft_tpu.linalg.reduce import segment_sum
+
+    return segment_sum(adj.data, adj.row_ids(), adj.shape[0])
 
 
 def _laplacian_apply(deg, op, x):

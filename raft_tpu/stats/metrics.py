@@ -20,6 +20,7 @@ from raft_tpu.distance import DistanceType
 # where the public @auto_sync_handle wrapper would force a blocking
 # default-handle sync per tile
 from raft_tpu.distance.pairwise import distance as pairwise_distance
+from raft_tpu.linalg.reduce import reduce_cols_by_key
 
 
 # -- classification / regression ---------------------------------------------
@@ -174,7 +175,7 @@ def silhouette_score(x, labels, n_clusters: Optional[int] = None,
         n_clusters = int(jnp.max(labels)) + 1
     d = pairwise_distance(x, x, metric)
     # per-row sums of distances to each cluster: (n, n_clusters)
-    cluster_sums = jax.ops.segment_sum(d.T, labels, num_segments=n_clusters).T
+    cluster_sums = reduce_cols_by_key(d, labels, n_clusters)
     counts = jnp.zeros((n_clusters,), d.dtype).at[labels].add(1.0)
     own = labels
     own_count = counts[own]
@@ -210,7 +211,7 @@ def silhouette_score_batched(x, labels, n_clusters: Optional[int] = None,
         xb = x[start:start + batch_size]
         lb = labels[start:start + batch_size]
         d = pairwise_distance(xb, x, metric)
-        cluster_sums = jax.ops.segment_sum(d.T, labels, num_segments=n_clusters).T
+        cluster_sums = reduce_cols_by_key(d, labels, n_clusters)
         own_count = counts[lb]
         a = jnp.where(own_count > 1,
                       jnp.take_along_axis(cluster_sums, lb[:, None], axis=1)[:, 0]

@@ -1,0 +1,335 @@
+"""Fused single-pass EM tests (PR 2): fused_em_step ≡ the unfused two-pass
+E+M across dtypes × weighting × loop forms, the empty-cluster fallback, the
+ragged final tile, the MNMG packed wire format, the keyed-reduction engine
+equivalence, and the segment-sum lint quarantine."""
+
+import pathlib
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import cluster
+from raft_tpu.cluster import (EMPartials, InitMethod, KMeansParams,
+                              centroids_from_sums, fused_em_step,
+                              min_cluster_and_distance, pack_em_partials,
+                              unpack_em_partials, update_centroids)
+from raft_tpu.random import RngState, make_blobs
+
+
+@pytest.fixture
+def blobs():
+    x, labels, centers = make_blobs(RngState(21), 900, 12, n_clusters=5,
+                                    cluster_std=0.3)
+    return np.asarray(x), np.asarray(labels), np.asarray(centers)
+
+
+class TestFusedStepBuildingBlock:
+    def test_matches_two_pass_oracle(self, blobs):
+        """One fused pass == unfused E-step + M-step on the same centroids
+        (sums, weights, inertia).  (Raggedness is NOT exercised here on the
+        CPU backend — its tile growth swallows 900 rows into one tile; see
+        test_ragged_tile_oracle.)"""
+        x, _, c = blobs
+        p = fused_em_step(x, c, batch_samples=256)
+        nn = min_cluster_and_distance(jnp.asarray(x), jnp.asarray(c))
+        new_exp, w_exp = update_centroids(x, nn.key, 5, old_centroids=c)
+        got = centroids_from_sums(p.sums, p.weights, jnp.asarray(c),
+                                  jnp.float32)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(new_exp),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(p.weights), np.asarray(w_exp),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(float(p.inertia),
+                                   float(cluster.cluster_cost(nn)), rtol=1e-5)
+
+    def test_return_labels_same_pass(self, blobs):
+        """return_labels=True emits the per-row (label, distance) pair from
+        the SAME single pass — identical to the unfused E-step's."""
+        x, _, c = blobs
+        p = fused_em_step(x, c, batch_samples=256, return_labels=True)
+        nn = min_cluster_and_distance(jnp.asarray(x), jnp.asarray(c))
+        np.testing.assert_array_equal(np.asarray(p.labels), np.asarray(nn.key))
+        # both forms carry ~1e-4 expanded-form error vs an f64 oracle; they
+        # differ from each other by fp association (xn+(yn-2xy) vs
+        # (xn+yn)-2xy) — same tolerance as the E-step-vs-scipy test
+        np.testing.assert_allclose(np.asarray(p.distances),
+                                   np.asarray(nn.value), rtol=1e-4, atol=1e-4)
+
+    def test_weighted_partials(self, blobs):
+        x, _, c = blobs
+        w = np.random.default_rng(3).random(len(x)).astype(np.float32) + 0.5
+        p = fused_em_step(x, c, sample_weights=w, batch_samples=256)
+        nn = min_cluster_and_distance(jnp.asarray(x), jnp.asarray(c))
+        from raft_tpu.cluster.kmeans import _weighted_cluster_sums
+
+        sums_e, wsum_e = _weighted_cluster_sums(jnp.asarray(x), nn.key,
+                                                jnp.asarray(w), 5)
+        np.testing.assert_allclose(np.asarray(p.sums), np.asarray(sums_e),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(p.weights),
+                                   np.asarray(wsum_e), rtol=1e-6)
+        np.testing.assert_allclose(float(p.inertia),
+                                   float(jnp.sum(nn.value * w)), rtol=1e-5)
+
+    @pytest.mark.parametrize("weighted", [False, True])
+    def test_ragged_tile_oracle(self, weighted):
+        """The pad-masking branch MUST actually execute: on the CPU backend
+        row tiles are grown to ≥16k rows, so n must exceed that for the
+        final tile to be ragged (n=17001 → 2 tiles, 15767 padding rows).
+        Covers both discard mechanisms: the ``n_clusters`` discard label +
+        zeroed distance (unweighted) and the weight-0 padding (weighted)."""
+        rng = np.random.default_rng(9)
+        x = rng.random((17001, 8)).astype(np.float32)
+        c = x[:5].copy()
+        w = (rng.random(17001).astype(np.float32) + 0.5) if weighted else None
+        p = fused_em_step(x, c, sample_weights=w)
+        nn = min_cluster_and_distance(jnp.asarray(x), jnp.asarray(c))
+        from raft_tpu.cluster.kmeans import _weighted_cluster_sums
+
+        sums_e, wsum_e = _weighted_cluster_sums(
+            jnp.asarray(x), nn.key, None if w is None else jnp.asarray(w), 5)
+        np.testing.assert_allclose(np.asarray(p.sums), np.asarray(sums_e),
+                                   rtol=1e-5, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(p.weights), np.asarray(wsum_e),
+                                   rtol=1e-6)
+        cost_e = float(cluster.cluster_cost(
+            nn, None if w is None else jnp.asarray(w)))
+        np.testing.assert_allclose(float(p.inertia), cost_e, rtol=1e-5)
+        # labels from the same pass: padding rows must be sliced off
+        q = fused_em_step(x, c, sample_weights=w, return_labels=True)
+        assert q.labels.shape == (17001,)
+        np.testing.assert_array_equal(np.asarray(q.labels), np.asarray(nn.key))
+
+    def test_pack_unpack_roundtrip(self, blobs):
+        """The MNMG wire format: ONE (k·d + k + 1) vector carries the whole
+        per-iteration payload."""
+        x, _, c = blobs
+        p = fused_em_step(x, c)
+        packed = pack_em_partials(p)
+        assert packed.shape == (5 * 12 + 5 + 1,)
+        q = unpack_em_partials(packed, 5, 12)
+        np.testing.assert_array_equal(np.asarray(q.sums), np.asarray(p.sums))
+        np.testing.assert_array_equal(np.asarray(q.weights),
+                                      np.asarray(p.weights))
+        np.testing.assert_array_equal(np.asarray(q.inertia),
+                                      np.asarray(p.inertia))
+
+    def test_bf16_accumulates_f32(self, blobs):
+        x, _, c = blobs
+        p = fused_em_step(jnp.asarray(x, jnp.bfloat16),
+                          jnp.asarray(c, jnp.bfloat16), batch_samples=256)
+        assert p.sums.dtype == jnp.float32
+        assert p.weights.dtype == jnp.float32
+        assert p.inertia.dtype == jnp.float32
+
+    def test_engine_validation_shared_with_unfused(self, blobs):
+        from raft_tpu.distance import DistanceType
+
+        x, _, c = blobs
+        with pytest.raises(ValueError, match="L2 metric family"):
+            fused_em_step(x, c, metric=DistanceType.CosineExpanded,
+                          engine="pallas")
+        with pytest.raises(ValueError, match="unknown engine"):
+            fused_em_step(x, c, engine="cuda")
+
+
+class TestFusedEqualsUnfusedFit:
+    """The property grid the satellite pins: fused EM ≡ unfused EM
+    (centroids, inertia, n_iter) across {f32, bf16} × {weighted,
+    unweighted} × both loop forms."""
+
+    @pytest.mark.parametrize("dtype", ["f32", "bf16"])
+    @pytest.mark.parametrize("weighted", [False, True])
+    @pytest.mark.parametrize("loop", ["while", "fori"])
+    def test_grid(self, blobs, dtype, weighted, loop):
+        x, _, c = blobs
+        if dtype == "bf16":
+            x = jnp.asarray(x, jnp.bfloat16)
+            c = jnp.asarray(c, jnp.bfloat16)
+            rtol, atol = 2e-2, 2e-2
+        else:
+            rtol, atol = 1e-4, 1e-5
+        w = (np.random.default_rng(5).random(900).astype(np.float32) + 0.5
+             if weighted else None)
+        params = KMeansParams(n_clusters=5, init=InitMethod.Array,
+                              max_iter=40, tol=1e-4)
+        a = cluster.fit(params, x, sample_weights=w, centroids=c, loop=loop,
+                        fused=True)
+        b = cluster.fit(params, x, sample_weights=w, centroids=c, loop=loop,
+                        fused=False)
+        assert int(a.n_iter) == int(b.n_iter) < 40
+        np.testing.assert_allclose(
+            np.asarray(a.centroids, np.float32),
+            np.asarray(b.centroids, np.float32), rtol=rtol, atol=atol)
+        np.testing.assert_allclose(float(a.inertia), float(b.inertia),
+                                   rtol=max(rtol, 1e-5))
+
+    def test_empty_cluster_keeps_previous_centroid(self):
+        """A centroid that owns no points keeps its previous value through
+        the fused fit (reference fallback), same as the unfused path."""
+        rng = np.random.default_rng(0)
+        x = rng.random((64, 4)).astype(np.float32)  # data in [0, 1)
+        far = np.full((1, 4), 50.0, np.float32)     # never wins an argmin
+        c0 = np.concatenate([x[:3], far]).astype(np.float32)
+        params = KMeansParams(n_clusters=4, init=InitMethod.Array,
+                              max_iter=10, tol=0.0)
+        out_f = cluster.fit(params, x, centroids=c0, fused=True)
+        out_u = cluster.fit(params, x, centroids=c0, fused=False)
+        np.testing.assert_array_equal(np.asarray(out_f.centroids)[3], far[0])
+        np.testing.assert_allclose(np.asarray(out_f.centroids),
+                                   np.asarray(out_u.centroids), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_ragged_final_tile(self):
+        """n deliberately not a multiple of the row tile: padding rows of
+        the last tile must contribute to neither sums nor inertia.  n is
+        kept above the CPU backend's ≥16k tile growth so the final tile is
+        genuinely ragged there (16384·2 − 17001 padding rows)."""
+        rng = np.random.default_rng(1)
+        x = rng.random((17001, 8)).astype(np.float32)
+        c = x[:6].copy()
+        params = KMeansParams(n_clusters=6, init=InitMethod.Array,
+                              max_iter=15, tol=1e-5, batch_samples=128)
+        a = cluster.fit(params, x, centroids=c, fused=True)
+        b = cluster.fit(params, x, centroids=c, fused=False)
+        assert int(a.n_iter) == int(b.n_iter)
+        np.testing.assert_allclose(np.asarray(a.centroids),
+                                   np.asarray(b.centroids), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_env_toggle(self, blobs, monkeypatch):
+        from raft_tpu.cluster.kmeans import fused_em_enabled
+
+        monkeypatch.setenv("RAFT_TPU_FUSED_EM", "0")
+        assert not fused_em_enabled()
+        monkeypatch.delenv("RAFT_TPU_FUSED_EM")
+        assert fused_em_enabled()
+
+    def test_fused_partials_namedtuple_shape(self, blobs):
+        x, _, c = blobs
+        p = fused_em_step(x, c)
+        assert isinstance(p, EMPartials)
+        assert p.labels is None and p.distances is None
+
+
+class TestKeyedReductionEngines:
+    """reduce_rows_by_key / reduce_cols_by_key pick the one-hot matmul or
+    the scatter per linalg.reduce.use_one_hot_engine — both engines must
+    agree bit-for-tolerance."""
+
+    def test_cols_by_key_engines_agree(self, monkeypatch):
+        import importlib
+
+        R = importlib.import_module("raft_tpu.linalg.reduce")
+
+        rng = np.random.default_rng(2)
+        d = jnp.asarray(rng.random((17, 33)).astype(np.float32))
+        keys = jnp.asarray(rng.integers(0, 7, 33).astype(np.int32))
+        monkeypatch.setattr(R, "use_one_hot_engine", lambda k: False)
+        scatter = R.reduce_cols_by_key(d, keys, 7)
+        monkeypatch.setattr(R, "use_one_hot_engine", lambda k: True)
+        onehot = R.reduce_cols_by_key(d, keys, 7)
+        np.testing.assert_allclose(np.asarray(scatter), np.asarray(onehot),
+                                   rtol=1e-6)
+        # oracle: explicit per-key column sums
+        dn, kn = np.asarray(d), np.asarray(keys)
+        want = np.stack([dn[:, kn == k].sum(axis=1) for k in range(7)], axis=1)
+        np.testing.assert_allclose(np.asarray(scatter), want, rtol=1e-6)
+
+    def test_rows_by_key_engines_agree(self, monkeypatch):
+        import importlib
+
+        R = importlib.import_module("raft_tpu.linalg.reduce")
+
+        rng = np.random.default_rng(3)
+        d = jnp.asarray(rng.random((40, 5)).astype(np.float32))
+        keys = jnp.asarray(rng.integers(0, 6, 40).astype(np.int32))
+        w = jnp.asarray(rng.random(40).astype(np.float32))
+        monkeypatch.setattr(R, "use_one_hot_engine", lambda k: False)
+        scatter = R.reduce_rows_by_key(d, keys, 6, weights=w)
+        monkeypatch.setattr(R, "use_one_hot_engine", lambda k: True)
+        onehot = R.reduce_rows_by_key(d, keys, 6, weights=w)
+        np.testing.assert_allclose(np.asarray(scatter), np.asarray(onehot),
+                                   rtol=1e-5)
+
+    def test_discard_slot_semantics(self):
+        """Key == n_keys is a discard slot (padding rows) on BOTH engines —
+        the fused scan relies on it for the ragged tail."""
+        from raft_tpu.cluster.kmeans import _mstep_tile_partials
+
+        x = jnp.ones((4, 3), jnp.float32)
+        labels = jnp.asarray([0, 1, 2, 2], jnp.int32)  # 2 == discard (k=2)
+        for one_hot in (False, True):
+            sums, wsum = _mstep_tile_partials(x, labels, None, 2, one_hot,
+                                              jnp.float32)
+            np.testing.assert_allclose(np.asarray(wsum), [1.0, 1.0])
+            np.testing.assert_allclose(np.asarray(sums),
+                                       np.ones((2, 3), np.float32))
+
+
+class TestSegmentSumQuarantine:
+    """ci/lint.py forbids raw jax.ops.segment_sum in raft_tpu/ outside
+    linalg/reduce.py (the ivf_pq M-step silently missing the one-hot engine
+    is the regression class this catches)."""
+
+    def test_lint_flags_raw_segment_sum(self, tmp_path):
+        import sys
+
+        sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+        from ci.lint import check_file
+
+        bad = tmp_path / "raft_tpu" / "somewhere" / "mod.py"
+        bad.parent.mkdir(parents=True)
+        bad.write_text("import jax\n\n\ndef f(v, i):\n"
+                       "    return jax.ops.segment_sum(v, i, num_segments=4)\n")
+        findings = check_file(bad)
+        assert any("segment_sum" in msg for _, msg in findings), findings
+        # noqa opts out
+        bad.write_text("import jax\n\n\ndef f(v, i):\n"
+                       "    return jax.ops.segment_sum(v, i, 4)  # noqa\n")
+        assert not any("segment_sum" in m for _, m in check_file(bad))
+
+    def test_lint_allows_reduce_py(self, tmp_path):
+        import sys
+
+        sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+        from ci.lint import check_file
+
+        ok = tmp_path / "raft_tpu" / "linalg" / "reduce.py"
+        ok.parent.mkdir(parents=True)
+        ok.write_text("import jax\n\n\ndef f(v, i):\n"
+                      "    return jax.ops.segment_sum(v, i, num_segments=4)\n")
+        assert not any("segment_sum" in m for _, m in check_file(ok))
+
+    def test_library_tree_is_clean(self):
+        """No raw segment_sum outside the blessed module in the shipped
+        tree (grep-level, independent of the lint runner)."""
+        root = pathlib.Path(__file__).resolve().parent.parent / "raft_tpu"
+        offenders = []
+        for f in root.rglob("*.py"):
+            if f.as_posix().endswith("linalg/reduce.py"):
+                continue
+            for i, line in enumerate(f.read_text().splitlines(), 1):
+                if "jax.ops.segment_sum" in line and "noqa" not in line:
+                    offenders.append(f"{f}:{i}")
+        assert not offenders, offenders
+
+
+def test_balanced_em_fused_matches_unfused():
+    """kmeans_balanced._em_program rides the fused scan: same centers as
+    the two-pass form (labels/distances for adjust_centers come out of the
+    same single pass)."""
+    from raft_tpu.cluster.kmeans_balanced import _em_program
+
+    x, _, _ = make_blobs(RngState(31), 1200, 8, n_clusters=6,
+                         cluster_std=0.4)
+    x = jnp.asarray(np.asarray(x))
+    c0 = x[:8]
+    from raft_tpu.distance import DistanceType
+
+    a = _em_program(x, c0, 8, 6, DistanceType.L2Expanded, 2, fused=True)
+    b = _em_program(x, c0, 8, 6, DistanceType.L2Expanded, 2, fused=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                               atol=1e-5)

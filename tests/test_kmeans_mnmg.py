@@ -141,6 +141,51 @@ def test_compute_new_centroids_building_block(comms, blobs):
                                rtol=1e-4)
 
 
+def test_fused_step_single_allreduce(comms, blobs):
+    """PR 2 wire-format guarantee: the fused EM iteration issues exactly
+    ONE allreduce (the packed (k·d + k + 1) carry) where the pre-PR
+    two-pass step issued three (sums / counts / inertia) — pinned via the
+    comms trace-time collective-call counter."""
+    from jax.sharding import PartitionSpec as P
+    import jax
+
+    x, _, centers = blobs
+    xs = jax.device_put(
+        jnp.asarray(x),
+        jax.sharding.NamedSharding(comms.mesh, P(comms.axis_name, None)))
+    c0 = jnp.asarray(centers)
+    for fused, expected in ((True, 1), (False, 3)):
+        before = comms.collective_calls["allreduce"]
+
+        def step(xx, cc, _f=fused):  # fresh closure → fresh trace
+            return kmeans_mnmg.compute_new_centroids(xx, cc, comms,
+                                                     fused=_f)
+
+        comms.run(step, xs, c0,
+                  in_specs=(P(comms.axis_name, None), P(None, None)),
+                  out_specs=(P(None, None), P(None), P()))
+        assert comms.collective_calls["allreduce"] - before == expected
+
+
+def test_fused_fit_matches_unfused(comms, blobs):
+    """Full distributed fit: fused (single-pass EM + packed allreduce) ==
+    unfused (two-pass + three collectives) on every loop form."""
+    x, _, centers = blobs
+    params = KMeansParams(n_clusters=4, init=InitMethod.Array, max_iter=50,
+                          tol=1e-4)
+    for loop in ("device", "fori"):
+        a = kmeans_mnmg.fit(params, comms, x, centroids=centers, loop=loop,
+                            fused=True)
+        b = kmeans_mnmg.fit(params, comms, x, centroids=centers, loop=loop,
+                            fused=False)
+        assert int(a.n_iter) == int(b.n_iter)
+        np.testing.assert_allclose(np.asarray(a.centroids),
+                                   np.asarray(b.centroids), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(float(a.inertia), float(b.inertia),
+                                   rtol=1e-4)
+
+
 def test_uneven_shards_rejected(comms):
     from raft_tpu.core import LogicError
 
