@@ -15,7 +15,8 @@ dominated: ~100 MB output at ~200 µs end-to-end).  vs_baseline is
 value / estimate, where ≥0.8 meets the north-star target.
 
 Select a metric with
-BENCH_METRIC=pairwise|kmeans|kmeans_mnmg|ivf_pq|lanczos|knn_bruteforce.
+BENCH_METRIC=pairwise|kmeans|kmeans_mnmg|ivf_pq|ivf_pq_search|lanczos|
+knn_bruteforce.
 
 Robust bring-up (the round-1 failure was an unguarded TPU backend init):
 the measurement runs in a *child* process under a watchdog.  The parent
@@ -231,6 +232,67 @@ def bench_ivf_pq():
     }
 
 
+def bench_ivf_pq_search():
+    """IVF-PQ search queries/s on the hoisted-ADC LUT pipeline (10k×128
+    f32, pq_dim=32 pq_bits=8, n_lists=100, n_probes=20, k=10) — the
+    scan-body A/B for the hoist PR, smaller than bench_ivf_pq's recall-
+    gated config so the A/B turns around fast on CPU.
+
+    Reports the HOISTED pipeline by default (build-time list-side ADC
+    tables + per-batch query LUT threaded through the probe scan as xs —
+    docs/ivf_pq_adc.md); ``RAFT_TPU_HOISTED_LUT=0`` restores the pre-PR
+    in-scan per-tile LUT recompute for the A/B, mirroring
+    ``RAFT_TPU_FUSED_EM`` — the row carries a "hoisted" field saying which
+    ran.  The two paths' f32-LUT top-k indices are asserted identical
+    here (acceptance gate), so an A/B pair is always comparing equal
+    outputs.
+    """
+    import jax
+
+    from raft_tpu.neighbors import ivf_pq
+
+    from bench.common import timed_chained
+
+    n, dim, nq, k = 10_000, 128, 1024, 10
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (n, dim)).astype(np.float32)
+    q = rng.normal(0, 1, (nq, dim)).astype(np.float32)
+    index = ivf_pq.build(ivf_pq.IndexParams(n_lists=100, pq_dim=32,
+                                            pq_bits=8, seed=1), x)
+    hoisted = ivf_pq.hoisted_lut_enabled()
+    sp = ivf_pq.SearchParams(n_probes=20, hoisted_lut=hoisted)
+    # equal-output guard: hoisted and in-scan f32 paths must agree exactly
+    # on the top-k ids before either side's qps is worth recording
+    qs = jax.device_put(q[:64])
+    i_h = np.asarray(ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=20, hoisted_lut=True), index, qs, k)[1])
+    i_l = np.asarray(ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=20, hoisted_lut=False), index, qs, k)[1])
+    if jax.default_backend() == "cpu":
+        # the CPU acceptance gate: both pipelines sum the same ADC
+        # decomposition in f64-accurate f32 — ids must match exactly
+        assert np.array_equal(i_h, i_l), "hoisted f32 top-k != in-scan top-k"
+    else:
+        # accelerator matmuls run the two pipelines' sums at different
+        # associativity/precision (default-precision einsums) — near-ties
+        # at the k boundary may flip rank; gate on overlap instead
+        ov = np.mean([len(set(a.tolist()) & set(b.tolist())) / k
+                      for a, b in zip(i_h, i_l)])
+        assert ov >= 0.95, f"hoisted vs in-scan top-k overlap {ov:.3f}"
+    qd = jax.device_put(q)
+    best = timed_chained(lambda qq: ivf_pq.search(sp, index, qq, k), qd,
+                         lambda qq, out: qq + 1e-12 * out[0][0, 0], iters=5)
+    qps = nq / best
+    # A100 reference ballpark for this small config ~100k qps
+    return {
+        "metric": f"ivf_pq_search_10kx128_pq8_probes20_q{nq}",
+        "value": round(qps, 1),
+        "unit": "qps",
+        "vs_baseline": round(qps / 100_000.0, 3),
+        "hoisted": hoisted,
+    }
+
+
 def bench_knn_bruteforce():
     """Brute-force kNN queries/s on the fused tiled scan (100k×64 f32,
     1024 queries, k=10, L2Sqrt) — the substrate under knn_mnmg,
@@ -305,6 +367,7 @@ def bench_lanczos():
 
 _METRICS = {"pairwise": bench_pairwise, "kmeans": bench_kmeans,
             "kmeans_mnmg": bench_kmeans_mnmg, "ivf_pq": bench_ivf_pq,
+            "ivf_pq_search": bench_ivf_pq_search,
             "lanczos": bench_lanczos, "knn_bruteforce": bench_knn_bruteforce}
 
 

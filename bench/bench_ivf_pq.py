@@ -1,0 +1,76 @@
+"""IVF-PQ search microbenches — the hoisted-ADC pipeline A/B
+(docs/ivf_pq_adc.md; reference cpp/bench/neighbors/knn.cu IVF-PQ rows).
+
+``search_hoisted`` vs ``search_inscan`` time the SAME index and query set
+with only the LUT pipeline flipped (``SearchParams.hoisted_lut``, which
+overrides the ``RAFT_TPU_HOISTED_LUT`` env gate), backing bench.py's
+``ivf_pq_search`` headline A/B: hoisted = build-time list-side ADC tables
++ one per-batch query-cross einsum + lookup-only scan body; inscan =
+the pre-hoist per-tile LUT recompute.  ``search_hoisted_fp8`` adds the
+compressed-LUT variant (per-probe combined tables + single per-query
+affine quantization)."""
+
+import numpy as np
+
+from bench.common import case, main_for
+from bench.sizes import size
+
+_N = size(10_000, 4096)
+_D = size(128, 32)
+_NQ = size(1024, 64)
+_LISTS = size(100, 16)
+_K = 10
+_PROBES = 20
+
+_STATE = {}
+
+
+def _built():
+    """One shared (index, device queries) per process — both A/B sides must
+    score the identical index or the comparison is meaningless."""
+    if "index" not in _STATE:
+        import jax
+
+        from raft_tpu.neighbors import ivf_pq
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(0, 1, (_N, _D)).astype(np.float32)
+        q = rng.normal(0, 1, (_NQ, _D)).astype(np.float32)
+        _STATE["index"] = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=_LISTS, pq_dim=min(32, _D),
+                               pq_bits=8, seed=1), x)
+        _STATE["q"] = jax.device_put(q)
+    return _STATE["index"], _STATE["q"]
+
+
+def _search_case(hoisted: bool, lut_dtype: str = "float32"):
+    from raft_tpu.neighbors import ivf_pq
+
+    index, q = _built()
+    sp = ivf_pq.SearchParams(n_probes=_PROBES, lut_dtype=lut_dtype,
+                             hoisted_lut=hoisted)
+    return (lambda: ivf_pq.search(sp, index, q, _K)[1]), {"items": _NQ}
+
+
+@case("ivf_pq/search_hoisted")
+def bench_search_hoisted():
+    return _search_case(hoisted=True)
+
+
+@case("ivf_pq/search_inscan")
+def bench_search_inscan():
+    return _search_case(hoisted=False)
+
+
+@case("ivf_pq/search_hoisted_fp8")
+def bench_search_hoisted_fp8():
+    return _search_case(hoisted=True, lut_dtype="float8_e4m3")
+
+
+@case("ivf_pq/search_inscan_fp8")
+def bench_search_inscan_fp8():
+    return _search_case(hoisted=False, lut_dtype="float8_e4m3")
+
+
+if __name__ == "__main__":
+    main_for("bench.bench_ivf_pq")

@@ -14,6 +14,17 @@ high-signal subset with stdlib ast/tokenize:
     one-hot path when profitable) or reduce.segment_sum; the ivf_pq
     codebook M-step silently missing the one-hot path (PR 2) is exactly
     the regression class this catches
+  * ``einsum``/``take_along_axis`` calls that CLOSE OVER out-of-callback
+    operands inside a tile callback passed to ``scan_probe_lists``
+    (raft_tpu/neighbors/ only) — per-batch-invariant LUT/scoring work
+    belongs OUTSIDE the probe scan, hoisted and threaded through as xs
+    (the ivf_pq hoisted-ADC pipeline, docs/ivf_pq_adc.md); an einsum over
+    closed-over codebooks re-entering the scan body is exactly the
+    regression the hoist PR removed.  Calls whose operands are all
+    callback-local (e.g. the ADC lookup contraction over the gathered
+    tile + threaded xs slice) pass; sanctioned closures (the
+    HOISTED_LUT=0 legacy baseline, ivf_flat's tile-scoring GEMM) carry an
+    ``adc-exempt`` marker comment on the call line.
 
 Exit code 1 on any finding.  Run: ``python ci/lint.py [paths...]``.
 """
@@ -25,6 +36,169 @@ import pathlib
 import sys
 
 MAX_LINE = 100
+
+_SCAN_CALLBACK_BANNED = ("einsum", "take_along_axis")
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _direct_bindings(fn) -> set:
+    """Names bound in *fn*'s OWN scope: params, direct assignments, loop /
+    comprehension / with targets, and the names of nested defs — but NOT
+    anything bound only inside a nested def's body.  Per-scope resolution
+    keeps the probe-scan rule honest: a closed-over operand that happens to
+    share a name with some nested helper's local must still read as
+    closed-over at the callsite's scope."""
+    bound = set()
+    a = fn.args
+    for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                + ([a.vararg] if a.vararg else [])
+                + ([a.kwarg] if a.kwarg else [])):
+        bound.add(arg.arg)
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            bound.add(node.name)        # the def name binds here ...
+            continue                    # ... its body is a nested scope
+        if isinstance(node, ast.Lambda):
+            continue
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            bound.add(node.id)
+        stack.extend(ast.iter_child_nodes(node))
+    return bound
+
+
+def _tainted_names(fn, local, module_names) -> set:
+    """Locals of *fn* assigned (in its own scope) from expressions that
+    reference closed-over or already-tainted names — the aliases that
+    would otherwise launder a closed-over operand past the probe-scan rule
+    (``cb = codebooks; jnp.einsum(..., r, cb)`` is exactly the legacy
+    per-tile LUT recompute shape).  Gather-derived tiles (``data =
+    big[rows]``) taint too: einsums over them are O(tile) scoring work,
+    sanctioned via the ``adc-exempt`` marker (ivf_flat's GEMM)."""
+    assigns = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue                    # nested scopes taint separately
+        if isinstance(node, ast.Assign):
+            assigns.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    tainted = set()
+    changed = True
+    while changed:                      # fixpoint over alias chains
+        changed = False
+        for node in assigns:
+            loads = {n.id for n in ast.walk(node.value)
+                     if isinstance(n, ast.Name)
+                     and isinstance(n.ctx, ast.Load)}
+            if any(nm in tainted
+                   or (nm not in local and nm not in module_names)
+                   for nm in loads):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id not in tainted:
+                        tainted.add(t.id)
+                        changed = True
+    return tainted
+
+
+def check_probe_scan_callbacks(tree, lines):
+    """The hoisted-ADC regression guard (scoped to raft_tpu/neighbors/):
+    einsum/take_along_axis inside a ``scan_probe_lists`` tile callback may
+    only consume CALLBACK-LOCAL data (the gathered tile, the threaded xs
+    slice) — an operand closed over from the enclosing search scope means
+    per-batch-invariant LUT work crept back into the scan body, the exact
+    per-tile recompute the hoist PR removed (docs/ivf_pq_adc.md).
+    ``adc-exempt`` on the call line sanctions a closure (the HOISTED_LUT=0
+    legacy baseline, ivf_flat's tile-scoring GEMM over closed-over
+    queries).  Helper closures invoked FROM a callback (e.g. the flattened
+    ADC lookup `_lookup`) are outside the rule by construction — they
+    receive the tile + LUT as arguments, closing over nothing per-batch."""
+    # tile callbacks = 2nd positional arg of every scan_probe_lists call
+    cb_names, cb_lambdas = set(), []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and _call_name(node) == "scan_probe_lists"
+                and len(node.args) >= 2):
+            cb = node.args[1]
+            if isinstance(cb, ast.Name):
+                cb_names.add(cb.id)
+            elif isinstance(cb, ast.Lambda):
+                cb_lambdas.append(cb)
+    callbacks = list(cb_lambdas)
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.FunctionDef) and node.name in cb_names):
+            callbacks.append(node)
+    # module-level names (imports, module defs/aliases like jnp) are not
+    # "closed-over operands" for this rule
+    module_names = set()
+    for node in tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                module_names.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, (ast.FunctionDef, ast.ClassDef)):
+            module_names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    module_names.add(t.id)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            if isinstance(node.target, ast.Name):
+                module_names.add(node.target.id)
+    findings = []
+
+    def check_scope(fn, inherited):
+        """Check one function scope; recurse into nested defs with this
+        scope's locals inherited (lexical scoping).  A local counts as
+        closed-over when it merely aliases / derives from closed-over data
+        (``_tainted_names``), so renaming can't launder the operand."""
+        local = (inherited | _direct_bindings(fn)) - _tainted_names(
+            fn, inherited | _direct_bindings(fn), module_names)
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                check_scope(node, local)
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+            if (not isinstance(node, ast.Call)
+                    or _call_name(node) not in _SCAN_CALLBACK_BANNED):
+                continue
+            # marker may ride the call line or the comment line above it
+            ctx = lines[max(0, node.lineno - 2):node.lineno]
+            if any("adc-exempt" in ln or "noqa" in ln for ln in ctx):
+                continue
+            free = set()
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for n in ast.walk(arg):
+                    if (isinstance(n, ast.Name)
+                            and isinstance(n.ctx, ast.Load)
+                            and n.id not in local
+                            and n.id not in module_names):
+                        free.add(n.id)
+            if free:
+                findings.append((
+                    node.lineno,
+                    f"{_call_name(node)} over closed-over operand(s) "
+                    f"{sorted(free)} inside a scan_probe_lists tile "
+                    "callback — hoist per-batch-invariant LUT work out of "
+                    "the probe scan and thread it as xs (docs/"
+                    "ivf_pq_adc.md), or mark the line adc-exempt"))
+
+    for cb in callbacks:
+        check_scope(cb, set())
+    return findings
 
 
 def check_file(path: pathlib.Path):
@@ -61,6 +235,10 @@ def check_file(path: pathlib.Path):
                                  "raw jax.ops.segment_sum outside "
                                  "linalg/reduce.py — use "
                                  "raft_tpu.linalg.reduce helpers"))
+
+    # probe-scan tile callbacks must stay lookup-only (hoisted-ADC guard)
+    if "raft_tpu/neighbors/" in posix:
+        findings.extend(check_probe_scan_callbacks(tree, lines))
 
     # format specs are themselves JoinedStr nodes — exclude them from the
     # placeholder check

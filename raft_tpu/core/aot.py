@@ -174,8 +174,17 @@ class AotFunction:
     def _signature(self, args):
         """Hashable signature; dynamic args may be pytrees of arrays (the
         reference's runtime API passes whole index structures by pointer —
-        here a tuple of device arrays plays that role)."""
-        sig = []
+        here a tuple of device arrays plays that role).
+
+        The DEFAULT DEVICE is part of the key: ``compiled()`` lowers for the
+        default device at compile time, so a process that changes it (e.g.
+        a test harness flipping jax_platforms, a ``jax.default_device``
+        context, or the ivf_pq search path whose lowering branches on
+        ``jax.default_backend()``) must miss the cache rather than dispatch
+        an executable built for another device.
+        """
+        default = jax.config.jax_default_device or jax.devices()[0]
+        sig = [("device", str(default))]
         for i, a in enumerate(args):
             if i in self._static:
                 sig.append(("static", a))

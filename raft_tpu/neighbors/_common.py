@@ -74,8 +74,15 @@ def pack_lists_chunked(payload, ids, labels, n_lists: int,
     phys_sizes (n_phys+1,) int32, logical_counts (n_lists,) int32,
     chunk_table (n_lists, max_chunks) int32 physical-row ids (dummy-padded),
     owner (n_phys+1,) int32 logical list of each physical row, cap).
+
+    *payload* may be a TUPLE of (n, …) arrays sharing ids/labels (e.g.
+    ivf_pq's packed codes + per-candidate ADC sums): each is scattered with
+    the SAME layout and ``data`` comes back as the matching tuple — one
+    rank computation, one set of tables, several aligned payloads.
     """
-    n = payload.shape[0]
+    multi = isinstance(payload, (tuple, list))
+    payloads = tuple(payload) if multi else (payload,)
+    n = payloads[0].shape[0]
     labels_h = np.asarray(labels)
     counts = np.bincount(labels_h, minlength=n_lists).astype(np.int64)
     if chunk_cap is None:
@@ -107,14 +114,17 @@ def pack_lists_chunked(payload, ids, labels, n_lists: int,
     starts_j = jnp.asarray(starts[:n_lists], jnp.int32)
     phys = starts_j[labels] + rank // cap
     flat_pos = phys * cap + rank % cap
-    tail = payload.shape[1:]
-    data = jnp.zeros(((n_phys + 1) * cap,) + tail, payload.dtype
-                     ).at[flat_pos].set(payload)
-    data = data.reshape((n_phys + 1, cap) + tail)
+    datas = []
+    for p in payloads:
+        tail = p.shape[1:]
+        d = jnp.zeros(((n_phys + 1) * cap,) + tail, p.dtype
+                      ).at[flat_pos].set(p)
+        datas.append(d.reshape((n_phys + 1, cap) + tail))
     idx = jnp.full(((n_phys + 1) * cap,), -1, jnp.int32
                    ).at[flat_pos].set(jnp.asarray(ids, jnp.int32)
                                       ).reshape(n_phys + 1, cap)
-    return (data, idx, jnp.asarray(phys_sizes),
+    return (tuple(datas) if multi else datas[0], idx,
+            jnp.asarray(phys_sizes),
             jnp.asarray(counts.astype(np.int32)),
             jnp.asarray(chunk_table), jnp.asarray(owner), cap)
 
@@ -139,12 +149,19 @@ def extend_lists_chunked(data, idx, list_sizes, chunk_table,
     (n_new,) ids / labels of the rows to add.  Returns the same tuple shape
     as pack_lists_chunked: (data, idx, phys_sizes, logical_counts,
     chunk_table, owner, cap).
+
+    Like :func:`pack_lists_chunked`, *data* / *payload_new* may be matching
+    TUPLES of aligned payloads; ``data`` comes back as the same tuple.
     """
+    multi = isinstance(data, (tuple, list))
+    datas = tuple(data) if multi else (data,)
+    payloads_new = (tuple(payload_new) if multi else (payload_new,))
+    data = datas[0]
     n_lists, max_chunks = chunk_table.shape
     cap = data.shape[1]
     n_phys = data.shape[0] - 1          # last physical row = reserved dummy
     dummy_old = n_phys
-    n_new = payload_new.shape[0]
+    n_new = payloads_new[0].shape[0]
 
     labels_h = np.asarray(labels_new)
     counts_old = np.asarray(list_sizes).astype(np.int64)
@@ -188,28 +205,34 @@ def extend_lists_chunked(data, idx, list_sizes, chunk_table,
     # --- payload scatter: new row (label l, rank r) lands at logical
     # position counts_old[l] + r → (chunk ordinal, slot) → physical row via
     # the updated table ---
-    tail = payload_new.shape[1:]
-    data2 = jnp.concatenate(
-        [data[:n_phys],
-         jnp.zeros((m + 1, cap) + tail, data.dtype)], axis=0)
-    idx2 = jnp.concatenate(
-        [idx[:n_phys], jnp.full((m + 1, cap), -1, jnp.int32)], axis=0)
     if n_new:
         rank = _ranks_within(jnp.asarray(labels_new), n_new, n_lists)
         pos = jnp.asarray(counts_old, jnp.int32)[labels_new] + rank
         ci, slot = pos // cap, pos % cap
         phys = jnp.asarray(table2)[labels_new, ci]
         flat = phys * cap + slot
-        data2 = data2.reshape((-1,) + tail).at[flat].set(
-            payload_new.astype(data.dtype)).reshape(data2.shape)
+    datas2 = []
+    for d, p_new in zip(datas, payloads_new):
+        tail = p_new.shape[1:]
+        d2 = jnp.concatenate(
+            [d[:n_phys], jnp.zeros((m + 1, cap) + tail, d.dtype)], axis=0)
+        if n_new:
+            d2 = d2.reshape((-1,) + tail).at[flat].set(
+                p_new.astype(d.dtype)).reshape(d2.shape)
+        datas2.append(d2)
+    idx2 = jnp.concatenate(
+        [idx[:n_phys], jnp.full((m + 1, cap), -1, jnp.int32)], axis=0)
+    if n_new:
         idx2 = idx2.reshape(-1).at[flat].set(
             jnp.asarray(ids_new, jnp.int32)).reshape(idx2.shape)
-    return (data2, idx2, jnp.asarray(phys_sizes2),
+    return (tuple(datas2) if multi else datas2[0], idx2,
+            jnp.asarray(phys_sizes2),
             jnp.asarray(counts_total.astype(np.int32)),
             jnp.asarray(table2), jnp.asarray(owner2), cap)
 
 
-def expand_probes(probe_ids, chunk_table, n_rows: int):
+def expand_probes(probe_ids, chunk_table, n_rows: int,
+                  return_ord: bool = False):
     """(nq, n_probes) logical probes → (nq, budget) physical rows.
 
     *n_rows* is the physical block's leading dim (n_phys + 1; the reserved
@@ -222,6 +245,15 @@ def expand_probes(probe_ids, chunk_table, n_rows: int):
     all scoring the masked dummy tile when one skewed list dominates.
     Chunk-major pre-order keeps the first chunk of every probe in the
     earliest scan steps.
+
+    With ``return_ord=True`` also returns the PROBE ORDINAL (nq, budget)
+    int32 of each physical slot — which of the query's n_probes coarse
+    probes the slot's chunk belongs to (continuation chunks of one list
+    share their probe's ordinal; dummy slots carry the ordinal of whatever
+    probe their pre-compaction position tiled from, harmless because the
+    dummy row's size is 0 and its scores are masked).  This is what lets a
+    per-(query, probe) lookup table computed ONCE per batch be gathered
+    into per-scan-step xs slices (ivf_pq hoisted-ADC pipeline).
     """
     n_probes = probe_ids.shape[1]
     n_lists = chunk_table.shape[0]
@@ -229,15 +261,20 @@ def expand_probes(probe_ids, chunk_table, n_rows: int):
     extra = max(0, (n_rows - 1) - n_lists)
     ph = chunk_table[probe_ids]               # (nq, n_probes, max_chunks)
     flat = jnp.swapaxes(ph, 1, 2).reshape(probe_ids.shape[0], -1)
+    # chunk-major flattening: flat position j holds probe ordinal j % n_probes
+    ord_flat = jnp.broadcast_to(
+        jnp.arange(flat.shape[1], dtype=jnp.int32) % n_probes, flat.shape)
     budget = min(flat.shape[1], n_probes + extra)
-    if budget == flat.shape[1]:
-        return flat
-    order = jnp.argsort(flat == dummy, axis=1, stable=True)[:, :budget]
-    return jnp.take_along_axis(flat, order, axis=1)
+    if budget != flat.shape[1]:
+        order = jnp.argsort(flat == dummy, axis=1, stable=True)[:, :budget]
+        flat = jnp.take_along_axis(flat, order, axis=1)
+        ord_flat = jnp.take_along_axis(ord_flat, order, axis=1)
+    return (flat, ord_flat) if return_ord else flat
 
 
 def scan_probe_lists(probe_ids, score_tile: Callable, list_indices,
-                     list_sizes, k: int, select_min: bool, dtype
+                     list_sizes, k: int, select_min: bool, dtype,
+                     xs: Optional[Tuple] = None
                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Running top-k over per-query probed lists — the shared inner loop of
     IVF-Flat, IVF-PQ and ball-cover search.
@@ -246,15 +283,28 @@ def scan_probe_lists(probe_ids, score_tile: Callable, list_indices,
     distances/similarities for each query's gathered list; padding slots
     (position ≥ list size) are masked to the sentinel here.  Returns
     (best_d (nq, k), best_i (nq, k) int32, -1 for empty slots).
+
+    *xs*: optional tuple of per-step arrays threaded through the scan as
+    additional ``lax.scan`` xs — each has leading dim equal to
+    ``probe_ids.shape[1]`` (the scan axis: the EXPANDED physical budget
+    when the caller scans ``expand_probes`` output, which exceeds the
+    logical n_probes when lists span multiple chunks) and its per-step
+    slice is passed to ``score_tile(lists, *slices)``.
+    This is how per-batch-invariant work hoisted OUT of the scan reaches
+    the tile callback (the fused-kNN scan threads per-row metric stats the
+    same way; ivf_pq's hoisted-ADC pipeline threads the quantized lookup
+    table and per-probe base terms) without the callback closing over and
+    recomputing it once per step.
     """
     nq = probe_ids.shape[0]
     cap = list_indices.shape[1]
     sentinel = jnp.asarray(jnp.inf if select_min else -jnp.inf, dtype)
     kk = min(k, cap)
 
-    def step(carry, probe_col):
+    def step(carry, inp):
+        probe_col, extras = inp[0], inp[1:]
         best_d, best_i = carry
-        d = score_tile(probe_col).astype(dtype)
+        d = score_tile(probe_col, *extras).astype(dtype)
         ids = list_indices[probe_col]
         sizes = list_sizes[probe_col]
         live = jnp.arange(cap)[None, :] < sizes[:, None]
@@ -268,8 +318,8 @@ def scan_probe_lists(probe_ids, score_tile: Callable, list_indices,
 
     init = (jnp.full((nq, k), sentinel, dtype),
             jnp.full((nq, k), -1, jnp.int32))
-    (best_d, best_i), _ = jax.lax.scan(step, init,
-                                       jnp.swapaxes(probe_ids, 0, 1))
+    (best_d, best_i), _ = jax.lax.scan(
+        step, init, (jnp.swapaxes(probe_ids, 0, 1),) + tuple(xs or ()))
     return best_d, best_i
 
 

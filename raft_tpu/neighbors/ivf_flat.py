@@ -277,7 +277,10 @@ def _scan_probes(queries, probe_ids, index_leaves, metric_val: int, k: int,
 
     def score_tile(rows):
         data = list_data[rows].astype(queries.dtype)        # (nq, cap, dim)
-        dots = jnp.einsum("qd,qcd->qc", queries, data,
+        # the tile-SCORING GEMM against the gathered rows — O(tile) work
+        # by construction, not per-batch LUT recompute (the ci/lint.py
+        # probe-scan rule's regression class)
+        dots = jnp.einsum("qd,qcd->qc", queries, data,  # adc-exempt
                           preferred_element_type=acc_t)
         if is_ip:
             return dots

@@ -18,10 +18,20 @@ TPU-first redesign:
   padded dense (n_lists, capacity, ⌈pq_dim·pq_bits/8⌉) uint8 blocks
   (reference packing contract ivf_pq_types.hpp:56-65 — a pq_bits=4 index
   costs half the bytes of pq_bits=8); search unpacks each gathered probe
-  tile with VPU shift/mask ops.  The LUT is a per-(query-batch)
-  (nq, pq_dim, 2^bits) array resident in VMEM during the scoring gather,
-  and scoring is ``Σ_m LUT[q, m, code[q, c, m]]`` — a one-hot contraction
-  XLA fuses with the running top-k merge.
+  tile with VPU shift/mask ops.
+- HOISTED ADC pipeline (default; docs/ivf_pq_adc.md): the classic ADC
+  decomposition ``‖r − c‖² = ‖r‖² − 2·rot_q·c + 2·ctr_rot·c + ‖c‖²``
+  splits the LUT into a list-side part that is constant at BUILD time
+  (``Index.list_adc`` = ‖c‖² + 2·ctr_rot·c, (n_lists, pq_dim, 2^bits))
+  and a query-side part computed ONCE per query batch (−2·rot_q·c, one
+  einsum for the whole batch).  The combined per-(query, probe) LUT is
+  quantized with a SINGLE per-(query, probe-set) affine and threaded
+  through the probe scan as ``lax.scan`` xs, so the scan body is only
+  bit-unpack + ``Σ_m LUT[q, m·2^bits + code[q, c, m]]`` — one flattened
+  take_along_axis on CPU / one one-hot MXU contraction on TPU, instead of
+  re-deriving the codebook einsums + norm epilogues + re-quantization per
+  physical chunk tile.  ``RAFT_TPU_HOISTED_LUT=0`` (or
+  ``SearchParams.hoisted_lut=False``) restores the pre-PR in-scan path.
 - Codebook training is Lloyd k-means ``vmap``-ed over subspaces (or over
   clusters for PER_CLUSTER) — all codebooks train simultaneously on the
   MXU instead of the reference's sequential per-subspace loop.
@@ -37,10 +47,12 @@ carries a ``dataset_dtype`` tag enforcing extend/search consistency.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import enum
 import functools
-from typing import Tuple
+import os
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -73,6 +85,21 @@ _LUT_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
 # (reference lut_dtype CUDA_R_8U plays the same compressed-LUT role,
 # ivf_pq_types.hpp:94-100).
 _FP8_PEAK = 440.0
+
+#: Trace-time counters (the ``Comms.collective_calls`` pattern): bumped
+#: while the search program is being TRACED, so tests can assert where the
+#: LUT gets built — ``in_scan_lut_builds`` increments once per trace of the
+#: legacy per-tile recompute path, ``hoisted_lut_builds`` once per trace of
+#: the per-batch hoisted build.  A hoisted-path trace bumping the in-scan
+#: counter would mean codebook einsums crept back into the scan body.
+lut_trace_counters: collections.Counter = collections.Counter()
+
+
+def hoisted_lut_enabled() -> bool:
+    """``RAFT_TPU_HOISTED_LUT`` env gate (default ON).
+    ``RAFT_TPU_HOISTED_LUT=0`` restores the pre-PR in-scan LUT recompute
+    for A/B measurement, mirroring ``RAFT_TPU_FUSED_EM``."""
+    return os.environ.get("RAFT_TPU_HOISTED_LUT", "1") != "0"
 
 
 class CodebookKind(enum.IntEnum):
@@ -120,6 +147,9 @@ class SearchParams:
     # CUDA_R_8U, ivf_pq_types.hpp:94-100)
     lut_dtype: str = "float32"
     internal_distance_dtype: str = "float32"  # float32 | float16
+    # None → RAFT_TPU_HOISTED_LUT env gate (default on).  False forces the
+    # pre-PR in-scan LUT recompute (the A/B baseline).
+    hoisted_lut: Optional[bool] = None
 
 
 @jax.tree_util.register_pytree_node_class
@@ -142,6 +172,18 @@ class Index:
     ``chunk_table``  (n_lists, max_chunks) int32 logical → physical rows
     ``owner``        (n_phys+1,) int32 logical list of each physical row
     ``list_sizes``   (n_lists,) int32 logical sizes
+    ``list_adc``     (n_lists, pq_dim, 2^bits) f32 — BUILD-TIME list-side
+                     ADC table ‖c‖² + 2·ctr_rot·c (codebook sq-norms folded
+                     with the center-cross term; :func:`_build_list_adc`).
+                     Constant per trained model.  Exact f32 regardless of
+                     the search-time ``lut_dtype``.
+    ``list_csum``    (n_phys+1, cap) f32 — the list-side table CONTRACTED
+                     per stored candidate at encode time:
+                     ``Σ_m list_adc[owner, m, code_m]`` (=‖decoded‖²
+                     + 2·ctr_rot·decoded, :func:`_csum_for_codes`), packed
+                     alongside ``list_codes``.  The lookup is linear in the
+                     LUT, so the hoisted search adds this scalar instead of
+                     gathering/combining per-(query, probe) list tables.
     """
 
     centers: jnp.ndarray
@@ -153,6 +195,8 @@ class Index:
     phys_sizes: jnp.ndarray
     chunk_table: jnp.ndarray
     owner: jnp.ndarray
+    list_adc: jnp.ndarray
+    list_csum: jnp.ndarray
     metric: DistanceType
     codebook_kind: CodebookKind
     pq_bits: int
@@ -196,7 +240,8 @@ class Index:
     def tree_flatten(self):
         leaves = (self.centers, self.rotation, self.codebooks,
                   self.list_codes, self.list_indices, self.list_sizes,
-                  self.phys_sizes, self.chunk_table, self.owner)
+                  self.phys_sizes, self.chunk_table, self.owner,
+                  self.list_adc, self.list_csum)
         return leaves, (self.metric, self.codebook_kind, self.pq_bits,
                         self.dataset_dtype)
 
@@ -400,6 +445,85 @@ def _encode(residuals, codebooks, labels, per_cluster: bool):
     return jnp.argmin(d, axis=-1).astype(jnp.uint8)
 
 
+@functools.partial(jax.jit, static_argnums=(3,))
+def _build_list_adc(centers, rotation, codebooks, per_cluster: bool):
+    """BUILD-TIME list-side ADC table (n_lists, pq_dim, 2^bits) f32:
+
+        list_adc[l, m, k] = ‖cb‖² + 2·ctr_rot[l, m]·cb
+
+    where ``ctr_rot[l, m]`` is subspace m of the rotated coarse center and
+    ``cb`` is codebook entry k of subspace m (PER_SUBSPACE) / of list l's
+    codebook (PER_CLUSTER — the per-list gather folds into the same
+    (n_lists, pq_dim, 2^bits) layout).  These are the two query-independent
+    terms of the ADC decomposition ``‖r − c‖² = ‖r‖² − 2·rot_q·c
+    + 2·ctr_rot·c + ‖c‖²``; computed exactly in f32 once per trained model
+    instead of re-derived per probe tile at search time."""
+    rot_centers = centers @ rotation                     # (L, rot_dim)
+    if per_cluster:
+        ds = codebooks.shape[2]
+        pq_dim = rot_centers.shape[1] // ds
+        ctr = rot_centers.reshape(-1, pq_dim, ds)
+        cb_sq = jnp.sum(codebooks ** 2, -1)              # (L, kcb)
+        cross = jnp.einsum("lmd,lkd->lmk", ctr, codebooks)
+        return cb_sq[:, None, :] + 2.0 * cross
+    pq_dim, _, ds = codebooks.shape
+    ctr = rot_centers.reshape(-1, pq_dim, ds)
+    cb_sq = jnp.sum(codebooks ** 2, -1)                  # (pq_dim, kcb)
+    cross = jnp.einsum("lmd,mkd->lmk", ctr, codebooks)
+    return cb_sq[None, :, :] + 2.0 * cross               # (L, pq_dim, kcb)
+
+
+@functools.partial(jax.jit, static_argnums=(5,))
+def _csum_for_codes(codes, labels, centers, rotation, codebooks,
+                    per_cluster: bool):
+    """Per-candidate contraction of the list-side ADC table:
+
+        csum[i] = Σ_m (‖cb_code‖² + 2·ctr_rot·cb_code)
+                = ‖decoded[i]‖² + 2·ctr_rot[label_i]·decoded[i]
+
+    where ``decoded`` is the candidate's reconstructed rotated residual.
+    The ADC lookup is LINEAR in the LUT, so the entire list-side half of
+    the decomposition collapses to this (n,) f32 scalar at ENCODE time —
+    the hoisted search adds it per gathered candidate instead of
+    materializing per-(query, probe) combined tables (which costs more
+    gather traffic than it saves; see docs/ivf_pq_adc.md).  Computed via
+    the decoded form: O(n·rot_dim), no (n, pq_dim, 2^bits) gather."""
+    n = codes.shape[0]
+    rot_centers = centers @ rotation
+    if per_cluster:
+        cbl = codebooks[labels]                          # (n, kcb, ds)
+        dec = jnp.take_along_axis(cbl, codes[:, :, None].astype(jnp.int32),
+                                  axis=1)                # (n, pq_dim, ds)
+        pq_dim = dec.shape[1]
+    else:
+        pq_dim = codebooks.shape[0]
+        dec = codebooks[jnp.arange(pq_dim)[None, :],
+                        codes.astype(jnp.int32)]         # (n, pq_dim, ds)
+    dec = dec.reshape(n, -1)                             # (n, rot_dim)
+    ctr = rot_centers[labels]
+    return jnp.sum(dec ** 2, -1) + 2.0 * jnp.sum(ctr * dec, -1)
+
+
+def _csum_for_packed(list_codes, owner, centers, rotation, codebooks,
+                     per_cluster: bool, pq_bits: int):
+    """``list_csum`` for an ALREADY-PACKED code block (legacy v1 archive
+    load): unpack every slot, contract, repack in place.  Padding slots get
+    garbage values — harmless, their scores are masked by ``phys_sizes``.
+    Transiently materializes the index-wide unpacked codes (compat path
+    only; fresh builds compute csum pre-pack)."""
+    rows, cap = list_codes.shape[0], list_codes.shape[1]
+    if per_cluster:
+        ds = codebooks.shape[2]
+        pq_dim = rotation.shape[1] // ds
+    else:
+        pq_dim = codebooks.shape[0]
+    codes = _unpack_codes(list_codes.reshape(rows * cap, -1), pq_dim,
+                          pq_bits)
+    labels = jnp.repeat(jnp.asarray(owner), cap)
+    return _csum_for_codes(codes, labels, centers, rotation, codebooks,
+                           per_cluster).reshape(rows, cap)
+
+
 @traced("raft_tpu.neighbors.ivf_pq.build")
 @auto_sync_handle
 def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
@@ -469,10 +593,12 @@ def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
     # 5) encode + bit-pack + scatter into lists (skipped entirely with
     # add_data_on_build=False: the trained model is kept, rows come later
     # via extend — reference ann::index_params::add_data_on_build)
+    per_cluster = params.codebook_kind == CodebookKind.PER_CLUSTER
     if params.add_data_on_build:
-        codes = _encode(resid, codebooks, labels,
-                        params.codebook_kind == CodebookKind.PER_CLUSTER)
+        codes = _encode(resid, codebooks, labels, per_cluster)
         packed = _pack_codes(codes, params.pq_bits)
+        csum = _csum_for_codes(codes, labels, centers, rotation, codebooks,
+                               per_cluster)
         if ids is None:
             ids = jnp.arange(n, dtype=jnp.int32)
         else:
@@ -483,14 +609,18 @@ def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
                 "rows — pass them to extend() instead")
         packed = jnp.zeros((0, _code_bytes(pq_dim, params.pq_bits)),
                            jnp.uint8)
+        csum = jnp.zeros((0,), jnp.float32)
         ids = jnp.zeros((0,), jnp.int32)
         labels = jnp.zeros((0,), jnp.int32)
-    (list_codes, list_indices, phys_sizes, list_sizes, chunk_table,
-     owner, _) = pack_lists_chunked(packed, ids, labels, n_lists)
+    ((list_codes, list_csum), list_indices, phys_sizes, list_sizes,
+     chunk_table, owner, _) = pack_lists_chunked((packed, csum), ids,
+                                                 labels, n_lists)
+    list_adc = _build_list_adc(centers, rotation, codebooks, per_cluster)
     return Index(centers=centers, rotation=rotation, codebooks=codebooks,
                  list_codes=list_codes, list_indices=list_indices,
                  list_sizes=list_sizes, phys_sizes=phys_sizes,
-                 chunk_table=chunk_table, owner=owner, metric=params.metric,
+                 chunk_table=chunk_table, owner=owner, list_adc=list_adc,
+                 list_csum=list_csum, metric=params.metric,
                  codebook_kind=params.codebook_kind, pq_bits=params.pq_bits,
                  dataset_dtype=dataset_dtype)
 
@@ -527,31 +657,197 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
     resid = (x - index.centers[labels]) @ index.rotation
     codes = _encode(resid, index.codebooks, labels, per_cluster)
     packed = _pack_codes(codes, index.pq_bits)
+    csum = _csum_for_codes(codes, labels, index.centers, index.rotation,
+                           index.codebooks, per_cluster)
 
     if base:
-        (list_codes, list_indices, phys_sizes, list_sizes, chunk_table,
-         owner, _) = extend_lists_chunked(
-            index.list_codes, index.list_indices, index.list_sizes,
-            index.chunk_table, packed, new_ids, labels)
+        ((list_codes, list_csum), list_indices, phys_sizes, list_sizes,
+         chunk_table, owner, _) = extend_lists_chunked(
+            (index.list_codes, index.list_csum), index.list_indices,
+            index.list_sizes, index.chunk_table, (packed, csum), new_ids,
+            labels)
     else:
-        (list_codes, list_indices, phys_sizes, list_sizes, chunk_table,
-         owner, _) = pack_lists_chunked(packed, new_ids, labels,
-                                        index.n_lists)
+        ((list_codes, list_csum), list_indices, phys_sizes, list_sizes,
+         chunk_table, owner, _) = pack_lists_chunked(
+            (packed, csum), new_ids, labels, index.n_lists)
+    # the trained model (centers/rotation/codebooks) is untouched by extend,
+    # so the build-time list-side ADC table carries over unchanged
     return Index(centers=index.centers, rotation=index.rotation,
                  codebooks=index.codebooks, list_codes=list_codes,
                  list_indices=list_indices, list_sizes=list_sizes,
                  phys_sizes=phys_sizes, chunk_table=chunk_table, owner=owner,
+                 list_adc=index.list_adc, list_csum=list_csum,
                  metric=index.metric, codebook_kind=index.codebook_kind,
                  pq_bits=index.pq_bits, dataset_dtype=index.dataset_dtype)
 
 
+def _scan_hoisted(q, probe_ids, rot_q, rot_centers, centers, codebooks,
+                  list_adc, list_csum, list_codes, list_indices, phys_sizes,
+                  chunk_table, nq: int, pq_dim: int, kcb: int, ds: int,
+                  k: int, is_ip: bool, per_cluster: bool,
+                  lut_dtype_name: str, acc_dtype, pq_bits: int):
+    """Hoisted-ADC probe scan: per-batch LUT stage + lookup-only scan body.
+
+    Stage 2 of the pipeline (stage 1 is the build-time ``list_adc`` /
+    ``list_csum``): for the whole query batch, compute the query-cross LUT
+    (−2·rot_q·codebooks for L2; rot_q·codebooks for IP — PER_SUBSPACE is
+    ONE einsum for the batch; PER_CLUSTER gathers the probed lists'
+    codebooks), quantize ONCE with a single per-(query, probe-set) affine
+    (:func:`_quantize_lut`), and thread the per-probe parts through the
+    probe scan as ``lax.scan`` xs via the expanded slots' probe ordinals.
+
+    The list-side half of the decomposition enters in one of two ways:
+
+    * ``lut_dtype=float32`` (no LUT compression): it does NOT enter the
+      LUT at all — the lookup is linear in the LUT, so the list-side
+      contribution is the per-candidate ``list_csum`` scalar precomputed
+      at encode time, added after the lookup.  For PER_SUBSPACE this makes
+      the LUT probe-INVARIANT (closed over by the scan body as a
+      constant): no per-(query, probe) combined-table materialization,
+      which measures SLOWER than the in-scan recompute on CPU — XLA:CPU
+      gathers are effectively single-threaded and combined tables cost
+      ~4× the legacy path's gathered bytes.
+    * compressed LUTs (bf16/f16/fp8): the stored ``list_adc`` is gathered
+      per probe and combined with the query-cross term BEFORE
+      quantization, exactly the reference's combined-LUT shape.  The
+      combined entries are small (the large ‖r‖²-free cross terms cancel
+      against the center-cross + sq-norm terms), so quantization error
+      stays relative to the quantity actually ranked — quantizing the raw
+      query-cross alone loses ~half the top-k to cancellation noise
+      (measured; docs/ivf_pq_adc.md).  ‖r‖² still rides the exact-f32
+      per-probe base, shrinking the fp8 dynamic range vs the legacy path.
+
+    Stage 3 is the scan body: bit-unpack + ONE flattened lookup — codes
+    offset by m·2^bits index a (nq, pq_dim·2^bits) LUT row, one
+    ``take_along_axis`` on CPU / one one-hot MXU einsum on TPU — replacing
+    the pq_dim sequential one-hot scan steps of the legacy path, plus the
+    csum gather and the threaded base add.  Per-probe work drops from
+    O(pq_dim·2^bits·ds) einsum flops + epilogues to a pure table lookup."""
+    lut_trace_counters["hoisted_lut_builds"] += 1
+    q_sub = rot_q.reshape(nq, pq_dim, ds)
+    # combined list+query LUT for compressed dtypes (quantization needs the
+    # small-dynamic-range combined entries); csum path for exact f32
+    combine = (not is_ip) and lut_dtype_name != "float32"
+    per_probe_lut = per_cluster or combine
+    if per_cluster:
+        cbp = codebooks[probe_ids]                      # (nq, P, kcb, ds)
+        qlut = jnp.einsum("qmd,qpkd->qpmk", q_sub, cbp)
+    else:
+        # ONE einsum for the whole batch — no per-tile owner gather; the
+        # size-1 probe axis keeps _quantize_lut single-shape
+        qlut = jnp.einsum("qmd,mkd->qmk", q_sub, codebooks)[:, None]
+    if is_ip:
+        # score = q·c + Σ_m rot_q·cb — no list-side term
+        base = jnp.einsum("qd,qpd->qp", q, centers[probe_ids])
+        lut = qlut
+    else:
+        lut = -2.0 * qlut
+        if combine:
+            lut = list_adc[probe_ids] + lut             # (nq, P, pq_dim, kcb)
+        # ‖r‖² — constant across a list's candidates, so it lives in the
+        # per-(query, probe) base, not the LUT (shrinks fp8 dynamic range)
+        rc = rot_centers[probe_ids]                     # (nq, P, rot_dim)
+        base = jnp.sum((rot_q[:, None, :] - rc) ** 2, axis=-1)
+    lut_q, base, scale = _quantize_lut(lut, base, lut_dtype_name)
+    lut_q = lut_q.reshape(nq, lut_q.shape[1], pq_dim * kcb)
+
+    phys_probes, probe_ord = expand_probes(
+        probe_ids, chunk_table, list_codes.shape[0], return_ord=True)
+    # per-scan-step xs: gather each physical slot's (probe ordinal) slice
+    # of the per-batch tables — (budget, nq, …) with the scan axis leading
+    base_xs = jnp.swapaxes(
+        jnp.take_along_axis(base, probe_ord, axis=1), 0, 1)
+    if per_probe_lut:
+        lut_xs = jnp.swapaxes(jnp.take_along_axis(
+            lut_q, probe_ord[:, :, None], axis=1), 0, 1)
+        xs = (lut_xs, base_xs)
+    else:
+        lut_flat = lut_q[:, 0]                          # (nq, pq_dim·kcb)
+        xs = (base_xs,)
+    offsets = jnp.arange(pq_dim, dtype=jnp.int32) * kcb
+
+    def _lookup(rows, lut_t):
+        """out[q, c] = Σ_m lut_t[q, m·kcb + code[q, c, m]] — the allowlisted
+        ADC lookup contraction; no LUT is built here."""
+        codes = _unpack_codes(list_codes[rows], pq_dim, pq_bits)
+        cap = codes.shape[1]
+        if jax.default_backend() == "cpu":
+            # CPU gathers are cheap (see the legacy path's measurement
+            # notes): ONE flattened take_along_axis for all subspaces
+            flat = (codes + offsets).reshape(nq, cap * pq_dim)
+            got = jnp.take_along_axis(lut_t, flat, axis=1)
+            return jnp.sum(got.astype(acc_dtype).reshape(nq, cap, pq_dim),
+                           axis=-1)
+        # TPU: the m-offset segments make the per-subspace one-hots one
+        # block-diagonal (cap, pq_dim·kcb) multi-hot — ONE MXU contraction
+        # instead of pq_dim sequential scan steps
+        oh = (codes[:, :, :, None] ==
+              jnp.arange(kcb, dtype=codes.dtype)).astype(lut_t.dtype)
+        return jnp.einsum("qck,qk->qc", oh.reshape(nq, cap, pq_dim * kcb),
+                          lut_t, preferred_element_type=acc_dtype)
+
+    add_csum = (not is_ip) and not combine
+
+    def _finish(rows, acc, base_t):
+        s = (acc.astype(jnp.float32) / scale[:, None]) + base_t[:, None]
+        # f32 path: list-side ADC contribution, contracted per candidate
+        # at encode time (combined-LUT path already carries it via
+        # list_adc; IP has no list-side term)
+        return s + list_csum[rows] if add_csum else s
+
+    if per_probe_lut:
+        def score_tile_hoisted(rows, lut_t, base_t):
+            return _finish(rows, _lookup(rows, lut_t), base_t)
+    else:
+        def score_tile_hoisted(rows, base_t):
+            return _finish(rows, _lookup(rows, lut_flat), base_t)
+
+    return scan_probe_lists(phys_probes, score_tile_hoisted, list_indices,
+                            phys_sizes, k, select_min=not is_ip,
+                            dtype=jnp.float32, xs=xs)
+
+
+def _quantize_lut(lut, base, lut_dtype_name: str):
+    """Quantize the per-batch query-side LUT (nq, P, pq_dim, kcb) f32 for
+    the scan (P = n_probes for PER_CLUSTER, 1 when probe-invariant),
+    returning (lut_q, base', scale).
+
+    fp8 contract (docs/ivf_pq_adc.md): each (query, probe, subspace) row is
+    shifted to 0 (the shift re-enters exactly via *base'*, f32), then ONE
+    scale per QUERY — computed over the query's ENTIRE probe set — maps the
+    peak to ``_FP8_PEAK``.  A single per-(query, probe-set) affine is what
+    makes the dequantized scores of candidates from different probe tiles
+    mutually comparable; the pre-hoist per-tile recompute re-derived
+    ``scale``/``lo`` from per-tile extrema, silently quantizing one query
+    with different affines across the tiles of one search (the latent fp8
+    bug this hoist fixes).  Positive affine maps preserve per-query
+    ranking; the scan inverts the map in f32 after lookup."""
+    nq = lut.shape[0]
+    if lut_dtype_name != "float8_e4m3":
+        return (lut.astype(_LUT_DTYPES[lut_dtype_name]), base,
+                jnp.ones((nq,), jnp.float32))
+    lo = jnp.min(lut, axis=-1, keepdims=True)       # (nq, P, pq_dim, 1)
+    lut0 = lut - lo
+    scale = _FP8_PEAK / jnp.maximum(
+        jnp.max(lut0, axis=(1, 2, 3)), 1e-30)       # (nq,) — ONE per query
+    lut_q = (lut0 * scale[:, None, None, None]).astype(jnp.float8_e4m3fn)
+    return lut_q, base + jnp.sum(lo[..., 0], axis=-1), scale
+
+
 def _search_batch_impl(q, probe_ids, leaves, metric_val: int, k: int,
                        per_cluster: bool, lut_dtype_name: str,
-                       int_dtype_name: str, pq_bits: int):
+                       int_dtype_name: str, pq_bits: int, hoisted: bool):
     """Score probed lists via per-query LUTs (reference similarity kernels
-    ivf_pq_search.cuh:594-738) with a running top-k merge."""
+    ivf_pq_search.cuh:594-738) with a running top-k merge.
+
+    *hoisted* (default path) builds the combined ADC LUT ONCE per (query
+    batch, probe set) — build-time ``list_adc`` + per-batch query-cross
+    einsum — quantizes it with a single per-query affine, and threads it
+    through the probe scan as xs; the scan body is pure bit-unpack +
+    flattened table lookup.  ``hoisted=False`` is the pre-PR per-tile
+    recompute, kept as the ``RAFT_TPU_HOISTED_LUT=0`` A/B baseline."""
     (centers, rotation, codebooks, list_codes, list_indices,
-     phys_sizes, chunk_table, owner) = leaves
+     phys_sizes, chunk_table, owner, list_adc, list_csum) = leaves
     nq = q.shape[0]
     is_ip = metric_val == int(DistanceType.InnerProduct)
     is_fp8 = lut_dtype_name == "float8_e4m3"
@@ -567,30 +863,49 @@ def _search_batch_impl(q, probe_ids, leaves, metric_val: int, k: int,
     else:
         pq_dim, kcb, ds = codebooks.shape
 
+    if hoisted:
+        best_d, best_i = _scan_hoisted(
+            q, probe_ids, rot_q, rot_centers, centers, codebooks,
+            list_adc, list_csum, list_codes, list_indices, phys_sizes,
+            chunk_table,
+            nq, pq_dim, kcb, ds, k, is_ip, per_cluster, lut_dtype_name,
+            acc_dtype, pq_bits)
+        if metric_val == int(DistanceType.L2SqrtExpanded):
+            best_d = jnp.sqrt(jnp.maximum(best_d, 0))
+        return best_d, best_i
+
+    lut_trace_counters["in_scan_lut_builds"] += 1
+
     def score_tile(rows):
         lists = owner[rows]                                # logical list ids
         c_rot = rot_centers[lists]                         # (nq, rot_dim)
         r = (rot_q - c_rot).reshape(nq, pq_dim, ds)        # query residual
         cb = (codebooks[lists] if per_cluster else codebooks)
+        # The in-scan codebook einsums below are the SANCTIONED legacy
+        # baseline (ci/lint.py forbids new ones in probe-scan callbacks —
+        # per-batch-invariant LUT work belongs in _scan_hoisted's batch
+        # stage); hence the adc-exempt markers.
         if is_ip:
             # score = q·(c + code) = q·c + Σ_m q_m·cb  → LUT of dots
             if per_cluster:
-                lut = jnp.einsum("qmd,qkd->qmk", rot_q.reshape(nq, pq_dim, ds),
-                                 cb)
+                lut = jnp.einsum(  # adc-exempt: HOISTED_LUT=0 baseline
+                    "qmd,qkd->qmk", rot_q.reshape(nq, pq_dim, ds), cb)
             else:
-                lut = jnp.einsum("qmd,mkd->qmk", rot_q.reshape(nq, pq_dim, ds),
-                                 cb)
+                lut = jnp.einsum(  # adc-exempt: HOISTED_LUT=0 baseline
+                    "qmd,mkd->qmk", rot_q.reshape(nq, pq_dim, ds), cb)
             base = jnp.sum(q * centers[lists], axis=-1)    # (nq,)
         else:
             # score = ||r − code||² summed over subspaces
             if per_cluster:
                 lut = (jnp.sum(r ** 2, -1)[:, :, None]
                        + jnp.sum(cb ** 2, -1)[:, None, :]
-                       - 2.0 * jnp.einsum("qmd,qkd->qmk", r, cb))
+                       - 2.0 * jnp.einsum(  # adc-exempt: =0 baseline
+                           "qmd,qkd->qmk", r, cb))
             else:
                 lut = (jnp.sum(r ** 2, -1)[:, :, None]
                        + jnp.sum(cb ** 2, -1)[None, :, :]
-                       - 2.0 * jnp.einsum("qmd,mkd->qmk", r, cb))
+                       - 2.0 * jnp.einsum(  # adc-exempt: =0 baseline
+                           "qmd,mkd->qmk", r, cb))
             base = jnp.zeros((nq,), jnp.float32)
         if is_fp8:
             # fp8 e4m3's dynamic range can't hold raw squared distances:
@@ -629,8 +944,9 @@ def _search_batch_impl(q, probe_ids, leaves, metric_val: int, k: int,
                 lut_m, codes_m = args                      # (nq,kcb),(nq,cap)
                 oh = (codes_m[:, :, None] ==
                       jnp.arange(kcb, dtype=codes_m.dtype)).astype(lut.dtype)
-                return acc + jnp.einsum("qck,qk->qc", oh, lut_m,
-                                        preferred_element_type=acc.dtype), None
+                return acc + jnp.einsum(  # adc-exempt: =0 baseline lookup
+                    "qck,qk->qc", oh, lut_m,
+                    preferred_element_type=acc.dtype), None
 
         acc, _ = jax.lax.scan(
             lut_step, jnp.zeros((nq, codes.shape[1]), acc_dtype),
@@ -650,10 +966,13 @@ def _search_batch_impl(q, probe_ids, leaves, metric_val: int, k: int,
 
 # Eager searches dispatch the AOT executable cache (reference precompiled
 # ivfpq similarity-kernel variants, CMakeLists.txt:357-371); jit kept for
-# traced callers.
-_search_batch = functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8))(
+# traced callers.  ``hoisted`` is a STATIC arg, so the two pipeline shapes
+# compile (and AOT-cache) as distinct executables — flipping
+# RAFT_TPU_HOISTED_LUT mid-process can never hit the other path's program.
+_SEARCH_STATICS = (3, 4, 5, 6, 7, 8, 9)
+_search_batch = functools.partial(jax.jit, static_argnums=_SEARCH_STATICS)(
     _search_batch_impl)
-_search_batch_aot = aot(_search_batch_impl, static_argnums=(3, 4, 5, 6, 7, 8))
+_search_batch_aot = aot(_search_batch_impl, static_argnums=_SEARCH_STATICS)
 
 
 @traced("raft_tpu.neighbors.ivf_pq.search")
@@ -682,9 +1001,34 @@ def search(params: SearchParams, index: Index, queries, k: int,
         return empty_result(0, int(k), jnp.float32)
     n_probes = min(params.n_probes, index.n_lists)
     is_ip = index.metric == DistanceType.InnerProduct
+    hoisted = (hoisted_lut_enabled() if params.hoisted_lut is None
+               else bool(params.hoisted_lut))
+    # list_adc feeds the compressed-LUT combine stage; the exact-f32 path
+    # consumes its per-candidate contraction list_csum (docs/ivf_pq_adc.md)
     leaves = (index.centers, index.rotation, index.codebooks,
               index.list_codes, index.list_indices, index.phys_sizes,
-              index.chunk_table, index.owner)
+              index.chunk_table, index.owner, index.list_adc,
+              index.list_csum)
+    if hoisted and (index.codebook_kind == CodebookKind.PER_CLUSTER
+                    or (not is_ip and params.lut_dtype != "float32")):
+        # These configs materialize per-(query, probe) combined ADC tables
+        # once per batch — several concurrent copies, not one: ~3 f32
+        # transients with an n_probes probe axis (the list_adc gather, the
+        # combined LUT, the shifted/quantizing copy) plus the xs gather
+        # whose probe axis is the EXPANDED physical budget (> n_probes when
+        # lists span multiple chunks) in the quantized dtype.  Bound the
+        # sum to ~128 MiB by shrinking the query batch (power of two, so
+        # the shape-bucketed executable set stays small); the legacy
+        # in-scan path only ever held one (nq, pq_dim, 2^bits) tile and
+        # needs no cap.
+        n_phys = index.list_codes.shape[0] - 1
+        budget = min(n_probes * index.chunk_table.shape[1],
+                     n_probes + max(0, n_phys - index.n_lists))
+        cell = index.pq_dim * (1 << index.pq_bits)
+        lut_bytes = jnp.dtype(_LUT_DTYPES[params.lut_dtype]).itemsize
+        per_q = cell * (3 * n_probes * 4 + budget * lut_bytes)
+        cap = 1 << max(5, ((128 << 20) // max(per_q, 1)).bit_length() - 1)
+        batch_size_query = min(batch_size_query, cap)
     # hoisted invariant statistic: coarse-center sq-norms once per search,
     # not once per query batch (distance.pairwise.metric_stats contract)
     center_sq = None if is_ip else _row_norms(index.centers)
@@ -723,7 +1067,7 @@ def search(params: SearchParams, index: Index, queries, k: int,
                         index.codebook_kind == CodebookKind.PER_CLUSTER,
                         params.lut_dtype,
                         params.internal_distance_dtype,
-                        index.pq_bits)
+                        index.pq_bits, hoisted)
         if n_valid != qb.shape[0]:
             d, i = d[:n_valid], i[:n_valid]
         if pool:

@@ -24,14 +24,26 @@ from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.neighbors import ivf_flat, ivf_pq
 
 _MAGIC = "raft-tpu-index"
-_VERSION = 1
+# Versions are PER KIND so a format change to one index type doesn't
+# spuriously break older readers of the others (archives are written once
+# and loaded across processes/releases).  v1: original leaf set.
+# ivf_pq v2 (hoisted-ADC PR): archives additionally carry the build-time
+# list-side ADC tables ``list_adc``/``list_csum``; v1 archives still load —
+# the tables are recomputed from centers/rotation/codebooks + stored codes,
+# which is exact (pure functions of the trained model).
+_VERSIONS = {"ivf_flat": 1, "ivf_pq": 2}
+# Readable versions are per kind too: accepting another kind's version at
+# the gate would defer the failure to an obscure Index(**arrays) TypeError
+# instead of the clean unsupported-version error this check exists to give.
+_READABLE_VERSIONS = {"ivf_flat": (1,), "ivf_pq": (1, 2)}
 
 
 def _pack(kind: str, index, aux: dict) -> dict:
     arrays = {f.name: np.asarray(getattr(index, f.name))
               for f in dataclasses.fields(index)
               if f.name not in aux}
-    header = {"magic": _MAGIC, "version": _VERSION, "kind": kind, "aux": aux}
+    header = {"magic": _MAGIC, "version": _VERSIONS[kind], "kind": kind,
+              "aux": aux}
     arrays["__header__"] = np.frombuffer(
         json.dumps(header).encode(), dtype=np.uint8)
     return arrays
@@ -52,11 +64,12 @@ def _unpack(path, kind: str):
         header = json.loads(bytes(z["__header__"]).decode())
         expects(header.get("magic") == _MAGIC,
                 f"{path}: not a raft-tpu index file")
-        expects(header.get("version") == _VERSION,
-                f"{path}: unsupported index version {header.get('version')}")
         if header["kind"] != kind:
             raise LogicError(
                 f"{path} holds a {header['kind']} index, not {kind}")
+        expects(header.get("version") in _READABLE_VERSIONS[kind],
+                f"{path}: unsupported {kind} index version "
+                f"{header.get('version')}")
         arrays = {k: z[k] for k in z.files if k != "__header__"}
     return header["aux"], arrays
 
@@ -87,8 +100,25 @@ def save_ivf_pq(path, index: ivf_pq.Index) -> None:
 
 def load_ivf_pq(path) -> ivf_pq.Index:
     aux, a = _unpack(path, "ivf_pq")
+    arrays = {k: jnp.asarray(v) for k, v in a.items()}
+    per_cluster = (ivf_pq.CodebookKind(aux["codebook_kind"])
+                   == ivf_pq.CodebookKind.PER_CLUSTER)
+    if "list_adc" not in arrays:
+        # v1 archive (pre hoisted-ADC): recompute the build-time list-side
+        # table from the trained model — exact, since it is a pure f32
+        # function of centers/rotation/codebooks
+        arrays["list_adc"] = ivf_pq._build_list_adc(
+            arrays["centers"], arrays["rotation"], arrays["codebooks"],
+            per_cluster)
+    if "list_csum" not in arrays:
+        # likewise its per-candidate contraction, re-derived by unpacking
+        # the stored codes once (compat path)
+        arrays["list_csum"] = ivf_pq._csum_for_packed(
+            arrays["list_codes"], arrays["owner"], arrays["centers"],
+            arrays["rotation"], arrays["codebooks"], per_cluster,
+            aux["pq_bits"])
     return ivf_pq.Index(
-        **{k: jnp.asarray(v) for k, v in a.items()},
+        **arrays,
         metric=DistanceType(aux["metric"]),
         codebook_kind=ivf_pq.CodebookKind(aux["codebook_kind"]),
         pq_bits=aux["pq_bits"],

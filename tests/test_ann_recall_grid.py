@@ -16,6 +16,13 @@ import os
 import numpy as np
 import pytest
 
+# Tier-1 budget (ROADMAP.md): the grid builds 10k-row indexes per case and
+# costs ~70s warm — slow-marked as a module; per-config recall gates stay
+# covered in tier-1 by tests/test_ivf_pq.py (recall_pq_bits, bf16/int
+# dataset recalls) and tests/test_ivf_flat.py.  Full-grid CI runs drop the
+# marker filter (or set RAFT_TPU_FULL_GRID=1 for the 100k sweep).
+pytestmark = pytest.mark.slow
+
 from raft_tpu.distance import DistanceType
 from raft_tpu.neighbors import ivf_flat, ivf_pq
 from raft_tpu.neighbors.brute_force import knn

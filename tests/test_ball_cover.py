@@ -14,7 +14,10 @@ from raft_tpu.neighbors.ball_cover import (
 )
 
 
-@pytest.mark.parametrize("n,dim,k", [(1500, 3, 7), (2000, 8, 11)])
+@pytest.mark.parametrize("n,dim,k", [
+    (1500, 3, 7),
+    pytest.param(2000, 8, 11, marks=pytest.mark.slow),  # budget
+])
 def test_ball_cover_knn_exact(n, dim, k):
     rng = np.random.default_rng(n)
     x = rng.random((n, dim)).astype(np.float32)
@@ -154,7 +157,10 @@ def test_ball_cover_duplicates_and_large_k():
     np.testing.assert_allclose(np.sort(np.array(d), 1), rd, atol=1e-3)
 
 
-@pytest.mark.parametrize("n,k", [(700, 5), (1200, 17)])
+@pytest.mark.parametrize("n,k", [
+    (700, 5),
+    pytest.param(1200, 17, marks=pytest.mark.slow),  # budget
+])
 def test_ball_cover_haversine_vs_host_oracle(n, k):
     """Haversine kNN against a full numpy great-circle oracle (the
     reference has a dedicated haversine ball-cover test family,

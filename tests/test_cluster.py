@@ -125,6 +125,7 @@ class TestBalanced:
         counts = np.bincount(np.asarray(nn.key), minlength=16)
         assert counts.min() > 0  # no empty clusters after balancing
 
+    @pytest.mark.slow  # 5k-row hierarchical build (tier-1 budget)
     def test_build_hierarchical(self):
         x, _, _ = make_blobs(RngState(8), 5000, 8, n_clusters=20, cluster_std=1.0)
         centers = cluster.build_hierarchical(RngState(0), x, 64, n_iters=8)
@@ -335,6 +336,7 @@ class TestLibraryOracles:
             ari = float(adjusted_rand_index(np.asarray(out.labels), want))
             assert ari == pytest.approx(1.0), f"n_clusters={n_clusters}"
 
+    @pytest.mark.slow  # full fits across a k sweep (tier-1 budget)
     def test_kmeans_inertia_monotone_in_k(self):
         """Optimal inertia is non-increasing in k (sanity property the
         reference checks via its elbow-style test grids)."""

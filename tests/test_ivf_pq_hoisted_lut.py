@@ -1,0 +1,378 @@
+"""Hoisted-ADC LUT pipeline (docs/ivf_pq_adc.md): hoisted ≡ in-scan
+property grid, the single-per-query fp8 affine contract, serialize v2
+round-trip + v1 compat, the trace-time LUT counters, and the ci/lint.py
+probe-scan regression guard."""
+
+import json
+
+import numpy as np
+import pytest
+
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.neighbors import ivf_pq
+from raft_tpu.neighbors.ivf_pq import (
+    CodebookKind,
+    IndexParams,
+    SearchParams,
+    build,
+    search,
+)
+
+L2 = DistanceType.L2Expanded
+L2S = DistanceType.L2SqrtExpanded
+IP = DistanceType.InnerProduct
+
+
+def make_data(n=2000, dim=32, n_queries=48, seed=0, clusters=20):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 5, (clusters, dim))
+    x = (centers[rng.integers(0, clusters, n)]
+         + rng.normal(0, 1, (n, dim))).astype(np.float32)
+    q = (centers[rng.integers(0, clusters, n_queries)]
+         + rng.normal(0, 1, (n_queries, dim))).astype(np.float32)
+    return x, q
+
+
+def overlap(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    k = a.shape[1]
+    return np.mean([len(set(r.tolist()) & set(s.tolist())) / k
+                    for r, s in zip(a, b)])
+
+
+_BUILDS = {}
+
+
+def built(kind, metric, bits):
+    """One build per (codebook kind, metric, pq_bits) — shared across the
+    lut_dtype axis of the grid, both A/B sides must score the same index."""
+    key = (kind, metric, bits)
+    if key not in _BUILDS:
+        x, q = make_data()
+        idx = build(IndexParams(n_lists=16, pq_dim=8, pq_bits=bits,
+                                codebook_kind=kind, metric=metric, seed=3), x)
+        _BUILDS[key] = (idx, q)
+    return _BUILDS[key]
+
+
+# {PER_SUBSPACE, PER_CLUSTER} × {L2, L2Sqrt, IP} × pq_bits {4, 5, 8}: the
+# metric axis rides PER_SUBSPACE, the bits axis rides L2, PER_CLUSTER
+# covers both score forms (L2 + IP) — 7 builds instead of 18, every axis
+# still exercised against every pipeline stage.
+CONFIGS = [
+    (CodebookKind.PER_SUBSPACE, L2, 8),
+    (CodebookKind.PER_SUBSPACE, L2S, 8),
+    (CodebookKind.PER_SUBSPACE, IP, 8),
+    (CodebookKind.PER_SUBSPACE, L2, 4),
+    (CodebookKind.PER_SUBSPACE, L2, 5),
+    (CodebookKind.PER_CLUSTER, L2, 8),
+    (CodebookKind.PER_CLUSTER, IP, 8),
+]
+_IDS = [f"{k.name}-{m.name}-b{b}" for k, m, b in CONFIGS]
+
+
+@pytest.mark.parametrize("kind,metric,bits", CONFIGS, ids=_IDS)
+def test_hoisted_matches_inscan_f32(kind, metric, bits):
+    """f32 LUT: same top-k IDS as the in-scan path (the bench acceptance
+    gate) and distances equal to accumulation-order tolerance — the two
+    pipelines sum the identical ADC decomposition in different
+    association orders, so bit-identity is not on the table but ranking
+    identity is."""
+    idx, q = built(kind, metric, bits)
+    dh, ih = search(SearchParams(n_probes=8, hoisted_lut=True), idx, q, 10)
+    dl, il = search(SearchParams(n_probes=8, hoisted_lut=False), idx, q, 10)
+    np.testing.assert_array_equal(np.asarray(ih), np.asarray(il))
+    np.testing.assert_allclose(np.asarray(dh), np.asarray(dl),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("kind,metric,bits", CONFIGS, ids=_IDS)
+def test_hoisted_matches_inscan_bf16(kind, metric, bits):
+    """bf16 LUT: the hoisted path quantizes the COMBINED list+query cross
+    terms and keeps ‖r‖² in the exact f32 base, the legacy path rounds the
+    full LUT — equal only to bf16 noise, bounded as top-k overlap."""
+    idx, q = built(kind, metric, bits)
+    sp = dict(n_probes=8, lut_dtype="bfloat16")
+    _, ih = search(SearchParams(**sp, hoisted_lut=True), idx, q, 10)
+    _, il = search(SearchParams(**sp, hoisted_lut=False), idx, q, 10)
+    assert overlap(ih, il) >= 0.8, overlap(ih, il)
+
+
+@pytest.mark.parametrize("kind,metric,bits", CONFIGS, ids=_IDS)
+def test_hoisted_fp8_vs_f32_topk(kind, metric, bits):
+    """fp8 regression (the latent-affine-bug satellite): hoisted fp8 top-k
+    must overlap the f32 top-k — one per-(query, probe-set) affine keeps
+    candidates from different probe tiles mutually comparable."""
+    idx, q = built(kind, metric, bits)
+    _, i32 = search(SearchParams(n_probes=8, hoisted_lut=True), idx, q, 10)
+    _, i8 = search(SearchParams(n_probes=8, lut_dtype="float8_e4m3",
+                                hoisted_lut=True), idx, q, 10)
+    assert overlap(i8, i32) >= 0.7, overlap(i8, i32)
+    # and against the legacy fp8 path (same decomposition, per-tile affine)
+    _, l8 = search(SearchParams(n_probes=8, lut_dtype="float8_e4m3",
+                                hoisted_lut=False), idx, q, 10)
+    assert overlap(i8, l8) >= 0.7, overlap(i8, l8)
+
+
+def test_fp8_single_affine_per_query():
+    """The fp8 contract itself: ONE scale per query over the whole probe
+    set (shape (nq,)), shifts re-entering exactly through the f32 base."""
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors.ivf_pq import _quantize_lut
+
+    rng = np.random.default_rng(5)
+    nq, P, pq_dim, kcb = 6, 4, 8, 16
+    lut = jnp.asarray(rng.normal(0, 3, (nq, P, pq_dim, kcb)), jnp.float32)
+    base = jnp.asarray(rng.normal(0, 1, (nq, P)), jnp.float32)
+    lut_q, base2, scale = _quantize_lut(lut, base, "float8_e4m3")
+    assert scale.shape == (nq,)
+    assert lut_q.dtype == jnp.float8_e4m3fn
+    # dequantized lookup + shifted base reproduces the f32 sum to fp8 noise
+    codes = rng.integers(0, kcb, (nq, P, pq_dim))
+    take = np.take_along_axis(np.asarray(lut, np.float32).reshape(
+        nq, P, pq_dim, kcb), codes[..., None], axis=-1)[..., 0].sum(-1)
+    want = take + np.asarray(base)
+    got = (np.asarray(lut_q, np.float32).reshape(nq, P, pq_dim, kcb)[
+        np.arange(nq)[:, None, None], np.arange(P)[None, :, None],
+        np.arange(pq_dim)[None, None, :], codes].sum(-1)
+        / np.asarray(scale)[:, None] + np.asarray(base2))
+    span = np.abs(want).max()
+    np.testing.assert_allclose(got, want, atol=0.15 * span)
+    # f32 passthrough keeps base/scale inert
+    lut_f, base_f, scale_f = _quantize_lut(lut, base, "float32")
+    np.testing.assert_array_equal(np.asarray(base_f), np.asarray(base))
+    np.testing.assert_array_equal(np.asarray(scale_f), np.ones(nq))
+
+
+def test_trace_time_lut_counters():
+    """The ``Comms.collective_calls``-style assertion: tracing the hoisted
+    search program bumps the per-batch counter and NOT the in-scan one —
+    a hoisted trace bumping ``in_scan_lut_builds`` would mean codebook
+    einsums crept back into the probe-scan body."""
+    x, q = make_data(n=1100, dim=32, n_queries=21, seed=7)
+    idx = build(IndexParams(n_lists=12, pq_dim=8, pq_bits=8, seed=9), x)
+    c = ivf_pq.lut_trace_counters
+    before = dict(c)
+    search(SearchParams(n_probes=6, hoisted_lut=True), idx, q, 9)
+    assert c["in_scan_lut_builds"] == before.get("in_scan_lut_builds", 0)
+    assert c["hoisted_lut_builds"] > before.get("hoisted_lut_builds", 0)
+    mid = dict(c)
+    search(SearchParams(n_probes=6, hoisted_lut=False), idx, q, 9)
+    assert c["in_scan_lut_builds"] > mid.get("in_scan_lut_builds", 0)
+    assert c["hoisted_lut_builds"] == mid.get("hoisted_lut_builds", 0)
+
+
+def test_env_gate_and_param_override(monkeypatch):
+    from raft_tpu.neighbors.ivf_pq import hoisted_lut_enabled
+
+    monkeypatch.delenv("RAFT_TPU_HOISTED_LUT", raising=False)
+    assert hoisted_lut_enabled()
+    monkeypatch.setenv("RAFT_TPU_HOISTED_LUT", "0")
+    assert not hoisted_lut_enabled()
+    # explicit SearchParams.hoisted_lut overrides the env gate: with the
+    # env forcing legacy, hoisted=True must still trace the hoisted program
+    x, q = make_data(n=900, dim=32, n_queries=17, seed=11)
+    idx = build(IndexParams(n_lists=10, pq_dim=8, pq_bits=8, seed=1), x)
+    c = ivf_pq.lut_trace_counters
+    before = dict(c)
+    search(SearchParams(n_probes=5, hoisted_lut=True), idx, q, 7)
+    assert c["in_scan_lut_builds"] == before.get("in_scan_lut_builds", 0)
+
+
+def test_index_carries_adc_tables():
+    """Build populates the stage-1 tables with the documented shapes and
+    exact-f32 values; extend carries list_adc over and keeps list_csum
+    consistent with a from-scratch recompute of the packed codes."""
+    from raft_tpu.neighbors.ivf_pq import _csum_for_packed
+
+    x, _ = make_data(n=1500)
+    idx = build(IndexParams(n_lists=16, pq_dim=8, pq_bits=8, seed=3), x)
+    assert idx.list_adc.shape == (16, 8, 256)
+    assert idx.list_adc.dtype == np.float32
+    assert idx.list_csum.shape == idx.list_indices.shape
+    idx2 = ivf_pq.extend(idx, x[:100] + 0.01)
+    np.testing.assert_array_equal(np.asarray(idx2.list_adc),
+                                  np.asarray(idx.list_adc))
+    want = np.asarray(_csum_for_packed(
+        idx2.list_codes, idx2.owner, idx2.centers, idx2.rotation,
+        idx2.codebooks, False, 8))
+    got = np.asarray(idx2.list_csum)
+    live = np.asarray(idx2.list_indices) >= 0
+    np.testing.assert_allclose(got[live], want[live], rtol=1e-5, atol=1e-5)
+
+
+def test_serialize_v2_roundtrip_new_fields(tmp_path):
+    from raft_tpu.neighbors.serialize import load_ivf_pq, save_ivf_pq
+
+    x, q = make_data(n=1200)
+    idx = build(IndexParams(n_lists=12, pq_dim=8, pq_bits=5, seed=2), x)
+    p = tmp_path / "pq_v2.npz"
+    save_ivf_pq(p, idx)
+    with np.load(p) as z:
+        header = json.loads(bytes(z["__header__"]).decode())
+        assert header["version"] == 2
+        assert "list_adc" in z.files and "list_csum" in z.files
+    idx2 = load_ivf_pq(p)
+    np.testing.assert_array_equal(np.asarray(idx2.list_adc),
+                                  np.asarray(idx.list_adc))
+    np.testing.assert_array_equal(np.asarray(idx2.list_csum),
+                                  np.asarray(idx.list_csum))
+    sp = SearchParams(n_probes=6)
+    d1, i1 = search(sp, idx, q, 8)
+    d2, i2 = search(sp, idx2, q, 8)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-6)
+
+
+def _downgrade_to_v1(path):
+    """Rewrite a v2 archive as the pre-hoist v1 format: strip the ADC
+    tables, stamp version 1 (what an old writer would have produced)."""
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files}
+    header = json.loads(bytes(arrays.pop("__header__")).decode())
+    header["version"] = 1
+    for k in ("list_adc", "list_csum"):
+        arrays.pop(k)
+    arrays["__header__"] = np.frombuffer(
+        json.dumps(header).encode(), dtype=np.uint8)
+    np.savez(path, **arrays)
+
+
+@pytest.mark.parametrize("kind", [CodebookKind.PER_SUBSPACE,
+                                  CodebookKind.PER_CLUSTER],
+                         ids=["per_subspace", "per_cluster"])
+def test_load_v1_archive_recomputes_tables(tmp_path, kind):
+    """Old-format load: a v1 archive (no list_adc/list_csum) loads and the
+    recomputed tables reproduce the original index's searches exactly —
+    the tables are pure functions of the trained model + stored codes."""
+    from raft_tpu.neighbors.serialize import load_ivf_pq, save_ivf_pq
+
+    x, q = make_data(n=1200)
+    idx = build(IndexParams(n_lists=12, pq_dim=8, pq_bits=8,
+                            codebook_kind=kind, seed=4), x)
+    p = str(tmp_path / "pq_v1.npz")
+    save_ivf_pq(p, idx)
+    _downgrade_to_v1(p)
+    idx2 = load_ivf_pq(p)
+    np.testing.assert_allclose(np.asarray(idx2.list_adc),
+                               np.asarray(idx.list_adc), rtol=1e-6)
+    live = np.asarray(idx.list_indices) >= 0
+    np.testing.assert_allclose(np.asarray(idx2.list_csum)[live],
+                               np.asarray(idx.list_csum)[live],
+                               rtol=1e-5, atol=1e-5)
+    sp = SearchParams(n_probes=6)
+    d1, i1 = search(sp, idx, q, 8)
+    d2, i2 = search(sp, idx2, q, 8)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-5)
+
+
+def test_unreadable_version_rejected(tmp_path):
+    from raft_tpu.core.error import RaftError
+    from raft_tpu.neighbors.serialize import load_ivf_pq, save_ivf_pq
+
+    x, _ = make_data(n=600)
+    idx = build(IndexParams(n_lists=8, pq_dim=8, pq_bits=8, seed=4), x)
+    p = str(tmp_path / "pq_v99.npz")
+    save_ivf_pq(p, idx)
+    with np.load(p) as z:
+        arrays = {k: z[k] for k in z.files}
+    header = json.loads(bytes(arrays.pop("__header__")).decode())
+    header["version"] = 99
+    arrays["__header__"] = np.frombuffer(
+        json.dumps(header).encode(), dtype=np.uint8)
+    np.savez(p, **arrays)
+    with pytest.raises(RaftError, match="version"):
+        load_ivf_pq(p)
+
+
+class TestProbeScanLintRule:
+    """ci/lint.py's hoisted-ADC regression guard: einsum/take_along_axis
+    over closed-over operands inside a scan_probe_lists tile callback."""
+
+    _VIOLATION = '''
+import jax.numpy as jnp
+def search(codebooks, rot_q, probes, idxs, sizes):
+    def score_tile(rows):
+        lut = jnp.einsum("qmd,mkd->qmk", rot_q, codebooks)
+        return lut.sum(-1)
+    return scan_probe_lists(probes, score_tile, idxs, sizes, 5, True, None)
+'''
+
+    def _check(self, src):
+        import ast
+
+        from ci.lint import check_probe_scan_callbacks
+
+        return check_probe_scan_callbacks(ast.parse(src), src.splitlines())
+
+    def test_flags_closed_over_einsum(self):
+        f = self._check(self._VIOLATION)
+        assert len(f) == 1 and "einsum" in f[0][1]
+
+    def test_marker_allowlists(self):
+        src = self._VIOLATION.replace(
+            "rot_q, codebooks)", "rot_q, codebooks)  # adc-exempt")
+        assert self._check(src) == []
+
+    def test_local_operands_pass(self):
+        src = self._VIOLATION.replace("rot_q, codebooks", "rows, rows")
+        assert self._check(src) == []
+
+    def test_alias_does_not_launder_closure(self):
+        """A local alias of a closed-over operand (``cb = codebooks``) is
+        still closed-over data — taint tracking keeps the rule firing on
+        the exact legacy per-tile-LUT shape it exists to catch."""
+        src = self._VIOLATION.replace(
+            '        lut = jnp.einsum("qmd,mkd->qmk", rot_q, codebooks)',
+            "        cb = codebooks\n"
+            '        lut = jnp.einsum("qmd,mkd->qmk", rot_q, cb)')
+        f = self._check(src)
+        assert len(f) == 1 and "einsum" in f[0][1]
+
+    def test_nested_scope_name_collision_still_flags(self):
+        """Scope resolution is per function: a nested helper whose params
+        shadow the closed-over operands must not launder the closure at
+        the callsite's scope (the flat any-binding-anywhere heuristic's
+        false negative)."""
+        src = self._VIOLATION.replace(
+            "    def score_tile(rows):",
+            "    def score_tile(rows):\n"
+            "        def helper(rot_q, codebooks):\n"
+            "            return rot_q\n")
+        f = self._check(src)
+        assert len(f) == 1 and "einsum" in f[0][1]
+
+    def test_nested_helper_params_are_local_in_helper(self):
+        """Inside the nested helper itself, its params ARE local — the
+        sanctioned _lookup pattern (tile + LUT arrive as arguments)."""
+        src = self._VIOLATION.replace(
+            'lut = jnp.einsum("qmd,mkd->qmk", rot_q, codebooks)',
+            "def lookup(tile, lut_t):\n"
+            '            return jnp.einsum("qk,qk->q", tile, lut_t)\n'
+            "        lut = lookup(rows, rows)")
+        assert self._check(src) == []
+
+    def test_scoped_to_neighbors(self, tmp_path):
+        from ci.lint import check_file
+
+        d = tmp_path / "raft_tpu" / "neighbors"
+        d.mkdir(parents=True)
+        f = d / "mod.py"
+        f.write_text(self._VIOLATION)
+        assert any("scan_probe_lists" in msg for _, msg in check_file(f))
+        other = tmp_path / "raft_tpu" / "cluster"
+        other.mkdir()
+        g = other / "mod.py"
+        g.write_text(self._VIOLATION)
+        assert not any("scan_probe_lists" in m for _, m in check_file(g))
+
+    def test_shipped_neighbors_tree_clean(self):
+        import pathlib
+
+        from ci.lint import check_file
+
+        root = pathlib.Path(__file__).resolve().parents[1]
+        for f in sorted((root / "raft_tpu" / "neighbors").glob("*.py")):
+            assert not check_file(f), f

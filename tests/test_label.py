@@ -73,7 +73,7 @@ def test_merge_labels():
     (10, 3, 0.5, 0),
     (100, 8, 0.3, 1),
     (100, 8, 0.9, 2),
-    (1000, 40, 0.5, 3),
+    pytest.param(1000, 40, 0.5, 3, marks=pytest.mark.slow),  # budget
     (1000, 5, 0.2, 4),    # few big classes: long merge chains
     (257, 257, 0.5, 5),   # singleton classes: only the mask connects
 ])

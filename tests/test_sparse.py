@@ -118,6 +118,7 @@ def test_degree_and_row_normalize():
     np.testing.assert_allclose(dense, expected / sums, rtol=1e-5)
 
 
+@pytest.mark.slow  # CSR transpose+add vs scipy oracle (tier-1 budget)
 def test_transpose_add():
     a = random_csr(8, 11, seed=11)
     b = random_csr(8, 11, seed=13)
@@ -249,6 +250,7 @@ class TestScipyOracleGrids:
         np.testing.assert_allclose(np.asarray(spmm(to_raft(a), b)), a @ b,
                                    atol=tol)
 
+    @pytest.mark.slow  # quantile-split grid vs scipy oracle (budget)
     def test_ell_quantile_split(self):
         """csr_to_ell puts at most the q-quantile row degree in the ELL
         part; the COO tail holds the rest; spmv equivalence holds at

@@ -38,7 +38,10 @@ def random_sym_graph(n, density=0.2, seed=0, connected=False):
     return d
 
 
-@pytest.mark.parametrize("n,k", [(40, 3), (80, 5)])
+@pytest.mark.parametrize("n,k", [
+    (40, 3),
+    pytest.param(80, 5, marks=pytest.mark.slow),  # tier-1 budget
+])
 def test_lanczos_smallest_vs_numpy(n, k):
     d = random_sym_graph(n, 0.3, seed=n, connected=True)
     lap = laplacian(dense_to_csr(d))
@@ -168,6 +171,7 @@ def test_fit_embedding_separates_blocks():
     assert side[0] != side[20]
 
 
+@pytest.mark.slow  # dense-spectrum convergence stress (tier-1 budget)
 def test_lanczos_clustered_spectrum():
     """Near-degenerate eigenvalue clusters must not be skipped (deflation
     restarts; the single weighted restart vector used to miss pairs)."""
@@ -234,6 +238,7 @@ def test_lanczos_breakdown_is_relative_to_scale():
     assert np.all(np.abs(np.asarray(evals)) <= ref * 1.01 + 1e-3)
 
 
+@pytest.mark.slow  # compile-cache behavior, full solves (tier-1 budget)
 def test_lanczos_repeated_solves_share_compiled_program():
     """CSR solves route through the module-level jitted program — repeat
     solves at the same shapes must not retrace (the old per-call closure
@@ -256,6 +261,7 @@ def test_lanczos_repeated_solves_share_compiled_program():
     assert L._trace_count == traces0
 
 
+@pytest.mark.slow  # compile-cache behavior, full solves (tier-1 budget)
 def test_lanczos_reused_callable_hits_weak_cache():
     """A reused plain matvec callable must reuse its compiled program
     (weak-cached); dropping the callable must release the cache entry."""
@@ -292,6 +298,7 @@ def test_lanczos_empty_graph_ell():
     y = np.asarray(ell_spmv(csr_to_ell(empty), np.ones(n, np.float32)))
     np.testing.assert_allclose(y, 0.0)
 
+@pytest.mark.slow  # compile-cache behavior, full solves (tier-1 budget)
 def test_lanczos_bound_method_reuses_program():
     """obj.method creates a fresh bound-method object per attribute access;
     the callable cache must key on (owner, function) so repeated solves with

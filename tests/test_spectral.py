@@ -53,6 +53,7 @@ def _agree(pred, truth):
     return (same_p == same_t).mean()
 
 
+@pytest.mark.slow  # full eigensolver partitions, ~4s each (tier-1 budget)
 @pytest.mark.parametrize("sizes", [(30, 30), (25, 25, 25)])
 def test_partition_recovers_planted_blocks(sizes):
     a, truth = planted_blocks(sizes, seed=len(sizes))
@@ -168,6 +169,7 @@ def test_modularity_operator_matches_dense_oracle():
     np.testing.assert_allclose(float(edge_sum), two_m, rtol=1e-6)
 
 
+@pytest.mark.slow  # full eigensolver partition (tier-1 budget)
 def test_partition_weighted_graph_and_unequal_blocks():
     """Weighted planted partition with unequal block sizes: recovered
     labels and an edge-cut that beats random by a wide margin (the
@@ -190,6 +192,7 @@ def test_partition_weighted_graph_and_unequal_blocks():
     assert float(cut) < 0.5 * float(rand_cut)
 
 
+@pytest.mark.slow  # hand-oracle over a full modularity solve (budget)
 def test_modularity_ring_of_cliques_hand_oracle():
     """Ring of m cliques of size c joined by single edges: the planted
     partition's modularity has a closed form

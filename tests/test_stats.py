@@ -145,6 +145,7 @@ class TestEmbeddingMetrics:
         expected = skm.silhouette_score(x, labels, metric="sqeuclidean")
         np.testing.assert_allclose(got, expected, atol=1e-5)
 
+    @pytest.mark.slow  # batched-vs-unbatched equivalence (tier-1 budget)
     def test_silhouette_batched_matches(self, rng):
         from raft_tpu.random import RngState, make_blobs
 
