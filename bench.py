@@ -16,7 +16,7 @@ value / estimate, where ≥0.8 meets the north-star target.
 
 Select a metric with
 BENCH_METRIC=pairwise|kmeans|kmeans_mnmg|ivf_pq|ivf_pq_search|lanczos|
-knn_bruteforce.
+knn_bruteforce|serve.
 
 Robust bring-up (the round-1 failure was an unguarded TPU backend init):
 the measurement runs in a *child* process under a watchdog.  The parent
@@ -293,6 +293,88 @@ def bench_ivf_pq_search():
     }
 
 
+def bench_serve():
+    """Batched-serving A/B: coalesced+warmed ServeEngine vs the naive
+    per-request dispatch loop on the SAME mixed-size request stream
+    (raft_tpu/serve, docs/serving.md).
+
+    Stream: 200 requests, sizes from the shared heavy-tailed serving mix
+    (85% 1-16 / 10% 17-128 / 5% 129-700 queries —
+    bench/common.serve_request_stream) against a 20k×64 f32 brute-force
+    index, k=10.  Both sides are fully warmed before timing (the naive
+    loop's bucket executables via one untimed pass; the engine via
+    ``warmup()``), so the A/B isolates the serving-path structure — per-
+    request dispatch + padding waste vs coalesced super-batches with
+    double-buffered dispatch — not compile costs.  Per-request top-k ids
+    are asserted IDENTICAL between the two sides before either number is
+    recorded (acceptance gate), and the row carries both sides' qps and
+    p50/p99 request latency.  The engine's zero-compile steady state is
+    counter-asserted (core.aot.aot_compile_counters must not move during
+    the timed replay).
+    """
+    from bench.common import serve_request_stream
+    from raft_tpu.core.aot import aot_compile_counters
+    from raft_tpu.neighbors import knn
+    from raft_tpu.serve import ServeEngine
+
+    n, dim, k, n_req = 20_000, 64, 10, 200
+    rng = np.random.default_rng(0)
+    x = rng.random((n, dim), dtype=np.float32)
+    reqs = serve_request_stream(seed=1, n_requests=n_req, dim=dim)
+    total_q = sum(q.shape[0] for q in reqs)
+
+    def naive_replay():
+        # closed-world replay: every request is in hand at t0, so request
+        # j's latency is its COMPLETION time since stream start (the same
+        # semantics as engine.last_latencies) — requests behind the loop
+        # head queue up, which is exactly the effect coalescing removes
+        outs, lat = [], []
+        t0 = time.perf_counter()
+        for q in reqs:
+            d, i = knn(x, q, k)
+            outs.append((np.asarray(d), np.asarray(i)))  # block per request
+            lat.append(time.perf_counter() - t0)
+        return outs, lat
+
+    naive_replay()  # untimed warm pass: compiles every bucket executable
+    t0 = time.perf_counter()
+    outs_naive, lat_naive = naive_replay()
+    naive_s = time.perf_counter() - t0
+
+    engine = ServeEngine(x, k, max_batch=1024)
+    engine.warmup()
+    engine.search(reqs[:3])  # tiny warm call (transfer/dispatch plumbing)
+    c0 = aot_compile_counters["compiles"]
+    sb0 = engine.stats["super_batches"]  # stats are cumulative: diff them
+    t0 = time.perf_counter()
+    outs_eng = engine.search(reqs)
+    eng_s = time.perf_counter() - t0
+    assert aot_compile_counters["compiles"] == c0, \
+        "serve engine compiled during the timed replay (warmup is broken)"
+    lat_eng = engine.last_latencies
+
+    # acceptance gate: per-request top-k identical to solo dispatch
+    for (dn, i_n), (de, ie) in zip(outs_naive, outs_eng):
+        assert np.array_equal(i_n, ie), "coalesced top-k != per-request"
+
+    qps_naive, qps_eng = total_q / naive_s, total_q / eng_s
+    return {
+        "metric": f"serve_{n // 1000}kx{dim}_req{n_req}_k{k}_f32",
+        "value": round(qps_eng, 1),
+        "unit": "qps",
+        # the serving A/B is its own baseline: the gate is >= 2x over the
+        # naive per-request loop on the same stream (ISSUE 4 acceptance)
+        "vs_baseline": round(qps_eng / qps_naive, 3),
+        "naive_qps": round(qps_naive, 1),
+        "speedup": round(qps_eng / qps_naive, 2),
+        "p50_ms": round(float(np.percentile(lat_eng, 50)) * 1e3, 2),
+        "p99_ms": round(float(np.percentile(lat_eng, 99)) * 1e3, 2),
+        "naive_p50_ms": round(float(np.percentile(lat_naive, 50)) * 1e3, 2),
+        "naive_p99_ms": round(float(np.percentile(lat_naive, 99)) * 1e3, 2),
+        "super_batches": engine.stats["super_batches"] - sb0,
+    }
+
+
 def bench_knn_bruteforce():
     """Brute-force kNN queries/s on the fused tiled scan (100k×64 f32,
     1024 queries, k=10, L2Sqrt) — the substrate under knn_mnmg,
@@ -368,7 +450,8 @@ def bench_lanczos():
 _METRICS = {"pairwise": bench_pairwise, "kmeans": bench_kmeans,
             "kmeans_mnmg": bench_kmeans_mnmg, "ivf_pq": bench_ivf_pq,
             "ivf_pq_search": bench_ivf_pq_search,
-            "lanczos": bench_lanczos, "knn_bruteforce": bench_knn_bruteforce}
+            "lanczos": bench_lanczos, "knn_bruteforce": bench_knn_bruteforce,
+            "serve": bench_serve}
 
 
 def _orphan_watchdog():

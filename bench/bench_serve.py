@@ -1,0 +1,111 @@
+"""Serving-engine microbenches (raft_tpu/serve; docs/serving.md).
+
+``engine_coalesced`` vs ``naive_loop`` replay the SAME mixed-size request
+stream (bench/common.serve_request_stream — the protocol shared with
+bench.py's ``serve`` headline A/B) against one brute-force index:
+coalesced = warmed ServeEngine packing the stream into bucket-padded
+super-batches with double-buffered dispatch; naive = the per-request
+``knn`` loop every caller writes first.  ``engine_ivf_flat`` covers the
+IVF path's coalesced dispatch.  ``dispatchable_gate`` times the
+``core.aot.aot_dispatchable`` eager-dispatch gate on the ivf_pq call shape
+(1 query array + a 10-leaf index tuple) — the per-call overhead the PR-4
+fast path cut ~4× (26.8 → ~7 µs; see the function's docstring).
+"""
+
+import numpy as np
+
+from bench.common import case, main_for, serve_request_stream
+from bench.sizes import size
+
+_N = size(20_000, 2048)
+_DIM = size(64, 16)
+_NREQ = size(120, 12)
+_K = 10
+
+_STATE = {}
+
+
+def _stream():
+    """One shared (index, request stream, warmed engines) per process —
+    both A/B sides must serve the identical stream."""
+    if "x" not in _STATE:
+        rng = np.random.default_rng(0)
+        _STATE["x"] = rng.random((_N, _DIM), dtype=np.float32)
+        _STATE["reqs"] = serve_request_stream(seed=1, n_requests=_NREQ,
+                                              dim=_DIM)
+        _STATE["total_q"] = sum(q.shape[0] for q in _STATE["reqs"])
+    return _STATE["x"], _STATE["reqs"], _STATE["total_q"]
+
+
+@case("serve/engine_coalesced")
+def bench_engine_coalesced():
+    from raft_tpu.serve import ServeEngine
+
+    x, reqs, total_q = _stream()
+    if "engine" not in _STATE:
+        eng = ServeEngine(x, _K, max_batch=1024)
+        eng.warmup()
+        _STATE["engine"] = eng
+    eng = _STATE["engine"]
+    # results are host numpy already — return a token array for the timer's
+    # block_until_ready contract
+    return (lambda: np.asarray(eng.search(reqs)[0][1])), {"items": total_q}
+
+
+@case("serve/naive_loop")
+def bench_naive_loop():
+    from raft_tpu.neighbors import knn
+
+    x, reqs, total_q = _stream()
+
+    def run():
+        out = None
+        for q in reqs:
+            d, i = knn(x, q, _K)
+            out = np.asarray(i)  # block per request, as a naive server does
+        return out
+
+    return run, {"items": total_q}
+
+
+@case("serve/engine_ivf_flat")
+def bench_engine_ivf_flat():
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.serve import ServeEngine
+
+    x, reqs, total_q = _stream()
+    if "ivf_engine" not in _STATE:
+        idx = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=size(128, 16)), x)
+        eng = ServeEngine(idx, _K,
+                          ivf_flat.SearchParams(n_probes=size(16, 4)),
+                          max_batch=1024)
+        eng.warmup()
+        _STATE["ivf_engine"] = eng
+    eng = _STATE["ivf_engine"]
+    return (lambda: np.asarray(eng.search(reqs)[0][1])), {"items": total_q}
+
+
+@case("serve/dispatchable_gate")
+def bench_dispatchable_gate():
+    import jax.numpy as jnp
+
+    from raft_tpu.core.aot import aot_dispatchable
+
+    q = jnp.asarray(np.random.default_rng(0).random((64, 16),
+                                                    dtype=np.float32))
+    leaves = tuple(jnp.zeros((8, 8), jnp.float32) for _ in range(10))
+    calls = 1000
+
+    def run():
+        ok = True
+        for _ in range(calls):
+            ok &= aot_dispatchable(q, leaves)
+        assert ok
+        return np.zeros(1)
+
+    return run, {"items": calls}
+
+
+if __name__ == "__main__":
+    main_for("bench.bench_serve")

@@ -345,3 +345,28 @@ def main_for(module_name: str):
     select = sys.argv[1] if len(sys.argv) > 1 else ""
     iters = int(sys.argv[2]) if len(sys.argv) > 2 else 10
     run_registered(iters=iters, select=select)
+
+
+def serve_request_stream(seed: int, n_requests: int, dim: int,
+                         dtype="float32"):
+    """The serve bench's mixed-size request stream — ONE protocol shared by
+    bench.py's ``serve`` headline metric and bench/bench_serve.py (the same
+    sharing rule as ``ivf_pq_bench_data``): request sizes are drawn from a
+    heavy-tailed serving mix, 85% interactive (1-16 queries), 10% medium
+    (17-128), 5% bulk (129-700) — the "millions of users" shape where most
+    requests are small and concurrent, which is exactly what coalescing
+    amortizes.  Returns a list of (size_j, dim) float arrays."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for _ in range(n_requests):
+        u = rng.random()
+        if u < 0.85:
+            s = int(rng.integers(1, 17))
+        elif u < 0.95:
+            s = int(rng.integers(17, 129))
+        else:
+            s = int(rng.integers(129, 701))
+        reqs.append(rng.random((s, dim)).astype(dtype))
+    return reqs

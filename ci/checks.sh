@@ -37,7 +37,7 @@ mods = [
     "raft_tpu.spectral", "raft_tpu.solver", "raft_tpu.comms",
     "raft_tpu.neighbors", "raft_tpu.neighbors.ivf_flat",
     "raft_tpu.neighbors.ivf_pq", "raft_tpu.neighbors.ball_cover",
-    "raft_tpu.native",
+    "raft_tpu.serve", "raft_tpu.native",
 ]
 for m in mods:
     importlib.import_module(m)

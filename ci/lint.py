@@ -26,6 +26,16 @@ high-signal subset with stdlib ast/tokenize:
     HOISTED_LUT=0 legacy baseline, ivf_flat's tile-scoring GEMM) carry an
     ``adc-exempt`` marker comment on the call line.
 
+  * ``jax.jit`` / ``jax.lax.*`` dispatch anywhere in ``raft_tpu/serve/`` —
+    the serving engine's zero-retrace guarantee holds only while every
+    device computation routes through the backends' ``aot()`` executable
+    caches (``core.aot.aot_compile_counters`` is counter-asserted around
+    steady-state traffic in tests/test_serve.py); a ``jax.jit`` or bare
+    ``jax.lax`` op creeping into the hot path reintroduces per-call trace
+    checks and per-shape silent recompiles outside the counter.  Lines
+    carrying a ``serve-exempt`` marker (or ``noqa``) are sanctioned — the
+    allowlist escape, mirroring the probe-scan rule's ``adc-exempt``.
+
 Exit code 1 on any finding.  Run: ``python ci/lint.py [paths...]``.
 """
 
@@ -201,6 +211,83 @@ def check_probe_scan_callbacks(tree, lines):
     return findings
 
 
+def check_serve_hot_path(tree, lines):
+    """The serving zero-retrace guard (scoped to raft_tpu/serve/): no
+    ``jax.jit`` and no ``jax.lax.*`` anywhere in the package — device work
+    must dispatch the backends' ``aot()`` caches so warmup pins every
+    executable and ``aot_compile_counters`` stays flat under traffic.
+    ``serve-exempt`` on the line (or the line above) sanctions a use."""
+    findings = []
+
+    def _sanctioned(node) -> bool:
+        ctx = lines[max(0, node.lineno - 2):node.lineno]
+        return any("serve-exempt" in ln or "noqa" in ln for ln in ctx)
+
+    # names bound by `from jax import jit/lax`, `from jax.lax import X`,
+    # or `import jax.lax as L` count too — renaming must not launder the
+    # dispatch past the rule
+    jax_aliases = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name in ("jit", "lax"):
+                        jax_aliases[a.asname or a.name] = a.name
+                        if not _sanctioned(node):
+                            findings.append((
+                                node.lineno,
+                                f"`from jax import {a.name}` in "
+                                "raft_tpu/serve/ — serve hot paths must "
+                                "dispatch through the aot() executable "
+                                "cache (zero-retrace guarantee), or mark "
+                                "the line serve-exempt"))
+            elif node.module and (node.module == "jax.lax"
+                                  or node.module.startswith("jax.lax.")):
+                if not _sanctioned(node):
+                    findings.append((
+                        node.lineno,
+                        f"`from {node.module} import ...` in "
+                        "raft_tpu/serve/ — serve hot paths must dispatch "
+                        "through the aot() executable cache (zero-retrace "
+                        "guarantee), or mark the line serve-exempt"))
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.lax" or a.name.startswith("jax.lax."):
+                    if a.asname:
+                        jax_aliases[a.asname] = "lax"
+                    if not _sanctioned(node):
+                        findings.append((
+                            node.lineno,
+                            f"`import {a.name}` in raft_tpu/serve/ — serve "
+                            "hot paths must dispatch through the aot() "
+                            "executable cache (zero-retrace guarantee), or "
+                            "mark the line serve-exempt"))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        base = node.value
+        is_jax_jit = (node.attr == "jit" and isinstance(base, ast.Name)
+                      and base.id == "jax")
+        is_jax_lax = (isinstance(base, ast.Attribute) and base.attr == "lax"
+                      and isinstance(base.value, ast.Name)
+                      and base.value.id == "jax")
+        is_alias_lax = (isinstance(base, ast.Name)
+                        and jax_aliases.get(base.id) == "lax")
+        if not (is_jax_jit or is_jax_lax or is_alias_lax):
+            continue
+        if _sanctioned(node):
+            continue
+        what = ("jax.jit" if is_jax_jit
+                else f"jax.lax.{node.attr}" if is_jax_lax
+                else f"{base.id}.{node.attr}")
+        findings.append((
+            node.lineno,
+            f"{what} in raft_tpu/serve/ — serve hot paths must dispatch "
+            "through the aot() executable cache (zero-retrace guarantee), "
+            "or mark the line serve-exempt"))
+    return findings
+
+
 def check_file(path: pathlib.Path):
     src = path.read_text()
     findings = []
@@ -239,6 +326,10 @@ def check_file(path: pathlib.Path):
     # probe-scan tile callbacks must stay lookup-only (hoisted-ADC guard)
     if "raft_tpu/neighbors/" in posix:
         findings.extend(check_probe_scan_callbacks(tree, lines))
+
+    # serve hot paths must dispatch the aot() cache (zero-retrace guard)
+    if "raft_tpu/serve/" in posix:
+        findings.extend(check_serve_hot_path(tree, lines))
 
     # format specs are themselves JoinedStr nodes — exclude them from the
     # placeholder check

@@ -32,6 +32,8 @@ PACKAGES = [
                 "single-linkage HAC"),
     ("neighbors", "Brute-force kNN, IVF-Flat, IVF-PQ, ball cover, "
                   "eps-neighborhood, haversine"),
+    ("serve", "Batched query serving: request coalescing, executable "
+              "warmup/pinning, double-buffered dispatch"),
     ("sparse", "COO/CSR containers, conversions, sparse linalg/distances/"
                "neighbors/solvers"),
     ("spectral", "Spectral partitioning and modularity maximization"),

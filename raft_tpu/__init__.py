@@ -20,6 +20,8 @@ Layer map (mirrors reference SURVEY.md §1, re-imagined TPU-first):
   distance  pairwise distances (20 metrics), fused L2 NN, gram kernels
   cluster   k-means (++/balanced), single-linkage HAC
   neighbors brute-force kNN, IVF-Flat, IVF-PQ, ball cover, eps-neighborhood
+  serve     batched query-serving engine: request coalescing, executable
+            warmup/pinning, double-buffered dispatch over the ANN backends
   sparse    COO/CSR containers, conversions, sparse linalg/distance/solvers
   spectral  spectral partitioning / modularity maximization
   solver    linear assignment problem
@@ -49,6 +51,7 @@ _SUBMODULES = (
     "distance",
     "cluster",
     "neighbors",
+    "serve",
     "sparse",
     "spectral",
     "solver",

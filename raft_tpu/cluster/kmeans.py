@@ -485,10 +485,19 @@ def init_random(rng: RngState, x, n_clusters: int):
     return shuffle_and_gather(rng, x, n_clusters)
 
 
-@functools.partial(jax.jit, static_argnames=("k",), donate_argnums=())
+@functools.partial(jax.jit, static_argnames=("k",))
 def _weighted_kmeans_pp(key, candidates, weights, k: int):
     """Greedy weighted k-means++ over a (small) candidate set — the final
-    step of k-means|| (reference initKMeansPlusPlus's CPU-side selection)."""
+    step of k-means|| (reference initKMeansPlusPlus's CPU-side selection).
+
+    No donation: none of the inputs can legally be donated.  The carry
+    buffers XLA could reuse (``chosen``/``min_d``) are created INSIDE the
+    program, so ``donate_argnums`` cannot reach them; of the actual
+    arguments, *candidates* and *weights* are re-read by every fori_loop
+    iteration (live until the end — donating them would be aliasing a
+    buffer the loop still reads) and *key* is folded per step.  A previous
+    revision carried a no-op ``donate_argnums=()`` here, which donated
+    nothing while implying it had been considered a win."""
     nc, dim = candidates.shape
 
     def body(i, state):

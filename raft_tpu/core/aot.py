@@ -20,12 +20,23 @@ of distinct executables for ragged workloads.
 
 from __future__ import annotations
 
+import collections
 import functools
 import os
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+#: Compile/lower counters (the ``Comms.collective_calls`` /
+#: ``ivf_pq.lut_trace_counters`` pattern): every :meth:`AotFunction.compiled`
+#: cache MISS bumps ``aot_compile_counters["compiles"]`` plus a per-function
+#: key (``f"compiles:{fn.__qualname__}"``).  This is what lets a serving
+#: engine ASSERT its steady state never compiles or retraces: snapshot
+#: ``aot_compile_counters["compiles"]`` after ``ServeEngine.warmup()``, serve
+#: traffic, and require the counter unchanged (tests/test_serve.py).  Never
+#: reset in library code — tests snapshot-and-diff.
+aot_compile_counters: collections.Counter = collections.Counter()
 
 
 def _machine_fingerprint() -> str:
@@ -127,21 +138,77 @@ def is_tracer(*values) -> bool:
     return any(isinstance(v, jax.core.Tracer) for v in values)
 
 
+#: Concrete non-tracer ``jax.Array`` implementation type, captured lazily on
+#: the first array :func:`aot_dispatchable` sees (capturing it at import
+#: would force backend initialization).  A ``type(v) is _ARRAY_LEAF_T``
+#: pointer compare replaces the ``isinstance(v, jax.Array)`` ABC check
+#: (measured 1.17 µs/leaf — the dominant cost of the old walk) and, because
+#: tracers are Tracer subclasses, proves non-tracer in the same compare.
+_ARRAY_LEAF_T: Optional[type] = None
+
+
+def _leaf_on_default(leaf, default) -> bool:
+    """One leaf's placement check: SingleDeviceSharding is recognized by
+    identity of its ``_device`` before falling back to the ``device_set``
+    set comparison (which constructs a set per call).  The ``.sharding``
+    access itself stays inside the guard: an unusual array type whose
+    sharding property raises must fall back to the jit path, not crash
+    the dispatch gate (the pre-fast-path behavior)."""
+    try:
+        s = leaf.sharding
+        if getattr(s, "_device", None) is default:
+            return True
+        return s.device_set == {default}
+    except Exception:  # unusual array types: be conservative
+        return False
+
+
 def aot_dispatchable(*values) -> bool:
     """True when an eager call may dispatch an AOT executable: no tracers
     (opaque to tracing) and every committed jax array on the default device
     (the executable is lowered for the default device only; inputs placed on
     another chip or sharded across a mesh must take the jit path, which
-    specializes per placement)."""
+    specializes per placement).
+
+    This gate runs on EVERY eager call of every AOT-backed entry point
+    (select_k, pairwise, the ivf searches, the serve engine's hot loop), so
+    the common all-``jax.Array``-on-the-default-device case is fast-pathed:
+    bare arrays and flat tuples of arrays skip ``tree_leaves`` entirely, the
+    concrete array type is matched by pointer (``_ARRAY_LEAF_T``) instead of
+    the ``isinstance(jax.Array)`` ABC walk, the default device is looked up
+    once per call (not once per leaf), and a ``SingleDeviceSharding`` is
+    recognized by its ``_device`` identity before the ``device_set`` set
+    compare.  Measured on the ivf_pq call shape (1 query array + a 10-leaf
+    index tuple): 26.8 µs → ~7 µs per call, ~4× (bench/bench_serve.py
+    ``serve/dispatchable_gate``; docs/serving.md has the full note)."""
+    global _ARRAY_LEAF_T
+    default = None
     for v in values:
+        tv = type(v)
+        if tv is _ARRAY_LEAF_T:
+            if default is None:
+                default = jax.devices()[0]
+            if not _leaf_on_default(v, default):
+                return False
+            continue
+        if ((tv is tuple or tv is list) and _ARRAY_LEAF_T is not None
+                and all(type(e) is _ARRAY_LEAF_T for e in v)):
+            # flat array sequence (the ivf index-leaves shape): no flatten
+            if default is None:
+                default = jax.devices()[0]
+            for e in v:
+                if not _leaf_on_default(e, default):
+                    return False
+            continue
         for leaf in jax.tree_util.tree_leaves(v):
             if isinstance(leaf, jax.core.Tracer):
                 return False
             if isinstance(leaf, jax.Array):
-                try:
-                    if leaf.sharding.device_set != {jax.devices()[0]}:
-                        return False
-                except Exception:  # unusual array types: be conservative
+                if _ARRAY_LEAF_T is None:
+                    _ARRAY_LEAF_T = type(leaf)
+                if default is None:
+                    default = jax.devices()[0]
+                if not _leaf_on_default(leaf, default):
                     return False
     return True
 
@@ -203,6 +270,12 @@ class AotFunction:
         sig = self._signature(args)
         entry = self._cache.get(sig)
         if entry is None:
+            # every lower+compile is observable: zero-retrace serving is
+            # asserted by diffing this counter around steady-state traffic
+            aot_compile_counters["compiles"] += 1
+            aot_compile_counters[
+                f"compiles:{getattr(self._fn, '__qualname__', repr(self._fn))}"
+            ] += 1
             _ensure_persistent_cache()
             jitted = jax.jit(self._fn, static_argnums=self._static)
             lower_args = []

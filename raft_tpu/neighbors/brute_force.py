@@ -42,7 +42,7 @@ from typing import Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from raft_tpu.core.aot import _bucket_dim
+from raft_tpu.core.aot import _bucket_dim, aot, aot_dispatchable
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.distance.distance_types import DISTANCE_TYPES, DistanceType
@@ -59,9 +59,8 @@ def _resolve_metric(metric) -> DistanceType:
     return DistanceType(metric)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
-def _knn_scan(index, queries, k: int, metric: DistanceType,
-              metric_arg: float, tile: int, select_min: bool):
+def _knn_scan_impl(index, queries, k: int, metric: DistanceType,
+                   metric_arg: float, tile: int, select_min: bool):
     """Running top-k over index tiles: never materializes (m, n)."""
     from raft_tpu.distance.pairwise import (accum_dtype, distance_with_stats,
                                             metric_stats)
@@ -131,6 +130,16 @@ def _knn_scan(index, queries, k: int, metric: DistanceType,
     return best_d, best_i
 
 
+# Eager calls dispatch the AOT executable cache (the precompiled
+# libraft-nn role, SURVEY.md §2.14) so steady-state serving skips the
+# per-call trace check; jit kept for traced callers and off-default-device
+# inputs.  serve.ServeEngine warms and dispatches _knn_scan_aot directly.
+_KNN_STATICS = (2, 3, 4, 5, 6)
+_knn_scan = functools.partial(jax.jit, static_argnums=_KNN_STATICS)(
+    _knn_scan_impl)
+_knn_scan_aot = aot(_knn_scan_impl, static_argnums=_KNN_STATICS)
+
+
 @auto_sync_handle
 def knn(index, queries, k: int,
         metric: Union[str, DistanceType] = DistanceType.L2SqrtExpanded,
@@ -177,8 +186,10 @@ def knn(index, queries, k: int,
         bucket = min(_bucket_dim(n_valid), bs)
         if bucket != n_valid:
             qb = jnp.pad(qb, ((0, bucket - n_valid), (0, 0)))
-        d, i = _knn_scan(index, qb, int(k), metric, float(metric_arg),
-                         int(tile), select_min)
+        scan_fn = (_knn_scan_aot if aot_dispatchable(index, qb)
+                   else _knn_scan)
+        d, i = scan_fn(index, qb, int(k), metric, float(metric_arg),
+                       int(tile), select_min)
         if bucket != n_valid:
             d, i = d[:n_valid], i[:n_valid]
         out_d.append(d)

@@ -31,7 +31,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from raft_tpu.core.aot import _bucket_dim
+from raft_tpu.core.aot import _bucket_dim, aot, aot_dispatchable
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.core.logger import traced
@@ -248,10 +248,10 @@ def _owner_of(chunk_table, n_phys_rows: int):
         chunk_table.reshape(-1)].set(owners, mode="drop")
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4, 5))
-def _scan_probes(queries, probe_ids, index_leaves, metric_val: int, k: int,
-                 sqrt: bool):
-    """Score all probed lists for a query batch and select top-k.
+def _search_batch_impl(queries, index_leaves, metric_val: int, k: int,
+                       n_probes: int, sqrt: bool):
+    """ONE program for a query batch: coarse ranking → top-n_probes →
+    probe-list scan → top-k (reference ivf_flat_search.cuh:1057 pipeline).
 
     One `lax.scan` step per (probe rank, chunk): logical probes expand
     through the chunk table into physical rows, each step gathers one
@@ -259,10 +259,22 @@ def _scan_probes(queries, probe_ids, index_leaves, metric_val: int, k: int,
     analogue of the reference's per-(query, probe) interleaved scan blocks
     (ivf_flat_search.cuh:658-782), with the running top-k merge playing
     the role of the in-kernel warp-sort queues.
+
+    Lives behind BOTH a jit wrapper (traced / off-device callers) and an
+    ``aot()`` cache (eager serving dispatch — the whole per-batch search is
+    one cached executable, so ``serve.ServeEngine`` can pin its signatures
+    at warmup and never retrace; previously the coarse GEMM + select and
+    the probe scan were separate dispatches).
     """
-    list_data, list_indices, phys_sizes, chunk_table = index_leaves
+    (centers, list_data, list_indices, phys_sizes, chunk_table) = index_leaves
+    metric = DistanceType(metric_val)
     is_ip = metric_val == int(DistanceType.InnerProduct)
     is_cos = metric_val == int(DistanceType.CosineExpanded)
+
+    # coarse ranking against centroids (reference :1120 linalg::gemm)
+    cd = _coarse_distances(queries, centers, metric)
+    _, probe_sel = select_k(cd, n_probes, select_min=True)
+    probe_ids = probe_sel.astype(jnp.int32)
 
     # Half-precision datasets (bf16/f16 — TPU-native) keep half-width MXU
     # inputs but accumulate scores in f32 (same contract as
@@ -302,6 +314,16 @@ def _scan_probes(queries, probe_ids, index_leaves, metric_val: int, k: int,
     return best_d, best_i
 
 
+# Eager searches dispatch the AOT executable cache (reference precompiled
+# ivf-flat kernel instantiations, SURVEY.md §2.14); jit kept for traced
+# callers and inputs off the default device — the ivf_pq._search_batch
+# pattern, now covering the WHOLE batch program (coarse + select + scan).
+_SEARCH_STATICS = (2, 3, 4, 5)
+_search_batch = functools.partial(jax.jit, static_argnums=_SEARCH_STATICS)(
+    _search_batch_impl)
+_search_batch_aot = aot(_search_batch_impl, static_argnums=_SEARCH_STATICS)
+
+
 @traced("raft_tpu.neighbors.ivf_flat.search")
 @auto_sync_handle
 def search(params: SearchParams, index: Index, queries, k: int,
@@ -324,8 +346,8 @@ def search(params: SearchParams, index: Index, queries, k: int,
     if index.metric == DistanceType.CosineExpanded:
         qf = _normalize_rows(qf)
     sqrt = index.metric == DistanceType.L2SqrtExpanded
-    leaves = (index.list_data, index.list_indices, index.phys_sizes,
-              index.chunk_table)
+    leaves = (index.centers, index.list_data, index.list_indices,
+              index.phys_sizes, index.chunk_table)
     out_d, out_i = [], []
     for q0 in range(0, qf.shape[0], batch_size_query):
         q1 = min(q0 + batch_size_query, qf.shape[0])
@@ -336,11 +358,10 @@ def search(params: SearchParams, index: Index, queries, k: int,
         bucket = min(_bucket_dim(n_valid), batch_size_query)
         if bucket != n_valid:
             qb = jnp.pad(qb, ((0, bucket - n_valid), (0, 0)))
-        # coarse ranking against centroids (reference :1120 linalg::gemm)
-        cd = _coarse_distances(qb, index.centers, index.metric)
-        _, probes = select_k(cd, n_probes, select_min=True)
-        d, i = _scan_probes(qb, probes.astype(jnp.int32), leaves,
-                            int(index.metric), int(k), sqrt)
+        batch_fn = (_search_batch_aot if aot_dispatchable(qb, leaves)
+                    else _search_batch)
+        d, i = batch_fn(qb, leaves, int(index.metric), int(k),
+                        int(n_probes), sqrt)
         if n_valid != qb.shape[0]:
             d, i = d[:n_valid], i[:n_valid]
         out_d.append(d)

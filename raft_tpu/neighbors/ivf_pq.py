@@ -975,6 +975,62 @@ _search_batch = functools.partial(jax.jit, static_argnums=_SEARCH_STATICS)(
 _search_batch_aot = aot(_search_batch_impl, static_argnums=_SEARCH_STATICS)
 
 
+def _full_search_impl(queries, leaves, metric_val: int, k: int,
+                      n_probes: int, per_cluster: bool, lut_dtype_name: str,
+                      int_dtype_name: str, pq_bits: int, hoisted: bool):
+    """Coarse ranking + top-n_probes + probe scoring as ONE program — the
+    serving entry point (``serve.ServeEngine``): the whole query-batch →
+    (d, i) computation is one AOT-cacheable executable whose signatures can
+    be pinned at engine warmup, so steady-state dispatch never pays the
+    separate coarse/select/scan dispatch trace checks.  ``search()`` keeps its
+    two-stage path (it hoists the center sq-norms ACROSS batches of one
+    call — a win the single-batch serving shape cannot use)."""
+    centers = leaves[0]
+    if metric_val == int(DistanceType.InnerProduct):
+        coarse = -(queries @ centers.T)
+    else:
+        coarse = _l2_expanded(queries, centers, sqrt=False, precision=None)
+    _, probes = select_k(coarse, n_probes, select_min=True)
+    return _search_batch_impl(queries, probes.astype(jnp.int32), leaves,
+                              metric_val, k, per_cluster, lut_dtype_name,
+                              int_dtype_name, pq_bits, hoisted)
+
+
+_FULL_SEARCH_STATICS = (2, 3, 4, 5, 6, 7, 8, 9)
+_full_search = functools.partial(
+    jax.jit, static_argnums=_FULL_SEARCH_STATICS)(_full_search_impl)
+_full_search_aot = aot(_full_search_impl,
+                       static_argnums=_FULL_SEARCH_STATICS)
+
+
+def hoisted_batch_cap(index: Index, n_probes: int, lut_dtype: str,
+                      hoisted: bool) -> Optional[int]:
+    """Query-batch cap (power of two) bounding the hoisted pipeline's
+    per-batch transients to ~128 MiB, or None when the config builds no
+    per-(query, probe) combined tables (in-scan path, exact-f32
+    PER_SUBSPACE, IP).  The hoisted compressed-LUT / PER_CLUSTER configs
+    materialize several concurrent per-batch copies: ~3 f32 transients
+    with an n_probes probe axis (the list_adc gather, the combined LUT,
+    the shifted/quantizing copy) plus the xs gather whose probe axis is
+    the EXPANDED physical budget (> n_probes when lists span multiple
+    chunks) in the quantized dtype.  ONE formula shared by
+    :func:`search`'s query batching and the serving engine's super-batch
+    clamp (serve.engine._IvfPqBackend) — a tuning here reaches both."""
+    is_ip = index.metric == DistanceType.InnerProduct
+    per_cluster = index.codebook_kind == CodebookKind.PER_CLUSTER
+    if not (hoisted and (per_cluster or (not is_ip
+                                         and lut_dtype != "float32"))):
+        return None
+    n_phys = index.list_codes.shape[0] - 1
+    budget = min(n_probes * index.chunk_table.shape[1],
+                 n_probes + max(0, n_phys - index.n_lists))
+    cell = index.pq_dim * (1 << index.pq_bits)
+    lut_bytes = jnp.dtype(_LUT_DTYPES[lut_dtype]).itemsize
+    per_q = cell * (3 * n_probes * 4 + budget * lut_bytes)
+    # power of two keeps the shape-bucketed executable set small
+    return 1 << max(5, ((128 << 20) // max(per_q, 1)).bit_length() - 1)
+
+
 @traced("raft_tpu.neighbors.ivf_pq.search")
 @auto_sync_handle
 def search(params: SearchParams, index: Index, queries, k: int,
@@ -1009,25 +1065,12 @@ def search(params: SearchParams, index: Index, queries, k: int,
               index.list_codes, index.list_indices, index.phys_sizes,
               index.chunk_table, index.owner, index.list_adc,
               index.list_csum)
-    if hoisted and (index.codebook_kind == CodebookKind.PER_CLUSTER
-                    or (not is_ip and params.lut_dtype != "float32")):
-        # These configs materialize per-(query, probe) combined ADC tables
-        # once per batch — several concurrent copies, not one: ~3 f32
-        # transients with an n_probes probe axis (the list_adc gather, the
-        # combined LUT, the shifted/quantizing copy) plus the xs gather
-        # whose probe axis is the EXPANDED physical budget (> n_probes when
-        # lists span multiple chunks) in the quantized dtype.  Bound the
-        # sum to ~128 MiB by shrinking the query batch (power of two, so
-        # the shape-bucketed executable set stays small); the legacy
-        # in-scan path only ever held one (nq, pq_dim, 2^bits) tile and
-        # needs no cap.
-        n_phys = index.list_codes.shape[0] - 1
-        budget = min(n_probes * index.chunk_table.shape[1],
-                     n_probes + max(0, n_phys - index.n_lists))
-        cell = index.pq_dim * (1 << index.pq_bits)
-        lut_bytes = jnp.dtype(_LUT_DTYPES[params.lut_dtype]).itemsize
-        per_q = cell * (3 * n_probes * 4 + budget * lut_bytes)
-        cap = 1 << max(5, ((128 << 20) // max(per_q, 1)).bit_length() - 1)
+    # Bound the hoisted pipeline's per-batch combined-table transients to
+    # ~128 MiB by shrinking the query batch (hoisted_batch_cap docstring
+    # has the arithmetic); the legacy in-scan path only ever held one
+    # (nq, pq_dim, 2^bits) tile and needs no cap.
+    cap = hoisted_batch_cap(index, n_probes, params.lut_dtype, hoisted)
+    if cap is not None:
         batch_size_query = min(batch_size_query, cap)
     # hoisted invariant statistic: coarse-center sq-norms once per search,
     # not once per query batch (distance.pairwise.metric_stats contract)

@@ -1,0 +1,14 @@
+"""Batched query serving — coalesced, bucket-compiled, zero-retrace ANN
+dispatch over the neighbors backends (docs/serving.md).
+
+Public surface:
+
+- :class:`ServeEngine` — one engine per (index, k, params) serving key:
+  request coalescing into bucket-padded super-batches, executable
+  warmup/pinning through the ``core.aot`` cache, double-buffered dispatch
+  over the handle's stream pool, solo fallback for out-of-range requests.
+"""
+
+from raft_tpu.serve.engine import ServeEngine  # noqa: F401
+
+__all__ = ["ServeEngine"]

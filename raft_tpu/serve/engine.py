@@ -1,0 +1,463 @@
+"""Batched query-serving engine over the ANN backends.
+
+The reference ships precompiled instantiation libraries
+(``libraft-nn``/``libraft-distance``, SURVEY.md §2.14) precisely so a
+serving process never compiles on the request path; raft_tpu's kernels are
+fast (fused kNN scan, hoisted ADC) but a naive serving loop still pays, per
+request: a jit trace-check dispatch, one executable per ragged batch shape,
+and zero cross-request amortization of the scan's fixed costs.  This module
+closes that gap (docs/serving.md):
+
+* **Request coalescing** — concurrent ragged query batches against one
+  (index, k, params) engine are packed, in arrival order, into
+  ``core.aot._bucket_dim``-padded super-batches and dispatched as ONE fused
+  search each; results are sliced back per request.  Per-query rows of
+  every backend's search program are independent of the other rows in the
+  batch, so per-request results are bit-identical to solo dispatch (the
+  property tests/test_serve.py pins across backends × dtypes × mixes).
+* **Executable warmup/pinning** — :meth:`ServeEngine.warmup` pre-lowers
+  every (bucket, dtype) signature through the backend's ``aot()`` cache at
+  engine construction time, so steady-state serving never compiles or
+  retraces: asserted via ``core.aot.aot_compile_counters``.
+* **Double-buffered dispatch** — dispatch is async: while super-batch *i*
+  executes on device, super-batch *i+1* is coalesced, padded (host-side
+  numpy) and transferred.  In-flight outputs are recorded on the handle's
+  stream pool (``Handle.get_next_usable_stream``), alternating lanes, so
+  pool bookkeeping owns the overlap the way the reference's stream-pool
+  batched launches do (handle.hpp:88-130).
+* **Graceful degradation** — a request larger than the warmed bucket range
+  (or the engine's ``max_batch``) is served solo through the backend's
+  public entry point and counted in :attr:`ServeEngine.stats`, never
+  crashed and never silently recompiled into the coalesced path.
+
+Hot-path rule (ci/lint.py): nothing in this package may call ``jax.jit``
+or ``jax.lax`` — every device computation must route through the
+backends' ``aot()``-cached entry points, otherwise the zero-retrace
+guarantee silently erodes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.aot import _bucket_dim
+from raft_tpu.core.error import expects
+from raft_tpu.core.handle import Handle
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
+
+
+class _BruteForceBackend:
+    """Adapter: dense (n, dim) matrix → ``brute_force._knn_scan_aot``."""
+
+    name = "brute_force"
+
+    def __init__(self, index, k: int, metric, metric_arg: float,
+                 batch_size_index: int):
+        self.index = jnp.asarray(index)  # device-resident serving state
+        expects(self.index.ndim == 2, "brute-force index must be (n, dim)")
+        expects(1 <= k <= self.index.shape[0],
+                f"k={k} must be in [1, n_index={self.index.shape[0]}]")
+        self.k = int(k)
+        self.metric = brute_force._resolve_metric(metric)
+        self.metric_arg = float(metric_arg)
+        self.tile = int(min(batch_size_index, self.index.shape[0]))
+        self.select_min = self.metric != DistanceType.InnerProduct
+        self.dim = int(self.index.shape[1])
+        self.fn = brute_force._knn_scan_aot
+
+    def ingest(self, q):
+        """Per-request compute-form conversion (must match what the solo
+        path does BEFORE batching, so coalescing cannot change values)."""
+        q = np.asarray(q)
+        expects(q.ndim == 2 and q.shape[1] == self.dim,
+                "query must be (n, dim) with the index's dim")
+        return q
+
+    def _args(self, qb):
+        return (self.index, qb, self.k, self.metric, self.metric_arg,
+                self.tile, self.select_min)
+
+    def warm(self, bucket: int, dtype) -> None:
+        self.fn.compiled(*self._args(
+            jax.ShapeDtypeStruct((bucket, self.dim), dtype)))
+
+    def dispatch(self, qb):
+        return self.fn(*self._args(qb))
+
+    def solo(self, q):
+        return brute_force.knn(self.index, q, self.k, self.metric,
+                               self.metric_arg,
+                               batch_size_index=self.tile)
+
+
+class _IvfFlatBackend:
+    """Adapter: ``ivf_flat.Index`` → ``ivf_flat._search_batch_aot``."""
+
+    name = "ivf_flat"
+
+    def __init__(self, index: ivf_flat.Index, k: int,
+                 params: Optional[ivf_flat.SearchParams]):
+        self.index = index
+        self.params = params or ivf_flat.SearchParams()
+        expects(k >= 1, "k must be >= 1")
+        self.k = int(k)
+        self.n_probes = int(min(self.params.n_probes, index.n_lists))
+        self.sqrt = index.metric == DistanceType.L2SqrtExpanded
+        self.dim = int(index.dim)
+        self.leaves = (index.centers, index.list_data, index.list_indices,
+                       index.phys_sizes, index.chunk_table)
+        self.fn = ivf_flat._search_batch_aot
+
+    def ingest(self, q):
+        """HOST-side compute-form conversion wherever the conversion is
+        exact (int8/uint8 → f32 widening matches the device cast bit-for-
+        bit), so the hot loop's per-request work stays numpy — no device
+        bounce, no per-ragged-shape eager executables outside the
+        zero-compile counter.  The one INEXACT prologue step, cosine's
+        row normalize, must reproduce the solo path's device numerics
+        exactly (reduction order differs between numpy and XLA), so only
+        that metric pays a per-request device round-trip."""
+        q = np.asarray(q)
+        expects(q.ndim == 2 and q.shape[1] == self.dim, "query dim mismatch")
+        if q.dtype in (np.int8, np.uint8):
+            q = q.astype(np.float32)  # exact widening: matches device cast
+        if self.index.metric == DistanceType.CosineExpanded:
+            return np.asarray(ivf_flat._normalize_rows(jnp.asarray(q)))
+        return q
+
+    def _args(self, qb):
+        return (qb, self.leaves, int(self.index.metric), self.k,
+                self.n_probes, self.sqrt)
+
+    def warm(self, bucket: int, dtype) -> None:
+        self.fn.compiled(*self._args(
+            jax.ShapeDtypeStruct((bucket, self.dim), dtype)))
+
+    def dispatch(self, qb):
+        return self.fn(*self._args(qb))
+
+    def solo(self, q):
+        return ivf_flat.search(self.params, self.index, q, self.k)
+
+
+class _IvfPqBackend:
+    """Adapter: ``ivf_pq.Index`` → ``ivf_pq._full_search_aot`` (coarse +
+    select + probe scan as ONE pinned executable)."""
+
+    name = "ivf_pq"
+
+    def __init__(self, index: ivf_pq.Index, k: int,
+                 params: Optional[ivf_pq.SearchParams]):
+        self.index = index
+        self.params = params or ivf_pq.SearchParams()
+        expects(k >= 1, "k must be >= 1")
+        expects(self.params.lut_dtype in ivf_pq._LUT_DTYPES,
+                f"lut_dtype must be one of {list(ivf_pq._LUT_DTYPES)}")
+        self.k = int(k)
+        self.n_probes = int(min(self.params.n_probes, index.n_lists))
+        self.hoisted = (ivf_pq.hoisted_lut_enabled()
+                        if self.params.hoisted_lut is None
+                        else bool(self.params.hoisted_lut))
+        self.dim = int(index.dim)
+        self.leaves = (index.centers, index.rotation, index.codebooks,
+                       index.list_codes, index.list_indices,
+                       index.phys_sizes, index.chunk_table, index.owner,
+                       index.list_adc, index.list_csum)
+        self.fn = ivf_pq._full_search_aot
+
+    def ingest(self, q):
+        """HOST-side f32 ingest: every dtype ivf_pq accepts converts to
+        f32 EXACTLY (int8/uint8/bf16/f16 are all widenings, f32 is a
+        no-op), so the numpy cast is bit-identical to the solo path's
+        device cast — no device bounce per request (the dtype-acceptance
+        checks mirror ``ivf_pq._ingest_dataset``)."""
+        q = np.asarray(q)
+        if q.dtype in (np.int8, np.uint8):
+            q_dtype = str(q.dtype)
+        else:
+            expects(jnp.issubdtype(q.dtype, jnp.floating),
+                    f"ivf_pq: unsupported query dtype {q.dtype}")
+            q_dtype = "float32"
+        expects(q_dtype in (self.index.dataset_dtype, "float32"),
+                f"query dtype {q_dtype} != index dataset dtype "
+                f"{self.index.dataset_dtype}")
+        expects(q.ndim == 2 and q.shape[1] == self.dim,
+                "query dim mismatch")
+        return q.astype(np.float32)
+
+    def batch_cap(self) -> Optional[int]:
+        """Hoisted compressed-LUT / PER_CLUSTER configs materialize
+        per-(query, probe) combined ADC tables once per batch — the same
+        ~128 MiB transient bound ``ivf_pq.search`` applies to its query
+        batching must clamp the engine's super-batch; ONE shared formula
+        (``ivf_pq.hoisted_batch_cap``) so a tuning there reaches the
+        engine too."""
+        return ivf_pq.hoisted_batch_cap(self.index, self.n_probes,
+                                        self.params.lut_dtype, self.hoisted)
+
+    def _args(self, qb):
+        return (qb, self.leaves, int(self.index.metric), self.k,
+                self.n_probes,
+                self.index.codebook_kind == ivf_pq.CodebookKind.PER_CLUSTER,
+                self.params.lut_dtype, self.params.internal_distance_dtype,
+                self.index.pq_bits, self.hoisted)
+
+    def warm(self, bucket: int, dtype) -> None:
+        self.fn.compiled(*self._args(
+            jax.ShapeDtypeStruct((bucket, self.dim), dtype)))
+
+    def dispatch(self, qb):
+        return self.fn(*self._args(qb))
+
+    def solo(self, q):
+        return ivf_pq.search(self.params, self.index, q, self.k)
+
+
+def _make_backend(index, k, params, metric, metric_arg, batch_size_index):
+    if isinstance(index, ivf_flat.Index):
+        return _IvfFlatBackend(index, k, params)
+    if isinstance(index, ivf_pq.Index):
+        return _IvfPqBackend(index, k, params)
+    return _BruteForceBackend(index, k, metric, metric_arg,
+                              batch_size_index)
+
+
+class ServeEngine:
+    """Coalescing, bucket-compiled, zero-retrace query server for one
+    (index, k, params) serving key.
+
+    Construct one engine per serving key; concurrent requests against the
+    same key are what coalescing amortizes (the reference's analogue: one
+    precompiled kernel instantiation serving every caller of that
+    signature).  ``index`` selects the backend by type:
+
+    * a dense (n, dim) array → brute-force kNN (``metric``/``metric_arg``/
+      ``batch_size_index`` apply),
+    * :class:`raft_tpu.neighbors.ivf_flat.Index` → IVF-Flat
+      (*params* is an ``ivf_flat.SearchParams``),
+    * :class:`raft_tpu.neighbors.ivf_pq.Index` → IVF-PQ
+      (*params* is an ``ivf_pq.SearchParams``).
+
+    ``max_batch`` bounds one coalesced super-batch (and is the largest
+    bucket :meth:`warmup` pins by default).  ``handle`` supplies the stream
+    pool used for double-buffered dispatch; the default builds a 2-lane
+    pool (double buffering proper).
+
+    Thread-safety: :meth:`search` may be called concurrently; the engine
+    serializes planning/dispatch under a lock (the coalescing win comes
+    from batching WITHIN a call — an async front-end should gather its
+    in-flight requests and pass them as one ``search([...])``).
+    """
+
+    def __init__(self, index, k: int, params=None, *,
+                 metric=DistanceType.L2SqrtExpanded, metric_arg: float = 2.0,
+                 max_batch: int = 1024, batch_size_index: int = 16384,
+                 handle: Optional[Handle] = None):
+        expects(max_batch >= 8, "max_batch must be >= 8")
+        self._backend = _make_backend(index, k, params, metric, metric_arg,
+                                      batch_size_index)
+        self.max_batch = int(max_batch)
+        cap = getattr(self._backend, "batch_cap", lambda: None)()
+        if cap is not None:
+            self.max_batch = max(8, min(self.max_batch, cap))
+        # double-buffering wants >= 2 pool lanes to alternate; a caller-
+        # supplied handle is used AS-IS (its get_next_usable_stream falls
+        # back to the main stream when it carries no pool — correct, just
+        # single-lane bookkeeping), and the caller owns its sync
+        self._handle = handle if handle is not None else Handle(n_streams=2)
+        self._warmed: Dict[Any, set] = {}  # dtype(str) -> {buckets}
+        self._lock = threading.Lock()
+        self.stats: Dict[str, int] = {
+            "requests": 0, "queries": 0, "super_batches": 0,
+            "solo_fallbacks": 0, "coalesced_requests": 0,
+        }
+        #: Per-request completion latency (seconds, relative to the
+        #: enclosing ``search()`` entry) of the LAST search call — request
+        #: j completes when its super-batch's results land on the host.
+        #: Telemetry for the serve bench's p50/p99 replay numbers.
+        self.last_latencies: List[float] = []
+
+    @property
+    def backend(self) -> str:
+        return self._backend.name
+
+    @property
+    def k(self) -> int:
+        return self._backend.k
+
+    # -- warmup / pinning ---------------------------------------------------
+    def warmup(self, buckets: Optional[Sequence[int]] = None,
+               dtypes: Sequence[Any] = (jnp.float32,)) -> int:
+        """Pre-lower+compile every (bucket, dtype) search signature through
+        the backend's ``aot()`` cache (the ship-precompiled-libs moment).
+
+        *buckets* defaults to every power-of-two bucket from 8 up to
+        ``max_batch`` — after that, ANY coalesced super-batch the planner
+        can emit hits a pinned executable and steady-state serving performs
+        zero compiles (assert with ``core.aot.aot_compile_counters``).
+        Explicit *buckets* narrow the range: requests that cannot fit the
+        largest warmed bucket are served solo (counted, not compiled).
+        Returns the number of (bucket, dtype) signatures ensured."""
+        if buckets is None:
+            buckets = []
+            b = 8
+            while b < self.max_batch:
+                buckets.append(b)
+                b <<= 1
+            buckets.append(self.max_batch)
+        n = 0
+        with self._lock:
+            for dt in dtypes:
+                dt = jnp.dtype(dt)
+                warmed = self._warmed.setdefault(str(dt), set())
+                for b in sorted(set(int(x) for x in buckets)):
+                    expects(8 <= b <= self.max_batch,
+                            f"bucket {b} outside [8, max_batch="
+                            f"{self.max_batch}]")
+                    self._backend.warm(b, dt)
+                    warmed.add(b)
+                    n += 1
+        return n
+
+    def warmed_buckets(self, dtype) -> List[int]:
+        return sorted(self._warmed.get(str(jnp.dtype(dtype)), ()))
+
+    # -- the request path ---------------------------------------------------
+    def _plan(self, sizes: List[int], max_bucket: int
+              ) -> Tuple[List[List[Tuple[int, int, int]]], List[int]]:
+        """Greedy in-order packing: returns (super_batches, solo) where each
+        super-batch is [(request_idx, start_row, n_rows), ...] with total
+        rows ≤ *max_bucket*, and *solo* lists requests too large for it."""
+        batches: List[List[Tuple[int, int, int]]] = []
+        solo: List[int] = []
+        cur: List[Tuple[int, int, int]] = []
+        cur_n = 0
+        for j, n in enumerate(sizes):
+            if n > max_bucket:
+                solo.append(j)
+                continue
+            if cur_n + n > max_bucket:
+                batches.append(cur)
+                cur, cur_n = [], 0
+            cur.append((j, cur_n, n))
+            cur_n += n
+        if cur:
+            batches.append(cur)
+        return batches, solo
+
+    def _bucket_for(self, total: int, warmed: set) -> int:
+        """Smallest usable padded size: the power-of-two bucket, clamped to
+        max_batch; if warmup pinned an explicit set, the smallest warmed
+        bucket ≥ total (warmup guarantees one exists for totals the planner
+        emits — max_bucket below is min(max(warmed), max_batch))."""
+        b = min(_bucket_dim(total), self.max_batch)
+        if warmed and b not in warmed:
+            bigger = [w for w in warmed if w >= total]
+            if bigger:
+                b = min(bigger)
+        return b
+
+    def search(self, requests: Sequence[Any]
+               ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Serve a batch of concurrent requests.
+
+        *requests*: sequence of (n_j, dim) query matrices (ragged n_j ≥ 0).
+        Returns one ``(distances (n_j, k), indices (n_j, k))`` numpy pair
+        per request, in request order — each bit-identical to what the
+        backend's public solo entry point returns for that request.
+
+        Pipeline: ingest → group by compute dtype → greedy in-order packing
+        into ≤ max_batch super-batches → per batch: host-side numpy
+        assembly + pad to the warmed bucket, ONE device transfer, ONE fused
+        async dispatch recorded on the next pool stream (assembly of batch
+        i+1 overlaps execution of batch i) → collect host results → slice
+        per request."""
+        with self._lock:
+            return self._search_locked(requests)
+
+    def _search_locked(self, requests):
+        t_entry = time.perf_counter()
+        be = self._backend
+        ingested = [be.ingest(q) for q in requests]
+        self.stats["requests"] += len(ingested)
+        self.stats["queries"] += sum(int(q.shape[0]) for q in ingested)
+        results: List[Optional[Tuple[np.ndarray, np.ndarray]]] = (
+            [None] * len(ingested))
+        latencies = [0.0] * len(ingested)
+
+        # group by compute dtype (the engine IS the (index, k, params) key;
+        # dtype is the one per-request signature dimension left)
+        by_dtype: Dict[str, List[int]] = {}
+        for j, q in enumerate(ingested):
+            if q.shape[0] == 0:
+                results[j] = (np.zeros((0, be.k), np.float32),
+                              np.full((0, be.k), -1, np.int32))
+                continue
+            by_dtype.setdefault(str(q.dtype), []).append(j)
+
+        inflight = []  # (kind, payload...) in dispatch order
+        lane = 0
+        for dt, idxs in by_dtype.items():
+            warmed = self._warmed.get(dt, set())
+            max_bucket = (min(max(warmed), self.max_batch) if warmed
+                          else self.max_batch)
+            sizes = [int(ingested[j].shape[0]) for j in idxs]
+            batches, solo = self._plan(sizes, max_bucket)
+            for batch in batches:
+                members = [(idxs[jj], start, n) for jj, start, n in batch]
+                total = members[-1][1] + members[-1][2]
+                bucket = self._bucket_for(total, warmed)
+                # host-side assembly: one contiguous padded block, ONE
+                # transfer — deliberately numpy, so coalescing+padding is
+                # pure host work the double-buffering can overlap with the
+                # previous batch's device execution (and dispatches no
+                # per-shape concat/pad programs on device)
+                block = np.zeros((bucket, be.dim), ingested[idxs[0]].dtype)
+                for j, start, n in members:
+                    block[start:start + n] = ingested[j]
+                out = be.dispatch(jnp.asarray(block))  # async
+                self._handle.get_next_usable_stream(lane).record(out)
+                lane += 1
+                inflight.append(("coalesced", members, out))
+                self.stats["super_batches"] += 1
+                self.stats["coalesced_requests"] += len(members)
+            for jj in solo:
+                j = idxs[jj]
+                # the RAW request, not the ingested form: the public entry
+                # point applies its own ingest prologue, and re-ingesting
+                # (e.g. normalizing an already-normalized cosine query)
+                # would break the identical-to-solo contract at ulp level
+                out = be.solo(requests[j])  # public path: compiles allowed
+                self._handle.get_next_usable_stream(lane).record(out)
+                lane += 1
+                inflight.append(("solo", [(j, 0, ingested[j].shape[0])],
+                                 out))
+                self.stats["solo_fallbacks"] += 1
+
+        # collect: blocks per batch; later batches keep executing meanwhile
+        for _kind, members, out in inflight:
+            d, i = np.asarray(out[0]), np.asarray(out[1])
+            done = time.perf_counter() - t_entry
+            for j, start, n in members:
+                results[j] = (d[start:start + n], i[start:start + n])
+                latencies[j] = done
+        self.last_latencies = latencies
+        return results
+
+    def sync(self) -> None:
+        """Wait for every recorded in-flight dispatch (delegates to the
+        handle; ``search`` already collected its own results)."""
+        self._handle.sync()
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return (f"ServeEngine(backend={self.backend}, k={self.k}, "
+                f"max_batch={self.max_batch}, "
+                f"warmed={ {d: sorted(b) for d, b in self._warmed.items()} },"
+                f" stats={self.stats})")

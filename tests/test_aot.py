@@ -210,3 +210,34 @@ def test_aot_cache_keys_distinguish_dtypes():
     assert _distance_aot.cache_size == n0 + 2  # ...reused
     assert d32.dtype == np.float32 and dbf.dtype == jnp.float32
     np.testing.assert_allclose(np.asarray(dbf), np.asarray(d32), atol=0.03)
+
+
+def test_aot_dispatchable_fast_path_semantics():
+    """PR 4's fast path (pointer-matched array type, flat-tuple walk, lazy
+    default-device lookup) must preserve the gate's semantics exactly:
+    True for host values and default-device arrays in any container shape,
+    False for tracers and off-default placements wherever they hide."""
+    import jax
+
+    from raft_tpu.core.aot import aot_dispatchable
+
+    x = jnp.ones((4, 3))
+    assert aot_dispatchable()
+    assert aot_dispatchable(x, (x, x), [x], {"a": x}, np.ones(3), 2, None)
+    assert aot_dispatchable((x, {"b": (x,)}))  # nested pytree path
+
+    @jax.jit
+    def traced(v):
+        assert not aot_dispatchable(v)
+        assert not aot_dispatchable((v, v))     # tuple fast path
+        assert not aot_dispatchable({"a": v})   # general path
+        assert not aot_dispatchable(x, v)       # mixed concrete + tracer
+        return v
+
+    traced(x)
+
+    if len(jax.devices()) >= 2:
+        x1 = jax.device_put(np.ones((4, 3), np.float32), jax.devices()[1])
+        assert not aot_dispatchable(x1)
+        assert not aot_dispatchable((x, x1))    # tuple fast path
+        assert not aot_dispatchable({"a": x1})  # general path

@@ -84,6 +84,7 @@ def _recall_vs(i, ridx):
                for a, b in zip(np.asarray(i), ridx)) / ridx.size
 
 
+@pytest.mark.slow  # forced low-budget probe-doubling stress (tier-1 budget, PR 4)
 def test_ball_cover_forced_probe_doubling(monkeypatch):
     """initial_probes=1 starts below any reasonable coverage, so the
     exactness certificate MUST fail on the first pass and the host loop

@@ -152,6 +152,9 @@ def test_serialize_kind_mismatch(tmp_path):
         load_ivf_pq(p)
 
 
+# multi-extend stress; the chunked-extend oracle + single-extend tests
+# keep tier-1 coverage (tier-1 budget, PR 4)
+@pytest.mark.slow
 def test_ivf_flat_sequential_extends_with_ids():
     """Multiple extends with custom ids on chunked storage keep ids/recall."""
     rng = np.random.default_rng(9)
@@ -197,27 +200,55 @@ def test_ivf_flat_search_tail_bucketing():
 
 
 def test_ivf_flat_bf16_dataset_recall_near_f32():
-    """bf16 datasets score with f32 accumulation: recall lands within a
-    few points of the f32 index at identical parameters (bf16 scoring
-    without f32 accumulation measured ~0.04 worse on this config)."""
+    """bf16 datasets score with f32 accumulation — recall triage (PR 4).
+
+    Two separate claims, asserted separately:
+
+    1. SCORING is exact on the rounded data: with ALL lists probed
+       (n_probes = n_lists, probe selection removed), the bf16 index
+       recovers the bf16 brute-force top-k EXACTLY (measured 1.000 overlap
+       on this config) — i.e. the f32-accumulated in-list scan introduces
+       no error beyond the bf16 representation itself.  The representation
+       bound (exact bf16 brute force vs f32 ground truth) is ~0.988 here.
+    2. At partial probing the bf16 recall tracks f32 within partition
+       noise.  The historical 0.02 gate was BELOW the noise floor of this
+       estimator: 50 queries × k=5 = 250 candidates (one flipped candidate
+       = 0.004), and the bf16-rounded dataset trains a DIFFERENT coarse
+       partition whose probe-boundary losses are seed luck — measured
+       across seeds 0-5 at this config the (f32 − bf16) gap spans −0.024
+       … +0.044 (bf16 WINS on 3 of 6 seeds; mean +0.005).  Training the
+       quantizer in f32 and storing bf16 does not close it (0.796 vs
+       0.800 on seed 0), confirming there is no fixable scoring/training
+       bug — the gate is widened to 0.05, just past the observed spread.
+    """
     import jax.numpy as jnp
 
     rng = np.random.default_rng(0)
     x = rng.random((2000, 32)).astype(np.float32)
     q = rng.random((50, 32)).astype(np.float32)
     _, iref = knn(x, q, 5)
+    xb, qb = jnp.asarray(x, jnp.bfloat16), jnp.asarray(q, jnp.bfloat16)
+    _, ibf = knn(xb, qb, 5)  # exact search on the rounded data
 
-    def recall(xx, qq):
-        idx = build(IndexParams(n_lists=20), xx)
-        d, i = search(SearchParams(n_probes=8), idx, qq, 5)
-        return d, np.mean([len(set(a.tolist()) & set(b.tolist())) / 5
-                           for a, b in zip(np.asarray(i), np.asarray(iref))])
+    def overlap(i, ref):
+        return np.mean([len(set(a.tolist()) & set(b.tolist())) / 5
+                        for a, b in zip(np.asarray(i), np.asarray(ref))])
 
-    _, rec_f32 = recall(x, q)
-    d_bf, rec_bf = recall(jnp.asarray(x, jnp.bfloat16),
-                          jnp.asarray(q, jnp.bfloat16))
-    assert d_bf.dtype == jnp.float32  # scores accumulate in f32
-    assert rec_bf >= rec_f32 - 0.02, (rec_bf, rec_f32)
+    idx32 = build(IndexParams(n_lists=20), x)
+    idxb = build(IndexParams(n_lists=20), xb)
+
+    # claim 1: probe ALL lists → pure scoring; must reproduce the bf16
+    # brute-force top-k exactly (scores accumulate in f32)
+    d_all, i_all = search(SearchParams(n_probes=20), idxb, qb, 5)
+    assert d_all.dtype == jnp.float32  # scores accumulate in f32
+    assert overlap(i_all, ibf) == 1.0, overlap(i_all, ibf)
+
+    # claim 2: partial probing tracks f32 within the measured partition
+    # noise (±0.05 across seeds; NOT a precision bug — see docstring)
+    _, i32 = search(SearchParams(n_probes=8), idx32, q, 5)
+    _, ib = search(SearchParams(n_probes=8), idxb, qb, 5)
+    rec_f32, rec_bf = overlap(i32, iref), overlap(ib, iref)
+    assert rec_bf >= rec_f32 - 0.05, (rec_bf, rec_f32)
 
 
 def test_extend_lists_chunked_matches_full_repack():

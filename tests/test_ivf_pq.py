@@ -106,6 +106,9 @@ def test_ivf_pq_approx_distance_quality():
     assert np.median(rel) < 0.25
 
 
+# storage-size property; layout correctness rides pack_roundtrip +
+# extend_packed_bits4 (tier-1 budget, PR 4)
+@pytest.mark.slow
 def test_ivf_pq_packed_storage_bytes():
     # pq_bits=4 codes cost half the bytes of pq_bits=8 (reference packing
     # contract ivf_pq_types.hpp:56-65): storage per slot is
@@ -455,6 +458,9 @@ def test_ivf_pq_bf16_dataset_recall_within_pq_noise():
     assert rec_bf >= rec_f32 - 0.05, (rec_bf, rec_f32)
 
 
+# repeated-extend stress; single-extend exactness rides
+# test_ivf_pq_extend + extend_packed_bits4 (tier-1 budget, PR 4)
+@pytest.mark.slow
 def test_ivf_pq_repeated_extend_exact_codes():
     """r5 incremental extend: repeated extends keep every stored code
     byte-identical to encoding the same row directly (the extend path must
@@ -484,6 +490,9 @@ def test_ivf_pq_repeated_extend_exact_codes():
     assert hit >= 0.9
 
 
+# serialize x extend cross; both axes covered solo by
+# serialize_roundtrip + extend (tier-1 budget, PR 4)
+@pytest.mark.slow
 def test_ivf_pq_serialize_roundtrip_after_extend(tmp_path):
     """save → load → search equality must hold for an INCREMENTALLY
     extended index (r5: extend leaves non-contiguous per-list chunk
@@ -504,6 +513,7 @@ def test_ivf_pq_serialize_roundtrip_after_extend(tmp_path):
     np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-6)
 
 
+@pytest.mark.slow  # all-lists probe-order sweep/stress (tier-1 budget, PR 4)
 def test_ivf_pq_full_probe_order_invariance():
     """With ONE trained model (add_data_on_build=False — reference
     ann::index_params knob, r5 parity addition), full-probe search results

@@ -1,0 +1,278 @@
+"""Serving engine: coalesced ≡ per-request property grid, zero-compile
+steady state, graceful out-of-range fallback, and the ci/lint.py serve
+hot-path rule (raft_tpu/serve; docs/serving.md)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.core.aot import aot_compile_counters
+from raft_tpu.neighbors import ivf_flat, ivf_pq, knn
+from raft_tpu.serve import ServeEngine
+
+_N, _DIM, _K = 2000, 16, 5
+
+# ragged request mixes: empty, singletons, odd sizes, bucket-boundary and
+# multi-super-batch totals — the shapes a coalescer must not mangle
+_MIXES = [
+    (3, 70, 1, 40, 0, 7),
+    (16, 16, 1, 1, 1, 100),
+    (1,),
+    (127, 2),
+]
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 1, (_N, _DIM)).astype(np.float32)
+    return x, rng
+
+
+_STATE = {}
+
+
+def _index(backend: str):
+    """Build each index once per module (builds dominate test time)."""
+    if backend not in _STATE:
+        x, _ = _data()
+        if backend == "brute_force":
+            _STATE[backend] = x
+        elif backend == "ivf_flat":
+            _STATE[backend] = ivf_flat.build(
+                ivf_flat.IndexParams(n_lists=16), x)
+        else:
+            _STATE[backend] = ivf_pq.build(
+                ivf_pq.IndexParams(n_lists=16, pq_dim=8, pq_bits=8, seed=1),
+                x)
+    return _STATE[backend]
+
+
+def _engine(backend: str, max_batch=128):
+    idx = _index(backend)
+    if backend == "brute_force":
+        return ServeEngine(idx, _K, max_batch=max_batch)
+    if backend == "ivf_flat":
+        return ServeEngine(idx, _K, ivf_flat.SearchParams(n_probes=6),
+                           max_batch=max_batch)
+    return ServeEngine(idx, _K, ivf_pq.SearchParams(n_probes=6),
+                       max_batch=max_batch)
+
+
+def _solo(backend: str, q):
+    idx = _index(backend)
+    if backend == "brute_force":
+        return knn(idx, q, _K)
+    if backend == "ivf_flat":
+        return ivf_flat.search(ivf_flat.SearchParams(n_probes=6), idx, q, _K)
+    return ivf_pq.search(ivf_pq.SearchParams(n_probes=6), idx, q, _K)
+
+
+@pytest.mark.parametrize("backend", ["brute_force", "ivf_flat", "ivf_pq"])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_coalesced_matches_per_request(backend, dtype):
+    """The coalescing property: every request's (distances, indices) from a
+    coalesced super-batch dispatch is IDENTICAL to solo dispatch of that
+    request through the backend's public entry point — per-query rows of
+    the search programs are independent of the rest of the batch, and the
+    engine's ingest applies the same compute-form conversions the solo
+    prologue does.  (ivf_pq ingests bf16 queries to f32 on both paths, as
+    its reference is templated on T ∈ {float, int8, uint8}.)"""
+    _, rng = _data()
+    eng = _engine(backend)
+    for mix in _MIXES:
+        reqs = [rng.normal(0, 1, (s, _DIM)).astype(np.float32) for s in mix]
+        if dtype == "bfloat16":
+            reqs = [jnp.asarray(q, jnp.bfloat16) for q in reqs]
+        outs = eng.search(reqs)
+        assert len(outs) == len(reqs)
+        for q, (d, i) in zip(reqs, outs):
+            d0, i0 = _solo(backend, q)
+            np.testing.assert_array_equal(i, np.asarray(i0))
+            np.testing.assert_array_equal(d, np.asarray(d0))
+    assert eng.stats["requests"] == sum(len(m) for m in _MIXES)
+
+
+def test_zero_compiles_after_warmup():
+    """The pinning contract (ISSUE 4 acceptance): after ``warmup()``,
+    serving ANY request mix whose super-batches fall inside the warmed
+    bucket range triggers zero new compiles/retraces — counter-asserted
+    via core.aot.aot_compile_counters (every AotFunction cache miss bumps
+    it)."""
+    _, rng = _data(1)
+    # max_batch 128 keeps every _MIXES request size (max 127) INSIDE the
+    # warmed bucket range — out-of-range requests take the public solo
+    # path, which is allowed to compile (covered by the fallback test)
+    eng = _engine("brute_force", max_batch=128)
+    n_sigs = eng.warmup()                       # buckets 8..128, f32
+    assert n_sigs == 5
+    assert eng.warmed_buckets(np.float32) == [8, 16, 32, 64, 128]
+    # warm the engine's dispatch plumbing too (transfer paths, slicing)
+    eng.search([rng.normal(0, 1, (3, _DIM)).astype(np.float32)])
+    c0 = aot_compile_counters["compiles"]
+    for mix in _MIXES:
+        reqs = [rng.normal(0, 1, (s, _DIM)).astype(np.float32) for s in mix]
+        eng.search(reqs)
+    assert aot_compile_counters["compiles"] == c0, dict(aot_compile_counters)
+
+    # counter liveness guard: an unwarmed signature MUST move the counter
+    # (a dead counter would green-light a broken warmup forever).  A fresh
+    # odd-shaped index guarantees the signature exists in no shared cache.
+    eng2 = ServeEngine(rng.normal(0, 1, (53, _DIM)).astype(np.float32), 2,
+                       max_batch=16)
+    eng2.search([rng.normal(0, 1, (4, _DIM)).astype(np.float32)])
+    assert aot_compile_counters["compiles"] > c0
+
+
+def test_out_of_bucket_range_request_served_solo():
+    """A request larger than the warmed bucket range (or max_batch) is
+    served SOLO through the public entry point: counted in stats, results
+    correct, coalesced path untouched — never a crash, never a silent
+    recompile of a coalesced signature."""
+    _, rng = _data(2)
+    eng = _engine("brute_force", max_batch=64)
+    eng.warmup(buckets=(8, 16))                 # narrow pinned range
+    big = rng.normal(0, 1, (40, _DIM)).astype(np.float32)   # > 16, <= 64
+    huge = rng.normal(0, 1, (200, _DIM)).astype(np.float32)  # > max_batch
+    small = rng.normal(0, 1, (5, _DIM)).astype(np.float32)
+    outs = eng.search([big, small, huge])
+    assert eng.stats["solo_fallbacks"] == 2
+    assert eng.stats["coalesced_requests"] == 1
+    for q, (d, i) in zip([big, small, huge], outs):
+        d0, i0 = _solo("brute_force", q)
+        np.testing.assert_array_equal(i, np.asarray(i0))
+        np.testing.assert_array_equal(d, np.asarray(d0))
+
+
+def test_ingest_conversion_paths_match_solo():
+    """The two non-trivial ingest prologues stay identical to solo
+    dispatch: int8 queries (host-side exact widening to the compute
+    dtype) and CosineExpanded (the one inexact prologue step — row
+    normalize — which must reproduce the solo path's device numerics, so
+    it alone round-trips the device; review finding, PR 4)."""
+    rng = np.random.default_rng(6)
+    x8 = rng.integers(-100, 100, (800, _DIM)).astype(np.int8)
+    idx8 = ivf_flat.build(ivf_flat.IndexParams(n_lists=8), x8)
+    eng8 = ServeEngine(idx8, 3, ivf_flat.SearchParams(n_probes=4),
+                       max_batch=64)
+    reqs8 = [x8[:5], x8[40:41]]
+    for q, (d, i) in zip(reqs8, eng8.search(reqs8)):
+        d0, i0 = ivf_flat.search(ivf_flat.SearchParams(n_probes=4),
+                                 idx8, q, 3)
+        np.testing.assert_array_equal(i, np.asarray(i0))
+        np.testing.assert_array_equal(d, np.asarray(d0))
+
+    xf, _ = _data(6)
+    cidx = ivf_flat.build(ivf_flat.IndexParams(
+        n_lists=8, metric=ivf_flat.DistanceType.CosineExpanded), xf)
+    engc = ServeEngine(cidx, 3, ivf_flat.SearchParams(n_probes=4),
+                       max_batch=64)
+    reqs = [xf[:5], xf[100:123]]
+    for q, (d, i) in zip(reqs, engc.search(reqs)):
+        d0, i0 = ivf_flat.search(ivf_flat.SearchParams(n_probes=4),
+                                 cidx, q, 3)
+        np.testing.assert_array_equal(i, np.asarray(i0))
+        np.testing.assert_array_equal(d, np.asarray(d0))
+
+
+def test_mixed_dtype_stream_groups_by_dtype():
+    """One call may carry f32 and bf16 requests: the coalescer groups per
+    compute dtype (the one per-request signature dimension left once the
+    engine pins (index, k, params)) and never packs across groups."""
+    _, rng = _data(3)
+    eng = _engine("brute_force")
+    q32 = rng.normal(0, 1, (9, _DIM)).astype(np.float32)
+    qbf = jnp.asarray(rng.normal(0, 1, (11, _DIM)), jnp.bfloat16)
+    outs = eng.search([q32, qbf, q32[:2]])
+    assert eng.stats["super_batches"] == 2      # one per dtype group
+    np.testing.assert_array_equal(
+        outs[0][1], np.asarray(knn(_index("brute_force"), q32, _K)[1]))
+    np.testing.assert_array_equal(
+        outs[1][1], np.asarray(knn(_index("brute_force"), qbf, _K)[1]))
+
+
+def test_latency_telemetry_and_stats():
+    _, rng = _data(4)
+    eng = _engine("brute_force")
+    reqs = [rng.normal(0, 1, (s, _DIM)).astype(np.float32)
+            for s in (4, 0, 31)]
+    eng.search(reqs)
+    assert len(eng.last_latencies) == 3
+    assert all(t >= 0.0 for t in eng.last_latencies)
+    assert eng.stats["queries"] == 35
+    assert eng.stats["requests"] == 3
+
+
+class TestServeLintRule:
+    """ci/lint.py's serve hot-path guard: jax.jit / jax.lax (and their
+    from-imports) are forbidden inside raft_tpu/serve/ — the zero-retrace
+    guarantee requires every device computation to route through the
+    backends' aot() caches."""
+
+    _VIOLATION = '''
+import jax
+import functools
+from jax import lax
+jitted = functools.partial(jax.jit, static_argnums=(0,))
+def hot(x):
+    return jax.lax.scan(lambda c, _: (c, None), x, None, length=3)
+def hot2(x):
+    return lax.fori_loop(0, 3, lambda i, c: c, x)
+'''
+
+    def _check(self, src):
+        import ast
+
+        from ci.lint import check_serve_hot_path
+
+        return check_serve_hot_path(ast.parse(src), src.splitlines())
+
+    def test_flags_jit_lax_and_from_imports(self):
+        msgs = [m for _, m in self._check(self._VIOLATION)]
+        assert any("jax.jit" in m for m in msgs)
+        assert any("jax.lax.scan" in m for m in msgs)
+        assert any("lax.fori_loop" in m for m in msgs)
+        assert any("from jax import lax" in m for m in msgs)
+
+    def test_import_laundering_does_not_evade(self):
+        """`from jax.lax import X` and `import jax.lax as L` must not
+        launder the dispatch past the rule (review finding, PR 4)."""
+        src = ("from jax.lax import fori_loop\n"
+               "import jax.lax as L\n"
+               "def hot(x):\n"
+               "    return L.scan(lambda c, _: (c, None), x, None, length=2)\n")
+        msgs = [m for _, m in self._check(src)]
+        assert any("from jax.lax import" in m for m in msgs)
+        assert any("import jax.lax" in m for m in msgs)
+        assert any("L.scan" in m for m in msgs)
+
+    def test_marker_allowlists(self):
+        src = "\n".join(ln + "  # serve-exempt: sanctioned"
+                        if ("jax." in ln or "import lax" in ln
+                            or "lax.fori" in ln) else ln
+                        for ln in self._VIOLATION.splitlines())
+        assert self._check(src) == []
+
+    def test_scoped_to_serve(self, tmp_path):
+        from ci.lint import check_file
+
+        d = tmp_path / "raft_tpu" / "serve"
+        d.mkdir(parents=True)
+        f = d / "mod.py"
+        f.write_text(self._VIOLATION)
+        assert any("aot() executable cache" in m for _, m in check_file(f))
+        other = tmp_path / "raft_tpu" / "cluster"
+        other.mkdir()
+        g = other / "mod.py"
+        g.write_text(self._VIOLATION)
+        assert not any("aot() executable cache" in m
+                       for _, m in check_file(g))
+
+    def test_shipped_serve_tree_clean(self):
+        import pathlib
+
+        from ci.lint import check_file
+
+        root = pathlib.Path(__file__).resolve().parents[1]
+        for f in sorted((root / "raft_tpu" / "serve").glob("*.py")):
+            assert not check_file(f), f

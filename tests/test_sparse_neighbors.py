@@ -123,6 +123,9 @@ def test_auto_engine_picks_compressed_for_highdim():
     assert (d >= -1e-6).all()
 
 
+# tiling oracle; the ragged-batch kNN param below exercises the same
+# tiled path (tier-1 budget, PR 4)
+@pytest.mark.slow
 def test_sparse_pairwise_batched_matches_unbatched():
     a = random_csr(50, 16, seed=3)
     b = random_csr(40, 16, seed=4)
@@ -134,7 +137,12 @@ def test_sparse_pairwise_batched_matches_unbatched():
     np.testing.assert_allclose(tiled, full, rtol=1e-5)
 
 
-@pytest.mark.parametrize("batch", [(16384, 4096), (13, 11)])
+@pytest.mark.parametrize("batch", [
+    # single-tile shape; the ragged (13, 11) param covers the tiled
+    # path (budget, PR 4)
+    pytest.param((16384, 4096), marks=pytest.mark.slow),
+    (13, 11),
+])
 def test_sparse_brute_force_knn(batch):
     bi, bq = batch
     index = random_csr(60, 12, seed=5)

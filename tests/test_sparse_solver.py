@@ -65,7 +65,11 @@ def test_lanczos_largest_vs_numpy():
     np.testing.assert_allclose(np.array(evals), ref, atol=1e-3)
 
 
-@pytest.mark.parametrize("n,seed", [(30, 0), (64, 1), (100, 2)])
+@pytest.mark.parametrize("n,seed", [
+    pytest.param(30, 0, marks=pytest.mark.slow),  # budget (PR 4)
+    pytest.param(64, 1, marks=pytest.mark.slow),  # budget (PR 4)
+    (100, 2),
+])
 def test_boruvka_mst_matches_scipy(n, seed):
     d = random_sym_graph(n, 0.25, seed=seed, connected=True)
     res = boruvka_mst(dense_to_csr(d))
