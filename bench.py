@@ -16,7 +16,7 @@ value / estimate, where ≥0.8 meets the north-star target.
 
 Select a metric with
 BENCH_METRIC=pairwise|kmeans|kmeans_mnmg|ivf_pq|ivf_pq_search|lanczos|
-knn_bruteforce|serve.
+knn_bruteforce|serve|ann_sharded.
 
 Robust bring-up (the round-1 failure was an unguarded TPU backend init):
 the measurement runs in a *child* process under a watchdog.  The parent
@@ -375,6 +375,77 @@ def bench_serve():
     }
 
 
+def bench_ann_sharded():
+    """Sharded ANN serving metric (ISSUE 6): IVF-Flat search sharded over
+    ALL local devices as one shard_map program per batch vs single-device
+    search of the SAME index — 100k×64 f32, n_lists=512, n_probes=16,
+    k=10, 1024 queries.
+
+    Acceptance gates enforced in-bench before any number is recorded:
+    the sharded f32 top-k (ids AND distances) must be IDENTICAL to the
+    single-device search, and the trace-time collective counter must show
+    EXACTLY one allgather per traced search program — with its payload
+    bytes matching the packed (bucket, 2k) f32 merge payload, so an
+    over-chatty or over-fat program fails the bench rather than shipping
+    a number.  The row reports sharded qps, single-device qps, their
+    ratio (vs_baseline: on a 1-device host this measures pure shard_map
+    overhead, ~parity; on a pod it scales with HBM/capacity), world, and
+    collective bytes per query.
+    """
+    import jax
+
+    from bench.common import timed_chained
+    from raft_tpu.comms import build_comms
+    from raft_tpu.neighbors import ann_mnmg, ivf_flat
+
+    n, dim, nq, k = 100_000, 64, 1024, 10
+    rng = np.random.default_rng(0)
+    x = rng.random((n, dim), dtype=np.float32)
+    q = jax.device_put(rng.random((nq, dim), dtype=np.float32))
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=512), x)
+    sp = ivf_flat.SearchParams(n_probes=16)
+    comms = build_comms()
+    world = comms.get_size()
+    sharded = index.shard(comms)
+
+    d0, i0 = ivf_flat.search(sp, index, q, k)
+    ag0 = comms.collective_calls["allgather"]
+    agb0 = comms.collective_calls["allgather_bytes"]
+    d1, i1 = ann_mnmg.search(sharded, q, k, sp)  # traces ONE program
+    jax.block_until_ready(d1)
+    # identity + one-collective gates (counters are TRACE-time)
+    assert np.array_equal(np.asarray(i1), np.asarray(i0)), \
+        "sharded top-k ids != single-device"
+    assert np.array_equal(np.asarray(d1), np.asarray(d0)), \
+        "sharded distances != single-device"
+    n_launch = comms.collective_calls["allgather"] - ag0
+    payload = comms.collective_calls["allgather_bytes"] - agb0
+    assert n_launch == 1, \
+        f"sharded search traced {n_launch} allgathers (want exactly 1)"
+    assert payload == nq * 2 * k * 4, \
+        f"allgather payload {payload} B != packed (nq, 2k) f32"
+
+    best = timed_chained(lambda qq: ann_mnmg.search(sharded, qq, k, sp), q,
+                         lambda qq, out: qq + 1e-12 * out[0][0, 0], iters=5)
+    qps = nq / best
+    best_solo = timed_chained(lambda qq: ivf_flat.search(sp, index, qq, k),
+                              q, lambda qq, out: qq + 1e-12 * out[0][0, 0],
+                              iters=5)
+    qps_solo = nq / best_solo
+    return {
+        "metric": f"ann_sharded_ivf_flat_{n // 1000}kx{dim}_probes16_"
+                  f"{world}dev",
+        "value": round(qps, 1),
+        "unit": "qps",
+        # self-baselined like serve: ratio to single-device search of the
+        # same index (1-device host → shard_map overhead; pod → scale-out)
+        "vs_baseline": round(qps / qps_solo, 3),
+        "single_device_qps": round(qps_solo, 1),
+        "world": world,
+        "collective_bytes_per_query": 2 * k * 4,
+    }
+
+
 def bench_knn_bruteforce():
     """Brute-force kNN queries/s on the fused tiled scan (100k×64 f32,
     1024 queries, k=10, L2Sqrt) — the substrate under knn_mnmg,
@@ -451,7 +522,7 @@ _METRICS = {"pairwise": bench_pairwise, "kmeans": bench_kmeans,
             "kmeans_mnmg": bench_kmeans_mnmg, "ivf_pq": bench_ivf_pq,
             "ivf_pq_search": bench_ivf_pq_search,
             "lanczos": bench_lanczos, "knn_bruteforce": bench_knn_bruteforce,
-            "serve": bench_serve}
+            "serve": bench_serve, "ann_sharded": bench_ann_sharded}
 
 
 def _orphan_watchdog():

@@ -108,11 +108,21 @@ def bench_estep_pallas():
     """Fused Pallas distance+argmin engine (pallas_fused_l2nn.py) vs the
     XLA engine (kmeans/estep) — the A/B behind the engine="pallas" knob.
     TPU-only: off-TPU the kernel runs under the Pallas interpreter,
-    ~1000x slower than the XLA path at these sizes."""
+    ~1000x slower than the XLA path at these sizes.
+
+    This case IS the A/B instrument, so it unlocks the r5 experimental
+    gate itself (ADVICE r5): standalone ``python -m bench.bench_kmeans``
+    runs on TPU would otherwise raise ValueError from the engine
+    selection unless the caller remembered RAFT_TPU_PALLAS_EXPERIMENTAL=1
+    (bench.tpu_session sets it, but this module must stand alone too).
+    """
+    import os
+
     import jax
 
     if jax.default_backend() != "tpu":
         return None, {"skip": "tpu-only (Pallas interpret mode on cpu)"}
+    os.environ.setdefault("RAFT_TPU_PALLAS_EXPERIMENTAL", "1")
     from raft_tpu.cluster import min_cluster_and_distance
 
     x, c, _ = _data()

@@ -95,18 +95,22 @@ def make_emitter(out_path):
         emit.rows += 1
         if "error" in obj:
             emit.errors += 1
+        emit.history.append(obj)
         line = json.dumps(obj)
         print(line, flush=True)
         with open(out_path, "a") as f:
             f.write(line + "\n")
 
-    # Running row/error counters: the session's main loop snapshots them
-    # around each inline stage so a stage whose every emitted row was an
-    # error row is retried at the next window instead of being marked
-    # stage_done (r4 advisor finding — the per-config except handlers
-    # swallow failures and return None).
+    # Running row/error counters + per-row history: the session's main
+    # loop snapshots them around each inline stage so a stage whose every
+    # emitted row was an error row — or whose any individual CASE only
+    # ever errored (ADVICE r5: one decisive failed config + one auxiliary
+    # success must not be marked stage_done forever) — is retried at the
+    # next window (the per-config except handlers swallow failures and
+    # return None).
     emit.rows = 0
     emit.errors = 0
+    emit.history = []
     return emit
 
 

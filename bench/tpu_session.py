@@ -697,6 +697,28 @@ def lanczos_stage():
     emit({"stage": "lanczos", "solves_s": round(1.0 / best, 3)})
 
 
+def _case_key(row):
+    """The identity of one measured CASE within a stage: every tag field
+    that distinguishes configs (case label, metric/config axes).  Rows
+    sharing a key are retries/aspects of the same config."""
+    keys = ("case", "metric", "n_probes", "engine", "precision",
+            "batch_samples", "nq", "n_cand", "k")
+    return tuple((f, row[f]) for f in keys if f in row)
+
+
+def _failed_cases(rows):
+    """Case keys that ONLY ever errored among *rows* — the per-case error
+    state behind the stage gate (ADVICE r5): a stage with one decisive
+    failed config and one auxiliary success must not be ``stage_done``
+    forever, so ANY case whose every row is an error row blocks the
+    marker and the stage retries at the next window.  Stages for which an
+    error row IS the decisive result (pallas_probe) return True
+    explicitly, which bypasses this gate."""
+    ok_keys = {_case_key(r) for r in rows if "error" not in r}
+    return sorted({str(_case_key(r)) for r in rows
+                   if "error" in r and _case_key(r) not in ok_keys})
+
+
 def _completed_stages():
     """Stage names with a ``stage_done`` row already in OUT — the resume
     set for re-armed windows (bench/tpu_wait_and_measure.sh re-runs the
@@ -790,18 +812,21 @@ if __name__ == "__main__":
         # mode is hanging on the dead tunnel until the outer timeout
         # kills the whole session, which also leaves no marker) — but
         # their per-config except handlers swallow failures, so an inline
-        # stage whose EVERY emitted row was an error row must also not be
-        # marked done (r4 advisor finding): snapshot the emitter's
-        # row/error counters around the call and treat all-errors as a
-        # stage failure.
-        rows0, errs0 = emit.rows, emit.errors
+        # stage with error rows must also not be marked done: PER-CASE
+        # error state (ADVICE r5 — any case whose every row errored
+        # blocks the marker, so one decisive failed config is not masked
+        # by an auxiliary success), which subsumes the r4 all-errors
+        # gate.  Stages where an error row IS the decisive result
+        # (pallas_probe) return True explicitly and bypass this.
+        rows0 = emit.rows
         ok = stage_fn()
         if DRYRUN:
             continue  # rehearsals never write resume state
-        rows, errs = emit.rows - rows0, emit.errors - errs0
-        if ok is None and rows > 0 and errs == rows:
-            emit({"stage": "session", "stage_all_errors": name,
-                  "rows": rows})
+        stage_rows = emit.history[rows0:emit.rows]
+        failed = _failed_cases(stage_rows)
+        if ok is None and failed:
+            emit({"stage": "session", "stage_failed_cases": name,
+                  "cases": failed})
             ok = False
         if ok is False:
             all_ok = False

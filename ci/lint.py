@@ -26,6 +26,13 @@ high-signal subset with stdlib ast/tokenize:
     HOISTED_LUT=0 legacy baseline, ivf_flat's tile-scoring GEMM) carry an
     ``adc-exempt`` marker comment on the call line.
 
+  * host transfers (``np.asarray``/``np.array``, ``jax.device_get``,
+    ``.addressable_data``, ``.block_until_ready``) anywhere in
+    ``raft_tpu/neighbors/ann_mnmg.py`` outside ``host-ok``-marked lines —
+    the sharded-ANN search path is ONE shard_map program per batch with
+    no host round-trips by design; build/serialize-time table assembly
+    routes through the blessed ``_host`` helper
+
   * ``jax.jit`` / ``jax.lax.*`` dispatch anywhere in ``raft_tpu/serve/`` —
     the serving engine's zero-retrace guarantee holds only while every
     device computation routes through the backends' ``aot()`` executable
@@ -288,6 +295,55 @@ def check_serve_hot_path(tree, lines):
     return findings
 
 
+#: Host-transfer surfaces banned in the sharded-ANN search module: a fetch
+#: anywhere in the search path reintroduces the host round-trip the
+#: one-shard_map-program design exists to eliminate (and silently
+#: serializes the whole mesh behind one host thread).
+_HOST_TRANSFER_CALLS = ("asarray", "array", "device_get",
+                        "addressable_data", "block_until_ready")
+
+
+def check_ann_mnmg_host_transfers(tree, lines):
+    """The sharded-ANN no-host-transfer guard (scoped to
+    raft_tpu/neighbors/ann_mnmg.py): ``np.asarray``/``np.array``,
+    ``jax.device_get``, ``.addressable_data`` and ``.block_until_ready``
+    are banned module-wide — the search path must stay device-resident
+    end to end (ONE shard_map program per batch).  Build/serialize-time
+    table assembly goes through blessed helpers whose lines carry a
+    ``host-ok`` marker (the adc-exempt/serve-exempt allowlist idiom);
+    pure-numpy table arithmetic on host data (np.arange/zeros/...) is not
+    a transfer and is not flagged."""
+    found = {}
+    for node in ast.walk(tree):
+        name = None
+        if isinstance(node, ast.Call):
+            cname = _call_name(node)
+            if cname in ("device_get", "addressable_data",
+                         "block_until_ready"):
+                name = cname
+            elif cname in ("asarray", "array"):
+                f = node.func
+                if (isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "np"):
+                    name = f"np.{cname}"
+        elif (isinstance(node, ast.Attribute)
+              and node.attr in ("addressable_data", "block_until_ready")):
+            name = node.attr
+        if name is None:
+            continue
+        ctx = lines[max(0, node.lineno - 2):node.lineno]
+        if any("host-ok" in ln or "noqa" in ln for ln in ctx):
+            continue
+        found.setdefault((node.lineno, name.split(".")[-1]), name)
+    return [(lineno,
+             f"{name} in ann_mnmg — the sharded search path must stay "
+             "device-resident (one shard_map program per batch, no host "
+             "round-trips); route build/serialize-time fetches through a "
+             "host-ok-marked helper")
+            for (lineno, _), name in sorted(found.items())]
+
+
 def check_file(path: pathlib.Path):
     src = path.read_text()
     findings = []
@@ -326,6 +382,11 @@ def check_file(path: pathlib.Path):
     # probe-scan tile callbacks must stay lookup-only (hoisted-ADC guard)
     if "raft_tpu/neighbors/" in posix:
         findings.extend(check_probe_scan_callbacks(tree, lines))
+
+    # the sharded search path must never fetch to host (one shard_map
+    # program per batch; build-time helpers carry host-ok markers)
+    if posix.endswith("neighbors/ann_mnmg.py"):
+        findings.extend(check_ann_mnmg_host_transfers(tree, lines))
 
     # serve hot paths must dispatch the aot() cache (zero-retrace guard)
     if "raft_tpu/serve/" in posix:

@@ -50,6 +50,21 @@ _REDUCERS = {
 }
 
 
+def shard_map_compat(fn, mesh, in_specs, out_specs, check_vma=False):
+    """Version-compat ``shard_map`` wrapper (jax >= 0.7 top-level name +
+    ``check_vma`` kwarg; 0.4.x experimental home + ``check_rep``) — the ONE
+    import-shim for every mapped program builder (``Comms.run``, the
+    sharded-ANN program cache in ``neighbors.ann_mnmg``)."""
+    try:  # jax ≥ 0.7 top-level name / kwarg
+        from jax import shard_map
+        vma_kw = "check_vma"
+    except ImportError:  # 0.4.x: experimental home, check_rep kwarg
+        from jax.experimental.shard_map import shard_map
+        vma_kw = "check_rep"
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     **{vma_kw: check_vma})
+
+
 class _Mailboxes:
     """Process-local tagged mailboxes for the host p2p plane."""
 
@@ -101,7 +116,11 @@ class Comms:
         # traced program contains (one increment per allreduce/bcast/... in
         # the traced body), not per-execution events.  Tests use it to pin
         # payload shapes — e.g. fused MNMG k-means issues exactly ONE
-        # allreduce per EM iteration (tests/test_kmeans_mnmg.py).
+        # allreduce per EM iteration (tests/test_kmeans_mnmg.py).  Each
+        # launch ALSO records its per-rank payload under "<name>_bytes"
+        # (the sharded-ANN layer asserts bytes, not just counts, so an
+        # over-chatty program that splits one allgather into many small
+        # ones — or fattens the payload — is caught either way).
         self.collective_calls: Counter = Counter()
         # Host p2p plane: TCP mailbox (cross-process, ucp_helper.hpp role)
         # when a coordinator address is configured, else process-local
@@ -217,6 +236,15 @@ class Comms:
         return sub
 
     # -- device collectives (used inside shard_map) --------------------------
+    def _count_collective(self, name: str, x) -> None:
+        """Bump the trace-time launch counter AND record the launch's
+        per-rank payload bytes under ``f"{name}_bytes"`` (shapes are static
+        at trace time, so the byte count is exact even for tracers)."""
+        self.collective_calls[name] += 1
+        itemsize = jnp.dtype(jnp.result_type(x)).itemsize
+        self.collective_calls[f"{name}_bytes"] += int(
+            itemsize * np.prod(jnp.shape(x)))
+
     def _gather_all(self, x):
         """all_gather over the FULL axis (grouped selection is masked on top)."""
         return jax.lax.all_gather(x, self.axis_name)
@@ -273,7 +301,7 @@ class Comms:
 
     def allreduce(self, x, op: ReduceOp = ReduceOp.SUM):
         """reference comms_t::allreduce (core/comms.hpp:322)."""
-        self.collective_calls["allreduce"] += 1
+        self._count_collective("allreduce", x)
         if self.groups is None:
             if op == ReduceOp.PROD:
                 # no pprod primitive: exp∘psum∘log is invalid for ≤0
@@ -287,7 +315,7 @@ class Comms:
 
         Grouped path: mask to the root's contribution, then the O(group)
         ring/butterfly allreduce — traffic O(group)·|x|, not O(world)."""
-        self.collective_calls["bcast"] += 1
+        self._count_collective("bcast", x)
         if self.groups is None:
             return self._gather_all(x)[root]
         x = jnp.asarray(x)
@@ -313,7 +341,7 @@ class Comms:
         After s forward rotations this rank holds the shard of the member
         s positions behind it, so the stacked parts are rolled into
         position order with a traced take."""
-        self.collective_calls["allgather"] += 1
+        self._count_collective("allgather", x)
         if self.groups is None:
             return self._gather_all(x)
         expects(self._group_size is not None,
@@ -380,7 +408,7 @@ class Comms:
     def reducescatter(self, x, op: ReduceOp = ReduceOp.SUM):
         """reference comms_t::reducescatter (core/comms.hpp:481): reduce then
         scatter equal chunks; x's leading dim must be divisible by size."""
-        self.collective_calls["reducescatter"] += 1
+        self._count_collective("reducescatter", x)
         if self.groups is not None:
             expects(self._group_size is not None,
                     "reducescatter requires equal-sized groups (chunk shapes "
@@ -613,13 +641,6 @@ class Comms:
         """
         from jax.sharding import PartitionSpec as P
 
-        try:  # jax ≥ 0.7 top-level name / kwarg
-            from jax import shard_map
-            vma_kw = "check_vma"
-        except ImportError:  # 0.4.x: experimental home, check_rep kwarg
-            from jax.experimental.shard_map import shard_map
-            vma_kw = "check_rep"
-
         if in_specs is None:
             in_specs = tuple(P(self.axis_name) for _ in args)
         if out_specs is None:
@@ -631,17 +652,16 @@ class Comms:
         # replication/varying-axes checker OFF: grouped collectives are
         # all_gather + masked reductions, which ARE replicated per-group but
         # not provably so to the static checker (check_vma on jax ≥ 0.7,
-        # check_rep on 0.4.x).
-        if "check_vma" in shard_kw and vma_kw != "check_vma":
-            shard_kw[vma_kw] = shard_kw.pop("check_vma")
-        shard_kw.setdefault(vma_kw, False)
+        # check_rep on 0.4.x — shard_map_compat owns the version shim).
+        check_vma = shard_kw.pop("check_vma", shard_kw.pop("check_rep", False))
+        expects(not shard_kw, f"unsupported shard_map kwargs: {shard_kw}")
         # Cache the jitted wrapper: jit caches are keyed by callable identity,
         # so rebuilding shard_map(fn) per call would retrace every time.
-        cache_key = (fn, str(in_specs), str(out_specs), str(sorted(shard_kw.items())))
+        cache_key = (fn, str(in_specs), str(out_specs), check_vma)
         jitted = self._run_cache.get(cache_key)
         if jitted is None:
-            mapped = shard_map(fn, mesh=self.mesh, in_specs=in_specs,
-                               out_specs=out_specs, **shard_kw)
+            mapped = shard_map_compat(fn, self.mesh, in_specs, out_specs,
+                                      check_vma=check_vma)
             jitted = jax.jit(mapped)
             self._run_cache[cache_key] = jitted
         return jitted(*args)

@@ -264,6 +264,12 @@ class AotFunction:
                 sig.append((treedef, entry))
         return tuple(sig)
 
+    def _leaf_struct(self, leaf) -> jax.ShapeDtypeStruct:
+        """The abstract value one dynamic leaf lowers as — the ONE hook
+        subclasses override (MeshAotFunction preserves shardings here)."""
+        shape, dtype = self._leaf_spec(leaf)
+        return jax.ShapeDtypeStruct(self._bucket_shape(shape), dtype)
+
     def compiled(self, *args):
         """Return the compiled executable for this signature (compiling on
         miss) without running it."""
@@ -278,15 +284,10 @@ class AotFunction:
             ] += 1
             _ensure_persistent_cache()
             jitted = jax.jit(self._fn, static_argnums=self._static)
-            lower_args = []
-            for i, a in enumerate(args):
-                if i in self._static:
-                    lower_args.append(a)
-                else:
-                    lower_args.append(jax.tree_util.tree_map(
-                        lambda leaf: jax.ShapeDtypeStruct(
-                            self._bucket_shape(self._leaf_spec(leaf)[0]),
-                            self._leaf_spec(leaf)[1]), a))
+            lower_args = [
+                a if i in self._static
+                else jax.tree_util.tree_map(self._leaf_struct, a)
+                for i, a in enumerate(args)]
             entry = jitted.lower(*lower_args).compile()
             self._cache[sig] = entry
         return entry
@@ -309,6 +310,71 @@ class AotFunction:
     @property
     def cache_size(self) -> int:
         return len(self._cache)
+
+
+class MeshAotFunction(AotFunction):
+    """AOT executable cache for ``shard_map`` programs over a fixed mesh.
+
+    The single-device :class:`AotFunction` lowers for the default device and
+    keys signatures on (shape, dtype) alone — a mesh program's executable is
+    additionally specialized on every dynamic leaf's SHARDING (replicated
+    queries vs world-stacked index shards), and calling a ``Compiled`` with
+    differently-laid-out inputs is a hard error, not a silent reshard.  So
+    here:
+
+    * the signature keys on each dynamic leaf's sharding object (hashable,
+      mesh-identity included) alongside shape/dtype;
+    * lowering preserves shardings via ``ShapeDtypeStruct(..., sharding=)``,
+      so :meth:`compiled` can pre-lower a (bucket, dtype, world) signature
+      from specs at serve-engine warmup without materializing data;
+    * no shape bucketing/padding is applied at call time — callers pre-pad
+      to their bucket (the sharded-ANN search path does), because padding a
+      mesh-global array here would silently gather it to one device.
+
+    Compile misses bump ``aot_compile_counters`` exactly like the base
+    class, so the serving engine's zero-retrace steady state stays
+    counter-assertable across sharded backends too.  One instance per
+    (communicator, statics) program — the sharded-ANN layer caches
+    instances on the communicator, so the mesh/world is part of the cache
+    identity by construction.
+    """
+
+    @staticmethod
+    def _leaf_sharding(leaf):
+        return getattr(leaf, "sharding", None)
+
+    def _signature(self, args):
+        sig = []
+        for i, a in enumerate(args):
+            if i in self._static:
+                sig.append(("static", a))
+            else:
+                leaves, treedef = jax.tree_util.tree_flatten(a)
+                entry = tuple(
+                    (self._leaf_spec(leaf)[0], str(self._leaf_spec(leaf)[1]),
+                     self._leaf_sharding(leaf))
+                    for leaf in leaves)
+                sig.append((treedef, entry))
+        return tuple(sig)
+
+    def _leaf_struct(self, leaf) -> jax.ShapeDtypeStruct:
+        # no shape bucketing (a mesh-global array must not be padded), and
+        # the leaf's sharding rides into the lowering
+        shape, dtype = self._leaf_spec(leaf)
+        return jax.ShapeDtypeStruct(shape, dtype,
+                                    sharding=self._leaf_sharding(leaf))
+
+    def __call__(self, *args):
+        exe = self.compiled(*args)
+        return exe(*[a for i, a in enumerate(args)
+                     if i not in self._static])
+
+
+def mesh_aot(fn: Callable, *, static_argnums: Tuple[int, ...] = ()
+             ) -> MeshAotFunction:
+    """Decorator/factory: AOT-compile a shard_map program per
+    (shape, dtype, sharding) signature — see :class:`MeshAotFunction`."""
+    return MeshAotFunction(fn, static_argnums)
 
 
 def aot(fn: Optional[Callable] = None, *, static_argnums: Tuple[int, ...] = (),

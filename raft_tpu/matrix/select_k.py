@@ -269,6 +269,52 @@ def merge_sorted_runs(a_vals, a_idx, b_vals, b_idx, k: Optional[int] = None,
     return fn(*args, k, select_min)
 
 
+def merge_sorted_parts(part_vals, part_idx, k: Optional[int] = None,
+                       select_min: bool = True
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fold STACKED sorted runs (n_parts, ..., in_k) into the best k of
+    their union — the device-side core under ``knn_merge_parts`` and the
+    sharded-ANN cross-shard merge (``neighbors.ann_mnmg``), shared so the
+    part-merge semantics live in ONE place.
+
+    The fold seeds from part 0 (not a sentinel carry): a sentinel init
+    would tie-beat REAL candidates sitting at the sentinel value (±inf
+    distances are legal in parts — masked/padded select_k outputs) and
+    replace their ids with -1.  Only when k > in_k does part 0 need
+    sentinel padding, where that residual tie edge remains (documented at
+    ``knn_merge_parts``).  Earlier parts win ties (the carry is run *a* of
+    :func:`merge_sorted_runs`), so folding parts in part order reproduces
+    a stable full sort over the concatenated candidates — which is exactly
+    why a sharded scan merged in shard order matches the single-device
+    sequential scan bit for bit.
+
+    Traceable (runs inside shard_map programs); eager callers go through
+    :func:`merge_sorted_runs`'s own AOT/jit dispatch per fold step.
+    """
+    d = jnp.asarray(part_vals)
+    i = jnp.asarray(part_idx)
+    n_parts = d.shape[0]
+    in_k = d.shape[-1]
+    k = int(in_k if k is None else k)
+    if in_k >= k:
+        init = (d[0, ..., :k], i[0, ..., :k])
+    else:
+        sentinel = jnp.asarray(_worst_value(d.dtype, select_min), d.dtype)
+        pad = [(0, 0)] * (d.ndim - 2) + [(0, k - in_k)]
+        init = (jnp.pad(d[0], pad, constant_values=sentinel),
+                jnp.pad(i[0], pad, constant_values=jnp.asarray(-1, i.dtype)))
+    if n_parts == 1:
+        return init
+
+    def step(carry, part):
+        pd, pi = part
+        return merge_sorted_runs(carry[0], carry[1], pd, pi, k=k,
+                                 select_min=select_min), None
+
+    (md, mi), _ = jax.lax.scan(step, init, (d[1:], i[1:]))
+    return md, mi
+
+
 def select_min_k(values, k: int, indices=None):
     return select_k(values, k, select_min=True, indices=indices)
 

@@ -232,7 +232,7 @@ def extend_lists_chunked(data, idx, list_sizes, chunk_table,
 
 
 def expand_probes(probe_ids, chunk_table, n_rows: int,
-                  return_ord: bool = False):
+                  return_ord: bool = False, extra: Optional[int] = None):
     """(nq, n_probes) logical probes → (nq, budget) physical rows.
 
     *n_rows* is the physical block's leading dim (n_phys + 1; the reserved
@@ -246,6 +246,15 @@ def expand_probes(probe_ids, chunk_table, n_rows: int,
     Chunk-major pre-order keeps the first chunk of every probe in the
     earliest scan steps.
 
+    *extra* overrides the continuation-chunk count derived from the table
+    shape.  A SHARD-LOCAL chunk table (``neighbors.ann_mnmg``) still spans
+    every logical list but its physical block holds only the local shard's
+    rows, so ``n_phys_local − n_lists`` UNDERCOUNTS the local continuation
+    chunks (it can even go negative) — truncating real chunks and silently
+    dropping candidates.  The sharded layer passes the true per-shard
+    worst case explicitly (the same static value on every shard: SPMD
+    needs one program).
+
     With ``return_ord=True`` also returns the PROBE ORDINAL (nq, budget)
     int32 of each physical slot — which of the query's n_probes coarse
     probes the slot's chunk belongs to (continuation chunks of one list
@@ -258,7 +267,9 @@ def expand_probes(probe_ids, chunk_table, n_rows: int,
     n_probes = probe_ids.shape[1]
     n_lists = chunk_table.shape[0]
     dummy = n_rows - 1
-    extra = max(0, (n_rows - 1) - n_lists)
+    if extra is None:
+        extra = max(0, (n_rows - 1) - n_lists)
+    extra = int(extra)
     ph = chunk_table[probe_ids]               # (nq, n_probes, max_chunks)
     flat = jnp.swapaxes(ph, 1, 2).reshape(probe_ids.shape[0], -1)
     # chunk-major flattening: flat position j holds probe ordinal j % n_probes

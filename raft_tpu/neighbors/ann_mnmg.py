@@ -1,0 +1,582 @@
+"""Sharded multi-device ANN search: IVF-Flat, IVF-PQ and brute force as
+ONE ``shard_map`` program per query batch.
+
+Counterpart of the reference ecosystem's MNMG ANN layer (cuML's
+distributed brute-force/ANN driven through raft comms, the
+``neighbors/brute_force.cuh:76`` part-merge design): the index is
+partitioned across the communicator's devices, every device scans its
+shard with the SAME fused single-device kernels (the PR-1 fused scan, the
+PR-3 hoisted-ADC pipeline), and per-shard top-k candidates merge on
+device.  Design (docs/sharded_ann.md):
+
+* **Partitioning** — inverted lists are assigned round-robin
+  (``list l → shard l % world``) at ``shard()`` time: coarse centroids /
+  rotation / codebooks / ``list_adc`` are REPLICATED (they are read by
+  every query against every probe), while the packed list blocks
+  (vectors/codes, indices, per-chunk sizes, ADC csums) are gathered into
+  per-shard blocks stacked along a leading ``world`` axis and laid out on
+  the mesh with ``P(axis)`` — inside the program each device sees only
+  its own block.  Brute force shards rows contiguously (the OPG split
+  ``knn_mnmg`` uses), so global ids are ``rank·rows_per + local``.
+
+* **Probe intersection** — search runs the replicated coarse GEMM +
+  top-``n_probes`` on every shard (identical, collective-free), then
+  intersects the GLOBAL probe set with the local lists through the
+  shard-LOCAL chunk table: probes owned elsewhere expand to the local
+  dummy row and compact to the back of the scan (``expand_probes``),
+  so each shard pays only for its own lists.  The continuation-chunk
+  budget cannot be derived from the local table shape (it spans all
+  logical lists but holds only local rows) — ``shard()`` computes the
+  true per-shard worst case and threads it through as the static
+  ``probe_extra``.
+
+* **Merge** — per-shard (nq, k) results pack distances and bitcast ids
+  into ONE payload, ONE ``comms.allgather`` moves them, and
+  ``matrix.select_k.merge_sorted_parts`` folds the (world, nq, k) parts
+  on device — no host round-trips anywhere in the search path (a
+  ci/lint.py rule bans host transfers in this module outside ``host-ok``
+  lines).  The L2Sqrt root is DEFERRED past the merge, so merging
+  squared distances in shard order reproduces the single-device scan's
+  stable tie order bit for bit.
+
+* **Caching/serving** — the whole batch is one
+  ``core.aot.MeshAotFunction`` executable keyed on (bucket, dtype,
+  leaf shardings) and cached per (communicator, statics), so
+  ``serve.ServeEngine``'s sharded backend warms every signature up front
+  and steady-state dispatch never retraces; ``Comms.collective_calls``
+  (count AND payload bytes) pins exactly one allgather per search batch.
+
+The query-sharded large-batch brute-force mode (split queries instead of
+the index when nq dominates — zero collectives, disjoint results gathered
+by the output sharding alone) lives in ``knn_mnmg(partition=...)`` and
+shares this module's program-cache plumbing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.comms.comms import Comms, as_comms, shard_map_compat
+from raft_tpu.core.aot import MeshAotFunction, _bucket_dim
+from raft_tpu.core.error import expects
+from raft_tpu.core.logger import traced
+from raft_tpu.cluster.kmeans_mnmg import _cached_program
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.matrix.select_k import merge_sorted_parts
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
+from raft_tpu.neighbors._common import empty_result
+
+
+def _host(x) -> np.ndarray:
+    """Device→host fetch for BUILD/SERIALIZE-time table construction only.
+    The search path must never fetch (the ci/lint.py ann_mnmg rule bans
+    unmarked host transfers in this module)."""
+    return np.asarray(x)  # host-ok: build/serialize-time table assembly
+
+
+def _full_axis_comms(comms) -> Comms:
+    comms = as_comms(comms)
+    # A split communicator's get_size() is group-local while P(axis) shards
+    # over the FULL mesh axis — the partition arithmetic would silently
+    # corrupt: require the full-axis communicator (knn_mnmg's rule).
+    expects(getattr(comms, "groups", None) is None,
+            "sharded ANN needs a full (non-split) communicator")
+    return comms
+
+
+# ---------------------------------------------------------------------------
+# the sharded index container
+
+
+@dataclasses.dataclass
+class ShardedIndex:
+    """A list- (or row-) partitioned ANN index resident across the devices
+    of one communicator.
+
+    ``replicated`` holds the global tables every shard reads (coarse
+    centroids; for IVF-PQ also rotation/codebooks/list_adc), laid out
+    replicated on the mesh; ``stacked`` holds the per-shard blocks with a
+    leading ``world`` axis sharded along the communicator's mesh axis.
+    ``aux`` carries the static search configuration (metric, dims, the
+    per-shard ``probe_extra`` budget, ...).  Build with ``Index.shard``
+    (:func:`shard_ivf_flat` / :func:`shard_ivf_pq`) or
+    :func:`shard_brute_force`; search with :func:`search` or through
+    ``serve.ServeEngine``.  The partition is immutable: after
+    ``extend()``-ing the base index, re-``shard()`` it (partitioning is
+    one host-side table pass + device gathers, cheap next to a build).
+    """
+
+    kind: str                    # "ivf_flat" | "ivf_pq" | "brute_force"
+    comms: Comms
+    replicated: Tuple[Any, ...]  # kind-specific global tables
+    stacked: Tuple[Any, ...]     # kind-specific (world, ...) shard blocks
+    aux: Dict[str, Any]          # static search metadata (JSON-safe)
+
+    @property
+    def world(self) -> int:
+        return int(self.aux["world"])
+
+    @property
+    def dim(self) -> int:
+        return int(self.aux["dim"])
+
+    @property
+    def metric(self) -> DistanceType:
+        return DistanceType(self.aux["metric"])
+
+    def search(self, queries, k: int, params=None, **kw):
+        return search(self, queries, k, params, **kw)
+
+    def searcher(self, k: int, params=None) -> "ShardedSearcher":
+        return ShardedSearcher(self, k, params)
+
+
+# ---------------------------------------------------------------------------
+# partitioning (build/shard time; host-side table arithmetic + device gathers)
+
+
+def _partition(chunk_table_h: np.ndarray, n_rows: int, world: int):
+    """Round-robin partition of a chunked-list layout; *n_rows* is the
+    global physical block's leading dim (n_phys + 1).
+
+    Returns ``(gather, local_tables, probe_extra, local_rows)`` where
+    ``gather`` (world, local_rows+1) maps each shard's local physical
+    slot to a GLOBAL physical row (padding slots and the local dummy map
+    to the global dummy, whose size is 0 — they never score),
+    ``local_tables`` (world, n_lists, max_chunks) int32 is each shard's
+    logical→local chunk table (non-local lists → local dummy), and
+    ``probe_extra`` is the max over shards of local continuation chunks —
+    the static scan budget every shard's ``expand_probes`` must use (one
+    SPMD program).
+    """
+    n_lists, max_chunks = chunk_table_h.shape
+    dummy = n_rows - 1
+    lists = np.arange(n_lists)
+    shard_of = lists % world
+    real = chunk_table_h != dummy                    # (n_lists, max_chunks)
+    counts = real.sum(axis=1)                        # real chunks per list
+    n_local = np.array([int(counts[shard_of == s].sum())  # host-ok: build
+                        for s in range(world)], np.int64)
+    local_rows = int(n_local.max()) if world else 0
+    gather = np.full((world, local_rows + 1), dummy, np.int64)
+    local_tables = np.full((world, n_lists, max_chunks), local_rows,
+                           np.int32)                 # default: local dummy
+    for s in range(world):
+        ls = lists[shard_of == s]
+        rs, cs = np.nonzero(real[ls])                # (list-major, chunk asc)
+        glob = chunk_table_h[ls[rs], cs]
+        gather[s, :glob.size] = glob
+        local_tables[s, ls[rs], cs] = np.arange(glob.size, dtype=np.int32)
+    probe_extra = int(max(
+        (int((counts[shard_of == s] - 1).clip(min=0).sum())
+         for s in range(world)), default=0))
+    return gather, local_tables, probe_extra, local_rows
+
+
+def _stack_shards(comms: Comms, leaf, gather: np.ndarray):
+    """Gather one global physical block into the (world, local_rows+1, …)
+    stacked layout and lay it out shard-per-device on the mesh.
+
+    The gather runs HOST-side: a device gather would materialize the
+    whole padded stack on the default device (~2× the index) before
+    distribution, defeating the capacity win sharding exists for — the
+    host copy routes through ``device_put``-to-NamedSharding, which
+    transfers each shard straight to its own device."""
+    from jax.sharding import PartitionSpec as P
+
+    stacked = _host(leaf)[gather]
+    return comms.globalize(stacked, P(comms.axis_name))
+
+
+def _replicate(comms: Comms, leaf):
+    from jax.sharding import PartitionSpec as P
+
+    return comms.globalize(jnp.asarray(leaf), P())
+
+
+@traced("raft_tpu.neighbors.ann_mnmg.shard_ivf_flat")
+def shard_ivf_flat(index: ivf_flat.Index, comms) -> ShardedIndex:
+    """Partition an IVF-Flat index's lists round-robin across *comms*'
+    devices (``list l → shard l % world``); centroids replicate."""
+    comms = _full_axis_comms(comms)
+    world = comms.get_size()
+    table_h = _host(index.chunk_table)
+    gather, local_tables, probe_extra, _ = _partition(
+        table_h, index.list_data.shape[0], world)
+    stacked = (
+        _stack_shards(comms, index.list_data, gather),
+        _stack_shards(comms, index.list_indices, gather),
+        _stack_shards(comms, index.phys_sizes, gather),
+        _replicate_stacked_tables(comms, local_tables),
+    )
+    replicated = (_replicate(comms, index.centers),)
+    aux = {"world": world, "dim": index.dim, "metric": int(index.metric),
+           "n_lists": index.n_lists, "probe_extra": probe_extra}
+    return ShardedIndex("ivf_flat", comms, replicated, stacked, aux)
+
+
+def _replicate_stacked_tables(comms: Comms, tables_h: np.ndarray):
+    """Per-shard chunk tables are host-built (world, n_lists, max_chunks)
+    numpy — shard them along the world axis like the data blocks."""
+    from jax.sharding import PartitionSpec as P
+
+    return comms.globalize(jnp.asarray(tables_h), P(comms.axis_name))
+
+
+@traced("raft_tpu.neighbors.ann_mnmg.shard_ivf_pq")
+def shard_ivf_pq(index: ivf_pq.Index, comms) -> ShardedIndex:
+    """Partition an IVF-PQ index's lists round-robin across *comms*'
+    devices; the trained model (centers/rotation/codebooks) and the
+    list-side ADC table replicate — probe ids stay GLOBAL list ids, so the
+    hoisted per-batch LUT stage runs unchanged against the full tables
+    while the scan touches only local rows."""
+    comms = _full_axis_comms(comms)
+    world = comms.get_size()
+    table_h = _host(index.chunk_table)
+    gather, local_tables, probe_extra, _ = _partition(
+        table_h, index.list_codes.shape[0], world)
+    stacked = (
+        _stack_shards(comms, index.list_codes, gather),
+        _stack_shards(comms, index.list_indices, gather),
+        _stack_shards(comms, index.phys_sizes, gather),
+        _replicate_stacked_tables(comms, local_tables),
+        _stack_shards(comms, index.owner, gather),   # local row → GLOBAL list
+        _stack_shards(comms, index.list_csum, gather),
+    )
+    replicated = (_replicate(comms, index.centers),
+                  _replicate(comms, index.rotation),
+                  _replicate(comms, index.codebooks),
+                  _replicate(comms, index.list_adc))
+    aux = {"world": world, "dim": index.dim, "metric": int(index.metric),
+           "n_lists": index.n_lists, "probe_extra": probe_extra,
+           "pq_bits": int(index.pq_bits),
+           "codebook_kind": int(index.codebook_kind),
+           "dataset_dtype": index.dataset_dtype,
+           "pq_dim": int(index.pq_dim),
+           # per-shard transient-cap inputs (ivf_pq.hoisted_batch_cap_dims
+           # derives its scan budget as n_probes + (n_phys − n_lists), and
+           # the sharded program's true budget is n_probes + probe_extra —
+           # feeding the LOCAL block shape would undercount it and void
+           # the ~128 MiB bound)
+           "cap_n_phys": int(index.n_lists + probe_extra),
+           "cap_max_chunks": int(index.chunk_table.shape[1])}
+    return ShardedIndex("ivf_pq", comms, replicated, stacked, aux)
+
+
+@traced("raft_tpu.neighbors.ann_mnmg.shard_brute_force")
+def shard_brute_force(dataset, comms, metric=DistanceType.L2SqrtExpanded,
+                      metric_arg: float = 2.0,
+                      batch_size_index: int = 16384) -> ShardedIndex:
+    """Shard a dense (n, dim) matrix row-contiguously (the OPG split of
+    ``knn_mnmg``) for serving: global ids are ``rank·rows_per + local``.
+    Ragged row counts pad with huge-magnitude sentinel rows (L2 metrics
+    only): their distances rank WORST — as +inf, or as NaN for extreme
+    queries whose sentinel dot overflows, which the NaN-robust
+    select/merge also rank worst — so they surface only when k exceeds
+    the real row count."""
+    comms = _full_axis_comms(comms)
+    world = comms.get_size()
+    x = jnp.asarray(dataset)
+    expects(x.ndim == 2, "brute-force index must be (n, dim)")
+    n = x.shape[0]
+    metric = brute_force._resolve_metric(metric)
+    rows_per = -(-n // world)
+    if rows_per * world != n:
+        # Sentinel rows exist only for the L2 metrics: a huge-magnitude
+        # row's squared distance beats (loses to) every real row, so it
+        # can only surface when k exceeds the REAL row count.  No finite
+        # vector is guaranteed to lose under InnerProduct (dot grows WITH
+        # magnitude for aligned queries) or Cosine (scale-invariant — a
+        # sentinel's direction can genuinely rank), and integer dtypes
+        # overflow the filler — require an even split for all of those.
+        expects(metric in (DistanceType.L2Expanded,
+                           DistanceType.L2SqrtExpanded)
+                and jnp.issubdtype(x.dtype, jnp.floating),
+                f"n ({n}) not divisible by world ({world}): sentinel row "
+                f"padding is only sound for float L2 metrics, not "
+                f"{DistanceType(metric).name}/{x.dtype} — pad the dataset "
+                "to a multiple of world first")
+        pad_rows = rows_per * world - n
+        filler = jnp.full((pad_rows, x.shape[1]),
+                          jnp.asarray(1e30, jnp.float32).astype(x.dtype))
+        x = jnp.concatenate([x, filler], axis=0)
+    from jax.sharding import PartitionSpec as P
+
+    xs = comms.globalize(x.reshape(world, rows_per, x.shape[1]),
+                         P(comms.axis_name))
+    aux = {"world": world, "dim": int(x.shape[1]), "metric": int(metric),
+           "metric_arg": float(metric_arg), "rows_per": int(rows_per),
+           "n_rows": int(n),
+           "tile": int(min(batch_size_index, rows_per))}
+    return ShardedIndex("brute_force", comms, (), (xs,), aux)
+
+
+# ---------------------------------------------------------------------------
+# the one-allgather cross-shard merge
+
+
+def _merge_one_allgather(comms: Comms, d, i, k: int, select_min: bool):
+    """Merge per-shard (nq, k) top-k runs with EXACTLY ONE collective.
+
+    Distances and ids pack into one (nq, 2k) payload — int32 ids bitcast
+    into the f32 lane (or widened exactly into the f64 lane under x64) —
+    so the whole exchange is a single ``comms.allgather`` launch; the
+    (world, nq, k) parts then fold on device via ``merge_sorted_parts``
+    (earlier shards win ties, reproducing the single-device scan order).
+    ``Comms.collective_calls`` records the launch and its payload bytes;
+    tests and the bench assert both."""
+    i = i.astype(jnp.int32)
+    if d.dtype == jnp.float64:
+        ids_lane = i.astype(jnp.float64)      # exact for |id| < 2^53
+        parts = comms.allgather(jnp.concatenate([d, ids_lane], axis=1))
+        pd = parts[..., :k]
+        pi = parts[..., k:].astype(jnp.int32)
+    else:
+        d = d.astype(jnp.float32)
+        ids_lane = jax.lax.bitcast_convert_type(i, jnp.float32)
+        parts = comms.allgather(jnp.concatenate([d, ids_lane], axis=1))
+        pd = parts[..., :k]
+        pi = jax.lax.bitcast_convert_type(parts[..., k:], jnp.int32)
+    return merge_sorted_parts(pd, pi, k=k, select_min=select_min)
+
+
+# ---------------------------------------------------------------------------
+# per-kind shard programs (cached per (comms, statics))
+
+
+def _ivf_flat_program(comms: Comms, metric_val: int, k: int, n_probes: int,
+                      probe_extra: int):
+    sqrt = metric_val == int(DistanceType.L2SqrtExpanded)
+    is_ip = metric_val == int(DistanceType.InnerProduct)
+    # defer the L2Sqrt root PAST the merge: shards merge squared distances
+    # in shard order, reproducing the single-device scan's stable tie
+    # order; the root is applied once on the merged (nq, k)
+    scan_metric = (int(DistanceType.L2Expanded) if sqrt else metric_val)
+
+    def program(q, centers, data, idx, psz, ctab):
+        local = (centers, data[0], idx[0], psz[0], ctab[0])
+        d, i = ivf_flat._search_batch_impl(q, local, scan_metric, k,
+                                           n_probes, False, probe_extra)
+        d, i = _merge_one_allgather(comms, d, i, k, select_min=not is_ip)
+        if sqrt:
+            d = jnp.sqrt(jnp.maximum(d, 0))
+        return d, i
+
+    return program
+
+
+def _ivf_pq_program(comms: Comms, metric_val: int, k: int, n_probes: int,
+                    per_cluster: bool, lut_dtype: str, int_dtype: str,
+                    pq_bits: int, hoisted: bool, probe_extra: int):
+    sqrt = metric_val == int(DistanceType.L2SqrtExpanded)
+    is_ip = metric_val == int(DistanceType.InnerProduct)
+    scan_metric = (int(DistanceType.L2Expanded) if sqrt else metric_val)
+
+    def program(q, centers, rotation, codebooks, list_adc,
+                codes, idx, psz, ctab, owner, csum):
+        leaves = (centers, rotation, codebooks, codes[0], idx[0], psz[0],
+                  ctab[0], owner[0], list_adc, csum[0])
+        d, i = ivf_pq._full_search_impl(q, leaves, scan_metric, k, n_probes,
+                                        per_cluster, lut_dtype, int_dtype,
+                                        pq_bits, hoisted, probe_extra)
+        d, i = _merge_one_allgather(comms, d, i, k, select_min=not is_ip)
+        if sqrt:
+            d = jnp.sqrt(jnp.maximum(d, 0))
+        return d, i
+
+    return program
+
+
+def _brute_force_program(comms: Comms, metric_val: int, metric_arg: float,
+                         k: int, tile: int, rows_per: int):
+    metric = DistanceType(metric_val)
+    select_min = metric != DistanceType.InnerProduct
+    defer = metric == DistanceType.L2SqrtExpanded
+    scan_metric = DistanceType.L2Expanded if defer else metric
+
+    def program(q, xs):
+        # chunked: keeps knn()'s bounded (4096, tile) per-step transient
+        d, i = brute_force._knn_scan_chunked(xs[0], q, k, scan_metric,
+                                             metric_arg, tile, select_min)
+        rank = jax.lax.axis_index(comms.axis_name)
+        i = i + (rank * rows_per).astype(i.dtype)
+        d, i = _merge_one_allgather(comms, d, i, k, select_min)
+        if defer:
+            d = jnp.sqrt(d)   # knn's deferred-root epilogue, post-merge
+        return d, i
+
+    return program
+
+
+def _searcher_fn(sharded: ShardedIndex, key, builder) -> MeshAotFunction:
+    """One MeshAotFunction per (communicator, program statics): program
+    identity (and with it the jit/AOT caches) is stable across repeated
+    searcher constructions — the kmeans_mnmg._cached_program pattern."""
+    from jax.sharding import PartitionSpec as P
+
+    comms = sharded.comms
+
+    def build():
+        program = builder()
+        n_rep = len(sharded.replicated)
+        in_specs = ((P(),) + (P(),) * n_rep
+                    + (P(comms.axis_name),) * len(sharded.stacked))
+        mapped = shard_map_compat(program, comms.mesh, in_specs,
+                                  (P(), P()), check_vma=False)
+        return MeshAotFunction(mapped)
+
+    return _cached_program(comms, ("ann_mnmg",) + tuple(key), build)
+
+
+class ShardedSearcher:
+    """Warm-able zero-retrace dispatcher for one (sharded index, k, params)
+    serving key — the object ``serve.ServeEngine``'s sharded backend warms
+    and dispatches.  ``warm(bucket, dtype)`` pre-lowers the (bucket,
+    dtype, world) signature through the MeshAot cache;
+    ``dispatch(qb)`` runs one pre-bucketed query batch and returns
+    replicated (d, i)."""
+
+    def __init__(self, sharded: ShardedIndex, k: int, params=None):
+        expects(k >= 1, "k must be >= 1")
+        self.sharded = sharded
+        self.k = int(k)
+        aux = sharded.aux
+        if sharded.kind == "ivf_flat":
+            p = params or ivf_flat.SearchParams()
+            self.n_probes = int(min(p.n_probes, aux["n_lists"]))
+            key = ("ivf_flat", aux["metric"], self.k, self.n_probes,
+                   aux["probe_extra"])
+            builder = lambda: _ivf_flat_program(  # noqa: E731
+                sharded.comms, aux["metric"], self.k, self.n_probes,
+                aux["probe_extra"])
+        elif sharded.kind == "ivf_pq":
+            p = params or ivf_pq.SearchParams()
+            expects(p.lut_dtype in ivf_pq._LUT_DTYPES,
+                    f"lut_dtype must be one of {list(ivf_pq._LUT_DTYPES)}")
+            self.n_probes = int(min(p.n_probes, aux["n_lists"]))
+            hoisted = (ivf_pq.hoisted_lut_enabled() if p.hoisted_lut is None
+                       else bool(p.hoisted_lut))
+            per_cluster = (aux["codebook_kind"]
+                           == int(ivf_pq.CodebookKind.PER_CLUSTER))
+            statics = (aux["metric"], self.k, self.n_probes, per_cluster,
+                       p.lut_dtype, p.internal_distance_dtype,
+                       aux["pq_bits"], hoisted, aux["probe_extra"])
+            key = ("ivf_pq",) + statics
+            builder = lambda: _ivf_pq_program(  # noqa: E731
+                sharded.comms, *statics)
+            self.hoisted = hoisted
+            self.lut_dtype = p.lut_dtype
+        else:
+            expects(sharded.kind == "brute_force",
+                    f"unknown sharded kind {sharded.kind!r}")
+            expects(params is None, "brute_force sharded search takes no "
+                    "SearchParams (metric rides the ShardedIndex)")
+            expects(self.k <= aux["n_rows"],
+                    f"k={k} must be <= n_index={aux['n_rows']}")
+            key = ("brute_force", aux["metric"], aux["metric_arg"], self.k,
+                   aux["tile"], aux["rows_per"])
+            builder = lambda: _brute_force_program(  # noqa: E731
+                sharded.comms, aux["metric"], aux["metric_arg"], self.k,
+                aux["tile"], aux["rows_per"])
+        self.fn = _searcher_fn(sharded, key, builder)
+        self._tail = tuple(sharded.replicated) + tuple(sharded.stacked)
+
+    @property
+    def dim(self) -> int:
+        return self.sharded.dim
+
+    def _q_spec(self, bucket: int, dtype):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.ShapeDtypeStruct(
+            (int(bucket), self.dim), jnp.dtype(dtype),
+            sharding=NamedSharding(self.sharded.comms.mesh, P()))
+
+    def warm(self, bucket: int, dtype) -> None:
+        """Pre-lower+compile the (bucket, dtype, world) signature."""
+        self.fn.compiled(self._q_spec(bucket, dtype), *self._tail)
+
+    def dispatch(self, qb):
+        """Run one PRE-BUCKETED (bucket, dim) batch; returns replicated
+        (d (bucket, k), i (bucket, k))."""
+        from jax.sharding import PartitionSpec as P
+
+        q = self.sharded.comms.globalize(jnp.asarray(qb), P())
+        return self.fn(q, *self._tail)
+
+
+# ---------------------------------------------------------------------------
+# the public search entry point
+
+
+def _ingest(sharded: ShardedIndex, queries):
+    """Per-kind compute-form prologue — MUST match the single-device
+    search's own ingest so sharded results stay comparable bit-for-bit."""
+    if sharded.kind == "ivf_pq":
+        q, q_dtype = ivf_pq._ingest_dataset(queries)
+        expects(q_dtype in (sharded.aux["dataset_dtype"], "float32"),
+                f"query dtype {q_dtype} != index dataset dtype "
+                f"{sharded.aux['dataset_dtype']}")
+        return q
+    q = jnp.asarray(queries)
+    if sharded.kind == "ivf_flat":
+        q = q.astype(ivf_flat._compute_dtype(q))
+        if sharded.metric == DistanceType.CosineExpanded:
+            q = ivf_flat._normalize_rows(q)
+    return q
+
+
+@traced("raft_tpu.neighbors.ann_mnmg.search")
+def search(sharded: ShardedIndex, queries, k: int, params=None, *,
+           batch_size_query: int = 1024
+           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Search a :class:`ShardedIndex` across all of its devices.
+
+    One ``shard_map`` program per (bucketed) query batch: replicated
+    coarse ranking → per-shard fused probe scan → ONE packed allgather →
+    on-device part merge.  Returns replicated ``(distances (nq, k),
+    indices (nq, k))`` — top-k IDENTICAL (f32) to the single-device
+    search of the unsharded index (ties at exactly-equal distances may
+    resolve by shard order instead of scan order).
+    """
+    q = _ingest(sharded, queries)
+    expects(q.ndim == 2 and q.shape[1] == sharded.dim, "query dim mismatch")
+    if q.shape[0] == 0:
+        # distance dtype must match the solo path's empty result: the
+        # accumulation dtype of the ingested queries (f32 for ivf_pq,
+        # whose ingest already lands on f32)
+        from raft_tpu.distance.pairwise import accum_dtype
+
+        return empty_result(0, int(k), accum_dtype(q.dtype))
+    s = sharded.searcher(int(k), params)
+    bs = int(batch_size_query)
+    if sharded.kind == "ivf_pq" and getattr(s, "hoisted", False):
+        cap = ivf_pq.hoisted_batch_cap_dims(
+            sharded.metric, sharded.aux["codebook_kind"]
+            == int(ivf_pq.CodebookKind.PER_CLUSTER),
+            sharded.aux["cap_n_phys"], sharded.aux["cap_max_chunks"],
+            sharded.aux["n_lists"], sharded.aux["pq_dim"],
+            sharded.aux["pq_bits"], s.n_probes, s.lut_dtype, s.hoisted)
+        if cap is not None:
+            bs = min(bs, cap)
+    out_d, out_i = [], []
+    for q0 in range(0, q.shape[0], bs):
+        q1 = min(q0 + bs, q.shape[0])
+        qb = q[q0:q1]
+        n_valid = qb.shape[0]
+        bucket = min(_bucket_dim(n_valid), bs)
+        if bucket != n_valid:
+            qb = jnp.pad(qb, ((0, bucket - n_valid), (0, 0)))
+        d, i = s.dispatch(qb)
+        if n_valid != qb.shape[0]:
+            d, i = d[:n_valid], i[:n_valid]
+        out_d.append(d)
+        out_i.append(i)
+    d = out_d[0] if len(out_d) == 1 else jnp.concatenate(out_d, axis=0)
+    i = out_i[0] if len(out_i) == 1 else jnp.concatenate(out_i, axis=0)
+    return d, i

@@ -46,7 +46,8 @@ from raft_tpu.core.aot import _bucket_dim, aot, aot_dispatchable
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.distance.distance_types import DISTANCE_TYPES, DistanceType
-from raft_tpu.matrix.select_k import merge_sorted_runs, select_k
+from raft_tpu.matrix.select_k import (merge_sorted_parts, merge_sorted_runs,
+                                      select_k)
 
 _INT32_MAX = 2**31 - 1
 
@@ -128,6 +129,28 @@ def _knn_scan_impl(index, queries, k: int, metric: DistanceType,
     if defer_sqrt:
         best_d = jnp.sqrt(best_d)
     return best_d, best_i
+
+
+def _knn_scan_chunked(index, queries, k: int, metric: DistanceType,
+                      metric_arg: float, tile: int, select_min: bool,
+                      batch_size_query: int = 4096):
+    """Traced-context query chunking around :func:`_knn_scan_impl`.
+
+    ``knn()`` bounds the per-scan-step (bq, tile) distance transient with
+    its eager query loop; shard_map programs (knn_mnmg, ann_mnmg) call
+    the scan impl directly inside a trace and would otherwise materialize
+    a (nq, tile) tile per step — 4 GB at nq=64k, tile=16k.  This restores
+    the same bound inside the trace (nq is static there, so the chunk
+    loop unrolls into independent scan segments)."""
+    nq = queries.shape[0]
+    if nq <= batch_size_query:
+        return _knn_scan_impl(index, queries, k, metric, metric_arg, tile,
+                              select_min)
+    outs = [_knn_scan_impl(index, queries[q0:min(q0 + batch_size_query, nq)],
+                           k, metric, metric_arg, tile, select_min)
+            for q0 in range(0, nq, batch_size_query)]
+    return (jnp.concatenate([d for d, _ in outs], axis=0),
+            jnp.concatenate([i for _, i in outs], axis=0))
 
 
 # Eager calls dispatch the AOT executable cache (the precompiled
@@ -262,26 +285,7 @@ def knn_merge_parts(part_distances, part_indices, k: Optional[int] = None,
                 "need one translation per part")
         t = jnp.asarray(translations, i.dtype).reshape(n_parts, 1, 1)
         i = i + t
-    # Seed the fold from part 0 (not a sentinel carry): a sentinel init
-    # would tie-beat REAL candidates sitting at the sentinel value (±inf
-    # distances are legal in parts — masked/padded select_k outputs) and
-    # replace their ids with -1.  Only when k > in_k does part 0 need
-    # sentinel padding, where that residual tie edge is documented above.
-    if in_k >= k:
-        init = (d[0, :, :k], i[0, :, :k])
-    else:
-        sentinel = jnp.asarray(jnp.inf if select_min else -jnp.inf, d.dtype)
-        init = (jnp.concatenate(
-                    [d[0], jnp.full((nq, k - in_k), sentinel, d.dtype)], 1),
-                jnp.concatenate(
-                    [i[0], jnp.full((nq, k - in_k), -1, i.dtype)], 1))
-    if n_parts == 1:
-        return init
-
-    def step(carry, part):
-        pd, pi = part
-        return merge_sorted_runs(carry[0], carry[1], pd, pi, k=k,
-                                 select_min=select_min), None
-
-    (md, mi), _ = jax.lax.scan(step, init, (d[1:], i[1:]))
-    return md, mi
+    # The fold itself (part-0 seed, earlier-part-wins ties) is the shared
+    # matrix.select_k.merge_sorted_parts primitive — ONE implementation
+    # under this surface and the sharded-ANN cross-shard merge.
+    return merge_sorted_parts(d, i, k=k, select_min=select_min)

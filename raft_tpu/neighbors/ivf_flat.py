@@ -126,6 +126,16 @@ class Index:
         total = self.list_data.shape[0] * self.capacity
         return 1.0 - self.size / max(total, 1)
 
+    def shard(self, comms):
+        """Partition this index's lists round-robin across *comms*' devices
+        for multi-device search — returns a
+        :class:`raft_tpu.neighbors.ann_mnmg.ShardedIndex` whose
+        ``search``/serving run as ONE shard_map program per batch
+        (docs/sharded_ann.md)."""
+        from raft_tpu.neighbors import ann_mnmg
+
+        return ann_mnmg.shard_ivf_flat(self, comms)
+
     def tree_flatten(self):
         leaves = (self.centers, self.list_data, self.list_indices,
                   self.list_sizes, self.phys_sizes, self.chunk_table)
@@ -249,9 +259,14 @@ def _owner_of(chunk_table, n_phys_rows: int):
 
 
 def _search_batch_impl(queries, index_leaves, metric_val: int, k: int,
-                       n_probes: int, sqrt: bool):
+                       n_probes: int, sqrt: bool, probe_extra: int = -1):
     """ONE program for a query batch: coarse ranking → top-n_probes →
     probe-list scan → top-k (reference ivf_flat_search.cuh:1057 pipeline).
+
+    ``probe_extra`` (static): continuation-chunk budget override for
+    ``expand_probes`` (−1 derives it from the table shape).  Shard-local
+    index blocks (``ann_mnmg``) must pass their true per-shard worst case
+    — the local table shape undercounts it (see expand_probes).
 
     One `lax.scan` step per (probe rank, chunk): logical probes expand
     through the chunk table into physical rows, each step gathers one
@@ -304,8 +319,8 @@ def _search_batch_impl(queries, index_leaves, metric_val: int, k: int,
         xn = jnp.sum(data.astype(acc_t) ** 2, axis=-1)
         return q_sq + xn - 2.0 * dots
 
-    phys_probes = expand_probes(probe_ids, chunk_table,
-                                list_data.shape[0])
+    phys_probes = expand_probes(probe_ids, chunk_table, list_data.shape[0],
+                                extra=None if probe_extra < 0 else probe_extra)
     best_d, best_i = scan_probe_lists(phys_probes, score_tile, list_indices,
                                       phys_sizes, k, select_min=not is_ip,
                                       dtype=acc_t)
@@ -318,7 +333,7 @@ def _search_batch_impl(queries, index_leaves, metric_val: int, k: int,
 # ivf-flat kernel instantiations, SURVEY.md §2.14); jit kept for traced
 # callers and inputs off the default device — the ivf_pq._search_batch
 # pattern, now covering the WHOLE batch program (coarse + select + scan).
-_SEARCH_STATICS = (2, 3, 4, 5)
+_SEARCH_STATICS = (2, 3, 4, 5, 6)
 _search_batch = functools.partial(jax.jit, static_argnums=_SEARCH_STATICS)(
     _search_batch_impl)
 _search_batch_aot = aot(_search_batch_impl, static_argnums=_SEARCH_STATICS)
@@ -361,7 +376,7 @@ def search(params: SearchParams, index: Index, queries, k: int,
         batch_fn = (_search_batch_aot if aot_dispatchable(qb, leaves)
                     else _search_batch)
         d, i = batch_fn(qb, leaves, int(index.metric), int(k),
-                        int(n_probes), sqrt)
+                        int(n_probes), sqrt, -1)
         if n_valid != qb.shape[0]:
             d, i = d[:n_valid], i[:n_valid]
         out_d.append(d)

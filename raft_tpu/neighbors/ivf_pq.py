@@ -237,6 +237,16 @@ class Index:
     def size(self) -> int:
         return int(jnp.sum(self.list_sizes))
 
+    def shard(self, comms):
+        """Partition this index's lists round-robin across *comms*' devices
+        for multi-device search — returns a
+        :class:`raft_tpu.neighbors.ann_mnmg.ShardedIndex` whose
+        ``search``/serving run as ONE shard_map program per batch
+        (docs/sharded_ann.md)."""
+        from raft_tpu.neighbors import ann_mnmg
+
+        return ann_mnmg.shard_ivf_pq(self, comms)
+
     def tree_flatten(self):
         leaves = (self.centers, self.rotation, self.codebooks,
                   self.list_codes, self.list_indices, self.list_sizes,
@@ -685,7 +695,8 @@ def _scan_hoisted(q, probe_ids, rot_q, rot_centers, centers, codebooks,
                   list_adc, list_csum, list_codes, list_indices, phys_sizes,
                   chunk_table, nq: int, pq_dim: int, kcb: int, ds: int,
                   k: int, is_ip: bool, per_cluster: bool,
-                  lut_dtype_name: str, acc_dtype, pq_bits: int):
+                  lut_dtype_name: str, acc_dtype, pq_bits: int,
+                  probe_extra: int = -1):
     """Hoisted-ADC probe scan: per-batch LUT stage + lookup-only scan body.
 
     Stage 2 of the pipeline (stage 1 is the build-time ``list_adc`` /
@@ -752,7 +763,8 @@ def _scan_hoisted(q, probe_ids, rot_q, rot_centers, centers, codebooks,
     lut_q = lut_q.reshape(nq, lut_q.shape[1], pq_dim * kcb)
 
     phys_probes, probe_ord = expand_probes(
-        probe_ids, chunk_table, list_codes.shape[0], return_ord=True)
+        probe_ids, chunk_table, list_codes.shape[0], return_ord=True,
+        extra=None if probe_extra < 0 else probe_extra)
     # per-scan-step xs: gather each physical slot's (probe ordinal) slice
     # of the per-batch tables — (budget, nq, …) with the scan axis leading
     base_xs = jnp.swapaxes(
@@ -836,7 +848,8 @@ def _quantize_lut(lut, base, lut_dtype_name: str):
 
 def _search_batch_impl(q, probe_ids, leaves, metric_val: int, k: int,
                        per_cluster: bool, lut_dtype_name: str,
-                       int_dtype_name: str, pq_bits: int, hoisted: bool):
+                       int_dtype_name: str, pq_bits: int, hoisted: bool,
+                       probe_extra: int = -1):
     """Score probed lists via per-query LUTs (reference similarity kernels
     ivf_pq_search.cuh:594-738) with a running top-k merge.
 
@@ -869,7 +882,7 @@ def _search_batch_impl(q, probe_ids, leaves, metric_val: int, k: int,
             list_adc, list_csum, list_codes, list_indices, phys_sizes,
             chunk_table,
             nq, pq_dim, kcb, ds, k, is_ip, per_cluster, lut_dtype_name,
-            acc_dtype, pq_bits)
+            acc_dtype, pq_bits, probe_extra)
         if metric_val == int(DistanceType.L2SqrtExpanded):
             best_d = jnp.sqrt(jnp.maximum(best_d, 0))
         return best_d, best_i
@@ -954,8 +967,8 @@ def _search_batch_impl(q, probe_ids, leaves, metric_val: int, k: int,
         # fp8: invert the per-query affine quantization (scale is 1 else)
         return (acc.astype(jnp.float32) / scale[:, None]) + base[:, None]
 
-    phys_probes = expand_probes(probe_ids, chunk_table,
-                                list_codes.shape[0])
+    phys_probes = expand_probes(probe_ids, chunk_table, list_codes.shape[0],
+                                extra=None if probe_extra < 0 else probe_extra)
     best_d, best_i = scan_probe_lists(phys_probes, score_tile, list_indices,
                                       phys_sizes, k, select_min=not is_ip,
                                       dtype=jnp.float32)
@@ -969,7 +982,7 @@ def _search_batch_impl(q, probe_ids, leaves, metric_val: int, k: int,
 # traced callers.  ``hoisted`` is a STATIC arg, so the two pipeline shapes
 # compile (and AOT-cache) as distinct executables — flipping
 # RAFT_TPU_HOISTED_LUT mid-process can never hit the other path's program.
-_SEARCH_STATICS = (3, 4, 5, 6, 7, 8, 9)
+_SEARCH_STATICS = (3, 4, 5, 6, 7, 8, 9, 10)
 _search_batch = functools.partial(jax.jit, static_argnums=_SEARCH_STATICS)(
     _search_batch_impl)
 _search_batch_aot = aot(_search_batch_impl, static_argnums=_SEARCH_STATICS)
@@ -977,7 +990,8 @@ _search_batch_aot = aot(_search_batch_impl, static_argnums=_SEARCH_STATICS)
 
 def _full_search_impl(queries, leaves, metric_val: int, k: int,
                       n_probes: int, per_cluster: bool, lut_dtype_name: str,
-                      int_dtype_name: str, pq_bits: int, hoisted: bool):
+                      int_dtype_name: str, pq_bits: int, hoisted: bool,
+                      probe_extra: int = -1):
     """Coarse ranking + top-n_probes + probe scoring as ONE program — the
     serving entry point (``serve.ServeEngine``): the whole query-batch →
     (d, i) computation is one AOT-cacheable executable whose signatures can
@@ -993,14 +1007,35 @@ def _full_search_impl(queries, leaves, metric_val: int, k: int,
     _, probes = select_k(coarse, n_probes, select_min=True)
     return _search_batch_impl(queries, probes.astype(jnp.int32), leaves,
                               metric_val, k, per_cluster, lut_dtype_name,
-                              int_dtype_name, pq_bits, hoisted)
+                              int_dtype_name, pq_bits, hoisted, probe_extra)
 
 
-_FULL_SEARCH_STATICS = (2, 3, 4, 5, 6, 7, 8, 9)
+_FULL_SEARCH_STATICS = (2, 3, 4, 5, 6, 7, 8, 9, 10)
 _full_search = functools.partial(
     jax.jit, static_argnums=_FULL_SEARCH_STATICS)(_full_search_impl)
 _full_search_aot = aot(_full_search_impl,
                        static_argnums=_FULL_SEARCH_STATICS)
+
+
+def hoisted_batch_cap_dims(metric, per_cluster: bool, n_phys: int,
+                           max_chunks: int, n_lists: int, pq_dim: int,
+                           pq_bits: int, n_probes: int, lut_dtype: str,
+                           hoisted: bool) -> Optional[int]:
+    """Dims-form core of :func:`hoisted_batch_cap` — callers without an
+    ``Index`` in hand (the sharded layer sizes by its PER-SHARD physical
+    block, ``neighbors.ann_mnmg``) pass the layout numbers directly; the
+    formula itself stays in ONE place."""
+    is_ip = DistanceType(metric) == DistanceType.InnerProduct
+    if not (hoisted and (per_cluster or (not is_ip
+                                         and lut_dtype != "float32"))):
+        return None
+    budget = min(n_probes * max_chunks,
+                 n_probes + max(0, n_phys - n_lists))
+    cell = pq_dim * (1 << pq_bits)
+    lut_bytes = jnp.dtype(_LUT_DTYPES[lut_dtype]).itemsize
+    per_q = cell * (3 * n_probes * 4 + budget * lut_bytes)
+    # power of two keeps the shape-bucketed executable set small
+    return 1 << max(5, ((128 << 20) // max(per_q, 1)).bit_length() - 1)
 
 
 def hoisted_batch_cap(index: Index, n_probes: int, lut_dtype: str,
@@ -1013,22 +1048,16 @@ def hoisted_batch_cap(index: Index, n_probes: int, lut_dtype: str,
     with an n_probes probe axis (the list_adc gather, the combined LUT,
     the shifted/quantizing copy) plus the xs gather whose probe axis is
     the EXPANDED physical budget (> n_probes when lists span multiple
-    chunks) in the quantized dtype.  ONE formula shared by
-    :func:`search`'s query batching and the serving engine's super-batch
-    clamp (serve.engine._IvfPqBackend) — a tuning here reaches both."""
-    is_ip = index.metric == DistanceType.InnerProduct
-    per_cluster = index.codebook_kind == CodebookKind.PER_CLUSTER
-    if not (hoisted and (per_cluster or (not is_ip
-                                         and lut_dtype != "float32"))):
-        return None
-    n_phys = index.list_codes.shape[0] - 1
-    budget = min(n_probes * index.chunk_table.shape[1],
-                 n_probes + max(0, n_phys - index.n_lists))
-    cell = index.pq_dim * (1 << index.pq_bits)
-    lut_bytes = jnp.dtype(_LUT_DTYPES[lut_dtype]).itemsize
-    per_q = cell * (3 * n_probes * 4 + budget * lut_bytes)
-    # power of two keeps the shape-bucketed executable set small
-    return 1 << max(5, ((128 << 20) // max(per_q, 1)).bit_length() - 1)
+    chunks) in the quantized dtype.  ONE formula
+    (:func:`hoisted_batch_cap_dims`) shared by :func:`search`'s query
+    batching, the serving engine's super-batch clamp
+    (serve.engine._IvfPqBackend) and the sharded layer — a tuning there
+    reaches all three."""
+    return hoisted_batch_cap_dims(
+        index.metric, index.codebook_kind == CodebookKind.PER_CLUSTER,
+        index.list_codes.shape[0] - 1, index.chunk_table.shape[1],
+        index.n_lists, index.pq_dim, index.pq_bits, n_probes, lut_dtype,
+        hoisted)
 
 
 @traced("raft_tpu.neighbors.ivf_pq.search")
@@ -1110,7 +1139,7 @@ def search(params: SearchParams, index: Index, queries, k: int,
                         index.codebook_kind == CodebookKind.PER_CLUSTER,
                         params.lut_dtype,
                         params.internal_distance_dtype,
-                        index.pq_bits, hoisted)
+                        index.pq_bits, hoisted, -1)
         if n_valid != qb.shape[0]:
             d, i = d[:n_valid], i[:n_valid]
         if pool:

@@ -6,6 +6,23 @@ index rows, queries are replicated, every rank computes a local top-k,
 and per-rank candidate sets are allgathered and merged with
 ``knn_merge_parts`` (reference neighbors/brute_force.cuh:76,144).  One
 shard_map program: local scan + allgather over ICI + on-device merge.
+
+Two collective topologies (docs/sharded_ann.md):
+
+* ``partition="index"`` (default) — rows sharded, queries replicated.
+  Distances and ids pack into ONE allgather payload (ann_mnmg's merge:
+  int32 ids bitcast into the f32 lane) and merge on device with the
+  L2Sqrt root DEFERRED past the merge — half the collective launches of
+  the r1 two-allgather program and bit-identical top-k to single-device
+  ``knn`` (the merge in shard order reproduces the sequential scan's
+  stable tie order on squared distances).
+* ``partition="queries"`` — the large-batch mode: queries shard, the
+  index replicates, and each rank searches only its query slice.  Results
+  are DISJOINT per rank, so the gather is the output sharding itself —
+  ZERO collective launches inside the program (counter-assertable).  The
+  right topology when nq dominates: same FLOPs, no (world, nq, k)
+  exchange, at the cost of a replicated index (must fit one device).
+  ``partition="auto"`` picks it when nq >= the index row count.
 """
 
 from __future__ import annotations
@@ -18,31 +35,62 @@ from raft_tpu.core.logger import traced
 from raft_tpu.comms.comms import as_comms
 from raft_tpu.cluster.kmeans_mnmg import _cached_program
 from raft_tpu.distance.distance_types import DistanceType
-from raft_tpu.neighbors.brute_force import knn, knn_merge_parts
+from raft_tpu.neighbors.brute_force import _knn_scan_chunked, _resolve_metric
 
 
-def _search_program(comms, k: int, metric, metric_arg: float, rows_per: int):
+def _search_program(comms, k: int, metric, metric_arg: float, rows_per: int,
+                    tile: int):
     """Per-shard search body, cached per (comms, statics) so repeated
     searches reuse comms.run's identity-keyed jit cache instead of
     retracing per call (see kmeans_mnmg._fit_program's measurement)."""
+    from raft_tpu.neighbors.ann_mnmg import _merge_one_allgather
+
+    select_min = metric != DistanceType.InnerProduct
+    defer = metric == DistanceType.L2SqrtExpanded
+    scan_metric = DistanceType.L2Expanded if defer else metric
 
     def local(xs, qs):
-        d, i = knn(xs, qs, k, metric, metric_arg)
+        # chunked: keeps knn()'s bounded (4096, tile) per-step transient
+        # inside the trace
+        d, i = _knn_scan_chunked(xs, qs, k, scan_metric, metric_arg, tile,
+                                 select_min)
         rank = jax.lax.axis_index(comms.axis_name)
         i = i + (rank * rows_per).astype(i.dtype)   # local → global ids
-        dd = comms.allgather(d)                     # (world, nq, k)
-        ii = comms.allgather(i)
-        return knn_merge_parts(dd, ii, k, metric=metric)
+        d, i = _merge_one_allgather(comms, d, i, k, select_min)
+        if defer:
+            d = jnp.sqrt(d)  # knn's deferred-root epilogue, post-merge
+        return d, i
 
-    return _cached_program(comms, ("knn", k, metric, metric_arg, rows_per),
+    return _cached_program(comms, ("knn", k, metric, metric_arg, rows_per,
+                                   tile), lambda: local)
+
+
+def _query_sharded_program(comms, k: int, metric, metric_arg: float,
+                           tile: int):
+    """Query-sharded body: each rank runs the UNMODIFIED single-device
+    scan (internal deferred root and all) on its query slice against the
+    full index — no rank arithmetic, no collective."""
+    select_min = metric != DistanceType.InnerProduct
+
+    def local(xs, qs):
+        return _knn_scan_chunked(xs, qs, k, metric, metric_arg, tile,
+                                 select_min)
+
+    return _cached_program(comms, ("knn_qs", k, metric, metric_arg, tile),
                            lambda: local)
 
 
 @traced("raft_tpu.neighbors.knn_mnmg")
 def knn_mnmg(comms, index, queries, k: int,
-             metric=DistanceType.L2SqrtExpanded, metric_arg: float = 2.0):
-    """Exact kNN of *queries* among the rows of *index*, index sharded
-    row-wise over the communicator's mesh (queries replicated).
+             metric=DistanceType.L2SqrtExpanded, metric_arg: float = 2.0,
+             partition: str = "index"):
+    """Exact kNN of *queries* among the rows of *index* across the
+    communicator's mesh.
+
+    *partition* selects the sharding topology: ``"index"`` (rows sharded,
+    queries replicated — the OPG default, one allgather), ``"queries"``
+    (queries sharded, index replicated — zero collectives, for
+    nq-dominated batches), or ``"auto"`` (queries when nq >= n).
 
     *comms* may be a Comms or a Handle carrying one.  Returns
     (distances [nq, k], global indices [nq, k]) — identical (up to ties)
@@ -56,13 +104,46 @@ def knn_mnmg(comms, index, queries, k: int,
     # below would silently corrupt: require the full-axis communicator.
     expects(getattr(comms, "groups", None) is None,
             "knn_mnmg needs a full (non-split) communicator")
+    metric = _resolve_metric(metric)
     x = jnp.asarray(index)
     q = jnp.asarray(queries)
+    # the shard programs call the scan impl directly, so the validation
+    # knn() used to provide must happen here (clean errors at the caller,
+    # not shape failures deep inside shard_map)
+    expects(x.ndim == 2 and q.ndim == 2, "inputs must be 2-d")
+    expects(x.shape[1] == q.shape[1], "feature dim mismatch")
     nranks = comms.get_size()
     n = x.shape[0]
+    nq = q.shape[0]
+    expects(partition in ("index", "queries", "auto"),
+            f"unknown partition {partition!r}")
+    if partition == "auto":
+        # nq-dominated batches: the (world, nq, k) exchange outgrows the
+        # per-shard capacity win — split the queries instead
+        partition = "queries" if nq >= n else "index"
+
+    if partition == "queries":
+        expects(1 <= k <= n, f"k={k} must be in [1, n_index={n}]")
+        # pad the query axis so every rank gets an equal bucketed slice
+        # (one executable per per-rank bucket, not per nq residue)
+        from raft_tpu.core.aot import _bucket_dim
+
+        per = _bucket_dim(-(-nq // nranks))
+        n_pad = per * nranks
+        qp = jnp.pad(q, ((0, n_pad - nq), (0, 0))) if n_pad != nq else q
+        local = _query_sharded_program(comms, int(k), metric,
+                                       float(metric_arg),
+                                       int(min(16384, n)))
+        d, i = comms.run(local, x, qp,
+                         in_specs=(P(None, None), P(comms.axis_name, None)),
+                         out_specs=(P(comms.axis_name, None),
+                                    P(comms.axis_name, None)))
+        return d[:nq], i[:nq]
+
     expects(n % nranks == 0,
             f"n ({n}) must be divisible by the number of ranks ({nranks}) — "
-            "pad the index shard (OPG assumes equal parts)")
+            "pad the index shard (OPG assumes equal parts), or use "
+            "ann_mnmg.shard_brute_force which pads with sentinel rows")
     rows_per = n // nranks
     expects(k <= rows_per,
             "k must not exceed rows per shard (each rank contributes k "
@@ -78,7 +159,7 @@ def knn_mnmg(comms, index, queries, k: int,
             "global_id_offset (int64 ids under jax_enable_x64)")
 
     local = _search_program(comms, int(k), metric, float(metric_arg),
-                            rows_per)
+                            rows_per, int(min(16384, rows_per)))
     x_sharded = comms.globalize(x, P(comms.axis_name, None))
     return comms.run(local, x_sharded, q,
                      in_specs=(P(comms.axis_name, None), P(None, None)),

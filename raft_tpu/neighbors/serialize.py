@@ -31,11 +31,11 @@ _MAGIC = "raft-tpu-index"
 # list-side ADC tables ``list_adc``/``list_csum``; v1 archives still load —
 # the tables are recomputed from centers/rotation/codebooks + stored codes,
 # which is exact (pure functions of the trained model).
-_VERSIONS = {"ivf_flat": 1, "ivf_pq": 2}
+_VERSIONS = {"ivf_flat": 1, "ivf_pq": 2, "sharded": 1}
 # Readable versions are per kind too: accepting another kind's version at
 # the gate would defer the failure to an obscure Index(**arrays) TypeError
 # instead of the clean unsupported-version error this check exists to give.
-_READABLE_VERSIONS = {"ivf_flat": (1,), "ivf_pq": (1, 2)}
+_READABLE_VERSIONS = {"ivf_flat": (1,), "ivf_pq": (1, 2), "sharded": (1,)}
 
 
 def _pack(kind: str, index, aux: dict) -> dict:
@@ -96,6 +96,59 @@ def save_ivf_pq(path, index: ivf_pq.Index) -> None:
            "pq_bits": int(index.pq_bits),
            "dataset_dtype": index.dataset_dtype}
     np.savez(_normalize(path), **_pack("ivf_pq", index, aux))
+
+
+def save_sharded(path, sharded) -> None:
+    """Write an :class:`raft_tpu.neighbors.ann_mnmg.ShardedIndex` to
+    *path* (``.npz``): the replicated tables, the per-shard stacked
+    blocks, and the static aux (incl. world) — so a serving fleet shards
+    once and every process loads the finished partition.
+
+    Requires the stacked leaves to be host-fetchable (single-process mesh
+    or fully-replicated layout); a multi-process OPG fleet saves from the
+    process that built the partition before distribution."""
+    for leaf in tuple(sharded.replicated) + tuple(sharded.stacked):
+        expects(getattr(leaf, "is_fully_addressable", True)
+                or getattr(leaf, "is_fully_replicated", False),
+                "save_sharded: leaves span non-addressable devices — save "
+                "from the building process before distribution")
+    aux = {"kind": sharded.kind, "aux": dict(sharded.aux)}
+    arrays = {f"rep{j}": np.asarray(leaf)
+              for j, leaf in enumerate(sharded.replicated)}
+    arrays.update({f"st{j}": np.asarray(leaf)
+                   for j, leaf in enumerate(sharded.stacked)})
+    header = {"magic": _MAGIC, "version": _VERSIONS["sharded"],
+              "kind": "sharded", "aux": aux}
+    arrays["__header__"] = np.frombuffer(
+        json.dumps(header).encode(), dtype=np.uint8)
+    np.savez(_normalize(path), **arrays)
+
+
+def load_sharded(path, comms):
+    """Load a sharded index back onto *comms*' mesh: stacked blocks land
+    shard-per-device (``P(axis)``), replicated tables replicate.  The
+    archive's world must match the communicator's size — a partition is
+    laid out for one world; re-shard from the base index to change it."""
+    from jax.sharding import PartitionSpec as P
+
+    from raft_tpu.comms.comms import as_comms
+    from raft_tpu.neighbors import ann_mnmg
+
+    comms = as_comms(comms)
+    aux, a = _unpack(path, "sharded")
+    world = int(aux["aux"]["world"])
+    expects(world == comms.get_size(),
+            f"archive was sharded for world={world}, communicator has "
+            f"{comms.get_size()} — re-shard the base index instead")
+    n_rep = sum(1 for k in a if k.startswith("rep"))
+    n_st = sum(1 for k in a if k.startswith("st"))
+    replicated = tuple(comms.globalize(jnp.asarray(a[f"rep{j}"]), P())
+                       for j in range(n_rep))
+    stacked = tuple(
+        comms.globalize(jnp.asarray(a[f"st{j}"]), P(comms.axis_name))
+        for j in range(n_st))
+    return ann_mnmg.ShardedIndex(aux["kind"], comms, replicated, stacked,
+                                 dict(aux["aux"]))
 
 
 def load_ivf_pq(path) -> ivf_pq.Index:

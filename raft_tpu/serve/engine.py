@@ -50,7 +50,7 @@ from raft_tpu.core.aot import _bucket_dim
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import Handle
 from raft_tpu.distance.distance_types import DistanceType
-from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
+from raft_tpu.neighbors import ann_mnmg, brute_force, ivf_flat, ivf_pq
 
 
 class _BruteForceBackend:
@@ -134,7 +134,7 @@ class _IvfFlatBackend:
 
     def _args(self, qb):
         return (qb, self.leaves, int(self.index.metric), self.k,
-                self.n_probes, self.sqrt)
+                self.n_probes, self.sqrt, -1)
 
     def warm(self, bucket: int, dtype) -> None:
         self.fn.compiled(*self._args(
@@ -207,7 +207,7 @@ class _IvfPqBackend:
                 self.n_probes,
                 self.index.codebook_kind == ivf_pq.CodebookKind.PER_CLUSTER,
                 self.params.lut_dtype, self.params.internal_distance_dtype,
-                self.index.pq_bits, self.hoisted)
+                self.index.pq_bits, self.hoisted, -1)
 
     def warm(self, bucket: int, dtype) -> None:
         self.fn.compiled(*self._args(
@@ -220,7 +220,90 @@ class _IvfPqBackend:
         return ivf_pq.search(self.params, self.index, q, self.k)
 
 
+class _ShardedBackend:
+    """Adapter: ``ann_mnmg.ShardedIndex`` → one MeshAot shard_map
+    executable whose super-batches dispatch across EVERY device of the
+    index's communicator (coarse replicated, probe scan per shard, ONE
+    allgather + on-device merge — docs/sharded_ann.md).  Warmup pre-lowers
+    each (bucket, dtype, world) signature through the MeshAot cache, so
+    the zero-compile steady state holds for sharded serving too."""
+
+    def __init__(self, sharded, k: int, params):
+        expects(k >= 1, "k must be >= 1")
+        self.sharded = sharded
+        # brute-force sharded indexes carry their metric themselves —
+        # reject params loudly (ShardedSearcher's contract) instead of
+        # silently serving with them ignored
+        expects(sharded.kind != "brute_force" or params is None,
+                "sharded brute-force serving takes no SearchParams "
+                "(metric/metric_arg ride the ShardedIndex)")
+        self.params = params
+        self.name = f"sharded_{sharded.kind}"
+        self.searcher = sharded.searcher(int(k), self.params)
+        self.k = int(k)
+        self.dim = int(sharded.dim)
+
+    def ingest(self, q):
+        """Per-request compute-form conversion mirroring
+        ``ann_mnmg._ingest`` (itself mirroring each kind's solo prologue):
+        exact host-side widenings stay numpy; only cosine's inexact row
+        normalize round-trips the device (the _IvfFlatBackend contract)."""
+        q = np.asarray(q)
+        expects(q.ndim == 2 and q.shape[1] == self.dim,
+                "query must be (n, dim) with the index's dim")
+        kind = self.sharded.kind
+        if kind == "brute_force":
+            return q
+        if kind == "ivf_pq":
+            # dataset-dtype consistency BEFORE the widening (the
+            # _IvfPqBackend/ann_mnmg._ingest contract — widening first
+            # would silently admit traffic the solo fallback rejects)
+            if q.dtype in (np.int8, np.uint8):
+                q_dtype = str(q.dtype)
+            else:
+                expects(jnp.issubdtype(q.dtype, jnp.floating),
+                        f"ivf_pq: unsupported query dtype {q.dtype}")
+                q_dtype = "float32"
+            expects(q_dtype in (self.sharded.aux["dataset_dtype"],
+                                "float32"),
+                    f"query dtype {q_dtype} != index dataset dtype "
+                    f"{self.sharded.aux['dataset_dtype']}")
+            return q.astype(np.float32)
+        if q.dtype in (np.int8, np.uint8):
+            q = q.astype(np.float32)  # exact widening: matches device cast
+        if self.sharded.metric == DistanceType.CosineExpanded:
+            return np.asarray(ivf_flat._normalize_rows(jnp.asarray(q)))
+        return q
+
+    def batch_cap(self) -> Optional[int]:
+        """Per-SHARD transient bound: the hoisted compressed-LUT configs
+        materialize their combined tables on every shard, so the clamp
+        sizes by the shard-local physical block (the ONE formula,
+        ``ivf_pq.hoisted_batch_cap_dims``)."""
+        if self.sharded.kind != "ivf_pq" or not getattr(
+                self.searcher, "hoisted", False):
+            return None
+        aux = self.sharded.aux
+        return ivf_pq.hoisted_batch_cap_dims(
+            self.sharded.metric,
+            aux["codebook_kind"] == int(ivf_pq.CodebookKind.PER_CLUSTER),
+            aux["cap_n_phys"], aux["cap_max_chunks"], aux["n_lists"],
+            aux["pq_dim"], aux["pq_bits"], self.searcher.n_probes,
+            self.searcher.lut_dtype, self.searcher.hoisted)
+
+    def warm(self, bucket: int, dtype) -> None:
+        self.searcher.warm(bucket, dtype)
+
+    def dispatch(self, qb):
+        return self.searcher.dispatch(qb)
+
+    def solo(self, q):
+        return ann_mnmg.search(self.sharded, q, self.k, self.params)
+
+
 def _make_backend(index, k, params, metric, metric_arg, batch_size_index):
+    if isinstance(index, ann_mnmg.ShardedIndex):
+        return _ShardedBackend(index, k, params)
     if isinstance(index, ivf_flat.Index):
         return _IvfFlatBackend(index, k, params)
     if isinstance(index, ivf_pq.Index):
@@ -243,7 +326,12 @@ class ServeEngine:
     * :class:`raft_tpu.neighbors.ivf_flat.Index` → IVF-Flat
       (*params* is an ``ivf_flat.SearchParams``),
     * :class:`raft_tpu.neighbors.ivf_pq.Index` → IVF-PQ
-      (*params* is an ``ivf_pq.SearchParams``).
+      (*params* is an ``ivf_pq.SearchParams``),
+    * :class:`raft_tpu.neighbors.ann_mnmg.ShardedIndex` → the sharded
+      multi-device backend: super-batches dispatch as ONE shard_map
+      program across every device of the index's communicator (*params*
+      is the underlying kind's SearchParams; brute-force sharded indexes
+      carry their metric themselves).
 
     ``max_batch`` bounds one coalesced super-batch (and is the largest
     bucket :meth:`warmup` pins by default).  ``handle`` supplies the stream

@@ -34,13 +34,24 @@ from raft_tpu.core.error import expects
 
 
 class LAPResult(NamedTuple):
-    """Solution of a batch of assignment problems."""
+    """Solution of a batch of assignment problems.
+
+    ``converged``/``residual`` make the solver's two silent degradation
+    modes OBSERVABLE (ADVICE r5): ``converged[b]`` is False when the final
+    auction phase hit its round cap and the completion fallback had to
+    assign leftover rows (the returned permutation is valid but the
+    ``n·ε_eff`` optimality bound no longer certifies it), and
+    ``residual[b] = primal − dual`` is the duality gap — the computable
+    certificate, ≤ ``n·ε_eff`` whenever the bound holds (up to fp
+    rounding)."""
 
     row_assignment: jnp.ndarray   # (batch, n) int32: col assigned to each row
     col_assignment: jnp.ndarray   # (batch, n) int32: row assigned to each col
     objective: jnp.ndarray        # (batch,) primal objective Σ cost[i, σ(i)]
     row_duals: jnp.ndarray        # (batch, n) dual u_i
     col_duals: jnp.ndarray        # (batch, n) dual v_j (auction prices)
+    converged: jnp.ndarray        # (batch,) bool: final phase completed
+    residual: jnp.ndarray         # (batch,) duality gap |primal − dual|
 
 
 def _auction_phase(benefit, prices, eps, max_rounds):
@@ -143,7 +154,10 @@ def _solve_single(cost, final_eps: float, scaling_factor: float,
     # reachable on adversarial tie structures), assign each leftover row to
     # its best FREE column in row order — among sub-ε ties this loses
     # nothing, and it restores the permutation invariant every caller
-    # relies on.
+    # relies on.  ``converged`` records whether the fallback fired at all
+    # (False → the n·ε_eff bound is no longer certified; the returned
+    # ``residual`` duality gap is then the only certificate).
+    converged = jnp.all(r2c >= 0)
     inf = jnp.asarray(jnp.finfo(benefit.dtype).max, benefit.dtype)
 
     def complete(i, carry):
@@ -163,7 +177,11 @@ def _solve_single(cost, final_eps: float, scaling_factor: float,
     # slackness in the max-benefit form; reference exposes row/col duals
     # via getRowDualVector/getColDualVector).
     u = jnp.max(benefit - prices[None, :], axis=1)
-    return r2c, c2r, objective, -u, -prices  # negate back to min-cost form
+    # duality gap in min-cost form: primal − dual ∈ [0, n·ε_eff] when the
+    # bound holds (tiny negative values are fp rounding of the two sums)
+    residual = objective - (jnp.sum(-u) + jnp.sum(-prices))
+    # negate duals back to min-cost form
+    return r2c, c2r, objective, -u, -prices, converged, residual
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2, 3))
@@ -185,7 +203,21 @@ def solve_lap(costs, epsilon: float = 1e-6, scaling_factor: float = 8.0,
     For integer costs pass ``epsilon < 1/n`` to get the exact optimum,
     provided the floor itself stays below 1/n (true whenever
     ``spread · n ≲ 1e6`` in f32; use f64 costs beyond that).
+
+    Observability (ADVICE r5): the result carries ``converged`` (False →
+    the final phase round-capped and the completion fallback fired; the
+    optimality bound is then uncertified) and ``residual`` (the duality
+    gap, the computable certificate).  When the ULP floor EXCEEDS the
+    requested *epsilon* for concrete (non-traced) inputs, integer costs
+    are silently UPCAST to f64 under ``jax_enable_x64`` (restoring the
+    documented integer-exactness guarantee instead of voiding it in the
+    fine print); otherwise a warning is logged with the effective ε.
     """
+    import jax as _jax
+
+    from raft_tpu.core.aot import is_tracer
+    from raft_tpu.core.logger import log_warn
+
     costs = jnp.asarray(costs)
     squeeze = costs.ndim == 2
     if squeeze:
@@ -195,10 +227,30 @@ def solve_lap(costs, epsilon: float = 1e-6, scaling_factor: float = 8.0,
     n = costs.shape[1]
     if max_rounds_per_phase <= 0:
         max_rounds_per_phase = 16 * n + 256
-    r2c, c2r, obj, u, v = _solve_batched(
-        costs.astype(jnp.promote_types(costs.dtype, jnp.float32)),
+    compute_dtype = jnp.promote_types(costs.dtype, jnp.float32)
+    if not is_tracer(costs) and costs.size:
+        spread = max(float(jnp.max(costs) - jnp.min(costs)), 1.0)
+        floor = spread * 8 * float(jnp.finfo(compute_dtype).eps)
+        if floor > float(epsilon):
+            integer = jnp.issubdtype(costs.dtype, jnp.integer)
+            if integer and bool(_jax.config.jax_enable_x64) \
+                    and compute_dtype != jnp.float64:
+                # integer-cost callers asked for exactness (ε < 1/n): keep
+                # the guarantee by computing in f64, whose ULP floor at
+                # this spread sits ~2^29 lower
+                compute_dtype = jnp.float64
+            else:
+                log_warn(
+                    "solve_lap: requested epsilon=%g is below the f%d ULP "
+                    "floor %g at cost spread %g — the optimality bound "
+                    "degrades to n*%g%s", float(epsilon),
+                    jnp.finfo(compute_dtype).bits, floor, spread, floor,
+                    " (enable jax_enable_x64 or pass f64 costs to keep "
+                    "integer exactness)" if integer else "")
+    r2c, c2r, obj, u, v, conv, resid = _solve_batched(
+        costs.astype(compute_dtype),
         float(epsilon), float(scaling_factor), int(max_rounds_per_phase))
-    res = LAPResult(r2c, c2r, obj, u, v)
+    res = LAPResult(r2c, c2r, obj, u, v, conv, resid)
     if squeeze:
         res = LAPResult(*(a[0] for a in res))
     return res

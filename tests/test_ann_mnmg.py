@@ -1,0 +1,332 @@
+"""Sharded multi-device ANN search (raft_tpu/neighbors/ann_mnmg;
+docs/sharded_ann.md): sharded ≡ single-device property grid across
+{ivf_flat, ivf_pq, brute_force} × {f32, bf16} × world {1, 2, 8}, ragged
+(multi-chunk) list partitions, empty-shard probe sets, ShardedIndex
+serialize round-trip, the one-allgather collective contract (count AND
+payload bytes), zero-compile warmed dispatch, the query-sharded
+zero-collective knn_mnmg mode, and ServeEngine sharded coalescing."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.comms import build_comms
+from raft_tpu.core.aot import aot_compile_counters
+from raft_tpu.neighbors import ann_mnmg, ivf_flat, ivf_pq, knn
+from raft_tpu.neighbors.knn_mnmg import knn_mnmg
+
+_N, _DIM, _K = 600, 16, 5
+_PROBES = 4
+
+_COMMS = {}
+
+
+def _comms(world):
+    """One communicator per world size for the whole module (each carries
+    its program/jit caches — rebuilding per test would retrace)."""
+    if world not in _COMMS:
+        from jax.sharding import Mesh
+
+        _COMMS[world] = build_comms(
+            Mesh(np.array(jax.devices()[:world]), ("world",)))
+    return _COMMS[world]
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 1, (_N, _DIM)).astype(np.float32)
+    q = rng.normal(0, 1, (33, _DIM)).astype(np.float32)
+    return x, q
+
+
+_STATE = {}
+
+
+def _index(backend):
+    """Build each base index once per module (builds dominate test time)."""
+    if backend not in _STATE:
+        x, _ = _data()
+        if backend == "brute_force":
+            _STATE[backend] = x
+        elif backend == "ivf_flat":
+            _STATE[backend] = ivf_flat.build(
+                ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=4), x)
+        else:
+            _STATE[backend] = ivf_pq.build(
+                ivf_pq.IndexParams(n_lists=16, pq_dim=8, pq_bits=8,
+                                   kmeans_n_iters=4, seed=1), x)
+    return _STATE[backend]
+
+
+def _solo(backend, q, k=_K):
+    idx = _index(backend)
+    if backend == "brute_force":
+        return knn(idx, q, k)
+    if backend == "ivf_flat":
+        return ivf_flat.search(ivf_flat.SearchParams(n_probes=_PROBES),
+                               idx, q, k)
+    return ivf_pq.search(ivf_pq.SearchParams(n_probes=_PROBES), idx, q, k)
+
+
+def _sharded(backend, world):
+    key = (backend, world)
+    if key not in _STATE:
+        comms = _comms(world)
+        idx = _index(backend)
+        if backend == "brute_force":
+            _STATE[key] = ann_mnmg.shard_brute_force(idx, comms)
+        else:
+            _STATE[key] = idx.shard(comms)
+    return _STATE[key]
+
+
+def _params(backend):
+    if backend == "brute_force":
+        return None
+    if backend == "ivf_flat":
+        return ivf_flat.SearchParams(n_probes=_PROBES)
+    return ivf_pq.SearchParams(n_probes=_PROBES)
+
+
+@pytest.mark.parametrize("world", [1, 2, 8])
+@pytest.mark.parametrize("backend", ["brute_force", "ivf_flat", "ivf_pq"])
+def test_sharded_matches_single_device(backend, world):
+    """The core contract: the sharded program's f32 top-k (ids AND
+    distances) is IDENTICAL to single-device search of the same index —
+    per-shard scans reproduce the solo scan's per-candidate scores
+    exactly, and the shard-order part merge reproduces the sequential
+    scan's stable tie order (deferred-sqrt merge on squared L2)."""
+    _, q = _data()
+    d0, i0 = _solo(backend, q)
+    sh = _sharded(backend, world)
+    d1, i1 = ann_mnmg.search(sh, q, _K, _params(backend))
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+
+
+@pytest.mark.parametrize("backend", ["brute_force", "ivf_flat", "ivf_pq"])
+def test_sharded_matches_single_device_bf16(backend):
+    """bf16 queries take the same accumulate-in-f32 path on both sides
+    (ivf_pq ingests bf16 → f32 on both; ivf_flat/brute-force keep bf16
+    MXU inputs with f32 scores), so sharded ≡ solo holds bit-for-bit for
+    half-precision serving traffic too."""
+    _, q = _data()
+    qb = jnp.asarray(q, jnp.bfloat16)
+    if backend == "brute_force":
+        # a bf16 INDEX exercises the half-precision scan carry; build its
+        # own shard (the f32 module index stays f32)
+        x, _ = _data()
+        xb = jnp.asarray(x, jnp.bfloat16)
+        d0, i0 = knn(xb, qb, _K)
+        sh = ann_mnmg.shard_brute_force(xb, _comms(8))
+        d1, i1 = ann_mnmg.search(sh, qb, _K)
+    else:
+        d0, i0 = _solo(backend, qb)
+        d1, i1 = ann_mnmg.search(_sharded(backend, 8), qb, _K,
+                                 _params(backend))
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+
+
+def test_ragged_list_partitions():
+    """Skewed cluster sizes force multi-chunk lists; the shard-local
+    chunk tables then carry continuation chunks whose budget CANNOT be
+    derived from the local table shape (expand_probes' extra override) —
+    a truncated budget would silently drop real candidates here."""
+    rng = np.random.default_rng(3)
+    # one dominant tight blob (most rows land in few lists → multi-chunk)
+    blob = rng.normal(0, 0.05, (400, _DIM)).astype(np.float32)
+    rest = rng.normal(0, 1, (200, _DIM)).astype(np.float32)
+    x = np.concatenate([blob, rest])
+    idx = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=4), x)
+    assert idx.chunk_table.shape[1] > 1, "data model failed to go ragged"
+    q = rng.normal(0, 0.3, (17, _DIM)).astype(np.float32)
+    sp = ivf_flat.SearchParams(n_probes=6)
+    d0, i0 = ivf_flat.search(sp, idx, q, _K)
+    for world in (2, 8):
+        sh = idx.shard(_comms(world))
+        assert sh.aux["probe_extra"] > 0, "ragged partition lost its chunks"
+        d1, i1 = ann_mnmg.search(sh, q, _K, sp)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+
+
+def test_empty_shard_probe_sets():
+    """n_probes=1 on world=8 leaves 7 shards with an EMPTY probe
+    intersection per query — their scans score only the masked dummy and
+    contribute sentinel/-1 runs the merge must discard."""
+    _, q = _data()
+    sp = ivf_flat.SearchParams(n_probes=1)
+    idx = _index("ivf_flat")
+    d0, i0 = ivf_flat.search(sp, idx, q, 3)
+    d1, i1 = ann_mnmg.search(_sharded("ivf_flat", 8), q, 3, sp)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+
+
+def test_one_allgather_per_search_counter_and_bytes():
+    """The collective contract (ISSUE 6 acceptance): one traced search
+    program contains EXACTLY one allgather, and its payload is the packed
+    (bucket, 2k) f32 merge payload — the bytes counter catches over-fat
+    programs the launch count alone would miss."""
+    comms = _comms(8)
+    _, q = _data()
+    q = q[:8]                      # bucket 8
+    k = 7                          # fresh statics → fresh trace
+    before = dict(comms.collective_calls)
+    d1, i1 = ann_mnmg.search(_sharded("ivf_flat", 8), q, k,
+                             ivf_flat.SearchParams(n_probes=_PROBES))
+    delta = {key: comms.collective_calls[key] - before.get(key, 0)
+             for key in comms.collective_calls
+             if comms.collective_calls[key] != before.get(key, 0)}
+    assert delta == {"allgather": 1,
+                     "allgather_bytes": 8 * 2 * k * 4}, delta
+    d0, i0 = _solo("ivf_flat", q, k)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+
+
+def test_warmed_searcher_zero_compiles():
+    """MeshAot pinning: after warm(bucket, dtype), dispatching that
+    signature performs zero compiles/retraces (counter-asserted — the
+    serving steady-state contract extended to shard_map programs)."""
+    sh = _sharded("ivf_flat", 8)
+    s = sh.searcher(_K, ivf_flat.SearchParams(n_probes=_PROBES))
+    s.warm(8, jnp.float32)
+    _, q = _data()
+    c0 = aot_compile_counters["compiles"]
+    d, i = ann_mnmg.search(sh, q[:6], _K,
+                           ivf_flat.SearchParams(n_probes=_PROBES))
+    assert aot_compile_counters["compiles"] == c0, \
+        "warmed sharded search compiled at dispatch"
+    assert np.asarray(d).shape == (6, _K)
+
+
+def test_sharded_serialize_roundtrip(tmp_path):
+    """ShardedIndex round-trip: the finished partition (replicated tables
+    + per-shard blocks + aux) reloads onto a same-world communicator and
+    searches identically; a world-mismatched load fails loudly."""
+    from raft_tpu.core.error import LogicError
+    from raft_tpu.neighbors import serialize
+
+    sh = _sharded("ivf_pq", 8)
+    p = str(tmp_path / "sharded.npz")
+    serialize.save_sharded(p, sh)
+    sh2 = serialize.load_sharded(p, _comms(8))
+    _, q = _data()
+    sp = ivf_pq.SearchParams(n_probes=_PROBES)
+    d1, i1 = ann_mnmg.search(sh, q, _K, sp)
+    d2, i2 = ann_mnmg.search(sh2, q, _K, sp)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+    with pytest.raises(LogicError):
+        serialize.load_sharded(p, _comms(2))  # partition is world-specific
+
+
+def test_brute_force_pad_rows_never_surface():
+    """501 rows over 8 shards pads with sentinel rows — they must never
+    appear in the top-k for k <= n."""
+    rng = np.random.default_rng(5)
+    x = rng.normal(0, 1, (501, _DIM)).astype(np.float32)
+    q = rng.normal(0, 1, (9, _DIM)).astype(np.float32)
+    d0, i0 = knn(x, q, 7)
+    sh = ann_mnmg.shard_brute_force(x, _comms(8))
+    d1, i1 = ann_mnmg.search(sh, q, 7)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+    assert int(np.max(np.asarray(i1))) < 501
+
+
+def test_brute_force_pad_rows_refused_outside_l2():
+    """Sentinel row padding is only sound for float L2 metrics: no finite
+    vector is guaranteed to LOSE under InnerProduct (dot grows with
+    magnitude) or Cosine (scale-invariant), so a ragged split there must
+    fail loudly instead of surfacing fabricated ids >= n."""
+    from raft_tpu.core.error import LogicError
+    from raft_tpu.distance.distance_types import DistanceType
+
+    rng = np.random.default_rng(6)
+    x = rng.normal(0, 1, (501, _DIM)).astype(np.float32)
+    with pytest.raises(LogicError):
+        ann_mnmg.shard_brute_force(x, _comms(8),
+                                   metric=DistanceType.InnerProduct)
+    with pytest.raises(LogicError):
+        ann_mnmg.shard_brute_force(x.astype(np.int8), _comms(8))
+    # an even split under IP is fine
+    sh = ann_mnmg.shard_brute_force(x[:496], _comms(8),
+                                    metric=DistanceType.InnerProduct)
+    q = rng.normal(0, 1, (5, _DIM)).astype(np.float32)
+    d0, i0 = knn(x[:496], q, 4, DistanceType.InnerProduct)
+    d1, i1 = ann_mnmg.search(sh, q, 4)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+
+
+def test_query_sharded_knn_zero_collectives():
+    """partition="queries": disjoint per-rank results gathered by the
+    output sharding alone — identical to single-device knn with ZERO
+    collective launches in the traced program."""
+    comms = _comms(8)
+    rng = np.random.default_rng(7)
+    x = rng.normal(0, 1, (256, _DIM)).astype(np.float32)
+    q = rng.normal(0, 1, (41, _DIM)).astype(np.float32)
+    d0, i0 = knn(x, q, 6)
+    before = dict(comms.collective_calls)
+    d1, i1 = knn_mnmg(comms, x, q, 6, partition="queries")
+    assert dict(comms.collective_calls) == before, \
+        "query-sharded program launched a collective"
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+    # auto mode: nq >= n flips to query sharding
+    d2, i2 = knn_mnmg(comms, x[:32], q, 6, partition="auto")
+    d3, i3 = knn(x[:32], q, 6)
+    np.testing.assert_array_equal(np.asarray(i2), np.asarray(i3))
+
+
+def test_index_sharded_knn_one_allgather():
+    """The default OPG topology now packs distances+ids into ONE
+    allgather (was two in r1) — counter-asserted with payload bytes."""
+    comms = _comms(8)
+    rng = np.random.default_rng(9)
+    x = rng.normal(0, 1, (256, _DIM)).astype(np.float32)
+    q = rng.normal(0, 1, (16, _DIM)).astype(np.float32)
+    k = 9                          # fresh statics → fresh trace
+    before = dict(comms.collective_calls)
+    d1, i1 = knn_mnmg(comms, x, q, k)
+    delta = {key: comms.collective_calls[key] - before.get(key, 0)
+             for key in comms.collective_calls
+             if comms.collective_calls[key] != before.get(key, 0)}
+    assert delta == {"allgather": 1,
+                     "allgather_bytes": 16 * 2 * k * 4}, delta
+    d0, i0 = knn(x, q, k)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+
+
+@pytest.mark.parametrize("backend", ["brute_force", "ivf_flat", "ivf_pq"])
+def test_serve_engine_sharded_coalescing(backend):
+    """ServeEngine over the sharded backend: coalesced super-batches
+    dispatch ONE shard_map program across all chips, per-request results
+    identical to the solo sharded path, zero compiles after warmup."""
+    from raft_tpu.serve import ServeEngine
+
+    sh = _sharded(backend, 8)
+    params = _params(backend)
+    eng = ServeEngine(sh, _K, params, max_batch=64)
+    assert eng.backend == f"sharded_{backend}"
+    eng.warmup()
+    rng = np.random.default_rng(11)
+    mixes = [(3, 17, 1, 0, 9), (64,), (1, 1, 1)]
+    eng.search([rng.normal(0, 1, (2, _DIM)).astype(np.float32)])
+    c0 = aot_compile_counters["compiles"]
+    for mix in mixes:
+        reqs = [rng.normal(0, 1, (s, _DIM)).astype(np.float32)
+                for s in mix]
+        outs = eng.search(reqs)
+        for qq, (d, i) in zip(reqs, outs):
+            d0, i0 = ann_mnmg.search(sh, qq, _K, params)
+            np.testing.assert_array_equal(i, np.asarray(i0))
+            np.testing.assert_array_equal(d, np.asarray(d0))
+    assert aot_compile_counters["compiles"] == c0, \
+        "sharded serving compiled during steady state"
+    assert eng.stats["super_batches"] >= len(mixes)

@@ -81,6 +81,26 @@ class TestSessionResume:
             emit(r)
         return s, old_out
 
+    def test_failed_cases_blocks_partial_stage(self, tmp_path):
+        """ADVICE r5 stage gate: a stage with one decisive failed CASE
+        and one auxiliary success must not read as complete — any case
+        whose every row errored blocks the stage_done marker; a case that
+        errored then succeeded (retry) does not."""
+        import bench.tpu_session as s
+
+        rows = [
+            {"stage": "mnmg_diag", "case": "B_jit_one_step", "iter_s": 5.0},
+            {"stage": "mnmg_diag", "case": "E_full_fit", "error": "boom"},
+        ]
+        assert s._failed_cases(rows) == [str((("case", "E_full_fit"),))]
+        # retried-and-succeeded case: not failed
+        rows.append({"stage": "mnmg_diag", "case": "E_full_fit",
+                     "iter_s": 3.0})
+        assert s._failed_cases(rows) == []
+        # all-errors single-row stage (the r4 gate) is subsumed
+        assert s._failed_cases([{"stage": "lanczos", "error": "x"}]) \
+            == [str(())]
+
     def test_stage_markers_and_reset(self, tmp_path):
         s, old = self._session(tmp_path, [
             {"stage": "session", "schema": 3},

@@ -43,6 +43,24 @@ class TestCollectives:
         assert float(mn) == 0 and float(mx) == n - 1
         assert float(pr) == float(np.prod(np.arange(1, n + 1)))
 
+    def test_collective_calls_record_payload_bytes(self, comms):
+        """Every collective launch also records its per-rank payload
+        bytes under "<name>_bytes" at trace time (the sharded-ANN layer
+        asserts bytes, not just counts — an over-chatty program that
+        splits or fattens its payload is caught either way)."""
+        before = dict(comms.collective_calls)
+
+        def fn(x):
+            return (comms.allreduce(x),              # (4, 8) f32
+                    comms.allgather(x[0]))           # (8,) f32
+
+        comms.run(fn, np.zeros((comms.get_size() * 4, 8), np.float32))
+        delta = {k: comms.collective_calls[k] - before.get(k, 0)
+                 for k in comms.collective_calls
+                 if comms.collective_calls[k] != before.get(k, 0)}
+        assert delta == {"allreduce": 1, "allreduce_bytes": 4 * 8 * 4,
+                         "allgather": 1, "allgather_bytes": 8 * 4}, delta
+
     def test_allgatherv(self, comms):
         n = comms.get_size()
         counts = [(r % 3) + 1 for r in range(n)]

@@ -41,6 +41,43 @@ def test_lap_integer_exact():
     assert float(res.objective) == scipy_objective(cost.astype(np.int64))
 
 
+def test_lap_converged_and_residual_observable():
+    """ADVICE r5: the result surfaces ``converged`` (completion fallback
+    never fired) and ``residual`` (the duality gap certificate, bounded
+    by n·ε_eff when the optimality bound holds)."""
+    rng = np.random.default_rng(6)
+    n = 16
+    cost = rng.random((n, n)).astype(np.float32)
+    res = solve_lap(cost, epsilon=1e-6)
+    assert bool(res.converged)
+    # certificate: gap within the stated bound (+ fp slack on the sums)
+    assert float(res.residual) <= n * 1e-5 + 1e-4
+    assert float(res.residual) >= -1e-4
+    # batched shape
+    costs = rng.random((3, n, n)).astype(np.float32)
+    resb = solve_lap(costs, epsilon=1e-6)
+    assert np.asarray(resb.converged).shape == (3,)
+    assert np.asarray(resb.residual).shape == (3,)
+    assert bool(np.all(np.asarray(resb.converged)))
+
+
+def test_lap_integer_upcasts_past_f32_ulp_floor():
+    """Integer costs whose spread pushes the f32 ULP floor above the
+    requested ε are upcast to f64 under x64 (ADVICE r5) — the documented
+    integer-exactness guarantee holds instead of silently voiding."""
+    rng = np.random.default_rng(7)
+    n = 16
+    # spread ~2e6 → f32 floor ≈ 2e6·8·1.2e-7 ≈ 1.9 > ε; exactness needs
+    # ε < 1/n, unreachable in f32 at this spread
+    cost = (rng.integers(0, 2_000_000, (n, n))).astype(np.int64)
+    res = solve_lap(cost, epsilon=1.0 / (2 * n))
+    r2c = np.asarray(res.row_assignment)
+    assert sorted(r2c.tolist()) == list(range(n))
+    assert float(res.objective) == scipy_objective(cost)
+    # the f64 duals certify it: gap below the integer resolution
+    assert abs(float(res.residual)) < 1.0
+
+
 def test_lap_batched():
     rng = np.random.default_rng(4)
     b, n = 5, 16
