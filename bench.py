@@ -15,8 +15,8 @@ dominated: ~100 MB output at ~200 µs end-to-end).  vs_baseline is
 value / estimate, where ≥0.8 meets the north-star target.
 
 Select a metric with
-BENCH_METRIC=pairwise|kmeans|kmeans_mnmg|ivf_pq|ivf_pq_search|lanczos|
-knn_bruteforce|serve|ann_sharded.
+BENCH_METRIC=pairwise|kmeans|kmeans_mnmg|ivf_pq|ivf_pq_search|ivf_build|
+lanczos|knn_bruteforce|serve|ann_sharded.
 
 Robust bring-up (the round-1 failure was an unguarded TPU backend init):
 the measurement runs in a *child* process under a watchdog.  The parent
@@ -446,6 +446,169 @@ def bench_ann_sharded():
     }
 
 
+def bench_ivf_build():
+    """Tiled vs monolithic IVF-PQ index construction A/B (ISSUE 7;
+    docs/index_build.md): rows/s ingesting 100k×64 f32 into a pre-trained
+    model (pq_dim=16, pq_bits=8, n_lists=512) — the populate/refresh hot
+    path (``extend``), which is exactly what a serving system re-ingesting
+    vectors pays.  Training runs ONCE outside the timed region (both
+    sides share the identical model, so the A/B isolates the populate
+    pipeline).
+
+    Tiled side: the fused per-tile AOT program (residual → encode →
+    bit-pack → csum in ONE executable, O(tile) transients, mul-reduce
+    encode lowering) + device-side pack.  Baseline side: the PRE-PR
+    populate chain replicated verbatim (assign → full-dataset residual →
+    ``_encode_legacy`` einsum encode → pack → csum → host-bookkept
+    ``pack_lists_chunked``) — frozen at its r6 form so the A/B keeps
+    measuring against what the code actually did before this PR even as
+    the shipped paths improve.  Gates asserted in-bench before any number
+    is recorded:
+
+    * the tiled build's f32 search top-k (ids AND distances) must be
+      bit-IDENTICAL to the monolithic (``tiled=False``) build's — shared
+      encode kernel, so this holds by construction (hard assert); the
+      pre-PR replica's top-k is additionally compared and recorded as
+      ``pre_pr_topk_identical`` (true on this config — the lowerings
+      differ only in FMA rounding — but a cross-lowering tie flip must
+      degrade to a visible field, not an environment-dependent bench
+      error);
+    * the tiled executable's peak transient (``memory_analysis``) must be
+      a small multiple of the tile, far under the pre-PR encode
+      program's dataset-sized transient;
+    * the timed tiled replay performs ZERO compiles (warm executables,
+      ``aot_compile_counters``).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.cluster import min_cluster_and_distance
+    from raft_tpu.core.aot import aot_compile_counters
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.neighbors._common import pack_lists_chunked
+
+    n, dim, nq, k = 100_000, 64, 256, 10
+    pq_dim, pq_bits, kcb, n_lists = 16, 8, 256, 512
+    rng = np.random.default_rng(0)
+    x = jax.device_put(rng.normal(0, 1, (n, dim)).astype(np.float32))
+    q = jax.device_put(rng.normal(0, 1, (nq, dim)).astype(np.float32))
+    params = ivf_pq.IndexParams(n_lists=n_lists, pq_dim=pq_dim,
+                                pq_bits=pq_bits, kmeans_n_iters=10, seed=1,
+                                add_data_on_build=False)
+    base = ivf_pq.build(params, x)  # model only; populate timed below
+    ids = jnp.arange(n, dtype=jnp.int32)
+
+    def prepr_populate():
+        """The r6 populate, replicated: monolithic dispatch chain with
+        dataset-sized transients + the host-bookkept pack (the r6 pack
+        fetched the whole (n,) label vector to host for its bincount —
+        reproduced explicitly, since pack_lists_chunked itself now
+        accumulates counts on device)."""
+        labels = min_cluster_and_distance(x, base.centers).key.astype(
+            jnp.int32)
+        resid = (x - base.centers[labels]) @ base.rotation
+        codes = ivf_pq._encode_legacy(resid, base.codebooks, labels, False)
+        packed = ivf_pq._pack_codes(codes, pq_bits)
+        csum = ivf_pq._csum_for_codes(codes, labels, base.centers,
+                                      base.rotation, base.codebooks, False)
+        np.asarray(labels)  # the r6 pack's host label fetch
+        return pack_lists_chunked((packed, csum), ids, labels, n_lists)
+
+    # acceptance gate 1: bit-identical f32 search top-k across all three
+    # populates of the same trained model — tiled vs monolithic-shipped
+    # (guaranteed: shared kernel) and tiled vs the pre-PR replica
+    idx_t = ivf_pq.extend(base, x, tiled=True)
+    idx_m = ivf_pq.extend(base, x, tiled=False)
+    st = prepr_populate()
+    idx_p = ivf_pq.Index(
+        centers=base.centers, rotation=base.rotation,
+        codebooks=base.codebooks, list_codes=st[0][0], list_indices=st[1],
+        list_sizes=st[3], phys_sizes=st[2], chunk_table=st[4], owner=st[5],
+        list_adc=base.list_adc, list_csum=st[0][1], metric=base.metric,
+        codebook_kind=base.codebook_kind, pq_bits=base.pq_bits)
+    sp = ivf_pq.SearchParams(n_probes=20)
+    d_t, i_t = ivf_pq.search(sp, idx_t, q, k)
+    d_m, i_m = ivf_pq.search(sp, idx_m, q, k)
+    assert np.array_equal(np.asarray(i_t), np.asarray(i_m)), \
+        "tiled build top-k ids != monolithic build"
+    assert np.array_equal(np.asarray(d_t), np.asarray(d_m)), \
+        "tiled build distances != monolithic build"
+    # the pre-PR replica runs the _encode_legacy einsum lowering, whose
+    # argmin can in principle tie-break differently from the shared
+    # kernel's on sub-ulp codeword ties — equal on this config today, but
+    # an XLA upgrade flipping one of the 1.6M argmins should degrade to a
+    # visible field, not kill the whole metric (the HARD identity gate is
+    # the shipped pair above, which shares one kernel by construction)
+    d_p, i_p = ivf_pq.search(sp, idx_p, q, k)
+    pre_pr_identical = bool(
+        np.array_equal(np.asarray(i_t), np.asarray(i_p))
+        and np.array_equal(np.asarray(d_t), np.asarray(d_p)))
+
+    # acceptance gate 2: the per-tile executable's transient footprint is
+    # O(tile) — a small multiple of the tile's encode tables — while the
+    # pre-PR encode program's transient scales with the dataset
+    tile = 8192
+    tile_exe = ivf_pq._encode_tile_aot.compiled(
+        jax.ShapeDtypeStruct((tile, dim), np.float32),
+        jax.ShapeDtypeStruct((tile,), np.int32), base.centers,
+        base.rotation, base.codebooks, False, pq_bits)
+    mono = jax.jit(lambda rr, ll: ivf_pq._encode_legacy(
+        rr, base.codebooks, ll, False))
+    mono_exe = mono.lower(
+        jax.ShapeDtypeStruct((n, pq_dim * (dim // pq_dim)), np.float32),
+        jax.ShapeDtypeStruct((n,), np.int32)).compile()
+    tile_temp = mono_temp = None
+    try:
+        tile_temp = int(tile_exe.memory_analysis().temp_size_in_bytes)
+        mono_temp = int(mono_exe.memory_analysis().temp_size_in_bytes)
+        # the dominant tile transient is the (tile, pq_dim, 2^bits) f32
+        # encode-distance table; allow a few concurrent copies of it but
+        # nothing dataset-shaped
+        assert tile_temp <= 6 * tile * pq_dim * kcb * 4, \
+            f"tile program transient {tile_temp} B is not O(tile)"
+        assert tile_temp * 4 <= mono_temp, \
+            (f"tile transient {tile_temp} B not << pre-PR "
+             f"{mono_temp} B — the tiling buys no memory headroom")
+    except AttributeError:
+        pass  # backend without memory_analysis: identity gates still hold
+
+    def timed(fn):
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = fn()
+            jax.block_until_ready(jax.tree_util.tree_leaves(out)[0])
+            best = min(best, time.perf_counter() - t0)
+        return n / best
+
+    run_tiled = lambda: ivf_pq.extend(base, x, tiled=True).list_codes  # noqa: E731
+    run_mono = lambda: ivf_pq.extend(base, x, tiled=False).list_codes  # noqa: E731
+    for f in (prepr_populate, run_mono, run_tiled):
+        timed(f)  # warm every pipeline's executables before the A/B
+    rows_prepr = timed(prepr_populate)
+    rows_mono = timed(run_mono)
+    c0 = aot_compile_counters["compiles"]
+    rows_tiled = timed(run_tiled)
+    assert aot_compile_counters["compiles"] == c0, \
+        "tiled populate compiled during the timed replay (cache is cold)"
+    row = {
+        "metric": f"ivf_build_{n // 1000}kx{dim}_pq16_lists512_f32",
+        "value": round(rows_tiled, 1),
+        "unit": "rows/s",
+        # self-baselined A/B like serve: the gate is >= 1.5x over the
+        # pre-PR populate on the same model (ISSUE 7)
+        "vs_baseline": round(rows_tiled / rows_prepr, 3),
+        "pre_pr_rows_s": round(rows_prepr, 1),
+        "monolithic_rows_s": round(rows_mono, 1),
+        "speedup": round(rows_tiled / rows_prepr, 2),
+        "pre_pr_topk_identical": pre_pr_identical,
+    }
+    if tile_temp is not None:
+        row["tile_temp_bytes"] = tile_temp
+        row["pre_pr_temp_bytes"] = mono_temp
+    return row
+
+
 def bench_knn_bruteforce():
     """Brute-force kNN queries/s on the fused tiled scan (100k×64 f32,
     1024 queries, k=10, L2Sqrt) — the substrate under knn_mnmg,
@@ -521,6 +684,7 @@ def bench_lanczos():
 _METRICS = {"pairwise": bench_pairwise, "kmeans": bench_kmeans,
             "kmeans_mnmg": bench_kmeans_mnmg, "ivf_pq": bench_ivf_pq,
             "ivf_pq_search": bench_ivf_pq_search,
+            "ivf_build": bench_ivf_build,
             "lanczos": bench_lanczos, "knn_bruteforce": bench_knn_bruteforce,
             "serve": bench_serve, "ann_sharded": bench_ann_sharded}
 

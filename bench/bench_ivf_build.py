@@ -1,0 +1,125 @@
+"""Tiled index-construction microbenches — the ISSUE 7 build/extend A/B
+(docs/index_build.md; reference ivf_pq_build.cuh's batched ingest).
+
+``populate_tiled`` vs ``populate_pre_pr`` time the SAME trained model
+ingesting the same rows with only the populate pipeline flipped, backing
+bench.py's ``ivf_build`` headline A/B: tiled = fused per-tile AOT encode
+programs + device-side pack; pre_pr = the r6 monolithic dispatch chain
+(einsum encode, dataset-sized transients, host-bookkept pack), replicated
+verbatim as the frozen baseline.  ``populate_monolithic`` is the SHIPPED
+``tiled=False`` path (monolithic structure, shared encode kernel — the
+bit-identity twin).  ``extend_in_place`` measures the donated append
+(capacity-fitting batches, O(n_new) per append), and ``build_sharded``
+the direct-to-shard populate over every local device."""
+
+import numpy as np
+
+from bench.common import case, main_for
+from bench.sizes import size
+
+_N = size(100_000, 4096)
+_DIM = size(64, 16)
+_LISTS = size(512, 16)
+_PQ_DIM = size(16, 4)
+_EXT = size(2048, 128)
+
+_STATE = {}
+
+
+def _model():
+    """One trained model-only index per process — every populate case must
+    ingest against the identical model or the A/B is meaningless."""
+    if "base" not in _STATE:
+        import jax
+
+        from raft_tpu.neighbors import ivf_pq
+
+        rng = np.random.default_rng(0)
+        _STATE["x"] = jax.device_put(
+            rng.normal(0, 1, (_N, _DIM)).astype(np.float32))
+        _STATE["base"] = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=_LISTS, pq_dim=_PQ_DIM, pq_bits=8,
+                               kmeans_n_iters=10, seed=1,
+                               add_data_on_build=False), _STATE["x"])
+        _STATE["ext"] = jax.device_put(
+            rng.normal(0, 1, (_EXT, _DIM)).astype(np.float32))
+    return _STATE["base"], _STATE["x"]
+
+
+@case("ivf_build/populate_tiled")
+def bench_populate_tiled():
+    from raft_tpu.neighbors import ivf_pq
+
+    base, x = _model()
+    return (lambda: ivf_pq.extend(base, x, tiled=True).list_codes,
+            {"items": _N})
+
+
+@case("ivf_build/populate_monolithic")
+def bench_populate_monolithic():
+    from raft_tpu.neighbors import ivf_pq
+
+    base, x = _model()
+    return (lambda: ivf_pq.extend(base, x, tiled=False).list_codes,
+            {"items": _N})
+
+
+@case("ivf_build/populate_pre_pr")
+def bench_populate_pre_pr():
+    import jax.numpy as jnp
+
+    from raft_tpu.cluster import min_cluster_and_distance
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.neighbors._common import pack_lists_chunked
+
+    base, x = _model()
+    ids = jnp.arange(_N, dtype=jnp.int32)
+
+    def run():
+        # the r6 populate, frozen verbatim (bench.py ivf_build baseline)
+        labels = min_cluster_and_distance(x, base.centers).key.astype(
+            jnp.int32)
+        resid = (x - base.centers[labels]) @ base.rotation
+        codes = ivf_pq._encode_legacy(resid, base.codebooks, labels, False)
+        packed = ivf_pq._pack_codes(codes, 8)
+        csum = ivf_pq._csum_for_codes(codes, labels, base.centers,
+                                      base.rotation, base.codebooks, False)
+        return pack_lists_chunked((packed, csum), ids, labels, _LISTS)[0][0]
+
+    return run, {"items": _N}
+
+
+@case("ivf_build/extend_in_place")
+def bench_extend_in_place():
+    from raft_tpu.neighbors import ivf_pq
+
+    base, x = _model()
+    # chained appends: each call consumes the previous index (donated
+    # blocks) and appends a capacity-fitting batch — the steady-state
+    # serving-refresh shape.  Lists eventually overflow a chunk; those
+    # calls take the grow path, which is part of the workload.
+    _STATE["chain"] = ivf_pq.extend(base, x, tiled=True)
+
+    def run():
+        _STATE["chain"] = ivf_pq.extend(_STATE["chain"], _STATE["ext"],
+                                        tiled=True, in_place=True)
+        return _STATE["chain"].list_codes
+
+    return run, {"items": _EXT}
+
+
+@case("ivf_build/build_sharded")
+def bench_build_sharded():
+    from raft_tpu.comms import build_comms
+    from raft_tpu.neighbors import ivf_pq
+
+    _model()
+    comms = _STATE.setdefault("comms", build_comms())
+    params = ivf_pq.IndexParams(n_lists=_LISTS, pq_dim=_PQ_DIM, pq_bits=8,
+                                kmeans_n_iters=4, seed=1)
+    return (lambda: ivf_pq.build_sharded(
+        params, _STATE["x"], comms).stacked[0], {"items": _N})
+
+
+if __name__ == "__main__":
+    main_for("bench.bench_ivf_build")

@@ -9,8 +9,8 @@ from bench.common import run_registered
 
 for mod in ("bench.bench_distance", "bench.bench_kmeans",
             "bench.bench_neighbors", "bench.bench_ivf_pq",
-            "bench.bench_serve", "bench.bench_sparse",
-            "bench.bench_linalg"):
+            "bench.bench_ivf_build", "bench.bench_serve",
+            "bench.bench_sparse", "bench.bench_linalg"):
     __import__(mod)
 
 if __name__ == "__main__":

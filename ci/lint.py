@@ -28,10 +28,15 @@ high-signal subset with stdlib ast/tokenize:
 
   * host transfers (``np.asarray``/``np.array``, ``jax.device_get``,
     ``.addressable_data``, ``.block_until_ready``) anywhere in
-    ``raft_tpu/neighbors/ann_mnmg.py`` outside ``host-ok``-marked lines —
-    the sharded-ANN search path is ONE shard_map program per batch with
-    no host round-trips by design; build/serialize-time table assembly
-    routes through the blessed ``_host`` helper
+    ``raft_tpu/neighbors/ann_mnmg.py`` OR ``raft_tpu/neighbors/_build.py``
+    outside ``host-ok``-marked lines — the sharded-ANN search path is ONE
+    shard_map program per batch with no host round-trips by design, and
+    the tiled build/populate hot path (ISSUE 7) must keep per-row data on
+    device end to end: only the (n_lists,)-shaped chunk-table bookkeeping
+    (and the (n,) label routing vector of the sharded populate) may fetch,
+    through ``host-ok``-marked lines.  A dataset-sized ``np.asarray``
+    creeping into the populate path reintroduces exactly the monolithic
+    host round-trip the tiled build removed
 
   * ``jax.jit`` / ``jax.lax.*`` dispatch anywhere in ``raft_tpu/serve/`` —
     the serving engine's zero-retrace guarantee holds only while every
@@ -304,15 +309,17 @@ _HOST_TRANSFER_CALLS = ("asarray", "array", "device_get",
 
 
 def check_ann_mnmg_host_transfers(tree, lines):
-    """The sharded-ANN no-host-transfer guard (scoped to
-    raft_tpu/neighbors/ann_mnmg.py): ``np.asarray``/``np.array``,
-    ``jax.device_get``, ``.addressable_data`` and ``.block_until_ready``
-    are banned module-wide — the search path must stay device-resident
-    end to end (ONE shard_map program per batch).  Build/serialize-time
-    table assembly goes through blessed helpers whose lines carry a
-    ``host-ok`` marker (the adc-exempt/serve-exempt allowlist idiom);
-    pure-numpy table arithmetic on host data (np.arange/zeros/...) is not
-    a transfer and is not flagged."""
+    """The device-residency guard (scoped to
+    raft_tpu/neighbors/ann_mnmg.py AND raft_tpu/neighbors/_build.py):
+    ``np.asarray``/``np.array``, ``jax.device_get``,
+    ``.addressable_data`` and ``.block_until_ready`` are banned
+    module-wide — the sharded search path must stay device-resident end to
+    end (ONE shard_map program per batch), and the tiled build/populate
+    hot path may fetch only its (n_lists,)-shaped chunk-table bookkeeping
+    (plus the (n,) label routing vector of the sharded populate), through
+    lines carrying a ``host-ok`` marker (the adc-exempt/serve-exempt
+    allowlist idiom); pure-numpy table arithmetic on host data
+    (np.arange/zeros/...) is not a transfer and is not flagged."""
     found = {}
     for node in ast.walk(tree):
         name = None
@@ -383,9 +390,11 @@ def check_file(path: pathlib.Path):
     if "raft_tpu/neighbors/" in posix:
         findings.extend(check_probe_scan_callbacks(tree, lines))
 
-    # the sharded search path must never fetch to host (one shard_map
-    # program per batch; build-time helpers carry host-ok markers)
-    if posix.endswith("neighbors/ann_mnmg.py"):
+    # the sharded search path and the tiled build/populate hot path must
+    # never fetch per-row data to host (chunk-table bookkeeping lines
+    # carry host-ok markers)
+    if (posix.endswith("neighbors/ann_mnmg.py")
+            or posix.endswith("neighbors/_build.py")):
         findings.extend(check_ann_mnmg_host_transfers(tree, lines))
 
     # serve hot paths must dispatch the aot() cache (zero-retrace guard)

@@ -214,13 +214,30 @@ def aot_dispatchable(*values) -> bool:
 
 
 class AotFunction:
-    """A function with a per-signature compiled-executable cache."""
+    """A function with a per-signature compiled-executable cache.
+
+    ``donate_argnums`` passes through to the underlying ``jax.jit``: the
+    named dynamic arguments' buffers are DONATED to the executable
+    (input/output aliasing), so an in-place-shaped update like the tiled
+    build's append-scatter writes into the existing block instead of
+    copying it.  Donated buffers are invalidated by the call — callers must
+    rebind from the outputs and must not pass donated args that alias live
+    state elsewhere (``neighbors._build.extend_device`` gates this behind
+    an explicit ``in_place`` opt-in for exactly that reason).  Donation
+    does not interact with shape bucketing (a padded leaf is a fresh
+    buffer); combining ``bucket=True`` with donation is rejected."""
 
     def __init__(self, fn: Callable, static_argnums: Tuple[int, ...] = (),
-                 bucket: bool = False):
+                 bucket: bool = False,
+                 donate_argnums: Tuple[int, ...] = ()):
         self._fn = fn
         self._static = tuple(static_argnums)
         self._bucket = bucket
+        self._donate = tuple(donate_argnums)
+        if self._donate and bucket:
+            raise ValueError("aot: donate_argnums is incompatible with "
+                             "bucket=True (padding would donate a fresh "
+                             "pad buffer, not the caller's)")
         self._cache: Dict[Any, Any] = {}
         functools.update_wrapper(self, fn)
 
@@ -283,7 +300,8 @@ class AotFunction:
                 f"compiles:{getattr(self._fn, '__qualname__', repr(self._fn))}"
             ] += 1
             _ensure_persistent_cache()
-            jitted = jax.jit(self._fn, static_argnums=self._static)
+            jitted = jax.jit(self._fn, static_argnums=self._static,
+                             donate_argnums=self._donate)
             lower_args = [
                 a if i in self._static
                 else jax.tree_util.tree_map(self._leaf_struct, a)
@@ -378,12 +396,15 @@ def mesh_aot(fn: Callable, *, static_argnums: Tuple[int, ...] = ()
 
 
 def aot(fn: Optional[Callable] = None, *, static_argnums: Tuple[int, ...] = (),
-        bucket: bool = False):
+        bucket: bool = False, donate_argnums: Tuple[int, ...] = ()):
     """Decorator: AOT-compile *fn* per (shape-bucket, dtype) signature.
 
     NB with ``bucket=True`` the caller must treat rows beyond the original
-    leading dim as padding in the result.
+    leading dim as padding in the result.  ``donate_argnums`` donates the
+    named dynamic args' buffers to the executable (see
+    :class:`AotFunction`) — the caller's arrays are invalidated by the call.
     """
     if fn is None:
-        return lambda f: AotFunction(f, static_argnums, bucket)
-    return AotFunction(fn, static_argnums, bucket)
+        return lambda f: AotFunction(f, static_argnums, bucket,
+                                     donate_argnums)
+    return AotFunction(fn, static_argnums, bucket, donate_argnums)

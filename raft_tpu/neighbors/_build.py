@@ -1,0 +1,495 @@
+"""Tiled, device-resident inverted-list index construction
+(docs/index_build.md; ISSUE 7).
+
+The pre-PR ``build()``/``extend()`` populate was monolithic and eager: the
+whole dataset's residuals, encode distances and bit tensors materialized at
+dataset size across several separate dispatches, and the packed blocks were
+assembled through host-side label fetches — the opposite of the reference's
+batched ``ivf_pq::build`` ingest (ivf_pq_build.cuh processes the dataset in
+capped batches).  This module is the shared tiled engine both IVF families
+populate through:
+
+* **Per-tile programs through the AOT cache** — the per-backend tile kernel
+  (assign → residual → encode → bit-pack → csum for PQ; the raw row payload
+  for flat) runs as ONE fused executable per fixed (tile, dim) shape, driven
+  by a host tile loop (:func:`run_tiles`).  The ragged tail pads up to the
+  tile and slices the result, so every step (and every later build/extend of
+  the same shape) dispatches the SAME warm executable —
+  ``core.aot.aot_compile_counters`` stays flat on repeat builds.  Peak
+  transient memory is O(tile), independent of the dataset
+  (``Compiled.memory_analysis().temp_size_in_bytes`` is asserted in-bench).
+
+* **Device-side packing** — list slots come from one rank/table-lookup
+  program (:func:`_list_slots_impl`) and one scatter program
+  (:func:`_scatter_new_impl`); only the (n_lists,)-shaped chunk-table
+  bookkeeping (``_common.chunk_layout`` / ``_common.extend_layout``) runs on
+  host.  A ci/lint.py rule bans host transfers module-wide outside
+  ``host-ok``-marked bookkeeping lines (the ann_mnmg rule, extended here).
+
+* **In-place extend** — :func:`extend_device` appends new rows into each
+  list's free tail slots via a buffer-DONATED scatter when no list overflows
+  (``in_place=True``), or into the grown block otherwise; either way the
+  old decode/repack round trip is gone.
+
+* **Direct-to-shard populate** — :func:`populate_sharded` runs the same
+  tile kernel as a ``shard_map`` program over a communicator's mesh: each
+  device encodes and packs ONLY the rows of its round-robin list shard,
+  producing per-shard blocks bit-identical to
+  ``build(...).shard(comms)``'s without the full packed index ever
+  existing on one device.
+
+Nothing here depends on a specific index family: the PQ/flat tile kernels
+live in their own modules and thread through as callables + AOT handles.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.aot import MeshAotFunction, aot, aot_dispatchable
+from raft_tpu.neighbors._common import (
+    ChunkLayout,
+    _ranks_within,
+    chunk_layout,
+    device_counts,
+    extend_layout,
+)
+
+#: Trace-time counters (the ``ivf_pq.lut_trace_counters`` pattern): each
+#: key increments once per TRACE of the named program, so tests can assert
+#: that warm builds/extends trace nothing (``aot_compile_counters`` pins the
+#: compile side; these pin the trace side even for jit fallbacks).
+build_trace_counters: collections.Counter = collections.Counter()
+
+#: Default per-tile row count for the build/extend populate loop.  At the
+#: default IVF-PQ shapes (pq_dim 16–32, 8-bit codebooks) the per-tile encode
+#: transient is tile·pq_dim·256·4 B ≈ 0.5–1 GiB/8192 rows on f32 — bounded
+#: and cache-friendly where the monolithic path's dataset-sized transient
+#: scales with n.  Override per call (``tile_rows=``) or process-wide with
+#: ``RAFT_TPU_BUILD_TILE``.
+DEFAULT_TILE_ROWS = 8192
+
+
+def tiled_build_enabled() -> bool:
+    """``RAFT_TPU_TILED_BUILD`` env gate (default ON).
+    ``RAFT_TPU_TILED_BUILD=0`` restores the pre-PR monolithic populate for
+    A/B measurement, mirroring ``RAFT_TPU_HOISTED_LUT`` /
+    ``RAFT_TPU_FUSED_EM``."""
+    return os.environ.get("RAFT_TPU_TILED_BUILD", "1") != "0"
+
+
+def resolve_tiled(tiled: Optional[bool]) -> bool:
+    """Per-call override (``build(..., tiled=)``) falling back to the env
+    gate — the ``SearchParams.hoisted_lut`` pattern."""
+    return tiled_build_enabled() if tiled is None else bool(tiled)
+
+
+def resolve_tile_rows(n: int, tile_rows: Optional[int] = None) -> int:
+    """Effective tile size: explicit arg > env > default, clamped to
+    [8, max(n, 1)] so a tile larger than the dataset runs as one step."""
+    t = tile_rows if tile_rows is not None else int(
+        os.environ.get("RAFT_TPU_BUILD_TILE", DEFAULT_TILE_ROWS))
+    return max(8, min(int(t), max(int(n), 1)))
+
+
+def _dispatch(jit_fn: Callable, aot_fn: Callable, *args):
+    """Eager-path executable dispatch: the AOT cache when every input is a
+    concrete default-device array, the jit twin otherwise (tracers,
+    off-device inputs) — the ivf_flat/ivf_pq `_search_batch` pattern."""
+    return (aot_fn if aot_dispatchable(*args) else jit_fn)(*args)
+
+
+# ---------------------------------------------------------------------------
+# device-side packing programs
+
+
+def _list_slots_impl(labels, fill0, table, cap: int, n_lists: int):
+    """Flat slot of every row in the (n_rows, cap) physical block:
+    ``rank = fill0[label] + rank-within-label`` (``fill0`` is 0 for a fresh
+    pack, the old logical sizes for an extend), chunk ordinal ``rank//cap``
+    resolved through the chunk table.  The rank/scatter machinery of
+    ``pack_lists_chunked``, now one device program — no per-row data
+    touches host."""
+    build_trace_counters["list_slots"] += 1
+    n = labels.shape[0]
+    rank = fill0[labels] + _ranks_within(labels, n, n_lists)
+    phys = table[labels, rank // cap]
+    return (phys * cap + rank % cap).astype(jnp.int32)
+
+
+def _scatter_new_impl(payloads: Tuple, ids, flat, n_rows: int, cap: int):
+    """Build fresh (n_rows, cap, …) padded blocks from per-row payloads +
+    precomputed flat slots.  Out-of-range slots (sharded pads) drop."""
+    build_trace_counters["scatter_new"] += 1
+    datas = []
+    for p in payloads:
+        tail = p.shape[1:]
+        d = jnp.zeros((n_rows * cap,) + tail, p.dtype
+                      ).at[flat].set(p, mode="drop")
+        datas.append(d.reshape((n_rows, cap) + tail))
+    idx = jnp.full((n_rows * cap,), -1, jnp.int32
+                   ).at[flat].set(ids.astype(jnp.int32), mode="drop"
+                                  ).reshape(n_rows, cap)
+    return tuple(datas), idx
+
+
+def _scatter_append_impl(datas: Tuple, idx, payloads: Tuple, ids, flat):
+    """Append per-row payloads into EXISTING blocks at precomputed flat
+    slots.  Compiled with donated block buffers (the in-place extend path)
+    or without (the functional copy path) — same trace either way."""
+    build_trace_counters["scatter_append"] += 1
+    out = []
+    for d, p in zip(datas, payloads):
+        tail = d.shape[2:]
+        out.append(d.reshape((-1,) + tail).at[flat].set(
+            p.astype(d.dtype), mode="drop").reshape(d.shape))
+    idx2 = idx.reshape(-1).at[flat].set(
+        ids.astype(jnp.int32), mode="drop").reshape(idx.shape)
+    return tuple(out), idx2
+
+
+_SLOTS_STATICS = (3, 4)
+_list_slots = jax.jit(_list_slots_impl, static_argnums=_SLOTS_STATICS)
+_list_slots_aot = aot(_list_slots_impl, static_argnums=_SLOTS_STATICS)
+
+_SCATTER_STATICS = (3, 4)
+_scatter_new = jax.jit(_scatter_new_impl, static_argnums=_SCATTER_STATICS)
+_scatter_new_aot = aot(_scatter_new_impl, static_argnums=_SCATTER_STATICS)
+
+_scatter_append = jax.jit(_scatter_append_impl)
+_scatter_append_aot = aot(_scatter_append_impl)
+# donated twins: blocks (args 0, 1) alias into the outputs — callers pass
+# buffers they own (freshly grown blocks, or the caller opted in_place)
+_scatter_append_dn = jax.jit(_scatter_append_impl, donate_argnums=(0, 1))
+_scatter_append_dn_aot = aot(_scatter_append_impl, donate_argnums=(0, 1))
+
+
+# ---------------------------------------------------------------------------
+# the host tile loop
+
+
+def run_tiles(tile_jit: Callable, tile_aot: Callable, x, labels,
+              extra_args: Tuple = (), statics: Tuple = (),
+              tile_rows: Optional[int] = None) -> Tuple:
+    """Drive a per-tile kernel over the dataset's rows through the AOT
+    executable cache.
+
+    ``tile_*(x_t, labels_t, *extra_args, *statics)`` must return a tuple of
+    per-row outputs (leading dim == tile).  Every full tile dispatches one
+    fixed-shape executable; the ragged tail pads up to the tile and slices
+    the result, so an n of any residue reuses the same two executables at
+    most (one when n ≤ tile).  Per-row outputs are concatenated back to
+    (n, …) device arrays — O(n·payload) like the final index, while the
+    kernel's transients stay O(tile)."""
+    n = x.shape[0]
+    tile = resolve_tile_rows(n, tile_rows)
+    outs = []
+    for t0 in range(0, n, tile):
+        t1 = min(t0 + tile, n)
+        w = t1 - t0
+        xt, lt = x[t0:t1], labels[t0:t1]
+        if w < tile:
+            xt = jnp.pad(xt, ((0, tile - w),) + ((0, 0),) * (xt.ndim - 1))
+            lt = jnp.pad(lt, ((0, tile - w),))
+        res = _dispatch(tile_jit, tile_aot, xt, lt, *extra_args, *statics)
+        if not isinstance(res, tuple):
+            res = (res,)
+        if w < tile:
+            res = tuple(r[:w] for r in res)
+        outs.append(res)
+    if not outs:
+        raise ValueError("run_tiles: empty dataset")
+    if len(outs) == 1:
+        return outs[0]
+    return tuple(jnp.concatenate(parts, axis=0) for parts in zip(*outs))
+
+
+# ---------------------------------------------------------------------------
+# single-device device-side pack / extend
+
+
+def pack_device(payload, ids, labels, n_lists: int,
+                chunk_cap: Optional[int] = None, quantile: float = 0.9):
+    """Device-side twin of ``_common.pack_lists_chunked`` (same return
+    contract): counts accumulate on device, the (n_lists,)-shaped layout
+    derives on host (``chunk_layout``), and the rank + scatter run as two
+    cached device programs.  Payload rows and ids stay on device end to
+    end."""
+    multi = isinstance(payload, (tuple, list))
+    payloads = tuple(payload) if multi else (payload,)
+    n = payloads[0].shape[0]
+    counts = (device_counts(labels, n_lists) if n
+              else np.zeros(n_lists, np.int64))
+    lay = chunk_layout(counts, chunk_cap, quantile)
+    labels_d = jnp.asarray(labels).astype(jnp.int32)
+    ids_d = jnp.asarray(ids, jnp.int32)
+    table_d = jnp.asarray(lay.chunk_table)
+    fill0 = jnp.zeros((n_lists,), jnp.int32)
+    flat = _dispatch(_list_slots, _list_slots_aot, labels_d, fill0, table_d,
+                     lay.cap, n_lists)
+    datas, idx = _dispatch(_scatter_new, _scatter_new_aot, payloads, ids_d,
+                           flat, lay.n_phys + 1, lay.cap)
+    return (datas if multi else datas[0], idx,
+            jnp.asarray(lay.phys_sizes),
+            jnp.asarray(lay.counts.astype(np.int32)),
+            table_d, jnp.asarray(lay.owner), lay.cap)
+
+
+def extend_device(data, idx, list_sizes, chunk_table, payload_new, ids_new,
+                  labels_new, in_place: bool = False):
+    """Device-side twin of ``_common.extend_lists_chunked`` (same return
+    contract): new rows append into each list's free tail slots through the
+    cached slot/scatter programs.
+
+    When no list overflows its chunks (``m == 0``) the blocks keep their
+    shape and the scatter can run IN PLACE: with ``in_place=True`` the
+    input blocks' buffers are DONATED to the executable, so the append
+    costs O(n_new) instead of an O(index) copy — but the caller's old
+    index becomes invalid (its leaves are consumed).  The default keeps
+    the functional contract (copying scatter).  When lists DO overflow,
+    the grown block is a fresh buffer and is always donated into the
+    scatter (no second copy)."""
+    multi = isinstance(data, (tuple, list))
+    datas = tuple(data) if multi else (data,)
+    payloads_new = tuple(payload_new) if multi else (payload_new,)
+    n_lists, _ = chunk_table.shape
+    cap = datas[0].shape[1]
+    n_phys = datas[0].shape[0] - 1
+    n_new = payloads_new[0].shape[0]
+
+    counts_old = np.asarray(list_sizes).astype(np.int64)  # host-ok (n_lists,)
+    added = (device_counts(labels_new, n_lists) if n_new
+             else np.zeros(n_lists, np.int64))
+    table_h = np.asarray(chunk_table)  # host-ok: (n_lists, max_chunks) table
+    lay = extend_layout(counts_old, added, cap, table_h, n_phys)
+    m = lay.m
+
+    labels_d = jnp.asarray(labels_new).astype(jnp.int32)
+    ids_d = jnp.asarray(ids_new, jnp.int32)
+    table_d = jnp.asarray(lay.chunk_table)
+    fill0 = jnp.asarray(counts_old.astype(np.int32))
+    flat = _dispatch(_list_slots, _list_slots_aot, labels_d, fill0, table_d,
+                     cap, n_lists)
+
+    if m:
+        datas2 = tuple(jnp.concatenate(
+            [d[:n_phys], jnp.zeros((m + 1, cap) + d.shape[2:], d.dtype)],
+            axis=0) for d in datas)
+        idx2 = jnp.concatenate(
+            [idx[:n_phys], jnp.full((m + 1, cap), -1, jnp.int32)], axis=0)
+        donate = True  # the grown blocks are temporaries we own
+    else:
+        datas2, idx2 = datas, idx
+        donate = bool(in_place)
+    if n_new:
+        if donate:
+            datas2, idx2 = _dispatch(_scatter_append_dn,
+                                     _scatter_append_dn_aot, datas2, idx2,
+                                     payloads_new, ids_d, flat)
+        else:
+            datas2, idx2 = _dispatch(_scatter_append, _scatter_append_aot,
+                                     datas2, idx2, payloads_new, ids_d, flat)
+    return (datas2 if multi else datas2[0], idx2,
+            jnp.asarray(lay.phys_sizes),
+            jnp.asarray(lay.counts_total.astype(np.int32)),
+            table_d, jnp.asarray(lay.owner), cap)
+
+
+# ---------------------------------------------------------------------------
+# direct-to-shard populate (shard_map; one program per tile step + one
+# per-shard scatter — docs/index_build.md §sharded)
+
+
+def _shard_rows(labels_h: np.ndarray, world: int):
+    """Host routing tables for the round-robin list partition: row i goes
+    to shard ``labels[i] % world``.  Returns (idxm (world, rows_max) int64
+    row indices, dataset order within each shard, 0-padded; cnt (world,)
+    valid counts).  O(n) int bookkeeping on the (n,) label vector — the
+    only per-row host work in the sharded populate."""
+    shard = labels_h % world
+    order = np.argsort(shard, kind="stable")
+    cnt = np.bincount(shard, minlength=world).astype(np.int64)
+    rows_max = max(int(cnt.max()) if world else 0, 1)
+    idxm = np.zeros((world, rows_max), np.int64)
+    s0 = 0
+    for s in range(world):
+        idxm[s, :cnt[s]] = order[s0:s0 + cnt[s]]
+        s0 += int(cnt[s])
+    return idxm, cnt
+
+
+def _cached_mesh_program(comms, key, builder) -> MeshAotFunction:
+    from raft_tpu.cluster.kmeans_mnmg import _cached_program
+
+    return _cached_program(comms, ("tiled_build",) + tuple(key), builder)
+
+
+def shard_tile_program(comms, key, core: Callable, n_margs: int,
+                       n_out: int) -> MeshAotFunction:
+    """One shard_map per-tile stage: every device runs *core* on ITS
+    (1, tile, …) row block against *n_margs* replicated trailing tables —
+    collective-free by construction (row-local math only).  Call signature
+    of the returned program: ``(rows_g, labels_g, *margs_g)`` with the two
+    leading args sharded ``P(axis)`` and the rest replicated.  One cached
+    MeshAotFunction per (communicator, *key*) — the per-backend populate
+    stages (encode/pack, csum) each get their OWN program so their
+    rounding matches the single-device tile programs' exactly (fusing the
+    stages into one program measurably changes the csum's last-ulp
+    rounding vs the monolithic trace — see ivf_pq._csum_tile_impl)."""
+    from jax.sharding import PartitionSpec as P
+
+    from raft_tpu.comms.comms import shard_map_compat
+
+    def build():
+        def program(xt, lt, *margs):
+            out = core(xt[0], lt[0], *margs)
+            out = out if isinstance(out, tuple) else (out,)
+            return tuple(o[None] for o in out)
+
+        in_specs = (P(comms.axis_name), P(comms.axis_name)) + (P(),) * n_margs
+        out_specs = (P(comms.axis_name),) * n_out
+        mapped = shard_map_compat(program, comms.mesh, in_specs, out_specs)
+        return MeshAotFunction(mapped)
+
+    return _cached_mesh_program(comms, ("stage",) + tuple(key), build)
+
+
+def _shard_scatter_program(comms, key, n_steps: int, n_payloads: int,
+                           rows_max: int, local_rows: int,
+                           cap: int) -> MeshAotFunction:
+    """One shard_map scatter: each device concatenates its per-step payload
+    parts and builds its LOCAL (local_rows+1, cap, …) blocks — the only
+    place the packed shard blocks ever exist, already device-local."""
+    from jax.sharding import PartitionSpec as P
+
+    from raft_tpu.comms.comms import shard_map_compat
+
+    def build():
+        def program(parts, ids_m, flat_m):
+            pay = tuple(
+                jnp.concatenate([step[j][0] for step in parts],
+                                axis=0)[:rows_max]
+                for j in range(n_payloads))
+            datas, idx = _scatter_new_impl(pay, ids_m[0], flat_m[0],
+                                           local_rows + 1, cap)
+            return tuple(d[None] for d in datas), idx[None]
+
+        ax = P(comms.axis_name)
+        mapped = shard_map_compat(program, comms.mesh, (ax, ax, ax),
+                                  ((ax,) * n_payloads, ax))
+        return MeshAotFunction(mapped)
+
+    return _cached_mesh_program(
+        comms, ("scatter", n_steps, n_payloads, rows_max, local_rows, cap)
+        + tuple(key), build)
+
+
+def populate_sharded(comms, x, labels, ids, lay: ChunkLayout,
+                     tile_fn: Optional[Callable], n_payloads: int,
+                     key: Tuple, tile_rows: Optional[int] = None):
+    """Direct-to-shard tiled populate: encode/pack each round-robin list
+    shard ON its own device, bit-identical to ``build(...).shard(comms)``.
+
+    *lay* is the GLOBAL chunk layout (from the device-accumulated counts);
+    the round-robin partition of it (``ann_mnmg._partition``) defines each
+    shard's local chunk table and row budget exactly as ``Index.shard``
+    would.  Per tile step, each shard's next row block is gathered on the
+    build device (O(world·tile·dim) transient — the dataset itself stays
+    wherever the caller put it), distributed with ``P(axis)``, and encoded
+    by the shard_map tile program; one final per-shard scatter program
+    assembles the local blocks in place on each device.  The full padded
+    index never exists on any single device.
+
+    Returns ``(stacked_payloads, stacked_idx, stacked_phys, stacked_tables,
+    stacked_owner, probe_extra, local_rows)`` where the stacked leaves are
+    mesh-resident (world, …) arrays laid out shard-per-device and the rest
+    is host bookkeeping, matching ``ann_mnmg._partition``'s contract.
+    ``tile_fn(x_step, labels_step) -> payload tuple`` maps one globalized
+    (world, tile, dim) row block to its per-row payloads, dispatching the
+    caller's cached :func:`shard_tile_program` stages (``None`` stores the
+    raw rows, the IVF-Flat case)."""
+    from jax.sharding import PartitionSpec as P
+
+    from raft_tpu.neighbors import ann_mnmg
+
+    world = comms.get_size()
+    n = x.shape[0]
+    n_lists = lay.chunk_table.shape[0]
+    cap = lay.cap
+    gather, local_tables, probe_extra, local_rows = ann_mnmg._partition(
+        lay.chunk_table, lay.n_phys + 1, world)
+
+    labels_h = np.asarray(labels)  # host-ok: (n,) int32 shard routing table
+    idxm, cnt = _shard_rows(labels_h, world)
+    rows_max = idxm.shape[1]
+    tile = resolve_tile_rows(rows_max, tile_rows)
+
+    # global list ranks: the SAME rank program as the single-device pack,
+    # so each row's (chunk, slot) matches the monolithic layout exactly
+    labels_d = jnp.asarray(labels).astype(jnp.int32)
+    fill0 = jnp.zeros((n_lists,), jnp.int32)
+    table_d = jnp.asarray(lay.chunk_table)
+    flat_g = _dispatch(_list_slots, _list_slots_aot, labels_d, fill0,
+                       table_d, cap, n_lists)
+
+    idxm_d = jnp.asarray(idxm)
+    tables_d = jnp.asarray(local_tables)                # (world, L, mc)
+    labels_m = labels_d[idxm_d]                         # (world, rows_max)
+    ids_m = jnp.asarray(ids, jnp.int32)[idxm_d]
+    # local slot: the global slot re-derives (chunk ordinal, slot) and
+    # resolves through the SHARD-LOCAL table — same formula, local rows
+    phys_g = flat_g // cap
+    slot_g = flat_g % cap
+    # chunk ordinal of each row within its list = phys_g - starts[label]
+    starts_d = jnp.asarray(lay.starts[:n_lists].astype(np.int32))
+    cord = phys_g - starts_d[labels_d]
+    cord_m = cord[idxm_d]
+    slot_m = slot_g[idxm_d]
+    sidx = jnp.arange(world, dtype=jnp.int32)[:, None]
+    phys_l = tables_d[sidx, labels_m, cord_m]           # (world, rows_max)
+    valid = (jnp.arange(rows_max, dtype=jnp.int32)[None, :]
+             < jnp.asarray(cnt.astype(np.int32))[:, None])
+    oob = jnp.int32((local_rows + 1) * cap)             # dropped by scatter
+    flat_m = jnp.where(valid, phys_l * cap + slot_m, oob).astype(jnp.int32)
+
+    ax = P(comms.axis_name)
+    ids_m_g = comms.globalize(ids_m, ax)
+    flat_m_g = comms.globalize(flat_m, ax)
+
+    parts = []
+    for t0 in range(0, rows_max, tile):
+        t1 = min(t0 + tile, rows_max)
+        sel = idxm_d[:, t0:t1]
+        if t1 - t0 < tile:  # pad the tail step to the fixed tile shape;
+            # padded slots gather row 0 and their flat_m entries are OOB
+            sel = jnp.pad(sel, ((0, 0), (0, tile - (t1 - t0))))
+        xt = jnp.take(x, sel.reshape(-1), axis=0
+                      ).reshape(world, tile, x.shape[1])
+        xt_g = comms.globalize(xt, ax)
+        if tile_fn is None:
+            parts.append((xt_g,))
+        else:
+            lt = labels_d[sel.reshape(-1)].reshape(world, tile)
+            lt_g = comms.globalize(lt, ax)
+            out = tile_fn(xt_g, lt_g)
+            parts.append(out if isinstance(out, tuple) else (out,))
+
+    scat = _shard_scatter_program(comms, key, len(parts), n_payloads,
+                                  rows_max, local_rows, cap)
+    stacked_payloads, stacked_idx = scat(tuple(parts), ids_m_g, flat_m_g)
+
+    # per-shard size/owner inverses: gathered from the global layout's host
+    # tables — identical to what Index.shard's _stack_shards produces
+    phys_l_h = lay.phys_sizes[gather]
+    owner_l_h = lay.owner[gather]
+    stacked_phys = comms.globalize(jnp.asarray(phys_l_h), ax)
+    stacked_owner = comms.globalize(jnp.asarray(owner_l_h), ax)
+    stacked_tables = comms.globalize(jnp.asarray(local_tables), ax)
+    return (stacked_payloads, stacked_idx, stacked_phys, stacked_tables,
+            stacked_owner, int(probe_extra), int(local_rows))

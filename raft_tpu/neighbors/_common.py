@@ -8,6 +8,7 @@ lands everywhere.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Callable, Optional, Tuple
 
 import jax
@@ -15,6 +16,140 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.matrix.select_k import merge_sorted_runs, select_k
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkLayout:
+    """Host-side chunked-list layout derived from (n_lists,) counts alone —
+    the ONE implementation of the chunk-table arithmetic, shared by
+    :func:`pack_lists_chunked` (monolithic populate), the tiled
+    device-resident build (``neighbors._build``) and the sharded direct
+    build (which runs :func:`raft_tpu.neighbors.ann_mnmg._partition` over
+    ``chunk_table``).  All fields are numpy; nothing here touches device
+    data, so deriving a layout costs O(n_lists) host work regardless of
+    dataset size."""
+
+    cap: int                    # per-chunk capacity (multiple of 8)
+    n_phys: int                 # real physical rows (block has n_phys + 1)
+    max_chunks: int
+    counts: np.ndarray          # (n_lists,) int64 logical sizes
+    starts: np.ndarray          # (n_lists + 1,) int64 first chunk per list
+    chunk_table: np.ndarray     # (n_lists, max_chunks) int32, dummy-padded
+    owner: np.ndarray           # (n_phys + 1,) int32
+    phys_sizes: np.ndarray      # (n_phys + 1,) int32
+
+
+def chunk_layout(counts: np.ndarray, chunk_cap: Optional[int] = None,
+                 quantile: float = 0.9) -> ChunkLayout:
+    """Chunked-list layout from logical list sizes (see :class:`ChunkLayout`).
+
+    cap policy: the *quantile* of nonzero list sizes, rounded up to the TPU
+    sublane (8) — most lists fit one chunk, outliers split (the
+    pack_lists_chunked policy, now factored so the tiled build can derive
+    tables from a device-accumulated (n_lists,) bincount without ever
+    fetching per-row data to host)."""
+    counts = np.asarray(counts).astype(np.int64)
+    n_lists = counts.shape[0]
+    if chunk_cap is None:
+        nz = counts[counts > 0]
+        q = int(np.percentile(nz, quantile * 100)) if nz.size else 8
+        chunk_cap = max(8, -(-q // 8) * 8)
+    cap = int(chunk_cap)
+    n_chunks = np.maximum(-(-counts // cap), 1)  # empty lists keep 1 row
+    max_chunks = int(n_chunks.max()) if n_lists else 1
+    starts = np.zeros(n_lists + 1, np.int64)
+    np.cumsum(n_chunks, out=starts[1:])
+    n_phys = int(starts[-1])
+    dummy = n_phys  # reserved empty physical row
+
+    owner = np.zeros(n_phys + 1, np.int32)
+    owner[:n_phys] = np.repeat(np.arange(n_lists, dtype=np.int32), n_chunks)
+    chunk_ord = np.arange(n_phys) - starts[owner[:n_phys]]
+    phys_sizes = np.zeros(n_phys + 1, np.int32)
+    phys_sizes[:n_phys] = np.minimum(
+        cap, np.maximum(0, counts[owner[:n_phys]] - chunk_ord * cap))
+    chunk_table = np.full((n_lists, max_chunks), dummy, np.int32)
+    chunk_table[owner[:n_phys], chunk_ord] = np.arange(n_phys,
+                                                       dtype=np.int32)
+    return ChunkLayout(cap=cap, n_phys=n_phys, max_chunks=max_chunks,
+                       counts=counts, starts=starts, chunk_table=chunk_table,
+                       owner=owner, phys_sizes=phys_sizes)
+
+
+@dataclasses.dataclass(frozen=True)
+class ExtendLayout:
+    """Host-side table update for an incremental extend (see
+    :func:`extend_layout`): the grown chunk table plus the recomputed
+    owner/size inverses.  ``m`` is the number of NEW physical chunks — when
+    0 and ``max_chunks2 == max_chunks`` the existing blocks can be appended
+    into in place (no growth copy)."""
+
+    m: int
+    max_chunks2: int
+    counts_total: np.ndarray     # (n_lists,) int64
+    chunk_table: np.ndarray      # (n_lists, max_chunks2) int32
+    owner: np.ndarray            # (n_phys + m + 1,) int32
+    phys_sizes: np.ndarray       # (n_phys + m + 1,) int32
+
+
+def extend_layout(counts_old: np.ndarray, added: np.ndarray, cap: int,
+                  chunk_table: np.ndarray, n_phys: int) -> ExtendLayout:
+    """Grow a chunked layout by per-list row additions — the ONE table
+    arithmetic for extend, shared by :func:`extend_lists_chunked` and the
+    tiled device-side extend (``neighbors._build.extend_device``).  All
+    inputs/outputs are (n_lists,)-shaped host bookkeeping; *n_phys* is the
+    old block's real-row count (its leading dim minus the reserved dummy)."""
+    n_lists, max_chunks = chunk_table.shape
+    counts_old = np.asarray(counts_old).astype(np.int64)
+    added = np.asarray(added).astype(np.int64)
+    counts_total = counts_old + added
+    chunks_old = np.maximum(-(-counts_old // cap), 1)
+    chunks_total = np.maximum(-(-counts_total // cap), 1)
+    added_chunks = chunks_total - chunks_old
+    m = int(added_chunks.sum())
+    dummy_old = int(n_phys)
+    dummy_new = n_phys + m
+
+    table2 = np.full((n_lists, max(max_chunks,
+                                   int(chunks_total.max()) if n_lists else 1)),
+                     dummy_new, np.int32)
+    max_chunks2 = table2.shape[1]
+    table2[:, :max_chunks] = np.where(chunk_table == dummy_old, dummy_new,
+                                      chunk_table)
+    if m:
+        new_owner = np.repeat(np.arange(n_lists, dtype=np.int32),
+                              added_chunks)
+        starts_added = np.zeros(n_lists + 1, np.int64)
+        np.cumsum(added_chunks, out=starts_added[1:])
+        ord_within = np.arange(m) - starts_added[new_owner]
+        chunk_ord_new = chunks_old[new_owner] + ord_within
+        table2[new_owner, chunk_ord_new] = (n_phys
+                                            + np.arange(m, dtype=np.int32))
+
+    # owner + per-chunk live sizes, recomputed from the table inverse
+    # (physical rows of a list are not contiguous after an extend)
+    owner2 = np.zeros(dummy_new + 1, np.int32)
+    phys_sizes2 = np.zeros(dummy_new + 1, np.int32)
+    real = table2 != dummy_new
+    rows_l, ords = np.nonzero(real)
+    phys_ids = table2[rows_l, ords]
+    owner2[phys_ids] = rows_l.astype(np.int32)
+    phys_sizes2[phys_ids] = np.minimum(
+        cap, np.maximum(0, counts_total[rows_l] - ords * cap)).astype(np.int32)
+    return ExtendLayout(m=m, max_chunks2=max_chunks2,
+                        counts_total=counts_total, chunk_table=table2,
+                        owner=owner2, phys_sizes=phys_sizes2)
+
+
+def device_counts(labels, n_lists: int) -> np.ndarray:
+    """(n_lists,) logical list sizes: accumulated ON DEVICE (one bincount),
+    with only the (n_lists,)-shaped result fetched for the host-side
+    chunk-table bookkeeping — the packing hot path never moves per-row
+    data to host (ISSUE 7 contract; the pre-PR path fetched the whole
+    (n,) label vector)."""
+    counts_d = jnp.bincount(jnp.asarray(labels).astype(jnp.int32),
+                            length=n_lists)
+    return np.asarray(counts_d).astype(np.int64)  # host-ok: (n_lists,) table
 
 
 def _ranks_within(labels, n: int, n_lists: int):
@@ -83,35 +218,15 @@ def pack_lists_chunked(payload, ids, labels, n_lists: int,
     multi = isinstance(payload, (tuple, list))
     payloads = tuple(payload) if multi else (payload,)
     n = payloads[0].shape[0]
-    labels_h = np.asarray(labels)
-    counts = np.bincount(labels_h, minlength=n_lists).astype(np.int64)
-    if chunk_cap is None:
-        nz = counts[counts > 0]
-        q = int(np.percentile(nz, quantile * 100)) if nz.size else 8
-        chunk_cap = max(8, -(-q // 8) * 8)
-    cap = int(chunk_cap)
-    n_chunks = np.maximum(-(-counts // cap), 1)  # empty lists keep 1 row
-    max_chunks = int(n_chunks.max()) if n_lists else 1
-    starts = np.zeros(n_lists + 1, np.int64)
-    np.cumsum(n_chunks, out=starts[1:])
-    n_phys = int(starts[-1])
-    dummy = n_phys  # reserved empty physical row
-
-    # vectorized table construction (build/extend run this per repack)
-    owner = np.zeros(n_phys + 1, np.int32)
-    owner[:n_phys] = np.repeat(np.arange(n_lists, dtype=np.int32),
-                               n_chunks)
-    chunk_ord = np.arange(n_phys) - starts[owner[:n_phys]]
-    phys_sizes = np.zeros(n_phys + 1, np.int32)
-    phys_sizes[:n_phys] = np.minimum(
-        cap, np.maximum(0, counts[owner[:n_phys]] - chunk_ord * cap))
-    chunk_table = np.full((n_lists, max_chunks), dummy, np.int32)
-    chunk_table[owner[:n_phys], chunk_ord] = np.arange(n_phys,
-                                                       dtype=np.int32)
+    # counts accumulate on device; only the (n_lists,) result reaches host
+    counts = device_counts(labels, n_lists) if n else np.zeros(n_lists,
+                                                               np.int64)
+    lay = chunk_layout(counts, chunk_cap, quantile)
+    cap, n_phys = lay.cap, lay.n_phys
 
     # rank within logical list → (physical row, slot)
     rank = _ranks_within(jnp.asarray(labels), n, n_lists)
-    starts_j = jnp.asarray(starts[:n_lists], jnp.int32)
+    starts_j = jnp.asarray(lay.starts[:n_lists], jnp.int32)
     phys = starts_j[labels] + rank // cap
     flat_pos = phys * cap + rank % cap
     datas = []
@@ -124,9 +239,9 @@ def pack_lists_chunked(payload, ids, labels, n_lists: int,
                    ).at[flat_pos].set(jnp.asarray(ids, jnp.int32)
                                       ).reshape(n_phys + 1, cap)
     return (tuple(datas) if multi else datas[0], idx,
-            jnp.asarray(phys_sizes),
-            jnp.asarray(counts.astype(np.int32)),
-            jnp.asarray(chunk_table), jnp.asarray(owner), cap)
+            jnp.asarray(lay.phys_sizes),
+            jnp.asarray(lay.counts.astype(np.int32)),
+            jnp.asarray(lay.chunk_table), jnp.asarray(lay.owner), cap)
 
 
 def extend_lists_chunked(data, idx, list_sizes, chunk_table,
@@ -160,47 +275,16 @@ def extend_lists_chunked(data, idx, list_sizes, chunk_table,
     n_lists, max_chunks = chunk_table.shape
     cap = data.shape[1]
     n_phys = data.shape[0] - 1          # last physical row = reserved dummy
-    dummy_old = n_phys
     n_new = payloads_new[0].shape[0]
 
-    labels_h = np.asarray(labels_new)
-    counts_old = np.asarray(list_sizes).astype(np.int64)
-    added = np.bincount(labels_h, minlength=n_lists).astype(np.int64)
-    counts_total = counts_old + added
-    chunks_old = np.maximum(-(-counts_old // cap), 1)
-    chunks_total = np.maximum(-(-counts_total // cap), 1)
-    added_chunks = chunks_total - chunks_old
-    m = int(added_chunks.sum())
-    dummy_new = n_phys + m
-
-    # --- chunk table: remap old dummy padding, place the m new chunks ---
-    max_chunks2 = max(max_chunks, int(chunks_total.max()) if n_lists else 1)
-    table_h = np.asarray(chunk_table)
-    table2 = np.full((n_lists, max_chunks2), dummy_new, np.int32)
-    table2[:, :max_chunks] = np.where(table_h == dummy_old, dummy_new,
-                                      table_h)
-    if m:
-        new_owner = np.repeat(np.arange(n_lists, dtype=np.int32),
-                              added_chunks)
-        starts_added = np.zeros(n_lists + 1, np.int64)
-        np.cumsum(added_chunks, out=starts_added[1:])
-        ord_within = np.arange(m) - starts_added[new_owner]
-        chunk_ord_new = chunks_old[new_owner] + ord_within
-        table2[new_owner, chunk_ord_new] = (n_phys
-                                            + np.arange(m, dtype=np.int32))
-
-    # --- owner + per-chunk live sizes, recomputed from the table inverse
-    # (physical rows of a list are no longer contiguous after an extend,
-    # so pack_lists_chunked's arange-minus-starts derivation cannot be
-    # reused on repeated extends) ---
-    owner2 = np.zeros(dummy_new + 1, np.int32)
-    phys_sizes2 = np.zeros(dummy_new + 1, np.int32)
-    real = table2 != dummy_new                       # (n_lists, max_chunks2)
-    rows_l, ords = np.nonzero(real)
-    phys_ids = table2[rows_l, ords]
-    owner2[phys_ids] = rows_l.astype(np.int32)
-    phys_sizes2[phys_ids] = np.minimum(
-        cap, np.maximum(0, counts_total[rows_l] - ords * cap)).astype(np.int32)
+    # table arithmetic: ONE implementation (extend_layout), fed by the
+    # device-accumulated (n_lists,) addition counts
+    counts_old = np.asarray(list_sizes).astype(np.int64)  # host-ok (n_lists,)
+    added = (device_counts(labels_new, n_lists) if n_new
+             else np.zeros(n_lists, np.int64))
+    lay = extend_layout(counts_old, added, cap, np.asarray(chunk_table),
+                        n_phys)
+    m = lay.m
 
     # --- payload scatter: new row (label l, rank r) lands at logical
     # position counts_old[l] + r → (chunk ordinal, slot) → physical row via
@@ -209,7 +293,7 @@ def extend_lists_chunked(data, idx, list_sizes, chunk_table,
         rank = _ranks_within(jnp.asarray(labels_new), n_new, n_lists)
         pos = jnp.asarray(counts_old, jnp.int32)[labels_new] + rank
         ci, slot = pos // cap, pos % cap
-        phys = jnp.asarray(table2)[labels_new, ci]
+        phys = jnp.asarray(lay.chunk_table)[labels_new, ci]
         flat = phys * cap + slot
     datas2 = []
     for d, p_new in zip(datas, payloads_new):
@@ -226,9 +310,9 @@ def extend_lists_chunked(data, idx, list_sizes, chunk_table,
         idx2 = idx2.reshape(-1).at[flat].set(
             jnp.asarray(ids_new, jnp.int32)).reshape(idx2.shape)
     return (tuple(datas2) if multi else datas2[0], idx2,
-            jnp.asarray(phys_sizes2),
-            jnp.asarray(counts_total.astype(np.int32)),
-            jnp.asarray(table2), jnp.asarray(owner2), cap)
+            jnp.asarray(lay.phys_sizes),
+            jnp.asarray(lay.counts_total.astype(np.int32)),
+            jnp.asarray(lay.chunk_table), jnp.asarray(lay.owner), cap)
 
 
 def expand_probes(probe_ids, chunk_table, n_rows: int,

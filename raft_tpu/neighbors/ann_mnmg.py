@@ -199,6 +199,36 @@ def _replicate(comms: Comms, leaf):
     return comms.globalize(jnp.asarray(leaf), P())
 
 
+def _ivf_flat_aux(world: int, dim: int, metric: int, n_lists: int,
+                  probe_extra: int) -> Dict[str, Any]:
+    """Static search aux for an IVF-Flat ShardedIndex — ONE builder shared
+    by :func:`shard_ivf_flat` and ``ivf_flat.build_sharded`` so the two
+    construction paths are identical by construction (program-cache keys
+    derive from these values)."""
+    return {"world": world, "dim": dim, "metric": metric,
+            "n_lists": n_lists, "probe_extra": probe_extra}
+
+
+def _ivf_pq_aux(world: int, dim: int, metric: int, n_lists: int,
+                probe_extra: int, pq_bits: int, codebook_kind: int,
+                dataset_dtype: str, pq_dim: int,
+                max_chunks: int) -> Dict[str, Any]:
+    """Static search aux for an IVF-PQ ShardedIndex — ONE builder shared by
+    :func:`shard_ivf_pq` and ``ivf_pq.build_sharded`` (see
+    :func:`_ivf_flat_aux`)."""
+    return {"world": world, "dim": dim, "metric": metric,
+            "n_lists": n_lists, "probe_extra": probe_extra,
+            "pq_bits": pq_bits, "codebook_kind": codebook_kind,
+            "dataset_dtype": dataset_dtype, "pq_dim": pq_dim,
+            # per-shard transient-cap inputs (ivf_pq.hoisted_batch_cap_dims
+            # derives its scan budget as n_probes + (n_phys − n_lists), and
+            # the sharded program's true budget is n_probes + probe_extra —
+            # feeding the LOCAL block shape would undercount it and void
+            # the ~128 MiB bound)
+            "cap_n_phys": int(n_lists + probe_extra),
+            "cap_max_chunks": int(max_chunks)}
+
+
 @traced("raft_tpu.neighbors.ann_mnmg.shard_ivf_flat")
 def shard_ivf_flat(index: ivf_flat.Index, comms) -> ShardedIndex:
     """Partition an IVF-Flat index's lists round-robin across *comms*'
@@ -215,8 +245,8 @@ def shard_ivf_flat(index: ivf_flat.Index, comms) -> ShardedIndex:
         _replicate_stacked_tables(comms, local_tables),
     )
     replicated = (_replicate(comms, index.centers),)
-    aux = {"world": world, "dim": index.dim, "metric": int(index.metric),
-           "n_lists": index.n_lists, "probe_extra": probe_extra}
+    aux = _ivf_flat_aux(world, index.dim, int(index.metric), index.n_lists,
+                        probe_extra)
     return ShardedIndex("ivf_flat", comms, replicated, stacked, aux)
 
 
@@ -252,19 +282,10 @@ def shard_ivf_pq(index: ivf_pq.Index, comms) -> ShardedIndex:
                   _replicate(comms, index.rotation),
                   _replicate(comms, index.codebooks),
                   _replicate(comms, index.list_adc))
-    aux = {"world": world, "dim": index.dim, "metric": int(index.metric),
-           "n_lists": index.n_lists, "probe_extra": probe_extra,
-           "pq_bits": int(index.pq_bits),
-           "codebook_kind": int(index.codebook_kind),
-           "dataset_dtype": index.dataset_dtype,
-           "pq_dim": int(index.pq_dim),
-           # per-shard transient-cap inputs (ivf_pq.hoisted_batch_cap_dims
-           # derives its scan budget as n_probes + (n_phys − n_lists), and
-           # the sharded program's true budget is n_probes + probe_extra —
-           # feeding the LOCAL block shape would undercount it and void
-           # the ~128 MiB bound)
-           "cap_n_phys": int(index.n_lists + probe_extra),
-           "cap_max_chunks": int(index.chunk_table.shape[1])}
+    aux = _ivf_pq_aux(world, index.dim, int(index.metric), index.n_lists,
+                      probe_extra, int(index.pq_bits),
+                      int(index.codebook_kind), index.dataset_dtype,
+                      int(index.pq_dim), int(index.chunk_table.shape[1]))
     return ShardedIndex("ivf_pq", comms, replicated, stacked, aux)
 
 

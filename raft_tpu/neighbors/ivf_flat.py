@@ -40,7 +40,10 @@ from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.distance.pairwise import (_l2_expanded, _mxu_dot, _row_norms,
                                         accum_dtype)
 from raft_tpu.matrix.select_k import select_k
+from raft_tpu.neighbors import _build
 from raft_tpu.neighbors._common import (
+    chunk_layout,
+    device_counts,
     empty_result,
     expand_probes,
     extend_lists_chunked,
@@ -166,23 +169,38 @@ def _assign_lists(q, centers, metric: DistanceType) -> jnp.ndarray:
     return min_cluster_and_distance(q, centers).key.astype(jnp.int32)
 
 
-@traced("raft_tpu.neighbors.ivf_flat.build")
-@auto_sync_handle
-def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
-    """Train + populate an IVF-Flat index (reference ``ivf_flat::build``,
-    neighbors/ivf_flat.cuh:64 → ivf_flat_build.cuh:228)."""
-    x = jnp.asarray(dataset)
-    expects(x.ndim == 2, "dataset must be (n, dim)")
-    expects(params.metric in _SUPPORTED,
-            f"ivf_flat: unsupported metric {params.metric}")
-    n = x.shape[0]
-    n_lists = min(params.n_lists, n)
+def _train_centers(params: IndexParams, x, n_lists: int):
+    """Coarse-quantizer training (shared VERBATIM by :func:`build` and
+    :func:`build_sharded` so both paths train the bit-identical model)."""
     xf = x.astype(_compute_dtype(x))
     train = subsample_trainset(xf, params.kmeans_trainset_fraction, n_lists,
                                params.seed)
     cx = _normalize_rows(train) if params.metric == DistanceType.CosineExpanded else train
     centers = build_hierarchical(RngState(params.seed), cx, n_lists,
                                  params.kmeans_n_iters)
+    return centers, xf
+
+
+@traced("raft_tpu.neighbors.ivf_flat.build")
+@auto_sync_handle
+def build(params: IndexParams, dataset, ids=None, *,
+          tiled: Optional[bool] = None, handle=None) -> Index:
+    """Train + populate an IVF-Flat index (reference ``ivf_flat::build``,
+    neighbors/ivf_flat.cuh:64 → ivf_flat_build.cuh:228).
+
+    The populate is device-resident by default (docs/index_build.md): the
+    assignment already runs at O(tile) transients through the fused-L2-NN
+    scan, and the pack routes through the cached device-side slot/scatter
+    programs (``_build.pack_device``) — no per-row host work.
+    ``tiled=False`` / ``RAFT_TPU_TILED_BUILD=0`` restores the pre-PR
+    host-bookkept pack (bit-identical results, the A/B baseline)."""
+    x = jnp.asarray(dataset)
+    expects(x.ndim == 2, "dataset must be (n, dim)")
+    expects(params.metric in _SUPPORTED,
+            f"ivf_flat: unsupported metric {params.metric}")
+    n = x.shape[0]
+    n_lists = min(params.n_lists, n)
+    centers, _ = _train_centers(params, x, n_lists)
     index = Index(centers=centers,
                   list_data=jnp.zeros((1, 8, x.shape[1]), x.dtype),
                   list_indices=jnp.full((1, 8), -1, jnp.int32),
@@ -192,7 +210,7 @@ def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
                   metric=params.metric,
                   adaptive_centers=params.adaptive_centers)
     if params.add_data_on_build:
-        index = extend(index, x, ids)
+        index = extend(index, x, ids, tiled=tiled)
     else:
         expects(ids is None,
                 "ids were passed but add_data_on_build=False stores no "
@@ -200,13 +218,65 @@ def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
     return index
 
 
-def extend(index: Index, new_vectors, new_ids=None) -> Index:
+@traced("raft_tpu.neighbors.ivf_flat.build_sharded")
+def build_sharded(params: IndexParams, dataset, comms, ids=None, *,
+                  tile_rows: Optional[int] = None):
+    """Train once (replicated) + populate DIRECT-TO-SHARD: each device of
+    *comms*' mesh packs ONLY its round-robin list shard's rows, producing
+    a :class:`raft_tpu.neighbors.ann_mnmg.ShardedIndex` bit-identical to
+    ``build(params, dataset).shard(comms)`` without the full padded index
+    ever materializing on one device (docs/index_build.md §sharded) —
+    for IVF-Flat the padded list blocks ARE the dataset-sized state, so
+    this is the capacity win sharding exists for.  *tile_rows* bounds the
+    per-step row transfer to the shards (``RAFT_TPU_BUILD_TILE``)."""
+    from raft_tpu.neighbors import ann_mnmg
+
+    comms = ann_mnmg._full_axis_comms(comms)
+    x = jnp.asarray(dataset)
+    expects(x.ndim == 2, "dataset must be (n, dim)")
+    expects(params.metric in _SUPPORTED,
+            f"ivf_flat: unsupported metric {params.metric}")
+    expects(params.add_data_on_build,
+            "build_sharded populates by construction — use "
+            "build(add_data_on_build=False) + extend + shard() for "
+            "deferred ingest")
+    n = x.shape[0]
+    n_lists = min(params.n_lists, n)
+    centers, xf = _train_centers(params, x, n_lists)
+    q = _normalize_rows(xf) if params.metric == DistanceType.CosineExpanded else xf
+    labels = _assign_lists(q, centers, params.metric)
+    if ids is None:
+        ids = jnp.arange(n, dtype=jnp.int32)
+    else:
+        ids = jnp.asarray(ids, jnp.int32)
+
+    lay = chunk_layout(device_counts(labels, n_lists))
+    key = ("ivf_flat", n_lists, str(x.dtype))
+    (stacked_pay, stacked_idx, stacked_phys, stacked_tables, _,
+     probe_extra, _) = _build.populate_sharded(
+        comms, x, labels, ids, lay, tile_fn=None, n_payloads=1, key=key,
+        tile_rows=tile_rows)
+    stacked = (stacked_pay[0], stacked_idx, stacked_phys, stacked_tables)
+    replicated = (ann_mnmg._replicate(comms, centers),)
+    aux = ann_mnmg._ivf_flat_aux(comms.get_size(), int(x.shape[1]),
+                                 int(params.metric), n_lists, probe_extra)
+    return ann_mnmg.ShardedIndex("ivf_flat", comms, replicated, stacked,
+                                 aux)
+
+
+def extend(index: Index, new_vectors, new_ids=None, *,
+           tiled: Optional[bool] = None, in_place: bool = False) -> Index:
     """Add vectors to an existing index (reference ``ivf_flat::extend``,
     ivf_flat_build.cuh:108).  Functional: returns a new Index.  INCREMENTAL
     (r5): new rows append into each list's free tail slots, only
-    overflowing lists grow a chunk (_common.extend_lists_chunked) — the
-    reference appends to the affected lists the same way; the r4 path
-    unpacked and re-sorted the whole index per extend."""
+    overflowing lists grow a chunk — the reference appends to the affected
+    lists the same way; the r4 path unpacked and re-sorted the whole index
+    per extend.  DEVICE-RESIDENT (r7, default): the append runs through
+    the cached slot/scatter programs (``_build.extend_device``), and
+    ``in_place=True`` DONATES the old blocks when no list overflows —
+    O(n_new) append, no O(index) copy, the input index is consumed.
+    ``tiled=False`` / ``RAFT_TPU_TILED_BUILD=0`` restores the pre-PR path
+    (bit-identical results)."""
     xa = jnp.asarray(new_vectors)
     expects(xa.ndim == 2 and xa.shape[1] == index.dim, "dim mismatch")
     n_new = xa.shape[0]
@@ -221,13 +291,17 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
     q = _normalize_rows(xf) if index.metric == DistanceType.CosineExpanded else xf
     labels = _assign_lists(q, index.centers, index.metric)
 
+    use_tiled = _build.resolve_tiled(tiled)
     if base:
+        ext = _build.extend_device if use_tiled else extend_lists_chunked
+        kw = {"in_place": in_place} if use_tiled else {}
         (data, idx, phys_sizes, sizes, chunk_table, _, _) = \
-            extend_lists_chunked(index.list_data, index.list_indices,
-                                 index.list_sizes, index.chunk_table,
-                                 xa, new_ids, labels)
+            ext(index.list_data, index.list_indices,
+                index.list_sizes, index.chunk_table,
+                xa, new_ids, labels, **kw)
     else:
-        data, idx, phys_sizes, sizes, chunk_table, _, _ = pack_lists_chunked(
+        pack = _build.pack_device if use_tiled else pack_lists_chunked
+        data, idx, phys_sizes, sizes, chunk_table, _, _ = pack(
             xa, new_ids, labels, index.n_lists)
     centers = index.centers
     if index.adaptive_centers:

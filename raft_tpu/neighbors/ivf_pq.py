@@ -34,7 +34,21 @@ TPU-first redesign:
   ``SearchParams.hoisted_lut=False``) restores the pre-PR in-scan path.
 - Codebook training is Lloyd k-means ``vmap``-ed over subspaces (or over
   clusters for PER_CLUSTER) — all codebooks train simultaneously on the
-  MXU instead of the reference's sequential per-subspace loop.
+  MXU instead of the reference's sequential per-subspace loop, on a
+  residual sample capped at ``IndexParams.pq_trainset_cap`` rows (the
+  reference likewise trains on a trainset fraction, ivf_pq_build.cuh).
+- TILED, device-resident populate (default; docs/index_build.md): the
+  per-row pipeline (residual → encode → bit-pack, plus the standalone
+  csum stage) runs as fused fixed-shape programs through the AOT cache —
+  peak transients are O(tile), repeated builds/extends dispatch warm
+  executables, packing is device-side, and ``build_sharded`` runs the
+  same kernels as a shard_map program that packs each round-robin list
+  shard directly on its own device (bit-identical to
+  ``build().shard(comms)``).  This mirrors the reference's batched
+  ``ivf_pq::build`` ingest (ivf_pq_build.cuh caps its batch sizes);
+  ``RAFT_TPU_TILED_BUILD=0`` / ``build(..., tiled=False)`` restores the
+  monolithic populate (bit-identical indexes, the A/B structure
+  baseline).
 - The random rotation is a QR-orthonormalized Gaussian (dim, rot_dim)
   matrix, applied as one GEMM (the reference multiplies by the same kind
   of matrix in ivf_pq_build).
@@ -66,7 +80,11 @@ from raft_tpu.cluster import build_hierarchical, min_cluster_and_distance
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.distance.pairwise import _l2_expanded, _row_norms
 from raft_tpu.matrix.select_k import select_k
+from raft_tpu.neighbors import _build
+from raft_tpu.neighbors._build import build_trace_counters
 from raft_tpu.neighbors._common import (
+    chunk_layout,
+    device_counts,
     empty_result,
     expand_probes,
     extend_lists_chunked,
@@ -135,6 +153,14 @@ class IndexParams:
     # SIFT-like model at 10k×128 pq8 nprobes=50: 0.95 vs 0.78; at 64-dim
     # pq4: 0.78 vs 0.45 — hence the default).  Requires rot_dim == dim.
     rotation_kind: str = "auto"
+    # Row cap on the residual sample the PQ codebooks train on (the
+    # reference trains codebooks on its trainset fraction, not the whole
+    # dataset — ivf_pq_build.cuh).  Datasets at or under the cap train on
+    # EVERY row (bit-identical to the pre-cap behavior); above it, a
+    # seeded uniform sample bounds the (n_train, rot_dim) training
+    # residual matrix — the populate pipeline itself never materializes
+    # dataset-sized residuals at all (tiled build, docs/index_build.md).
+    pq_trainset_cap: int = 262144
     seed: int = 1234
 
 
@@ -394,6 +420,28 @@ def _train_codebooks_subspace(key, residuals, pq_dim: int, k: int,
     return jax.vmap(lambda kk, d: _lloyd_kmeans(kk, d, k, iters))(keys, sub)
 
 
+def _cluster_sample_take(counts: np.ndarray, cap: int,
+                         rng_fill: np.random.Generator) -> np.ndarray:
+    """Per-(cluster, slot) pool position BEFORE the modulo-pool wrap.
+
+    Slot j < count keeps ``j`` — the j-th entry of the cluster's permuted
+    segment, so EVERY pool member enters the training sample exactly once
+    (full coverage, sampling without replacement; pools >= cap are
+    entirely this case, bit-identical to the r5 behavior).  Only the
+    EXCESS slots of sub-cap pools (j >= count) fill from the INDEPENDENT
+    ``rng_fill`` stream (r7): the r5 code tiled the permutation
+    cyclically there, so a tiny cluster's sample over-represented the
+    same few subvectors in a fixed deterministic pattern."""
+    n_lists = counts.shape[0]
+    j = np.arange(cap)
+    take = np.broadcast_to(j[None, :], (n_lists, cap)).copy()
+    excess = j[None, :] >= counts[:, None]              # sub-cap fill slots
+    if excess.any():
+        take[excess] = rng_fill.integers(0, 1 << 62,
+                                         size=int(excess.sum()))
+    return take
+
+
 def _train_codebooks_cluster_host(key, residuals_np, labels_np,
                                   n_lists: int, pq_dim: int, k: int,
                                   iters: int):
@@ -403,16 +451,26 @@ def _train_codebooks_cluster_host(key, residuals_np, labels_np,
 
     The sample assembly is ONE segment-shuffle + gather (r5): subvectors
     are randomly permuted within their cluster segment via a single
-    lexsort, and each cluster takes its first ``cap`` permuted entries
-    (modulo the pool size when a cluster is smaller than cap) — sampling
-    without replacement for pools >= cap, cyclic otherwise.  The r4
-    version looped ``rng.choice`` over n_lists clusters host-side —
-    O(n_lists) Python iterations, measurable at 8k lists.
+    lexsort, and each cluster takes its first ``cap`` permuted entries —
+    sampling without replacement for pools >= cap.  Sub-cap pools draw
+    their cap indices modulo the pool from an INDEPENDENT random stream
+    (r7): the r5/r6 code tiled one permutation cyclically
+    (``arange(cap) % count``), so a tiny cluster's sample was the same few
+    subvectors repeated in a deterministic pattern — the fill draw is now
+    random per (cluster, slot), seeded from the build key (seed-stable).
+    Pools >= cap are bit-identical to the r5 behavior.  The r4 version
+    looped ``rng.choice`` over n_lists clusters host-side — O(n_lists)
+    Python iterations, measurable at 8k lists.
     """
     n, rot_dim = residuals_np.shape
     ds = rot_dim // pq_dim
     cap = max(k * 4, 256)
-    rng = np.random.default_rng(int(jax.random.randint(key, (), 0, 2**31 - 1)))
+    seed0 = int(jax.random.randint(key, (), 0, 2**31 - 1))
+    rng = np.random.default_rng(seed0)
+    # independent stream for the sub-cap fill draws — offset-seeded rather
+    # than drawn from ``rng`` so the permutation stream (and with it every
+    # pool >= cap) stays bit-identical to the r5 behavior
+    rng_fill = np.random.default_rng(seed0 + 0x9E3779B9)
     # every row contributes its pq_dim subvectors to its cluster's pool
     sub = residuals_np.reshape(n * pq_dim, ds)
     lab = np.repeat(labels_np, pq_dim)
@@ -420,8 +478,8 @@ def _train_codebooks_cluster_host(key, residuals_np, labels_np,
     counts = np.bincount(lab, minlength=n_lists).astype(np.int64)
     starts = np.zeros(n_lists + 1, np.int64)
     np.cumsum(counts, out=starts[1:])
-    j = np.arange(cap)
-    gather = starts[:n_lists, None] + (j[None, :] % np.maximum(counts, 1)[:, None])
+    take = _cluster_sample_take(counts, cap, rng_fill)
+    gather = starts[:n_lists, None] + take % np.maximum(counts, 1)[:, None]
     # compose the index chains (shuf ∘ gather) — materializing sub[shuf]
     # first would copy the whole (n·pq_dim, ds) pool to read n_lists·cap rows
     batches = sub[shuf[np.minimum(gather, max(lab.shape[0] - 1, 0))]
@@ -435,10 +493,54 @@ def _train_codebooks_cluster_host(key, residuals_np, labels_np,
 
 @functools.partial(jax.jit, static_argnums=(3,))
 def _encode(residuals, codebooks, labels, per_cluster: bool):
-    """PQ-encode rotated residuals → (n, pq_dim) uint8."""
+    """PQ-encode rotated residuals → (n, pq_dim) uint8.
+
+    The cross term is a broadcast multiply-reduce over the subspace dim,
+    NOT a batched dot (r7): PQ subvectors are tiny (ds = rot_dim/pq_dim,
+    typically 2–16), so the ``nmd,mkd->nmk`` einsum lowers to rank-ds
+    batched GEMMs with no operand reuse — on XLA:CPU that materializes the
+    (n, pq_dim, 2^bits) tensor at DRAM bandwidth and measures ~3× slower
+    than the elementwise form, which fuses straight into the argmin so the
+    distance tensor never hits memory (bench.py ``ivf_build``; the tiled
+    build's O(tile) transient bound leans on this fusion).  EVERY shipped
+    populate path — tiled, monolithic (``tiled=False``) and sharded —
+    shares THIS one kernel, so tiled-vs-monolithic and sharded-vs-local
+    bit-identity hold by construction: the two lowerings differ in FMA
+    rounding of the ds-term accumulation, and degenerate sub-cap
+    PER_CLUSTER codebooks contain exact-duplicate codewords whose argmin
+    tie-break genuinely flips between lowerings (observed), so mixing
+    lowerings across pipelines is NOT sound.  The pre-PR einsum form
+    survives only as :func:`_encode_legacy`, the frozen baseline the
+    ``ivf_build`` bench A/B measures against."""
     n, rot_dim = residuals.shape
     if per_cluster:
-        k = codebooks.shape[1]
+        ds = codebooks.shape[2]
+        pq_dim = rot_dim // ds
+        sub = residuals.reshape(n, pq_dim, ds)
+        cb = codebooks[labels]                          # (n, k, ds)
+        d = (jnp.sum(sub ** 2, -1)[:, :, None]
+             + jnp.sum(cb ** 2, -1)[:, None, :]
+             - 2.0 * jnp.sum(sub[:, :, None, :] * cb[:, None, :, :], -1))
+        return jnp.argmin(d, axis=-1).astype(jnp.uint8)
+    pq_dim, k, ds = codebooks.shape
+    sub = residuals.reshape(n, pq_dim, ds)
+    d = (jnp.sum(sub ** 2, -1)[:, :, None]
+         + jnp.sum(codebooks ** 2, -1)[None, :, :]
+         - 2.0 * jnp.sum(sub[:, :, None, :]
+                         * codebooks[None, :, :, :], -1))
+    return jnp.argmin(d, axis=-1).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _encode_legacy(residuals, codebooks, labels, per_cluster: bool):
+    """The pre-r7 einsum-lowered encode, frozen VERBATIM as the
+    ``bench.py ivf_build`` A/B baseline kernel (see :func:`_encode` for
+    why the default moved off the batched dot, and why no SHIPPED populate
+    path may use this: exact-duplicate codewords tie-break differently
+    across lowerings, so a mixed-lowering index pair is not
+    bit-comparable)."""
+    n, rot_dim = residuals.shape
+    if per_cluster:
         ds = codebooks.shape[2]
         pq_dim = rot_dim // ds
         sub = residuals.reshape(n, pq_dim, ds)
@@ -515,35 +617,36 @@ def _csum_for_codes(codes, labels, centers, rotation, codebooks,
 
 
 def _csum_for_packed(list_codes, owner, centers, rotation, codebooks,
-                     per_cluster: bool, pq_bits: int):
+                     per_cluster: bool, pq_bits: int,
+                     tile_phys: int = 1024):
     """``list_csum`` for an ALREADY-PACKED code block (legacy v1 archive
     load): unpack every slot, contract, repack in place.  Padding slots get
     garbage values — harmless, their scores are masked by ``phys_sizes``.
-    Transiently materializes the index-wide unpacked codes (compat path
-    only; fresh builds compute csum pre-pack)."""
+    TILED over physical rows (r7): the unpacked (rows·cap, pq_dim) codes
+    and their decode transients exist only ``tile_phys`` chunk-rows at a
+    time, matching the tiled build's O(tile) memory contract on the compat
+    path too (each per-slot contraction is row-local, so chunking is
+    exact)."""
     rows, cap = list_codes.shape[0], list_codes.shape[1]
     if per_cluster:
         ds = codebooks.shape[2]
         pq_dim = rotation.shape[1] // ds
     else:
         pq_dim = codebooks.shape[0]
-    codes = _unpack_codes(list_codes.reshape(rows * cap, -1), pq_dim,
-                          pq_bits)
-    labels = jnp.repeat(jnp.asarray(owner), cap)
-    return _csum_for_codes(codes, labels, centers, rotation, codebooks,
-                           per_cluster).reshape(rows, cap)
+    owner_d = jnp.asarray(owner)
+    out = []
+    for r0 in range(0, rows, tile_phys):
+        r1 = min(r0 + tile_phys, rows)
+        codes = _unpack_codes(list_codes[r0:r1].reshape((r1 - r0) * cap, -1),
+                              pq_dim, pq_bits)
+        labels = jnp.repeat(owner_d[r0:r1], cap)
+        out.append(_csum_for_codes(codes, labels, centers, rotation,
+                                   codebooks, per_cluster
+                                   ).reshape(r1 - r0, cap))
+    return out[0] if len(out) == 1 else jnp.concatenate(out, axis=0)
 
 
-@traced("raft_tpu.neighbors.ivf_pq.build")
-@auto_sync_handle
-def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
-    """Train + populate (reference ``ivf_pq::build``, ivf_pq_build.cuh).
-
-    *dataset* may be float32, int8 or uint8 (reference build is templated
-    on T ∈ {float, int8_t, uint8_t}, neighbors/ivf_pq.cuh:62); integer
-    datasets train/encode in f32 and the index remembers the dtype so
-    extend()/search() stay consistent."""
-    x, dataset_dtype = _ingest_dataset(dataset)
+def _validate_build(params: IndexParams, x) -> None:
     expects(x.ndim == 2, "dataset must be (n, dim)")
     expects(params.metric in _SUPPORTED,
             f"ivf_pq: unsupported metric {params.metric}")
@@ -551,6 +654,21 @@ def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
             "pq_bits must be in [4, 8] (ivf_pq_types.hpp:52)")
     expects(params.rotation_kind in ("auto", "default", "pca_balanced"),
             f"unknown rotation_kind {params.rotation_kind!r}")
+
+
+def _train_model(params: IndexParams, x):
+    """Steps 1–4 of ``build`` (reference ivf_pq_build.cuh): coarse
+    quantizer, assignment, rotation, codebooks — ONE implementation shared
+    by :func:`build` (both populate modes) and :func:`build_sharded`, so
+    every pipeline trains the bit-identical model.
+
+    The assignment runs through the fused-L2-NN scan (O(tile) transients
+    already); the codebooks train on a residual sample capped at
+    ``params.pq_trainset_cap`` rows (all rows at or under the cap — the
+    pre-PR behavior — else a seeded uniform sample), so no stage here
+    materializes a dataset-sized residual matrix beyond the cap.  Returns
+    (centers, labels, rotation, codebooks, n_lists, pq_dim, per_cluster).
+    """
     n, dim = x.shape
     n_lists = min(params.n_lists, n)
     pq_dim = params.pq_dim or _calc_pq_dim(dim)
@@ -577,7 +695,7 @@ def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
     else:
         labels = min_cluster_and_distance(x, centers).key.astype(jnp.int32)
 
-    # 3) rotation + residuals in rotated space
+    # 3) rotation
     if rotation_kind == "pca_balanced":
         # residual-covariance sample; seed offset decorrelates it from the
         # trainset subsample (which uses params.seed)
@@ -589,30 +707,130 @@ def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
         rotation = _make_rotation(k_rot, dim, rot_dim,
                                   params.force_random_rotation
                                   or rot_dim != dim)
-    resid = (x - centers[labels]) @ rotation          # (n, rot_dim)
 
-    # 4) codebooks
+    # 4) codebooks, on the (capped) residual sample
+    cap_t = max(int(params.pq_trainset_cap), k)
+    if n > cap_t:
+        sel_t = jnp.asarray(np.sort(np.random.default_rng(
+            params.seed + 13).choice(n, size=cap_t, replace=False)))
+        x_t, lab_t = x[sel_t], labels[sel_t]
+    else:
+        x_t, lab_t = x, labels
+    resid_t = (x_t - centers[lab_t]) @ rotation      # (n_train, rot_dim)
     if params.codebook_kind == CodebookKind.PER_CLUSTER:
         codebooks = _train_codebooks_cluster_host(
-            k_cb, np.asarray(resid), np.asarray(labels), n_lists, pq_dim,
+            k_cb, np.asarray(resid_t), np.asarray(lab_t), n_lists, pq_dim,
             k, params.kmeans_n_iters)
     else:
-        codebooks = _train_codebooks_subspace(k_cb, resid, pq_dim, k,
+        codebooks = _train_codebooks_subspace(k_cb, resid_t, pq_dim, k,
                                               params.kmeans_n_iters)
+    per_cluster = params.codebook_kind == CodebookKind.PER_CLUSTER
+    return centers, labels, rotation, codebooks, n_lists, pq_dim, per_cluster
+
+
+def _encode_tile_impl(x_t, labels_t, centers, rotation, codebooks,
+                      per_cluster: bool, pq_bits: int):
+    """The per-tile encode kernel: residual → PQ encode → bit-pack, FUSED
+    into one executable — the (tile, rot_dim) residual, the
+    (tile, pq_dim, 2^bits) encode-distance transient and the
+    (tile, pq_dim, pq_bits) bit tensor exist only at tile size
+    (docs/index_build.md; the monolithic path materializes all three at
+    dataset size).  Also returns the raw (tile, pq_dim) codes for the
+    csum stage.  Row-local math only: the same kernel runs per shard
+    inside ``build_sharded``'s shard_map populate."""
+    build_trace_counters["pq_encode_tile"] += 1
+    resid = (x_t - centers[labels_t]) @ rotation
+    codes = _encode(resid, codebooks, labels_t, per_cluster)
+    packed = _pack_codes(codes, pq_bits)
+    return packed, codes
+
+
+def _csum_tile_impl(codes_t, labels_t, centers, rotation, codebooks,
+                    per_cluster: bool):
+    """Per-tile list-side ADC csum — its OWN program, NOT fused into the
+    encode tile: XLA reassociates the decode-contraction's reductions when
+    the encode is fused alongside, which perturbs the csum's last ulp vs
+    the monolithic ``_csum_for_codes`` dispatch (observed on PER_CLUSTER)
+    and would break the tiled ≡ monolithic bit-identity contract.  As a
+    standalone trace it is the monolithic program at tile shapes, and the
+    contraction is row-local, so row tiling is exact."""
+    build_trace_counters["pq_csum_tile"] += 1
+    return (_csum_for_codes(codes_t, labels_t, centers, rotation, codebooks,
+                            per_cluster),)
+
+
+_ENC_TILE_STATICS = (5, 6)
+_encode_tile = functools.partial(jax.jit, static_argnums=_ENC_TILE_STATICS)(
+    _encode_tile_impl)
+_encode_tile_aot = aot(_encode_tile_impl, static_argnums=_ENC_TILE_STATICS)
+_CSUM_TILE_STATICS = (5,)
+_csum_tile = functools.partial(jax.jit, static_argnums=_CSUM_TILE_STATICS)(
+    _csum_tile_impl)
+_csum_tile_aot = aot(_csum_tile_impl, static_argnums=_CSUM_TILE_STATICS)
+
+
+def _encode_rows(model, x, labels, pq_bits: int, per_cluster: bool,
+                 tiled: bool, tile_rows: Optional[int]):
+    """(packed, csum) for *x*'s rows: the tiled AOT loop (default) or the
+    monolithic dispatch chain (``tiled=False``) — same kernels, so the
+    results are bit-identical; only transient sizes and executable reuse
+    differ."""
+    centers, rotation, codebooks = model
+    if tiled and x.shape[0]:
+        packed, codes = _build.run_tiles(
+            _encode_tile, _encode_tile_aot, x, labels,
+            (centers, rotation, codebooks), (per_cluster, pq_bits),
+            tile_rows)
+        (csum,) = _build.run_tiles(
+            _csum_tile, _csum_tile_aot, codes, labels,
+            (centers, rotation, codebooks), (per_cluster,), tile_rows)
+        return packed, csum
+    resid = (x - centers[labels]) @ rotation          # (n, rot_dim)
+    codes = _encode(resid, codebooks, labels, per_cluster)
+    packed = _pack_codes(codes, pq_bits)
+    csum = _csum_for_codes(codes, labels, centers, rotation, codebooks,
+                           per_cluster)
+    return packed, csum
+
+
+@traced("raft_tpu.neighbors.ivf_pq.build")
+@auto_sync_handle
+def build(params: IndexParams, dataset, ids=None, *,
+          tiled: Optional[bool] = None, tile_rows: Optional[int] = None,
+          handle=None) -> Index:
+    """Train + populate (reference ``ivf_pq::build``, ivf_pq_build.cuh).
+
+    *dataset* may be float32, int8 or uint8 (reference build is templated
+    on T ∈ {float, int8_t, uint8_t}, neighbors/ivf_pq.cuh:62); integer
+    datasets train/encode in f32 and the index remembers the dtype so
+    extend()/search() stay consistent.
+
+    The populate runs TILED by default (docs/index_build.md): one fused
+    per-tile program (residual → encode → bit-pack → csum) through the AOT
+    executable cache plus a device-side pack, so peak transient memory is
+    O(tile) and repeated builds hit warm executables.  ``tiled=False`` (or
+    ``RAFT_TPU_TILED_BUILD=0``) restores the pre-PR monolithic populate —
+    the A/B baseline; both produce bit-identical indexes.  *tile_rows*
+    overrides the per-tile row count (``RAFT_TPU_BUILD_TILE``, default
+    8192)."""
+    x, dataset_dtype = _ingest_dataset(dataset)
+    _validate_build(params, x)
+    n = x.shape[0]
+    (centers, labels, rotation, codebooks, n_lists, pq_dim,
+     per_cluster) = _train_model(params, x)
+    use_tiled = _build.resolve_tiled(tiled)
 
     # 5) encode + bit-pack + scatter into lists (skipped entirely with
     # add_data_on_build=False: the trained model is kept, rows come later
     # via extend — reference ann::index_params::add_data_on_build)
-    per_cluster = params.codebook_kind == CodebookKind.PER_CLUSTER
     if params.add_data_on_build:
-        codes = _encode(resid, codebooks, labels, per_cluster)
-        packed = _pack_codes(codes, params.pq_bits)
-        csum = _csum_for_codes(codes, labels, centers, rotation, codebooks,
-                               per_cluster)
         if ids is None:
             ids = jnp.arange(n, dtype=jnp.int32)
         else:
             ids = jnp.asarray(ids, jnp.int32)
+        packed, csum = _encode_rows((centers, rotation, codebooks), x,
+                                    labels, params.pq_bits, per_cluster,
+                                    use_tiled, tile_rows)
     else:
         expects(ids is None,
                 "ids were passed but add_data_on_build=False stores no "
@@ -622,9 +840,9 @@ def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
         csum = jnp.zeros((0,), jnp.float32)
         ids = jnp.zeros((0,), jnp.int32)
         labels = jnp.zeros((0,), jnp.int32)
+    pack = _build.pack_device if use_tiled else pack_lists_chunked
     ((list_codes, list_csum), list_indices, phys_sizes, list_sizes,
-     chunk_table, owner, _) = pack_lists_chunked((packed, csum), ids,
-                                                 labels, n_lists)
+     chunk_table, owner, _) = pack((packed, csum), ids, labels, n_lists)
     list_adc = _build_list_adc(centers, rotation, codebooks, per_cluster)
     return Index(centers=centers, rotation=rotation, codebooks=codebooks,
                  list_codes=list_codes, list_indices=list_indices,
@@ -635,16 +853,102 @@ def build(params: IndexParams, dataset, ids=None, handle=None) -> Index:
                  dataset_dtype=dataset_dtype)
 
 
-def extend(index: Index, new_vectors, new_ids=None) -> Index:
+@traced("raft_tpu.neighbors.ivf_pq.build_sharded")
+def build_sharded(params: IndexParams, dataset, comms, ids=None, *,
+                  tile_rows: Optional[int] = None):
+    """Train once (replicated) + populate DIRECT-TO-SHARD: the tiled
+    per-tile encode kernel runs as a ``shard_map`` program over *comms*'
+    mesh, each device encoding and packing ONLY its round-robin list
+    shard's rows — producing a
+    :class:`raft_tpu.neighbors.ann_mnmg.ShardedIndex` bit-identical to
+    ``build(params, dataset).shard(comms)`` without the full packed index
+    ever materializing on one device (docs/index_build.md §sharded).  The
+    populate path moves no dataset-sized data to host (ci/lint.py
+    enforced) and repeated builds of the same shapes dispatch only warm
+    executables (``aot_compile_counters``-assertable)."""
+    from raft_tpu.neighbors import ann_mnmg
+
+    comms = ann_mnmg._full_axis_comms(comms)
+    x, dataset_dtype = _ingest_dataset(dataset)
+    _validate_build(params, x)
+    expects(params.add_data_on_build,
+            "build_sharded populates by construction — use "
+            "build(add_data_on_build=False) + extend + shard() for "
+            "deferred ingest")
+    n = x.shape[0]
+    (centers, labels, rotation, codebooks, n_lists, pq_dim,
+     per_cluster) = _train_model(params, x)
+    if ids is None:
+        ids = jnp.arange(n, dtype=jnp.int32)
+    else:
+        ids = jnp.asarray(ids, jnp.int32)
+
+    lay = chunk_layout(device_counts(labels, n_lists))
+    pq_bits = int(params.pq_bits)
+    key = ("ivf_pq", n_lists, pq_dim, pq_bits, per_cluster)
+    # two shard_map stages per tile, mirroring the single-device split:
+    # encode/pack fused, csum standalone (its rounding must match the
+    # monolithic trace — _csum_tile_impl docstring)
+    enc_prog = _build.shard_tile_program(
+        comms, key + ("enc",),
+        lambda xt, lt, c, r, cb: _encode_tile_impl(xt, lt, c, r, cb,
+                                                   per_cluster, pq_bits),
+        n_margs=3, n_out=2)
+    csum_prog = _build.shard_tile_program(
+        comms, key + ("csum",),
+        lambda ct, lt, c, r, cb: _csum_tile_impl(ct, lt, c, r, cb,
+                                                 per_cluster),
+        n_margs=3, n_out=1)
+    from jax.sharding import PartitionSpec as P
+
+    margs = tuple(comms.globalize(a, P())
+                  for a in (centers, rotation, codebooks))
+
+    def tile_fn(xt_g, lt_g):
+        packed, codes = enc_prog(xt_g, lt_g, *margs)
+        (csum,) = csum_prog(codes, lt_g, *margs)
+        return packed, csum
+
+    (stacked_pay, stacked_idx, stacked_phys, stacked_tables, stacked_owner,
+     probe_extra, _) = _build.populate_sharded(
+        comms, x, labels, ids, lay, tile_fn, n_payloads=2, key=key,
+        tile_rows=tile_rows)
+    list_adc = _build_list_adc(centers, rotation, codebooks, per_cluster)
+    stacked = (stacked_pay[0], stacked_idx, stacked_phys, stacked_tables,
+               stacked_owner, stacked_pay[1])
+    replicated = (ann_mnmg._replicate(comms, centers),
+                  ann_mnmg._replicate(comms, rotation),
+                  ann_mnmg._replicate(comms, codebooks),
+                  ann_mnmg._replicate(comms, list_adc))
+    aux = ann_mnmg._ivf_pq_aux(
+        world=comms.get_size(), dim=x.shape[1], metric=int(params.metric),
+        n_lists=n_lists, probe_extra=probe_extra, pq_bits=pq_bits,
+        codebook_kind=int(params.codebook_kind),
+        dataset_dtype=dataset_dtype, pq_dim=pq_dim,
+        max_chunks=lay.max_chunks)
+    return ann_mnmg.ShardedIndex("ivf_pq", comms, replicated, stacked, aux)
+
+
+def extend(index: Index, new_vectors, new_ids=None, *,
+           tiled: Optional[bool] = None, tile_rows: Optional[int] = None,
+           in_place: bool = False) -> Index:
     """Add vectors to an existing index (reference ``ivf_pq::extend``,
     neighbors/ivf_pq.cuh:103,128).  Functional: encodes the new vectors
     with the trained centers/rotation/codebooks (no retraining, as in the
     reference).  INCREMENTAL (r5): new codes append into each list's free
-    tail slots and only overflowing lists grow a chunk
-    (_common.extend_lists_chunked — the reference appends to the affected
-    lists, ivf_flat_build.cuh:108 same pattern for PQ); the r4 path
+    tail slots and only overflowing lists grow a chunk; the r4 path
     unpacked ALL live codes and re-sorted the whole index per extend.
-    """
+
+    TILED (r7, default; docs/index_build.md): the new rows encode through
+    the same warm per-tile AOT program as :func:`build` and append through
+    the device-side scatter (``_build.extend_device``) — no per-row host
+    work, O(tile) transients, O(n_new) scatter.  ``in_place=True``
+    additionally DONATES the old index's list blocks to the append when no
+    list overflows, making the append truly in place (O(n_new) total, no
+    O(index) copy) — the input *index* is consumed and must not be used
+    afterwards.  ``tiled=False`` (or ``RAFT_TPU_TILED_BUILD=0``) restores
+    the pre-PR monolithic encode + grow-by-concat path (the A/B baseline,
+    bit-identical results)."""
     x, new_dtype = _ingest_dataset(new_vectors)
     expects(new_dtype == index.dataset_dtype,
             f"extend dtype {new_dtype} != index dataset dtype "
@@ -659,26 +963,28 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
         new_ids = jnp.asarray(new_ids, jnp.int32)
         expects(new_ids.shape == (n_new,), "ids must be (n_new,)")
 
+    use_tiled = _build.resolve_tiled(tiled)
     per_cluster = index.codebook_kind == CodebookKind.PER_CLUSTER
     if index.metric == DistanceType.InnerProduct:
         labels = jnp.argmax(x @ index.centers.T, axis=1).astype(jnp.int32)
     else:
         labels = min_cluster_and_distance(x, index.centers).key.astype(jnp.int32)
-    resid = (x - index.centers[labels]) @ index.rotation
-    codes = _encode(resid, index.codebooks, labels, per_cluster)
-    packed = _pack_codes(codes, index.pq_bits)
-    csum = _csum_for_codes(codes, labels, index.centers, index.rotation,
-                           index.codebooks, per_cluster)
+    packed, csum = _encode_rows(
+        (index.centers, index.rotation, index.codebooks), x, labels,
+        index.pq_bits, per_cluster, use_tiled, tile_rows)
 
     if base:
+        ext = _build.extend_device if use_tiled else extend_lists_chunked
+        kw = {"in_place": in_place} if use_tiled else {}
         ((list_codes, list_csum), list_indices, phys_sizes, list_sizes,
-         chunk_table, owner, _) = extend_lists_chunked(
+         chunk_table, owner, _) = ext(
             (index.list_codes, index.list_csum), index.list_indices,
             index.list_sizes, index.chunk_table, (packed, csum), new_ids,
-            labels)
+            labels, **kw)
     else:
+        pack = _build.pack_device if use_tiled else pack_lists_chunked
         ((list_codes, list_csum), list_indices, phys_sizes, list_sizes,
-         chunk_table, owner, _) = pack_lists_chunked(
+         chunk_table, owner, _) = pack(
             (packed, csum), new_ids, labels, index.n_lists)
     # the trained model (centers/rotation/codebooks) is untouched by extend,
     # so the build-time list-side ADC table carries over unchanged

@@ -165,11 +165,14 @@ def load_ivf_pq(path) -> ivf_pq.Index:
             per_cluster)
     if "list_csum" not in arrays:
         # likewise its per-candidate contraction, re-derived by unpacking
-        # the stored codes once (compat path)
+        # the stored codes — TILED over physical rows (r7): the compat
+        # load of a large v1 archive must honor the same O(tile) transient
+        # contract as the tiled build, not materialize the index-wide
+        # unpacked codes (docs/index_build.md)
         arrays["list_csum"] = ivf_pq._csum_for_packed(
             arrays["list_codes"], arrays["owner"], arrays["centers"],
             arrays["rotation"], arrays["codebooks"], per_cluster,
-            aux["pq_bits"])
+            aux["pq_bits"], tile_phys=1024)
     return ivf_pq.Index(
         **arrays,
         metric=DistanceType(aux["metric"]),

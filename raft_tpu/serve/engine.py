@@ -351,6 +351,13 @@ class ServeEngine:
         expects(max_batch >= 8, "max_batch must be >= 8")
         self._backend = _make_backend(index, k, params, metric, metric_arg,
                                       batch_size_index)
+        # refresh() rebuilds a backend of the (possibly) same kind with the
+        # same serving knobs — keep them (and the UNCLAMPED batch bound:
+        # the transient cap depends on the index and is re-derived then)
+        self._ctor = dict(k=int(k), params=params, metric=metric,
+                          metric_arg=metric_arg,
+                          batch_size_index=batch_size_index)
+        self._requested_max_batch = int(max_batch)
         self.max_batch = int(max_batch)
         cap = getattr(self._backend, "batch_cap", lambda: None)()
         if cap is not None:
@@ -364,7 +371,7 @@ class ServeEngine:
         self._lock = threading.Lock()
         self.stats: Dict[str, int] = {
             "requests": 0, "queries": 0, "super_batches": 0,
-            "solo_fallbacks": 0, "coalesced_requests": 0,
+            "solo_fallbacks": 0, "coalesced_requests": 0, "refreshes": 0,
         }
         #: Per-request completion latency (seconds, relative to the
         #: enclosing ``search()`` entry) of the LAST search call — request
@@ -416,6 +423,58 @@ class ServeEngine:
 
     def warmed_buckets(self, dtype) -> List[int]:
         return sorted(self._warmed.get(str(jnp.dtype(dtype)), ()))
+
+    # -- index refresh ------------------------------------------------------
+    def refresh(self, index, params=None) -> None:
+        """Swap the served index for *index* without cold-serving a single
+        request — the serving half of the tiled-build refresh loop
+        (docs/index_build.md): rebuild or ``extend()`` the index off the
+        request path (``ivf_pq.build_sharded`` for multi-device serving),
+        then ``refresh()`` it in.
+
+        The replacement backend (same k; *params* defaults to the current
+        serving params) is constructed and EVERY previously-warmed
+        (bucket, dtype) signature is pre-lowered through its ``aot()``
+        cache BEFORE the swap, so compiles happen here — off the request
+        path — and steady-state traffic after the swap stays
+        zero-compile (counter-assertable exactly like first warmup).  The
+        swap itself is atomic under the engine lock; in-flight results of
+        earlier ``search()`` calls were already collected and are
+        unaffected.  ``max_batch`` re-derives from the requested bound and
+        the NEW index's transient cap; warmed buckets above it are
+        dropped (requests that needed them fall back to solo, counted)."""
+        with self._lock:  # snapshot under the lock: warmup() mutates it
+            c = dict(self._ctor)
+            snapshot = {dt: set(bs) for dt, bs in self._warmed.items()}
+        if params is None:
+            params = c["params"]
+        backend = _make_backend(index, c["k"], params, c["metric"],
+                                c["metric_arg"], c["batch_size_index"])
+        max_batch = self._requested_max_batch
+        cap = getattr(backend, "batch_cap", lambda: None)()
+        if cap is not None:
+            max_batch = max(8, min(max_batch, cap))
+        warmed = {dt: {b for b in bs if b <= max_batch}
+                  for dt, bs in snapshot.items()}
+        for dt, buckets in warmed.items():
+            for b in sorted(buckets):
+                backend.warm(b, jnp.dtype(dt))
+        with self._lock:
+            # signatures warmed by a concurrent warmup() since the
+            # snapshot must not be silently dropped — warm them under the
+            # lock (rare; blocks briefly) so the zero-retrace contract
+            # survives the swap
+            for dt, bs in self._warmed.items():
+                late = {b for b in bs if b <= max_batch} - warmed.get(
+                    dt, set())
+                for b in sorted(late):
+                    backend.warm(b, jnp.dtype(dt))
+                warmed.setdefault(dt, set()).update(late)
+            self._backend = backend
+            self._ctor = dict(c, params=params)
+            self.max_batch = max_batch
+            self._warmed = warmed
+            self.stats["refreshes"] += 1
 
     # -- the request path ---------------------------------------------------
     def _plan(self, sizes: List[int], max_bucket: int

@@ -82,6 +82,8 @@ _FAST_TESTS = {
     "test_handle_threading.py::test_handle_through_cluster_and_neighbors",
     "test_ivf_flat.py::test_ivf_flat_recall",
     "test_ivf_flat.py::test_extend_lists_chunked_matches_full_repack",
+    "test_ivf_build.py::test_search_identity_tiled_vs_monolithic",
+    "test_ivf_build.py::test_serve_engine_refresh_zero_compile",
     "test_serve.py::test_zero_compiles_after_warmup",
     "test_serve.py::test_out_of_bucket_range_request_served_solo",
     "test_ivf_pq.py::test_ivf_pq_recall_pq_bits",

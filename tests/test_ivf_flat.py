@@ -199,6 +199,35 @@ def test_ivf_flat_search_tail_bucketing():
         np.testing.assert_array_equal(np.asarray(i), np.asarray(ref_i)[:nq])
 
 
+def test_ivf_flat_search_no_retrace_across_ragged_query_counts():
+    """The eager ivf_flat search path routes every query batch through the
+    bucketed AOT program (``_search_batch_aot``): once one bucket's
+    executable is warm, ragged query counts inside that bucket must
+    dispatch with ZERO further compiles (ISSUE 7 satellite — the serving
+    no-retrace contract, counter-asserted like tests/test_serve.py)."""
+    from raft_tpu.core.aot import aot_compile_counters
+    from raft_tpu.neighbors import ivf_flat
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (1500, 16)).astype(np.float32)
+    q = rng.normal(0, 1, (64, 16)).astype(np.float32)
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=16), x)
+    sp = ivf_flat.SearchParams(n_probes=4)
+    # warm the 8/16/32/64 buckets once
+    for nq in (8, 16, 32, 64):
+        ivf_flat.search(sp, idx, q[:nq], 5)
+    c0 = aot_compile_counters["compiles"]
+    for nq in (3, 5, 7, 9, 13, 17, 25, 31, 33, 47, 63):
+        d, i = ivf_flat.search(sp, idx, q[:nq], 5)
+        assert np.asarray(d).shape == (nq, 5)
+    assert aot_compile_counters["compiles"] == c0, \
+        "ragged query counts recompiled inside warm buckets"
+    # liveness: the counter does move when a NEW bucket appears (65
+    # queries pad to the un-warmed 128 bucket)
+    ivf_flat.search(sp, idx, np.concatenate([q, q])[:65], 5)
+    assert aot_compile_counters["compiles"] > c0
+
+
 def test_ivf_flat_bf16_dataset_recall_near_f32():
     """bf16 datasets score with f32 accumulation — recall triage (PR 4).
 

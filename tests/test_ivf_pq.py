@@ -55,6 +55,55 @@ def test_ivf_pq_per_cluster_codebooks():
     assert recall(i, np.array(ti)) >= 0.8
 
 
+def test_ivf_pq_per_cluster_subcap_sampling_seed_stable():
+    """ADVICE r5 leftover (ISSUE 7 satellite): sub-cap clusters fill their
+    codebook-training sample with draws from an INDEPENDENT random stream
+    (``rng_fill``), not cyclic repetition of one permutation.  Contract:
+    (a) same build seed → bit-identical codebooks (seed-stable);
+    (b) a different seed re-draws the sub-cap fill → different codebooks;
+    (c) the fill indices are not the deterministic cyclic ``j % count``
+    pattern — for a tiny pool, consecutive sample slots must not simply
+    tile the permuted pool period-``count``."""
+    from raft_tpu.neighbors.ivf_pq import _train_codebooks_cluster_host
+
+    import jax
+
+    rng = np.random.default_rng(0)
+    n, n_lists, pq_dim, ds = 120, 6, 4, 3
+    resid = rng.normal(0, 1, (n, pq_dim * ds)).astype(np.float32)
+    labels = rng.integers(0, n_lists, n).astype(np.int32)
+    args = (resid, labels, n_lists, pq_dim, 16, 3)
+    cb1 = np.asarray(_train_codebooks_cluster_host(
+        jax.random.PRNGKey(7), *args))
+    cb2 = np.asarray(_train_codebooks_cluster_host(
+        jax.random.PRNGKey(7), *args))
+    assert np.array_equal(cb1, cb2), "same key must reproduce codebooks"
+    cb3 = np.asarray(_train_codebooks_cluster_host(
+        jax.random.PRNGKey(8), *args))
+    assert not np.array_equal(cb1, cb3), "independent fill must re-draw"
+    # (c) structural, on the extracted fill helper: a sub-cap pool's
+    # sample positions are NOT the deterministic cyclic ``j % count``
+    # tiling, cover the pool, and pools >= cap keep the exact r5
+    # without-replacement arange
+    from raft_tpu.neighbors.ivf_pq import _cluster_sample_take
+
+    counts = np.array([2, 100, 64], np.int64)
+    cap = 64
+    take = _cluster_sample_take(counts, cap,
+                                np.random.default_rng(3))
+    sub = take[0] % counts[0]
+    assert not np.array_equal(sub, np.arange(cap) % counts[0]), \
+        "sub-cap fill is still the cyclic permutation tiling"
+    # coverage: the first `count` slots are the without-replacement
+    # permutation prefix, so every pool member still trains exactly once
+    # before any random repeat (review hardening: iid fill over the WHOLE
+    # sample would drop ~1/e of a near-cap pool from training)
+    np.testing.assert_array_equal(take[0][:2], np.arange(2))
+    assert set(sub.tolist()) == {0, 1}, "fill must still cover the pool"
+    np.testing.assert_array_equal(take[1], np.arange(cap))
+    np.testing.assert_array_equal(take[2], np.arange(cap))
+
+
 def test_ivf_pq_rotation_non_divisible():
     # dim not a multiple of pq_dim → random rotation into rot_dim
     x, q = make_data(n=2000, dim=30)
