@@ -13,9 +13,10 @@ cd "$(dirname "$0")/.."
 echo "== compile =="
 python -m compileall -q raft_tpu tests bench ci docs bench.py __graft_entry__.py
 
-echo "== style =="
-# stdlib lint gate (ci/checks/style.sh role; no third-party linters here)
-python ci/lint.py
+echo "== style / contracts (analysis level 1) =="
+# stdlib AST rule engine (ci/checks/style.sh role + the hot-path contract
+# rules; ci/lint.py remains a back-compatible shim over the same engine)
+python -m raft_tpu.analysis --ast
 
 echo "== blacklist =="
 # only real imports/usages count — docstrings cite reference CUDA symbols
@@ -38,11 +39,23 @@ mods = [
     "raft_tpu.neighbors", "raft_tpu.neighbors.ivf_flat",
     "raft_tpu.neighbors.ivf_pq", "raft_tpu.neighbors.ball_cover",
     "raft_tpu.serve", "raft_tpu.native",
+    "raft_tpu.analysis", "raft_tpu.analysis.engine",
+    "raft_tpu.analysis.rules", "raft_tpu.analysis.registry",
 ]
 for m in mods:
     importlib.import_module(m)
 print(f"{len(mods)} modules import cleanly")
 EOF
+
+echo "== hlo audit (analysis level 2) =="
+# Lower every registered hot-path program and statically check host
+# purity, collective launch/byte budgets, donation aliasing and transient
+# ceilings (docs/static_analysis.md).  The FULL registry (incl. the
+# sharded one-allgather programs on the forced 8-device mesh) runs in
+# single-digit seconds on CPU; --fast restricts to the single-device
+# subset for constrained environments.  --strict: a skipped program (bad
+# device env) fails the gate instead of silently shrinking it.
+JAX_PLATFORMS=cpu python -m raft_tpu.analysis --hlo --strict
 
 echo "== tests =="
 # Shard per-file across workers when the host has the cores for it (the

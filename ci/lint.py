@@ -1,473 +1,55 @@
-"""Minimal style gate (the reference's ci/checks/style.sh role).
+"""Thin shim over :mod:`raft_tpu.analysis.engine` (ISSUE 8).
 
-No third-party linters ship in this environment, so this implements the
-high-signal subset with stdlib ast/tokenize:
+The style/contract gate that lived here grew into the two-level
+``raft_tpu/analysis/`` subsystem — a registered AST rule engine (this
+file's four historical rules plus collective-discipline, trace-impurity,
+static-arg-hashability, dtype-drift) and a lowered-HLO program auditor.
+This module keeps the historical surface working:
 
-  * unused imports (skipping __init__.py re-export files and `# noqa` lines)
-  * tabs in indentation, trailing whitespace
-  * lines over 100 columns
-  * bare `except:` clauses
-  * f-strings with no placeholders
-  * raw ``jax.ops.segment_sum`` anywhere in raft_tpu/ outside
-    linalg/reduce.py — keyed reductions must go through the
-    reduce_rows_by_key / reduce_cols_by_key engine (which picks the MXU
-    one-hot path when profitable) or reduce.segment_sum; the ivf_pq
-    codebook M-step silently missing the one-hot path (PR 2) is exactly
-    the regression class this catches
-  * ``einsum``/``take_along_axis`` calls that CLOSE OVER out-of-callback
-    operands inside a tile callback passed to ``scan_probe_lists``
-    (raft_tpu/neighbors/ only) — per-batch-invariant LUT/scoring work
-    belongs OUTSIDE the probe scan, hoisted and threaded through as xs
-    (the ivf_pq hoisted-ADC pipeline, docs/ivf_pq_adc.md); an einsum over
-    closed-over codebooks re-entering the scan body is exactly the
-    regression the hoist PR removed.  Calls whose operands are all
-    callback-local (e.g. the ADC lookup contraction over the gathered
-    tile + threaded xs slice) pass; sanctioned closures (the
-    HOISTED_LUT=0 legacy baseline, ivf_flat's tile-scoring GEMM) carry an
-    ``adc-exempt`` marker comment on the call line.
+* CLI: ``python ci/lint.py [paths...]`` — runs the FULL AST rule set over
+  the same default roots as before (the Level-1 half of
+  ``python -m raft_tpu.analysis``), exit 1 on findings.
+* ``check_file(path) -> [(lineno, message)]`` — the quarantine-test entry
+  point (tests/test_fused_em.py, test_ivf_build.py, ...).
+* ``check_probe_scan_callbacks(tree, lines)`` /
+  ``check_serve_hot_path(tree, lines)`` — the rule functions tests import
+  directly, re-exported from their new rule modules.
 
-  * host transfers (``np.asarray``/``np.array``, ``jax.device_get``,
-    ``.addressable_data``, ``.block_until_ready``) anywhere in
-    ``raft_tpu/neighbors/ann_mnmg.py`` OR ``raft_tpu/neighbors/_build.py``
-    outside ``host-ok``-marked lines — the sharded-ANN search path is ONE
-    shard_map program per batch with no host round-trips by design, and
-    the tiled build/populate hot path (ISSUE 7) must keep per-row data on
-    device end to end: only the (n_lists,)-shaped chunk-table bookkeeping
-    (and the (n,) label routing vector of the sharded populate) may fetch,
-    through ``host-ok``-marked lines.  A dataset-sized ``np.asarray``
-    creeping into the populate path reintroduces exactly the monolithic
-    host round-trip the tiled build removed
-
-  * ``jax.jit`` / ``jax.lax.*`` dispatch anywhere in ``raft_tpu/serve/`` —
-    the serving engine's zero-retrace guarantee holds only while every
-    device computation routes through the backends' ``aot()`` executable
-    caches (``core.aot.aot_compile_counters`` is counter-asserted around
-    steady-state traffic in tests/test_serve.py); a ``jax.jit`` or bare
-    ``jax.lax`` op creeping into the hot path reintroduces per-call trace
-    checks and per-shape silent recompiles outside the counter.  Lines
-    carrying a ``serve-exempt`` marker (or ``noqa``) are sanctioned — the
-    allowlist escape, mirroring the probe-scan rule's ``adc-exempt``.
-
-Exit code 1 on any finding.  Run: ``python ci/lint.py [paths...]``.
+Exemption markers: the unified ``# exempt(rule-id): rationale`` syntax;
+the legacy ``adc-exempt`` / ``serve-exempt`` / ``host-ok`` / ``noqa``
+spellings keep parsing (see docs/static_analysis.md).
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
-MAX_LINE = 100
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-_SCAN_CALLBACK_BANNED = ("einsum", "take_along_axis")
+from raft_tpu.analysis import engine as _engine  # noqa: E402
+from raft_tpu.analysis.rules.probe_scan import (  # noqa: E402,F401
+    check_probe_scan_callbacks,
+)
+from raft_tpu.analysis.rules.serve_path import (  # noqa: E402,F401
+    check_serve_hot_path,
+)
+from raft_tpu.analysis.rules.host_transfer import (  # noqa: E402,F401
+    check_host_transfers,
+)
 
-
-def _call_name(node: ast.Call) -> str:
-    f = node.func
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    if isinstance(f, ast.Name):
-        return f.id
-    return ""
-
-
-def _direct_bindings(fn) -> set:
-    """Names bound in *fn*'s OWN scope: params, direct assignments, loop /
-    comprehension / with targets, and the names of nested defs — but NOT
-    anything bound only inside a nested def's body.  Per-scope resolution
-    keeps the probe-scan rule honest: a closed-over operand that happens to
-    share a name with some nested helper's local must still read as
-    closed-over at the callsite's scope."""
-    bound = set()
-    a = fn.args
-    for arg in (a.posonlyargs + a.args + a.kwonlyargs
-                + ([a.vararg] if a.vararg else [])
-                + ([a.kwarg] if a.kwarg else [])):
-        bound.add(arg.arg)
-    stack = list(ast.iter_child_nodes(fn))
-    while stack:
-        node = stack.pop()
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            bound.add(node.name)        # the def name binds here ...
-            continue                    # ... its body is a nested scope
-        if isinstance(node, ast.Lambda):
-            continue
-        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
-            bound.add(node.id)
-        stack.extend(ast.iter_child_nodes(node))
-    return bound
+MAX_LINE = 100  # historical constant, still what the style rule enforces
 
 
-def _tainted_names(fn, local, module_names) -> set:
-    """Locals of *fn* assigned (in its own scope) from expressions that
-    reference closed-over or already-tainted names — the aliases that
-    would otherwise launder a closed-over operand past the probe-scan rule
-    (``cb = codebooks; jnp.einsum(..., r, cb)`` is exactly the legacy
-    per-tile LUT recompute shape).  Gather-derived tiles (``data =
-    big[rows]``) taint too: einsums over them are O(tile) scoring work,
-    sanctioned via the ``adc-exempt`` marker (ivf_flat's GEMM)."""
-    assigns = []
-    stack = list(ast.iter_child_nodes(fn))
-    while stack:
-        node = stack.pop()
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.Lambda)):
-            continue                    # nested scopes taint separately
-        if isinstance(node, ast.Assign):
-            assigns.append(node)
-        stack.extend(ast.iter_child_nodes(node))
-    tainted = set()
-    changed = True
-    while changed:                      # fixpoint over alias chains
-        changed = False
-        for node in assigns:
-            loads = {n.id for n in ast.walk(node.value)
-                     if isinstance(n, ast.Name)
-                     and isinstance(n.ctx, ast.Load)}
-            if any(nm in tainted
-                   or (nm not in local and nm not in module_names)
-                   for nm in loads):
-                for t in node.targets:
-                    if isinstance(t, ast.Name) and t.id not in tainted:
-                        tainted.add(t.id)
-                        changed = True
-    return tainted
-
-
-def check_probe_scan_callbacks(tree, lines):
-    """The hoisted-ADC regression guard (scoped to raft_tpu/neighbors/):
-    einsum/take_along_axis inside a ``scan_probe_lists`` tile callback may
-    only consume CALLBACK-LOCAL data (the gathered tile, the threaded xs
-    slice) — an operand closed over from the enclosing search scope means
-    per-batch-invariant LUT work crept back into the scan body, the exact
-    per-tile recompute the hoist PR removed (docs/ivf_pq_adc.md).
-    ``adc-exempt`` on the call line sanctions a closure (the HOISTED_LUT=0
-    legacy baseline, ivf_flat's tile-scoring GEMM over closed-over
-    queries).  Helper closures invoked FROM a callback (e.g. the flattened
-    ADC lookup `_lookup`) are outside the rule by construction — they
-    receive the tile + LUT as arguments, closing over nothing per-batch."""
-    # tile callbacks = 2nd positional arg of every scan_probe_lists call
-    cb_names, cb_lambdas = set(), []
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Call)
-                and _call_name(node) == "scan_probe_lists"
-                and len(node.args) >= 2):
-            cb = node.args[1]
-            if isinstance(cb, ast.Name):
-                cb_names.add(cb.id)
-            elif isinstance(cb, ast.Lambda):
-                cb_lambdas.append(cb)
-    callbacks = list(cb_lambdas)
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.FunctionDef) and node.name in cb_names):
-            callbacks.append(node)
-    # module-level names (imports, module defs/aliases like jnp) are not
-    # "closed-over operands" for this rule
-    module_names = set()
-    for node in tree.body:
-        if isinstance(node, (ast.Import, ast.ImportFrom)):
-            for a in node.names:
-                module_names.add((a.asname or a.name).split(".")[0])
-        elif isinstance(node, (ast.FunctionDef, ast.ClassDef)):
-            module_names.add(node.name)
-        elif isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name):
-                    module_names.add(t.id)
-        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
-            if isinstance(node.target, ast.Name):
-                module_names.add(node.target.id)
-    findings = []
-
-    def check_scope(fn, inherited):
-        """Check one function scope; recurse into nested defs with this
-        scope's locals inherited (lexical scoping).  A local counts as
-        closed-over when it merely aliases / derives from closed-over data
-        (``_tainted_names``), so renaming can't launder the operand."""
-        local = (inherited | _direct_bindings(fn)) - _tainted_names(
-            fn, inherited | _direct_bindings(fn), module_names)
-        stack = list(ast.iter_child_nodes(fn))
-        while stack:
-            node = stack.pop()
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.Lambda)):
-                check_scope(node, local)
-                continue
-            stack.extend(ast.iter_child_nodes(node))
-            if (not isinstance(node, ast.Call)
-                    or _call_name(node) not in _SCAN_CALLBACK_BANNED):
-                continue
-            # marker may ride the call line or the comment line above it
-            ctx = lines[max(0, node.lineno - 2):node.lineno]
-            if any("adc-exempt" in ln or "noqa" in ln for ln in ctx):
-                continue
-            free = set()
-            for arg in list(node.args) + [kw.value for kw in node.keywords]:
-                for n in ast.walk(arg):
-                    if (isinstance(n, ast.Name)
-                            and isinstance(n.ctx, ast.Load)
-                            and n.id not in local
-                            and n.id not in module_names):
-                        free.add(n.id)
-            if free:
-                findings.append((
-                    node.lineno,
-                    f"{_call_name(node)} over closed-over operand(s) "
-                    f"{sorted(free)} inside a scan_probe_lists tile "
-                    "callback — hoist per-batch-invariant LUT work out of "
-                    "the probe scan and thread it as xs (docs/"
-                    "ivf_pq_adc.md), or mark the line adc-exempt"))
-
-    for cb in callbacks:
-        check_scope(cb, set())
-    return findings
-
-
-def check_serve_hot_path(tree, lines):
-    """The serving zero-retrace guard (scoped to raft_tpu/serve/): no
-    ``jax.jit`` and no ``jax.lax.*`` anywhere in the package — device work
-    must dispatch the backends' ``aot()`` caches so warmup pins every
-    executable and ``aot_compile_counters`` stays flat under traffic.
-    ``serve-exempt`` on the line (or the line above) sanctions a use."""
-    findings = []
-
-    def _sanctioned(node) -> bool:
-        ctx = lines[max(0, node.lineno - 2):node.lineno]
-        return any("serve-exempt" in ln or "noqa" in ln for ln in ctx)
-
-    # names bound by `from jax import jit/lax`, `from jax.lax import X`,
-    # or `import jax.lax as L` count too — renaming must not launder the
-    # dispatch past the rule
-    jax_aliases = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom):
-            if node.module == "jax":
-                for a in node.names:
-                    if a.name in ("jit", "lax"):
-                        jax_aliases[a.asname or a.name] = a.name
-                        if not _sanctioned(node):
-                            findings.append((
-                                node.lineno,
-                                f"`from jax import {a.name}` in "
-                                "raft_tpu/serve/ — serve hot paths must "
-                                "dispatch through the aot() executable "
-                                "cache (zero-retrace guarantee), or mark "
-                                "the line serve-exempt"))
-            elif node.module and (node.module == "jax.lax"
-                                  or node.module.startswith("jax.lax.")):
-                if not _sanctioned(node):
-                    findings.append((
-                        node.lineno,
-                        f"`from {node.module} import ...` in "
-                        "raft_tpu/serve/ — serve hot paths must dispatch "
-                        "through the aot() executable cache (zero-retrace "
-                        "guarantee), or mark the line serve-exempt"))
-        elif isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name == "jax.lax" or a.name.startswith("jax.lax."):
-                    if a.asname:
-                        jax_aliases[a.asname] = "lax"
-                    if not _sanctioned(node):
-                        findings.append((
-                            node.lineno,
-                            f"`import {a.name}` in raft_tpu/serve/ — serve "
-                            "hot paths must dispatch through the aot() "
-                            "executable cache (zero-retrace guarantee), or "
-                            "mark the line serve-exempt"))
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Attribute):
-            continue
-        base = node.value
-        is_jax_jit = (node.attr == "jit" and isinstance(base, ast.Name)
-                      and base.id == "jax")
-        is_jax_lax = (isinstance(base, ast.Attribute) and base.attr == "lax"
-                      and isinstance(base.value, ast.Name)
-                      and base.value.id == "jax")
-        is_alias_lax = (isinstance(base, ast.Name)
-                        and jax_aliases.get(base.id) == "lax")
-        if not (is_jax_jit or is_jax_lax or is_alias_lax):
-            continue
-        if _sanctioned(node):
-            continue
-        what = ("jax.jit" if is_jax_jit
-                else f"jax.lax.{node.attr}" if is_jax_lax
-                else f"{base.id}.{node.attr}")
-        findings.append((
-            node.lineno,
-            f"{what} in raft_tpu/serve/ — serve hot paths must dispatch "
-            "through the aot() executable cache (zero-retrace guarantee), "
-            "or mark the line serve-exempt"))
-    return findings
-
-
-#: Host-transfer surfaces banned in the sharded-ANN search module: a fetch
-#: anywhere in the search path reintroduces the host round-trip the
-#: one-shard_map-program design exists to eliminate (and silently
-#: serializes the whole mesh behind one host thread).
-_HOST_TRANSFER_CALLS = ("asarray", "array", "device_get",
-                        "addressable_data", "block_until_ready")
-
-
-def check_ann_mnmg_host_transfers(tree, lines):
-    """The device-residency guard (scoped to
-    raft_tpu/neighbors/ann_mnmg.py AND raft_tpu/neighbors/_build.py):
-    ``np.asarray``/``np.array``, ``jax.device_get``,
-    ``.addressable_data`` and ``.block_until_ready`` are banned
-    module-wide — the sharded search path must stay device-resident end to
-    end (ONE shard_map program per batch), and the tiled build/populate
-    hot path may fetch only its (n_lists,)-shaped chunk-table bookkeeping
-    (plus the (n,) label routing vector of the sharded populate), through
-    lines carrying a ``host-ok`` marker (the adc-exempt/serve-exempt
-    allowlist idiom); pure-numpy table arithmetic on host data
-    (np.arange/zeros/...) is not a transfer and is not flagged."""
-    found = {}
-    for node in ast.walk(tree):
-        name = None
-        if isinstance(node, ast.Call):
-            cname = _call_name(node)
-            if cname in ("device_get", "addressable_data",
-                         "block_until_ready"):
-                name = cname
-            elif cname in ("asarray", "array"):
-                f = node.func
-                if (isinstance(f, ast.Attribute)
-                        and isinstance(f.value, ast.Name)
-                        and f.value.id == "np"):
-                    name = f"np.{cname}"
-        elif (isinstance(node, ast.Attribute)
-              and node.attr in ("addressable_data", "block_until_ready")):
-            name = node.attr
-        if name is None:
-            continue
-        ctx = lines[max(0, node.lineno - 2):node.lineno]
-        if any("host-ok" in ln or "noqa" in ln for ln in ctx):
-            continue
-        found.setdefault((node.lineno, name.split(".")[-1]), name)
-    return [(lineno,
-             f"{name} in ann_mnmg — the sharded search path must stay "
-             "device-resident (one shard_map program per batch, no host "
-             "round-trips); route build/serialize-time fetches through a "
-             "host-ok-marked helper")
-            for (lineno, _), name in sorted(found.items())]
-
-
-def check_file(path: pathlib.Path):
-    src = path.read_text()
-    findings = []
-    lines = src.splitlines()
-    for i, line in enumerate(lines, 1):
-        if "noqa" in line:
-            continue
-        if line.rstrip("\n") != line.rstrip():
-            findings.append((i, "trailing whitespace"))
-        if line.startswith("\t") or (line[: len(line) - len(line.lstrip())]
-                                     .find("\t") >= 0):
-            findings.append((i, "tab in indentation"))
-        if len(line) > MAX_LINE:
-            findings.append((i, f"line too long ({len(line)} > {MAX_LINE})"))
-    try:
-        tree = ast.parse(src)
-    except SyntaxError as e:
-        return [(e.lineno or 0, f"syntax error: {e.msg}")]
-
-    # raw scatter segment-sums are quarantined in linalg/reduce.py (its
-    # wrapper + the one-hot engine are the blessed routes) — library code
-    # only; bench/ keeps raw calls for the engine A/B microbenches
-    posix = path.as_posix()
-    if "raft_tpu/" in posix and not posix.endswith("linalg/reduce.py"):
-        for node in ast.walk(tree):
-            if (isinstance(node, ast.Attribute)
-                    and node.attr == "segment_sum"
-                    and isinstance(node.value, ast.Attribute)
-                    and node.value.attr == "ops"
-                    and "noqa" not in lines[node.lineno - 1]):
-                findings.append((node.lineno,
-                                 "raw jax.ops.segment_sum outside "
-                                 "linalg/reduce.py — use "
-                                 "raft_tpu.linalg.reduce helpers"))
-
-    # probe-scan tile callbacks must stay lookup-only (hoisted-ADC guard)
-    if "raft_tpu/neighbors/" in posix:
-        findings.extend(check_probe_scan_callbacks(tree, lines))
-
-    # the sharded search path and the tiled build/populate hot path must
-    # never fetch per-row data to host (chunk-table bookkeeping lines
-    # carry host-ok markers)
-    if (posix.endswith("neighbors/ann_mnmg.py")
-            or posix.endswith("neighbors/_build.py")):
-        findings.extend(check_ann_mnmg_host_transfers(tree, lines))
-
-    # serve hot paths must dispatch the aot() cache (zero-retrace guard)
-    if "raft_tpu/serve/" in posix:
-        findings.extend(check_serve_hot_path(tree, lines))
-
-    # format specs are themselves JoinedStr nodes — exclude them from the
-    # placeholder check
-    spec_ids = {id(fv.format_spec) for fv in ast.walk(tree)
-                if isinstance(fv, ast.FormattedValue)
-                and fv.format_spec is not None}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            if "noqa" not in lines[node.lineno - 1]:
-                findings.append((node.lineno, "bare except"))
-        if isinstance(node, ast.JoinedStr) and id(node) not in spec_ids:
-            if not any(isinstance(v, ast.FormattedValue) for v in node.values):
-                if "noqa" not in lines[node.lineno - 1]:
-                    findings.append((node.lineno,
-                                     "f-string without placeholders"))
-
-    if path.name != "__init__.py":
-        imported = {}  # alias -> lineno
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Import):
-                for a in node.names:
-                    name = (a.asname or a.name).split(".")[0]
-                    imported[name] = node.lineno
-            elif isinstance(node, ast.ImportFrom):
-                if node.module == "__future__":
-                    continue  # compiler directives, not names
-                for a in node.names:
-                    if a.name == "*":
-                        continue
-                    imported[a.asname or a.name] = node.lineno
-        used = set()
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Name):
-                used.add(node.id)
-        # names in docstrings/comments don't count; __all__ strings do
-        for node in ast.walk(tree):
-            if (isinstance(node, ast.Assign)
-                    and any(getattr(t, "id", None) == "__all__"
-                            for t in node.targets)):
-                for el in ast.walk(node.value):
-                    if isinstance(el, ast.Constant) and isinstance(el.value, str):
-                        used.add(el.value)
-        for name, lineno in sorted(imported.items(), key=lambda kv: kv[1]):
-            if name not in used and "noqa" not in lines[lineno - 1]:
-                findings.append((lineno, f"unused import: {name}"))
-    return findings
+def check_file(path):
+    """[(lineno, message)] findings for one file — the historical
+    signature over the full registered rule set."""
+    return [(f.lineno, f.message)
+            for f in _engine.check_file(pathlib.Path(path))]
 
 
 def main(argv):
-    roots = [pathlib.Path(p) for p in (argv or ["raft_tpu", "tests", "bench",
-                                                "ci", "docs", "bench.py",
-                                                "__graft_entry__.py"])]
-    files = []
-    for r in roots:
-        if r.is_dir():
-            files.extend(sorted(r.rglob("*.py")))
-        elif r.suffix == ".py":
-            files.append(r)
-    bad = 0
-    for f in files:
-        for lineno, msg in check_file(f):
-            print(f"{f}:{lineno}: {msg}")
-            bad += 1
-    if bad:
-        print(f"lint: {bad} finding(s)", file=sys.stderr)
-        return 1
-    print(f"lint: {len(files)} files clean")
-    return 0
+    return _engine.main(argv)
 
 
 if __name__ == "__main__":

@@ -41,6 +41,8 @@ PACKAGES = [
     ("label", "Label relabeling/merging utilities"),
     ("comms", "comms_t-shaped collectives over XLA; host p2p plane; "
               "session bootstrap"),
+    ("analysis", "Static analysis of hot-path contracts: AST rule engine "
+                 "+ lowered-HLO program auditor"),
 ]
 
 
@@ -104,6 +106,9 @@ _SUBMODULES = {
     # the submodule, not the package namespace — without this section the
     # MNMG API (including fit's loop=/sync_every= knobs) is undocumented.
     "cluster": ["kmeans_mnmg"],
+    # the analysis package is fully lazy (stdlib registry importable from
+    # hot modules at zero cost) — its whole surface lives on submodules
+    "analysis": ["engine", "hotpaths", "registry", "hlo_audit"],
 }
 
 

@@ -1,0 +1,43 @@
+"""Static analysis for the hot-path contracts (``docs/static_analysis.md``).
+
+Two levels, both runnable from ``python -m raft_tpu.analysis``:
+
+* **Level 1 — AST rule engine** (:mod:`raft_tpu.analysis.engine`,
+  :mod:`raft_tpu.analysis.rules`): source-level rules over the repo —
+  the four historical ``ci/lint.py`` contracts (raw segment-sums, probe-scan
+  closures, serve-path dispatch, hot-path host transfers) plus collective
+  discipline, trace purity, static-arg hashability and dtype drift — with
+  ONE unified inline-exemption syntax (``# exempt(rule-id): rationale``)
+  that subsumes the legacy ``adc-exempt`` / ``serve-exempt`` / ``host-ok``
+  markers (still parsed for back-compat).
+
+* **Level 2 — HLO program auditor** (:mod:`raft_tpu.analysis.hlo_audit`,
+  :mod:`raft_tpu.analysis.registry`): hot-path programs declare their
+  signature grid and budgets NEXT TO their definitions via
+  :func:`raft_tpu.analysis.registry.hlo_program`; the auditor lowers each
+  declared signature with ``jax.jit(...).lower(...)`` and statically checks
+  the artifact — no host callbacks/infeed/outfeed, collective launch count
+  and payload bytes within budget, declared donations actually landing in
+  ``input_output_alias``, and ``memory_analysis()`` transients under the
+  declared ceiling.
+
+This module imports NOTHING heavy at package-import time (``registry`` is
+stdlib-only, so hot modules can declare audit entries for free); the jax
+machinery loads only when the auditor actually runs.
+"""
+
+_SUBMODULES = ("engine", "hotpaths", "registry", "rules", "hlo_audit")
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        import importlib
+
+        mod = importlib.import_module(f"raft_tpu.analysis.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'raft_tpu.analysis' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals().keys()) + list(_SUBMODULES))

@@ -1,0 +1,104 @@
+"""CLI: ``python -m raft_tpu.analysis [options] [paths...]``.
+
+Default: BOTH levels — the AST rule engine over the repo surface, then the
+HLO auditor over every registered hot-path program.  Exit 1 on any
+finding.
+
+Options:
+  --ast             Level 1 only (stdlib-fast; what ci/lint.py shims to)
+  --hlo             Level 2 only
+  --fast            restrict the HLO audit to the fast (single-device)
+                    program subset
+  --strict          CI mode: a SKIPPED program counts as a failure (a
+                    preset XLA_FLAGS device count must not silently
+                    disable the sharded audits)
+  --programs a,b    audit only the named programs
+  --list            list registered rules and programs, run nothing
+  paths...          restrict the AST level to these files/dirs
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# The HLO auditor lowers mesh programs (sharded ANN search): on the CPU
+# backend give the process the 8-virtual-device mesh the test suite uses.
+# Must happen before the first backend initialization; importing raft_tpu
+# does not initialize one (jax.config only), so setting it here — after
+# package import, before any jax.devices() — is in time.
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
+
+def main(argv) -> int:
+    args = list(argv)
+    do_ast = do_hlo = True
+    fast_only = False
+    names = None
+    if "--ast" in args:
+        args.remove("--ast")
+        do_hlo = False
+    if "--hlo" in args:
+        args.remove("--hlo")
+        do_ast = False
+    if "--fast" in args:
+        args.remove("--fast")
+        fast_only = True
+    strict = False
+    if "--strict" in args:
+        args.remove("--strict")
+        strict = True
+    if "--programs" in args:
+        i = args.index("--programs")
+        args.pop(i)
+        if i < len(args):
+            names = args.pop(i).split(",")
+    else:
+        for a in list(args):
+            if a.startswith("--programs="):
+                args.remove(a)
+                names = a.split("=", 1)[1].split(",")
+    if "--list" in args:
+        from raft_tpu.analysis import engine, registry
+
+        print("AST rules:")
+        for r in engine.iter_rules():
+            doc = (r.doc.splitlines() or [""])[0]
+            print(f"  {r.id:26s} [{r.severity}] {doc[:70]}")
+        print("HLO programs:")
+        for e in registry.iter_programs():
+            tags = []
+            if e.fast:
+                tags.append("fast")
+            if e.requires_devices > 1:
+                tags.append(f">={e.requires_devices}dev")
+            print(f"  {e.name:32s} coll<={e.collectives} "
+                  f"bytes<={e.collective_bytes} "
+                  f"temp<={e.transient_bytes} {' '.join(tags)}")
+        return 0
+
+    bad = 0
+    if do_ast:
+        from raft_tpu.analysis import engine
+
+        print("== analysis: AST rules ==")
+        bad += engine.run(args or None)
+    if do_hlo:
+        from raft_tpu.analysis import hlo_audit
+
+        print("== analysis: HLO audit ==")
+        _, failed = hlo_audit.run(names, fast_only=fast_only,
+                                  strict=strict)
+        bad += failed
+    if bad:
+        print(f"analysis: {bad} failure(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,282 @@
+"""Level 1 — the AST rule engine.
+
+A rule is (id, severity, scope predicate, check function, optional legacy
+markers).  Rules register themselves via :func:`rule` at import of
+:mod:`raft_tpu.analysis.rules`; the engine parses each file once and hands
+every in-scope rule the same :class:`FileContext`.
+
+Exemptions — ONE unified inline syntax::
+
+    jnp.einsum(...)  # exempt(probe-scan-closure): HOISTED_LUT=0 baseline
+
+``# exempt(<rule-id>[, <rule-id>...]): <rationale>`` on the flagged line or
+the line above sanctions a finding of the named rule(s).  The rationale is
+REQUIRED — a marker without one does not exempt anything and is itself
+flagged (``exemption-hygiene``), so there are no blanket allowlists.  The
+pre-existing spellings remain parsed for back-compat and map onto rule ids:
+
+    ========================  =========================
+    legacy marker             rule id
+    ========================  =========================
+    ``adc-exempt``            ``probe-scan-closure``
+    ``serve-exempt``          ``serve-dispatch``
+    ``host-ok``               ``hot-path-host-transfer``
+    ``noqa``                  every rule
+    ========================  =========================
+
+This module is stdlib-only (ast/pathlib/re) so the whole Level-1 gate runs
+with zero jax import cost.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+import re
+import sys
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: unified marker: ``exempt(rule-a, rule-b): rationale`` inside a comment
+_EXEMPT_RE = re.compile(r"exempt\(\s*([a-z0-9_\-,\s]+?)\s*\)\s*:?\s*(.*)")
+
+#: legacy spellings → the rule id each one sanctions (back-compat)
+LEGACY_MARKERS = {
+    "adc-exempt": "probe-scan-closure",
+    "serve-exempt": "serve-dispatch",
+    "host-ok": "hot-path-host-transfer",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    lineno: int
+    message: str
+    severity: str = "error"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One registered contract check.
+
+    ``scope`` is a predicate over the file's posix path string — scoping is
+    path-shaped (package dirs, module names), matching how the historical
+    ci/lint.py rules were keyed, and works on quarantine tmp-paths too.
+    """
+
+    id: str
+    severity: str
+    doc: str
+    scope: Callable[[str], bool]
+    check: Callable[["FileContext"], List[Tuple[int, str]]]
+    legacy_markers: Tuple[str, ...] = ()
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def rule(id: str, *, scope: Callable[[str], bool], severity: str = "error",
+         legacy_markers: Tuple[str, ...] = (), doc: str = ""):
+    """Decorator: register ``fn(ctx) -> [(lineno, message)]`` as a rule."""
+
+    def deco(fn):
+        _RULES[id] = Rule(id, severity, doc or (fn.__doc__ or "").strip(),
+                          scope, fn, legacy_markers)
+        return fn
+
+    return deco
+
+
+def iter_rules() -> List[Rule]:
+    _ensure_rules_loaded()
+    return [r for _, r in sorted(_RULES.items())]
+
+
+def get_rule(rule_id: str) -> Optional[Rule]:
+    _ensure_rules_loaded()
+    return _RULES.get(rule_id)
+
+
+def _ensure_rules_loaded():
+    # rules modules self-register on import; idempotent
+    import raft_tpu.analysis.rules  # noqa: F401
+
+
+# ---------------------------------------------------------------------------
+# per-file context
+
+
+def call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def module_level_names(tree: ast.Module) -> set:
+    """Names bound at module level (imports, defs, assignments) — the
+    shared "not a closed-over operand / not a local" baseline several
+    rules resolve against."""
+    names = set()
+    for node in tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                names.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            if isinstance(node.target, ast.Name):
+                names.add(node.target.id)
+    return names
+
+
+class FileContext:
+    """One parsed file, shared by every rule that runs on it."""
+
+    def __init__(self, posix: str, src: str):
+        self.posix = posix
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src)
+        self._module_names: Optional[set] = None
+
+    @property
+    def module_names(self) -> set:
+        if self._module_names is None:
+            self._module_names = module_level_names(self.tree)
+        return self._module_names
+
+    def _marker_lines(self, lineno: int) -> List[str]:
+        # the flagged line and the line above carry markers (historical
+        # ci/lint.py contract, preserved so existing in-tree markers and
+        # quarantine tests keep working)
+        return self.lines[max(0, lineno - 2):lineno]
+
+    def exempt(self, rule_id: str, lineno: int) -> bool:
+        """True when *lineno* (or the line above) sanctions *rule_id* via
+        the unified marker, a legacy spelling, or ``noqa``."""
+        legacy = {m for m, rid in LEGACY_MARKERS.items() if rid == rule_id}
+        r = _RULES.get(rule_id)
+        if r is not None:
+            legacy.update(r.legacy_markers)
+        for ln in self._marker_lines(lineno):
+            if "noqa" in ln:
+                return True
+            if any(m in ln for m in legacy):
+                return True
+            m = _EXEMPT_RE.search(ln)
+            if m is not None:
+                ids = {p.strip() for p in m.group(1).split(",")}
+                if rule_id in ids and m.group(2).strip():
+                    return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# engine-level hygiene: a marker that cannot exempt anything is a finding
+
+
+def _check_marker_hygiene(ctx: FileContext) -> List[Finding]:
+    findings = []
+    for i, ln in enumerate(ctx.lines, 1):
+        hash_at = ln.find("#")
+        if hash_at < 0:
+            continue
+        comment = ln[hash_at:]
+        m = _EXEMPT_RE.search(comment)
+        if m is None:
+            continue
+        if not m.group(2).strip():
+            findings.append(Finding(
+                "exemption-hygiene", i,
+                "exempt(...) marker without a rationale — the unified "
+                "exemption syntax is `# exempt(rule-id): why this use is "
+                "sanctioned`; a bare marker exempts nothing "
+                "(no blanket allowlists)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# runners
+
+
+def check_source(posix: str, src: str) -> List[Finding]:
+    """Run every in-scope rule over one source blob (the quarantine-test
+    entry point: no file needs to exist)."""
+    _ensure_rules_loaded()
+    try:
+        ctx = FileContext(posix, src)
+    except SyntaxError as e:
+        return [Finding("syntax", e.lineno or 0, f"syntax error: {e.msg}")]
+    findings = _check_marker_hygiene(ctx)
+    for r in iter_rules():
+        if not r.scope(posix):
+            continue
+        findings.extend(Finding(r.id, lineno, msg, r.severity)
+                        for lineno, msg in r.check(ctx))
+    return sorted(findings, key=lambda f: (f.lineno, f.rule))
+
+
+def check_file(path: pathlib.Path) -> List[Finding]:
+    path = pathlib.Path(path)
+    return check_source(path.as_posix(), path.read_text())
+
+
+DEFAULT_ROOTS = ("raft_tpu", "tests", "bench", "ci", "docs", "bench.py",
+                 "__graft_entry__.py")
+
+#: the checkout this engine ships in — the default roots anchor here, so
+#: ``python -m raft_tpu.analysis`` works from any cwd
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+
+
+def collect_files(roots: Sequence[str]) -> List[pathlib.Path]:
+    files: List[pathlib.Path] = []
+    for r in roots:
+        p = pathlib.Path(r)
+        if not p.exists() and not p.is_absolute() and (REPO_ROOT / p).exists():
+            p = REPO_ROOT / p   # convenience fallback for explicit
+            #                     relative paths given from a foreign cwd
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py" and p.exists():
+            files.append(p)
+    return files
+
+
+def run(roots: Optional[Sequence[str]] = None, *,
+        out=sys.stdout) -> int:
+    """Check *roots* (files/dirs; defaults to the repo surface), print
+    findings, return the number of error-severity findings.  The DEFAULT
+    roots always anchor at the checkout (generic names like tests/ or
+    docs/ must not resolve against some other project in the caller's
+    cwd); explicit *roots* resolve cwd-first as passed."""
+    if roots is None:
+        roots = [str(REPO_ROOT / r) for r in DEFAULT_ROOTS]
+    files = collect_files(roots)
+    bad = 0
+    for f in files:
+        for fd in check_file(f):
+            print(f"{f}:{fd.lineno}: [{fd.rule}] {fd.message}", file=out)
+            if fd.severity == "error":
+                bad += 1
+    if not bad:
+        print(f"analysis: {len(files)} files clean "
+              f"({len(iter_rules())} rules)", file=out)
+    return bad
+
+
+def main(argv: Optional[Iterable[str]] = None) -> int:
+    bad = run(list(argv) if argv else None)
+    if bad:
+        print(f"analysis: {bad} finding(s)", file=sys.stderr)
+        return 1
+    return 0

@@ -1,0 +1,349 @@
+"""Level 2 — the lowered-HLO program auditor.
+
+Every program in :mod:`raft_tpu.analysis.registry` is lowered with
+``jax.jit(...).lower(...)`` (no data materializes; specs suffice) and its
+COMPILED artifact is checked statically:
+
+(a) **host purity** — no infeed/outfeed/send/recv ops and no
+    python-callback custom-calls (``pure_callback`` / ``io_callback`` /
+    ``jax.debug.print`` all lower to ``*python*callback*`` targets);
+    compute custom-calls (TopK, LAPACK) are fine — the contract is "no
+    host round-trips inside the program", not "no custom code".
+
+(b) **collective budget** — count and summed result-payload bytes of
+    ``all-reduce`` / ``all-gather`` / ``all-to-all`` /
+    ``collective-permute`` / ``reduce-scatter`` ops in the optimized
+    module must sit within the entry's declared budget.  This is the
+    static mirror of the runtime ``Comms.collective_calls`` asserts: a
+    program that grows a second allgather fails HERE, before any bench
+    runs.
+
+(c) **donation aliasing** — every declared ``donate_argnums`` must land in
+    the executable's ``input_output_alias`` table.  Backends differ:
+    XLA:TPU honors donation as must-alias; XLA:CPU records may-alias (a
+    hint the runtime may ignore) and can DROP it entirely — the entry's
+    ``donation_policy`` says which backends merely record status
+    ("may-alias") vs fail ("must-alias").  A silently dropped donation on
+    a must-alias backend is a finding (the O(index) copy returns).
+
+(d) **transient ceiling** — ``compiled.memory_analysis()
+    .temp_size_in_bytes`` must not exceed the declared ceiling
+    (graduating the PR-7 in-bench O(tile)-transient assert into CI).
+
+Run via ``python -m raft_tpu.analysis`` (both levels) or programmatically
+through :func:`run`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Tuple
+
+from raft_tpu.analysis import registry
+
+# ---------------------------------------------------------------------------
+# HLO text inspection (stdlib re over compiled.as_text())
+
+_COLLECTIVE_OPS = ("all-reduce", "all-gather", "all-to-all",
+                   "collective-permute", "reduce-scatter")
+
+#: ``f32[8,16]{1,0}`` → (dtype, dims); also bare ``f32[]`` scalars
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3": 1, "f8e5m2": 1, "f8e4m3fn": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+#: custom-call targets that mean "bounce through the host python runtime"
+_CALLBACK_RE = re.compile(r'custom_call_target="([^"]*(?:callback|infeed|'
+                          r'outfeed|host)[^"]*)"', re.IGNORECASE)
+
+_BANNED_OP_RE = re.compile(
+    r"=\s*[^=\n]*\b(infeed|outfeed|send|send-done|recv|recv-done)\(")
+
+
+def _element_bytes(shape_str: str) -> List[int]:
+    """Per-element byte sizes of a shape string — one entry for a plain
+    shape, one per component for tuples: ``(f32[8,16]{1,0}, s32[8]{0})``."""
+    out = []
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        nbytes = _DTYPE_BYTES.get(dtype)
+        if nbytes is None:
+            continue  # token/opaque shapes carry no payload
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        out.append(n * nbytes)
+    return out
+
+
+def _shape_bytes(shape_str: str) -> int:
+    return sum(_element_bytes(shape_str))
+
+
+def collective_stats(hlo_text: str) -> Tuple[int, int, List[str]]:
+    """(launch count, summed result-payload bytes, op lines) of collective
+    ops in an HLO module text.  ``*-start``/``*-done`` pairs count once
+    (async split of one launch)."""
+    count, total, ops = 0, 0, []
+    for line in hlo_text.splitlines():
+        s = line.strip()
+        m = re.match(r"(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(\([^)]*\)|\S+)\s+"
+                     r"([\w\-]+)\(", s)
+        if not m:
+            continue
+        shape_str, op = m.group(1), m.group(2)
+        base = op[:-6] if op.endswith("-start") else op
+        if base.endswith("-done"):
+            continue  # the paired -start already counted this launch
+        if base not in _COLLECTIVE_OPS:
+            continue
+        count += 1
+        if op.endswith("-start") and shape_str.startswith("("):
+            # async lowering returns (operands..., results...): count the
+            # RESULT half only — budgets declare result payload, and the
+            # operand aliases live buffers (no extra transfer)
+            elems = _element_bytes(shape_str)
+            total += sum(elems[len(elems) // 2:])
+        else:
+            total += _shape_bytes(shape_str)
+        ops.append(s[:160])
+    return count, total, ops
+
+
+def host_call_findings(hlo_text: str) -> List[str]:
+    """Host-purity violations in an HLO module text."""
+    findings = []
+    for m in _CALLBACK_RE.finditer(hlo_text):
+        findings.append(f"host callback custom-call: {m.group(1)}")
+    for m in _BANNED_OP_RE.finditer(hlo_text):
+        findings.append(f"host-transfer op: {m.group(1)}")
+    return sorted(set(findings))
+
+
+def aliased_params(hlo_text: str) -> List[Tuple[int, str]]:
+    """(parameter index, alias kind) pairs from the module's
+    ``input_output_alias`` declaration."""
+    m = re.search(r"input_output_alias=\{((?:[^{}]*\{[^{}]*\})*[^{}]*)\}",
+                  hlo_text)
+    if m is None:
+        return []
+    out = []
+    for pm in re.finditer(r"\(\s*(\d+)\s*,\s*\{[^}]*\}\s*(?:,\s*"
+                          r"([a-z\-]+))?\)", m.group(1)):
+        out.append((int(pm.group(1)), pm.group(2) or "must-alias"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-program audit
+
+
+@dataclasses.dataclass
+class ProgramReport:
+    name: str
+    status: str                      # "ok" | "fail" | "skipped"
+    findings: List[str]
+    stats: Dict[str, object]
+
+
+def _compile_entry(entry: registry.ProgramEntry):
+    """Builder contract: ``{"fn", "args", ...}`` → we lower+compile;
+    ``{"lowered": ...}`` → we compile; ``{"compiled": ...}`` → programs
+    that own their executable cache (MeshAotFunction) hand it over.
+    Returns (compiled, spec) — the spec rides along so the donation check
+    can count the declared donated LEAVES, not just non-emptiness."""
+    import jax
+
+    spec = entry.builder()
+    if "compiled" in spec:
+        return spec["compiled"], spec
+    if "lowered" in spec:
+        return spec["lowered"].compile(), spec
+    jitted = jax.jit(spec["fn"],
+                     static_argnums=tuple(spec.get("static_argnums", ())),
+                     donate_argnums=tuple(spec.get("donate_argnums",
+                                                   entry.donate_argnums)))
+    return jitted.lower(*spec["args"]).compile(), spec
+
+
+def _donated_leaf_count(entry, spec) -> Optional[int]:
+    """How many array leaves the declared donate_argnums cover, when the
+    spec exposes its args (None for compiled/lowered handovers)."""
+    import jax
+
+    if "args" not in spec:
+        return None
+    argnums = tuple(spec.get("donate_argnums", entry.donate_argnums))
+    return sum(len(jax.tree_util.tree_leaves(spec["args"][i]))
+               for i in argnums if i < len(spec["args"]))
+
+
+def audit_program(entry: registry.ProgramEntry) -> ProgramReport:
+    import jax
+
+    if len(jax.devices()) < entry.requires_devices:
+        return ProgramReport(entry.name, "skipped", [],
+                             {"reason": f"needs >= {entry.requires_devices} "
+                                        f"devices, have {len(jax.devices())}"})
+    backend = jax.default_backend()
+    findings: List[str] = []
+    stats: Dict[str, object] = {"backend": backend}
+    try:
+        compiled, spec = _compile_entry(entry)
+    except Exception as e:  # a program that fails to LOWER is a finding
+        return ProgramReport(entry.name, "fail",
+                             [f"lower/compile failed: {e!r}"], stats)
+    text = compiled.as_text()
+
+    # (a) host purity
+    host = host_call_findings(text)
+    findings.extend(host)
+
+    # (b) collective budget
+    count, nbytes, ops = collective_stats(text)
+    stats["collectives"] = count
+    stats["collective_bytes"] = nbytes
+    if count > entry.collectives:
+        findings.append(
+            f"collective launches {count} > budget {entry.collectives} "
+            f"({'; '.join(o.split(' = ')[0] for o in ops)})")
+    if nbytes > entry.collective_bytes:
+        findings.append(
+            f"collective payload {nbytes} B > budget "
+            f"{entry.collective_bytes} B")
+
+    # (c) donation aliasing
+    if entry.donate_argnums:
+        aliased = aliased_params(text)
+        stats["aliased_params"] = aliased
+        policy = entry.donation_policy.get(backend, "must-alias")
+        stats["donation_policy"] = f"{backend}:{policy}"
+        if not aliased:
+            msg = (f"declared donate_argnums={entry.donate_argnums} but "
+                   "the executable has NO input_output_alias — the "
+                   "donation was silently dropped (the O(buffer) copy "
+                   "is back)")
+            if policy == "must-alias":
+                findings.append(msg)
+            else:
+                stats["donation_status"] = (
+                    f"dropped on {backend} (policy {policy}: recorded, "
+                    "not failed)")
+        else:
+            kinds = {k for _, k in aliased}
+            expected = _donated_leaf_count(entry, spec)
+            stats["donation_status"] = (
+                f"{len(aliased)}/{expected if expected is not None else '?'}"
+                f" donated leaf(s) aliased, {sorted(kinds)}")
+            if expected is not None and len(aliased) < expected:
+                # PARTIAL drop: some donated leaves never landed in the
+                # alias table — the O(buffer) copy is back for exactly
+                # those, which "not aliased at all" checking would miss
+                msg = (f"only {len(aliased)} of {expected} donated "
+                       f"leaves landed in input_output_alias — the rest "
+                       "were silently dropped")
+                if policy == "must-alias":
+                    findings.append(msg)
+                else:
+                    stats["donation_status"] += (
+                        f"; partial drop on {backend} (policy {policy}: "
+                        "recorded, not failed)")
+            elif policy == "must-alias" and kinds == {"may-alias"}:
+                # hint-only aliasing on a backend that promised must-alias
+                findings.append(
+                    f"donation lowered as may-alias on {backend}, but the "
+                    "entry declares must-alias there")
+
+    # (d) transient ceiling
+    if entry.transient_bytes is not None:
+        try:
+            temp = int(compiled.memory_analysis().temp_size_in_bytes)
+        except Exception:
+            temp = None
+        stats["transient_bytes"] = temp
+        if temp is not None and temp > entry.transient_bytes:
+            findings.append(
+                f"transient {temp} B exceeds declared ceiling "
+                f"{entry.transient_bytes} B")
+        elif temp is None:
+            # a declared ceiling that cannot be MEASURED is a finding,
+            # not a silent pass — otherwise a backend without
+            # memory_analysis un-graduates the O(tile) gate unnoticed
+            findings.append(
+                "transient ceiling declared but memory_analysis is "
+                "unavailable on this backend — the ceiling went "
+                "unchecked")
+
+    return ProgramReport(entry.name, "fail" if findings else "ok",
+                         findings, stats)
+
+
+#: the acceptance floor for a FULL audit: fewer verified programs than
+#: this means the registry (or the device environment) silently collapsed
+MIN_VERIFIED = 6
+
+
+def run(names: Optional[List[str]] = None, *, fast_only: bool = False,
+        strict: bool = False, out=None) -> Tuple[List[ProgramReport], int]:
+    """Audit the registered programs (all, the fast subset, or *names*).
+    Returns (reports, failure count); prints a verification table.
+
+    ``strict`` (CI): a SKIPPED program counts as a failure — a preset
+    ``XLA_FLAGS`` device count must not quietly disable the sharded
+    one-allgather audits while the gate still exits 0.  Full runs
+    additionally enforce the :data:`MIN_VERIFIED` floor either way."""
+    import sys
+
+    out = out or sys.stdout
+    if names:
+        entries = []
+        for n in names:
+            e = registry.get_program(n)
+            if e is None:
+                raise KeyError(f"unknown hlo program {n!r} (registered: "
+                               f"{[p.name for p in registry.iter_programs()]})")
+            entries.append(e)
+    else:
+        entries = registry.iter_programs(fast_only=fast_only)
+    reports, failed = [], 0
+    for e in entries:
+        r = audit_program(e)
+        reports.append(r)
+        failed += r.status == "fail"
+        coll = r.stats.get("collectives")
+        extra = []
+        if coll is not None:
+            extra.append(f"coll {coll}/{e.collectives} "
+                         f"{r.stats.get('collective_bytes')}B")
+        if r.stats.get("transient_bytes") is not None:
+            extra.append(f"temp {r.stats['transient_bytes']}B"
+                         f"<={e.transient_bytes}B")
+        if "donation_status" in r.stats:
+            extra.append(f"donation: {r.stats['donation_status']}")
+        if "reason" in r.stats:
+            extra.append(str(r.stats["reason"]))
+        print(f"  [{r.status:>7}] {r.name:32s} {'; '.join(extra)}",
+              file=out)
+        for f in r.findings:
+            print(f"           - {f}", file=out)
+    verified = sum(r.status == "ok" for r in reports)
+    skipped = sum(r.status == "skipped" for r in reports)
+    print(f"hlo_audit: {verified} program(s) verified, {failed} failed, "
+          f"{skipped} skipped", file=out)
+    if strict and skipped:
+        print(f"hlo_audit: STRICT — {skipped} skipped program(s) count "
+              "as failures (device environment disabled part of the "
+              "registry)", file=out)
+        failed += skipped
+    if names is None and not fast_only and verified < MIN_VERIFIED:
+        print(f"hlo_audit: only {verified} verified < the {MIN_VERIFIED}-"
+              "program acceptance floor for a full audit", file=out)
+        failed += 1
+    return reports, failed

@@ -1,0 +1,96 @@
+"""The declared hot-path registry.
+
+The ``hot-path-host-transfer`` rule used to be two hardcoded module names
+(``ann_mnmg.py``, ``_build.py``); this registry generalizes it to the full
+set of paths whose performance contract is "per-row data never round-trips
+the host": the serving engine's dispatch path, every neighbors search
+program, the tiled/sharded build populate path, and the cluster fused-EM
+loop.  Entries are either module-wide or scoped to named functions (a
+module like ``kmeans.py`` legitimately touches host numpy in its training
+prologue — only the fused-EM loop bodies are hot).
+
+Declared here, consumed by :mod:`raft_tpu.analysis.rules.host_transfer`.
+Sanctioned fetches inside a hot path carry the unified exemption marker
+(``# exempt(hot-path-host-transfer): why`` — legacy ``host-ok`` still
+parses).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class HotPath:
+    """One declared hot path.
+
+    ``pattern`` matches as a posix-path substring (directories end with
+    ``/``) or suffix (module files); ``functions`` — when non-empty — limits
+    the rule to the bodies of the named top-level functions, so a module
+    can keep host-side training/setup code outside the contract.
+
+    Staleness guard: a ``functions`` name that stops resolving (a rename)
+    would silently void the entry's coverage — tier-1 pins every declared
+    name against its module's AST
+    (tests/test_analysis.py::TestShippedRegistry::
+    test_hotpath_function_scopes_resolve), so a rename fails CI loudly
+    instead.
+    """
+
+    pattern: str
+    functions: Tuple[str, ...] = ()
+    why: str = ""
+
+    def matches(self, posix: str) -> bool:
+        return self.pattern in posix
+
+
+#: The registry.  Order is documentation order; the rule unions matches.
+HOT_PATHS: Tuple[HotPath, ...] = (
+    HotPath("raft_tpu/neighbors/ann_mnmg.py",
+            why="sharded search is ONE shard_map program per batch; a host "
+                "fetch serializes the whole mesh behind one host thread"),
+    HotPath("raft_tpu/neighbors/_build.py",
+            why="tiled build/populate keeps per-row data on device end to "
+                "end; only (n_lists,)-shaped chunk-table bookkeeping and "
+                "the (n,) shard-routing vector may fetch, marked"),
+    HotPath("raft_tpu/neighbors/knn_mnmg.py",
+            why="multi-part kNN merge is one allgather + device fold; a "
+                "host fetch reintroduces the gather-to-host merge"),
+    HotPath("raft_tpu/neighbors/_common.py",
+            why="the chunked-list pack/scan layer: only (n_lists,)-shaped "
+                "table bookkeeping may fetch, marked"),
+    HotPath("raft_tpu/serve/",
+            why="the serving dispatch loop double-buffers device work; an "
+                "unmarked fetch would serialize lanes (host-side request "
+                "assembly and result delivery are sanctioned, marked)"),
+    HotPath("raft_tpu/neighbors/brute_force.py",
+            functions=("_knn_scan_impl", "_knn_scan_chunked"),
+            why="the fused kNN scan program body"),
+    HotPath("raft_tpu/neighbors/ivf_flat.py",
+            functions=("_search_batch_impl",),
+            why="the one-program ivf_flat batch search"),
+    HotPath("raft_tpu/neighbors/ivf_pq.py",
+            functions=("_search_batch_impl", "_full_search_impl",
+                       "_scan_hoisted", "_encode_tile_impl",
+                       "_csum_tile_impl"),
+            why="the ivf_pq search/encode program bodies"),
+    HotPath("raft_tpu/cluster/kmeans.py",
+            functions=("_fused_em_scan", "_fused_em_step", "_em_body",
+                       "_fit_main", "_fit_main_fori"),
+            why="the fused-EM loop reads x from HBM once per iteration; a "
+                "host fetch inside it re-serializes every iteration"),
+    HotPath("raft_tpu/cluster/kmeans_mnmg.py",
+            functions=("_step_program", "_fit_program",
+                       "_fit_program_fori"),
+            why="the MNMG EM programs are one-allreduce-per-iteration by "
+                "contract; a host fetch inside them serializes every "
+                "iteration behind one host thread"),
+)
+
+
+def match(posix: str) -> Optional[Tuple[HotPath, ...]]:
+    """Every registry entry covering *posix*, or None."""
+    hits = tuple(hp for hp in HOT_PATHS if hp.matches(posix))
+    return hits or None

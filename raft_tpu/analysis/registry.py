@@ -1,0 +1,131 @@
+"""Hot-path program registry for the Level-2 HLO auditor.
+
+Programs declare their audit signature and budgets NEXT TO their
+definitions — a module registers a lazy builder via :func:`hlo_program`::
+
+    from raft_tpu.analysis.registry import hlo_program
+
+    @hlo_program("ivf_pq.encode_tile",
+                 collectives=0,
+                 transient_bytes=8 << 20,   # graduates the PR-7 bench gate
+                 fast=True)
+    def _audit_encode_tile():
+        # runs only when the auditor does; returns the lowering recipe
+        return dict(fn=_encode_tile_impl, args=(...),
+                    static_argnums=_ENC_TILE_STATICS)
+
+The builder returns either ``{"fn", "args", "static_argnums"[,
+"donate_argnums"]}`` (the auditor lowers ``jax.jit(fn, ...)`` over the
+args — ``jax.ShapeDtypeStruct`` leaves welcome, no data needs to
+materialize) or ``{"lowered": <jax Lowered>}`` for programs that own
+their lowering (shard_map meshes, static_argnames jits).
+
+This module is STDLIB-ONLY: hot modules import it at definition time, so
+it must cost nothing (no jax, no engine).  The auditor
+(:mod:`raft_tpu.analysis.hlo_audit`) imports the declaring modules to
+populate the registry, then lowers/compiles and checks each entry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Mapping, Optional, Tuple
+
+#: canonical modules that declare audit entries — the auditor imports
+#: these to populate the registry (declaration rides with the program)
+DECLARING_MODULES = (
+    "raft_tpu.neighbors.brute_force",
+    "raft_tpu.neighbors.ivf_flat",
+    "raft_tpu.neighbors.ivf_pq",
+    "raft_tpu.neighbors._build",
+    "raft_tpu.neighbors.ann_mnmg",
+    "raft_tpu.cluster.kmeans",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramEntry:
+    """One declared hot-path program + its budgets.
+
+    ``collectives`` / ``collective_bytes`` bound the LAUNCH count and the
+    summed result-payload bytes of collective ops in the optimized module
+    (the static mirror of ``Comms.collective_calls``'s runtime counters).
+    ``transient_bytes`` caps ``compiled.memory_analysis().temp_size_in_
+    bytes``; None skips the check (shape-dependent scratch programs).
+    ``donate_argnums`` names argnums whose buffers the program declares
+    donated; ``donation_policy`` maps backend name → "must-alias" (a
+    missing ``input_output_alias`` is a FINDING) or "may-alias" (recorded
+    as per-backend status, not failed — XLA:CPU legitimately treats
+    donation as a hint; see docs/static_analysis.md §donation).
+    ``requires_devices`` gates mesh programs (sharded search needs >1
+    device to lower); entries whose requirement isn't met are reported as
+    skipped, never silently dropped.  ``fast`` marks the subset
+    ci/checks.sh runs on every push.
+    """
+
+    name: str
+    builder: Callable[[], dict]
+    collectives: int = 0
+    collective_bytes: int = 0
+    transient_bytes: Optional[int] = None
+    donate_argnums: Tuple[int, ...] = ()
+    donation_policy: Mapping[str, str] = dataclasses.field(
+        default_factory=dict)
+    requires_devices: int = 1
+    fast: bool = True
+    notes: str = ""
+
+
+_PROGRAMS: Dict[str, ProgramEntry] = {}
+
+
+def hlo_program(name: str, *, collectives: int = 0,
+                collective_bytes: int = 0,
+                transient_bytes: Optional[int] = None,
+                donate_argnums: Tuple[int, ...] = (),
+                donation_policy: Optional[Mapping[str, str]] = None,
+                requires_devices: int = 1, fast: bool = True,
+                notes: str = ""):
+    """Decorator: register the decorated zero-arg builder under *name*."""
+
+    def deco(builder):
+        prior = _PROGRAMS.get(name)
+        if prior is not None and (prior.builder.__module__
+                                  != builder.__module__):
+            # same-module re-registration is a module RELOAD (REPL/debug
+            # sessions) and overwrites; a second module claiming the name
+            # is a genuine collision
+            raise ValueError(f"hlo program {name!r} already registered by "
+                             f"{prior.builder.__module__}")
+        _PROGRAMS[name] = ProgramEntry(
+            name=name, builder=builder, collectives=collectives,
+            collective_bytes=collective_bytes,
+            transient_bytes=transient_bytes,
+            donate_argnums=tuple(donate_argnums),
+            donation_policy=dict(donation_policy or {}),
+            requires_devices=requires_devices, fast=fast, notes=notes)
+        return builder
+
+    return deco
+
+
+def load_declarations() -> None:
+    """Import every declaring module (idempotent) so the registry holds
+    the full catalog."""
+    import importlib
+
+    for mod in DECLARING_MODULES:
+        importlib.import_module(mod)
+
+
+def iter_programs(fast_only: bool = False) -> List[ProgramEntry]:
+    load_declarations()
+    entries = [e for _, e in sorted(_PROGRAMS.items())]
+    if fast_only:
+        entries = [e for e in entries if e.fast]
+    return entries
+
+
+def get_program(name: str) -> Optional[ProgramEntry]:
+    load_declarations()
+    return _PROGRAMS.get(name)

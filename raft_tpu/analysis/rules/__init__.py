@@ -1,0 +1,18 @@
+"""Rule modules self-register with :mod:`raft_tpu.analysis.engine` on
+import; importing this package loads the full catalog."""
+
+from raft_tpu.analysis.rules import (  # noqa: F401
+    collectives,
+    dtype_drift,
+    host_transfer,
+    probe_scan,
+    reductions,
+    serve_path,
+    static_args,
+    style,
+    trace_purity,
+)
+
+__all__ = ["collectives", "dtype_drift", "host_transfer", "probe_scan",
+           "reductions", "serve_path", "static_args", "style",
+           "trace_purity"]

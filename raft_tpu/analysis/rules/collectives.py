@@ -1,0 +1,83 @@
+"""``collective-discipline``: raw XLA collectives (``jax.lax.psum`` /
+``all_gather`` / ``ppermute`` / ...) anywhere in raft_tpu/ outside
+``comms/`` — every collective must launch through the :class:`Comms`
+wrappers, because anything else silently escapes the
+``Comms.collective_calls`` byte/count accounting that the MNMG tests and
+benches assert their launch budgets against (one-allreduce-per-EM-
+iteration, one-allgather-per-search-batch).  A raw ``lax.psum`` in a shard
+program is invisible to that counter: the budget assert still passes while
+the program grows chattier.  ``jax.lax.axis_index`` is NOT banned (rank
+lookup moves no payload)."""
+
+from __future__ import annotations
+
+import ast
+
+from raft_tpu.analysis.engine import rule
+
+#: payload-moving collective primitives (axis_index excluded: no payload)
+BANNED_COLLECTIVES = frozenset({
+    "psum", "psum_scatter", "pmax", "pmin", "pmean", "ppermute",
+    "pshuffle", "pbroadcast", "pdot", "all_gather", "all_gather_invariant",
+    "all_to_all",
+})
+
+
+def _scope(posix: str) -> bool:
+    return "raft_tpu/" in posix and "raft_tpu/comms/" not in posix
+
+
+@rule("collective-discipline", scope=_scope,
+      doc="raw jax.lax collectives outside comms/ escape the "
+          "collective_calls accounting")
+def check_collectives(ctx):
+    findings = []
+    lax_aliases = set()      # names that mean jax.lax in this module
+    direct_imports = set()   # collective names imported bare
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and (node.module == "jax.lax"
+                                or node.module.startswith("jax.lax.")):
+                for a in node.names:
+                    if a.name in BANNED_COLLECTIVES:
+                        direct_imports.add(a.asname or a.name)
+                        if not ctx.exempt("collective-discipline",
+                                          node.lineno):
+                            findings.append((
+                                node.lineno,
+                                f"`from jax.lax import {a.name}` outside "
+                                "comms/ — collectives must launch through "
+                                "the Comms wrappers so collective_calls "
+                                "byte/count accounting sees them, or mark "
+                                "the line exempt(collective-discipline)"))
+            elif node.module == "jax":
+                for a in node.names:
+                    if a.name == "lax":
+                        lax_aliases.add(a.asname or "lax")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.lax" and a.asname:
+                    lax_aliases.add(a.asname)
+    lax_aliases.add("lax")
+    for node in ast.walk(ctx.tree):
+        name = None
+        if isinstance(node, ast.Attribute) and node.attr in BANNED_COLLECTIVES:
+            base = node.value
+            if ((isinstance(base, ast.Attribute) and base.attr == "lax")
+                    or (isinstance(base, ast.Name)
+                        and base.id in lax_aliases)):
+                name = f"lax.{node.attr}"
+        elif (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+              and node.func.id in direct_imports):
+            name = node.func.id
+        if name is None:
+            continue
+        if ctx.exempt("collective-discipline", node.lineno):
+            continue
+        findings.append((
+            node.lineno,
+            f"raw collective {name} outside comms/ — it escapes the "
+            "Comms.collective_calls byte/count accounting (launch/payload "
+            "budget asserts go blind); route it through the Comms "
+            "wrappers, or mark the line exempt(collective-discipline)"))
+    return findings

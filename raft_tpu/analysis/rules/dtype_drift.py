@@ -1,0 +1,60 @@
+"""``dtype-drift``: ``np.float64`` / ``jnp.float64`` constants or dtypes in
+library code outside x64-marked lines.  Under the default
+``jax_enable_x64=False`` a ``jnp.float64`` request SILENTLY produces
+float32 — code that reads as double-precision isn't — and on TPU an actual
+f64 program falls off the MXU entirely.  Lines that are genuinely part of
+the x64-gated API surface mark themselves with ``x64`` in a same-line or
+preceding comment (the codebase's existing idiom: "exact f64 widening
+under x64"), or carry the unified exemption marker with a rationale
+(host-side numpy code that never becomes device constants).
+``raft_tpu/native/`` is out of scope — host FFI marshaling is definitionally
+host-side."""
+
+from __future__ import annotations
+
+import ast
+
+from raft_tpu.analysis.engine import rule
+
+
+def _scope(posix: str) -> bool:
+    # native/ is host FFI marshaling by definition; analysis/ names the
+    # banned tokens in its own rule sources
+    return ("raft_tpu/" in posix and "raft_tpu/native/" not in posix
+            and "raft_tpu/analysis/" not in posix)
+
+
+def _x64_marked(lines, lineno: int) -> bool:
+    for ln in lines[max(0, lineno - 2):lineno]:
+        if "x64" in ln.lower():
+            return True
+    return False
+
+
+@rule("dtype-drift", scope=_scope,
+      doc="float64 in library code outside x64-marked lines")
+def check_dtype_drift(ctx):
+    findings = []
+    for node in ast.walk(ctx.tree):
+        name = None
+        if isinstance(node, ast.Attribute) and node.attr == "float64":
+            base = node.value
+            if isinstance(base, ast.Name) and base.id in ("np", "numpy",
+                                                          "jnp", "jax"):
+                name = f"{base.id}.float64"
+        elif (isinstance(node, ast.Constant)
+              and node.value == "float64"):
+            name = '"float64"'
+        if name is None:
+            continue
+        if _x64_marked(ctx.lines, node.lineno):
+            continue
+        if ctx.exempt("dtype-drift", node.lineno):
+            continue
+        findings.append((
+            node.lineno,
+            f"{name} outside an x64-marked line — without jax_enable_x64 "
+            "this silently demotes to float32 (and on TPU f64 leaves the "
+            "MXU); if the line is genuinely x64-gated note `x64` in its "
+            "comment, otherwise mark it exempt(dtype-drift) with why"))
+    return findings

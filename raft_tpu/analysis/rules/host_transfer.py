@@ -1,0 +1,100 @@
+"""``hot-path-host-transfer`` (legacy marker ``host-ok``): the device-
+residency guard, generalized from two hardcoded module names to the
+declared hot-path registry (:mod:`raft_tpu.analysis.hotpaths`) —
+``np.asarray``/``np.array``, ``jax.device_get``, ``.addressable_data``
+and ``.block_until_ready`` are banned inside every registered hot path.
+Registry entries may scope the ban to named functions (only the fused-EM
+loop of ``kmeans.py`` is hot, not its training prologue); sanctioned
+bookkeeping fetches carry the unified marker with a rationale.  Pure-numpy
+table arithmetic on host data (np.arange/zeros/...) is not a transfer and
+is not flagged."""
+
+from __future__ import annotations
+
+import ast
+
+from raft_tpu.analysis import hotpaths
+from raft_tpu.analysis.engine import call_name, rule
+
+#: Host-transfer surfaces: a fetch anywhere in a hot path reintroduces the
+#: host round-trip the one-program designs exist to eliminate (and silently
+#: serializes device work behind one host thread).
+_HOST_TRANSFER_CALLS = ("asarray", "array", "device_get",
+                        "addressable_data", "block_until_ready")
+
+
+def _transfer_name(node):
+    """The banned-surface name this node uses, or None."""
+    if isinstance(node, ast.Call):
+        cname = call_name(node)
+        if cname in ("device_get", "addressable_data",
+                     "block_until_ready"):
+            return cname
+        if cname in ("asarray", "array"):
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "np"):
+                return f"np.{cname}"
+    elif (isinstance(node, ast.Attribute)
+          and node.attr in ("addressable_data", "block_until_ready")):
+        return node.attr
+    return None
+
+
+def _function_spans(tree, names):
+    """(start, end) line spans of the named top-level (or class-level)
+    function defs — the bodies a function-scoped registry entry covers."""
+    spans = []
+    for node in ast.walk(tree):
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in names):
+            spans.append((node.lineno, node.end_lineno or node.lineno))
+    return spans
+
+
+def check_host_transfers(tree, lines, posix="raft_tpu/neighbors/ann_mnmg.py",
+                         exempt=None):
+    """(tree, lines) form kept for the ci/lint.py shim.  *posix* selects
+    the registry entries (default: the historical ann_mnmg scope)."""
+    hits = hotpaths.match(posix)
+    if not hits:
+        return []
+    if exempt is None:
+        def exempt(lineno):
+            ctx = lines[max(0, lineno - 2):lineno]
+            return any("host-ok" in ln or "noqa" in ln for ln in ctx)
+
+    # module-wide if ANY matching entry is; else the union of function spans
+    module_wide = any(not hp.functions for hp in hits)
+    spans = [] if module_wide else _function_spans(
+        tree, {f for hp in hits for f in hp.functions})
+
+    def in_scope(lineno):
+        return module_wide or any(a <= lineno <= b for a, b in spans)
+
+    found = {}
+    for node in ast.walk(tree):
+        name = _transfer_name(node)
+        if name is None or not in_scope(node.lineno):
+            continue
+        if exempt(node.lineno):
+            continue
+        found.setdefault((node.lineno, name.split(".")[-1]), name)
+    where = "this declared hot path" if not module_wide else posix
+    return [(lineno,
+             f"{name} host transfer in {where} — hot paths must stay "
+             "device-resident (one program per batch/tile, no host "
+             "round-trips); route sanctioned bookkeeping fetches through "
+             "an exempt(hot-path-host-transfer)-marked line")
+            for (lineno, _), name in sorted(found.items())]
+
+
+@rule("hot-path-host-transfer",
+      scope=lambda p: hotpaths.match(p) is not None,
+      legacy_markers=("host-ok",),
+      doc="host fetches inside a declared hot path (hotpaths.HOT_PATHS)")
+def _rule(ctx):
+    return check_host_transfers(
+        ctx.tree, ctx.lines, ctx.posix,
+        exempt=lambda ln: ctx.exempt("hot-path-host-transfer", ln))

@@ -1,0 +1,164 @@
+"""``probe-scan-closure`` (legacy marker ``adc-exempt``): the hoisted-ADC
+regression guard, scoped to raft_tpu/neighbors/ — ``einsum`` /
+``take_along_axis`` inside a ``scan_probe_lists`` tile callback may only
+consume CALLBACK-LOCAL data (the gathered tile, the threaded xs slice); an
+operand closed over from the enclosing search scope means per-batch-
+invariant LUT work crept back into the scan body, the exact per-tile
+recompute the hoist PR removed (docs/ivf_pq_adc.md)."""
+
+from __future__ import annotations
+
+import ast
+
+from raft_tpu.analysis.engine import (call_name,
+                                      module_level_names, rule)
+
+_SCAN_CALLBACK_BANNED = ("einsum", "take_along_axis")
+
+
+def _direct_bindings(fn) -> set:
+    """Names bound in *fn*'s OWN scope: params, direct assignments, loop /
+    comprehension / with targets, and the names of nested defs — but NOT
+    anything bound only inside a nested def's body.  Per-scope resolution
+    keeps the rule honest: a closed-over operand that happens to share a
+    name with some nested helper's local must still read as closed-over at
+    the callsite's scope."""
+    bound = set()
+    a = fn.args
+    for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                + ([a.vararg] if a.vararg else [])
+                + ([a.kwarg] if a.kwarg else [])):
+        bound.add(arg.arg)
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            bound.add(node.name)        # the def name binds here ...
+            continue                    # ... its body is a nested scope
+        if isinstance(node, ast.Lambda):
+            continue
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            bound.add(node.id)
+        stack.extend(ast.iter_child_nodes(node))
+    return bound
+
+
+def _tainted_names(fn, local, module_names) -> set:
+    """Locals of *fn* assigned (in its own scope) from expressions that
+    reference closed-over or already-tainted names — the aliases that
+    would otherwise launder a closed-over operand past the rule
+    (``cb = codebooks; jnp.einsum(..., r, cb)`` is exactly the legacy
+    per-tile LUT recompute shape)."""
+    assigns = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue                    # nested scopes taint separately
+        if isinstance(node, ast.Assign):
+            assigns.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    tainted = set()
+    changed = True
+    while changed:                      # fixpoint over alias chains
+        changed = False
+        for node in assigns:
+            loads = {n.id for n in ast.walk(node.value)
+                     if isinstance(n, ast.Name)
+                     and isinstance(n.ctx, ast.Load)}
+            if any(nm in tainted
+                   or (nm not in local and nm not in module_names)
+                   for nm in loads):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id not in tainted:
+                        tainted.add(t.id)
+                        changed = True
+    return tainted
+
+
+def scan_callbacks(tree) -> list:
+    """Every tile callback handed to a ``scan_probe_lists`` call (2nd
+    positional arg): named defs and inline lambdas.  Shared with the
+    trace-impurity rule (callbacks are program bodies there too)."""
+    cb_names, cb_lambdas = set(), []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and call_name(node) == "scan_probe_lists"
+                and len(node.args) >= 2):
+            cb = node.args[1]
+            if isinstance(cb, ast.Name):
+                cb_names.add(cb.id)
+            elif isinstance(cb, ast.Lambda):
+                cb_lambdas.append(cb)
+    callbacks = list(cb_lambdas)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name in cb_names:
+            callbacks.append(node)
+    return callbacks
+
+
+def check_probe_scan_callbacks(tree, lines, exempt=None):
+    """(tree, lines) form kept for the ci/lint.py shim; *exempt* is a
+    ``(lineno) -> bool`` predicate (defaults to the legacy line-marker
+    parse, so the shim behaves exactly as before)."""
+    if exempt is None:
+        def exempt(lineno):
+            ctx = lines[max(0, lineno - 2):lineno]
+            return any("adc-exempt" in ln or "noqa" in ln for ln in ctx)
+
+    module_names = module_level_names(tree)
+    findings = []
+
+    def check_scope(fn, inherited):
+        """Check one function scope; recurse into nested defs with this
+        scope's locals inherited (lexical scoping).  A local counts as
+        closed-over when it merely aliases / derives from closed-over data
+        (``_tainted_names``), so renaming can't launder the operand."""
+        local = (inherited | _direct_bindings(fn)) - _tainted_names(
+            fn, inherited | _direct_bindings(fn), module_names)
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                check_scope(node, local)
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+            if (not isinstance(node, ast.Call)
+                    or call_name(node) not in _SCAN_CALLBACK_BANNED):
+                continue
+            if exempt(node.lineno):
+                continue
+            free = set()
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for n in ast.walk(arg):
+                    if (isinstance(n, ast.Name)
+                            and isinstance(n.ctx, ast.Load)
+                            and n.id not in local
+                            and n.id not in module_names):
+                        free.add(n.id)
+            if free:
+                findings.append((
+                    node.lineno,
+                    f"{call_name(node)} over closed-over operand(s) "
+                    f"{sorted(free)} inside a scan_probe_lists tile "
+                    "callback — hoist per-batch-invariant LUT work out of "
+                    "the probe scan and thread it as xs (docs/"
+                    "ivf_pq_adc.md), or mark the line "
+                    "exempt(probe-scan-closure)"))
+
+    for cb in scan_callbacks(tree):
+        check_scope(cb, set())
+    return findings
+
+
+@rule("probe-scan-closure",
+      scope=lambda p: "raft_tpu/neighbors/" in p,
+      legacy_markers=("adc-exempt",),
+      doc="einsum/take_along_axis over closed-over operands in a "
+          "scan_probe_lists tile callback (hoisted-ADC contract)")
+def _rule(ctx):
+    return check_probe_scan_callbacks(
+        ctx.tree, ctx.lines,
+        exempt=lambda ln: ctx.exempt("probe-scan-closure", ln))

@@ -1,0 +1,34 @@
+"""``raw-segment-sum``: a raw ``segment_sum`` call through the jax.ops
+module anywhere in raft_tpu/
+outside linalg/reduce.py — keyed reductions must go through the
+``reduce_rows_by_key`` / ``reduce_cols_by_key`` engine (which picks the MXU
+one-hot path when profitable) or ``reduce.segment_sum``; the ivf_pq codebook
+M-step silently missing the one-hot path (PR 2) is exactly the regression
+class this catches."""
+
+from __future__ import annotations
+
+import ast
+
+from raft_tpu.analysis.engine import rule
+
+
+def _scope(posix: str) -> bool:
+    return "raft_tpu/" in posix and not posix.endswith("linalg/reduce.py")
+
+
+@rule("raw-segment-sum", scope=_scope,
+      doc="raw segment_sum via jax.ops outside linalg/reduce.py")
+def check_raw_segment_sum(ctx):
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Attribute)
+                and node.attr == "segment_sum"
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr == "ops"
+                and not ctx.exempt("raw-segment-sum", node.lineno)):
+            findings.append((node.lineno,
+                             "raw segment_sum (jax.ops) outside "
+                             "linalg/reduce.py — use "
+                             "raft_tpu.linalg.reduce helpers"))
+    return findings

@@ -1,0 +1,100 @@
+"""``serve-dispatch`` (legacy marker ``serve-exempt``): the serving
+zero-retrace guard, scoped to raft_tpu/serve/ — no ``jax.jit`` and no
+``jax.lax.*`` anywhere in the package; device work must dispatch the
+backends' ``aot()`` caches so warmup pins every executable and
+``aot_compile_counters`` stays flat under traffic.  Renamed imports
+(``from jax.lax import X``, ``import jax.lax as L``) count too."""
+
+from __future__ import annotations
+
+import ast
+
+from raft_tpu.analysis.engine import rule
+
+
+def check_serve_hot_path(tree, lines, exempt=None):
+    """(tree, lines) form kept for the ci/lint.py shim; *exempt* is a
+    ``(lineno) -> bool`` predicate (defaults to the legacy line-marker
+    parse)."""
+    if exempt is None:
+        def exempt(lineno):
+            ctx = lines[max(0, lineno - 2):lineno]
+            return any("serve-exempt" in ln or "noqa" in ln for ln in ctx)
+
+    findings = []
+
+    # names bound by `from jax import jit/lax`, `from jax.lax import X`,
+    # or `import jax.lax as L` count too — renaming must not launder the
+    # dispatch past the rule
+    jax_aliases = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name in ("jit", "lax"):
+                        jax_aliases[a.asname or a.name] = a.name
+                        if not exempt(node.lineno):
+                            findings.append((
+                                node.lineno,
+                                f"`from jax import {a.name}` in "
+                                "raft_tpu/serve/ — serve hot paths must "
+                                "dispatch through the aot() executable "
+                                "cache (zero-retrace guarantee), or mark "
+                                "the line exempt(serve-dispatch)"))
+            elif node.module and (node.module == "jax.lax"
+                                  or node.module.startswith("jax.lax.")):
+                if not exempt(node.lineno):
+                    findings.append((
+                        node.lineno,
+                        f"`from {node.module} import ...` in "
+                        "raft_tpu/serve/ — serve hot paths must dispatch "
+                        "through the aot() executable cache (zero-retrace "
+                        "guarantee), or mark the line "
+                        "exempt(serve-dispatch)"))
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.lax" or a.name.startswith("jax.lax."):
+                    if a.asname:
+                        jax_aliases[a.asname] = "lax"
+                    if not exempt(node.lineno):
+                        findings.append((
+                            node.lineno,
+                            f"`import {a.name}` in raft_tpu/serve/ — serve "
+                            "hot paths must dispatch through the aot() "
+                            "executable cache (zero-retrace guarantee), or "
+                            "mark the line exempt(serve-dispatch)"))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        base = node.value
+        is_jax_jit = (node.attr == "jit" and isinstance(base, ast.Name)
+                      and base.id == "jax")
+        is_jax_lax = (isinstance(base, ast.Attribute) and base.attr == "lax"
+                      and isinstance(base.value, ast.Name)
+                      and base.value.id == "jax")
+        is_alias_lax = (isinstance(base, ast.Name)
+                        and jax_aliases.get(base.id) == "lax")
+        if not (is_jax_jit or is_jax_lax or is_alias_lax):
+            continue
+        if exempt(node.lineno):
+            continue
+        what = ("jax.jit" if is_jax_jit
+                else f"jax.lax.{node.attr}" if is_jax_lax
+                else f"{base.id}.{node.attr}")
+        findings.append((
+            node.lineno,
+            f"{what} in raft_tpu/serve/ — serve hot paths must dispatch "
+            "through the aot() executable cache (zero-retrace guarantee), "
+            "or mark the line exempt(serve-dispatch)"))
+    return findings
+
+
+@rule("serve-dispatch",
+      scope=lambda p: "raft_tpu/serve/" in p,
+      legacy_markers=("serve-exempt",),
+      doc="jax.jit / jax.lax in serve/ — device work must dispatch the "
+          "aot() caches (zero-retrace)")
+def _rule(ctx):
+    return check_serve_hot_path(
+        ctx.tree, ctx.lines,
+        exempt=lambda ln: ctx.exempt("serve-dispatch", ln))

@@ -1,0 +1,147 @@
+"""``static-arg-hashability``: unhashable literals (lists / dicts / sets /
+ndarray constructors) passed in a STATIC argument position of an
+``aot()``- or ``jax.jit``-wrapped callable at a call site.  Static args key
+the executable cache by ``hash()``: an unhashable value raises only at
+call time (after the trace investment), and a freshly-constructed ndarray
+would defeat the cache even where hashable.  The rule resolves, per
+module, which names are aot/jit wrappers and which positions they declare
+static — the ``F = aot(fn, static_argnums=_STATICS)`` /
+``functools.partial(jax.jit, static_argnums=...)(fn)`` idioms the codebase
+uses — then checks every call of those names."""
+
+from __future__ import annotations
+
+import ast
+
+from raft_tpu.analysis.engine import rule
+
+_ARRAY_CTORS = frozenset({"array", "asarray", "zeros", "ones", "full",
+                          "arange", "linspace"})
+
+
+def _int_tuple(node, consts):
+    """Resolve a static_argnums value to a tuple of ints, or None: an int
+    literal, a tuple/list of int literals, or a module-level Name bound to
+    one."""
+    if isinstance(node, ast.Name):
+        node = consts.get(node.id)
+        if node is None:
+            return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, int)):
+                return None
+            out.append(el.value)
+        return tuple(out)
+    return None
+
+
+def _wrapper_call(node):
+    """If *node* is a call that WRAPS a function with static argnums —
+    ``aot(...)``, ``jax.jit(...)``, ``mesh_aot(...)``, or
+    ``functools.partial(jax.jit, ...)(fn)`` — return its keyword list,
+    else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    fname = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else "")
+    if fname in ("aot", "jit", "mesh_aot"):
+        return node.keywords
+    # functools.partial(jax.jit, static_argnums=...)(fn)
+    if (isinstance(f, ast.Call) and isinstance(f.func, (ast.Name,
+                                                        ast.Attribute))):
+        inner = f.func.attr if isinstance(f.func, ast.Attribute) else \
+            f.func.id
+        if inner == "partial" and f.args:
+            first = f.args[0]
+            fa = first.attr if isinstance(first, ast.Attribute) else (
+                first.id if isinstance(first, ast.Name) else "")
+            if fa == "jit":
+                return f.keywords
+    return None
+
+
+def _unhashable(node) -> str:
+    """Why this argument expression is a static-cache hazard, or ''."""
+    if isinstance(node, (ast.List, ast.ListComp)):
+        return "list"
+    if isinstance(node, (ast.Dict, ast.DictComp)):
+        return "dict"
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return "set"
+    if isinstance(node, ast.Call):
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in _ARRAY_CTORS
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("np", "numpy", "jnp")):
+            return f"{f.value.id}.{f.attr}(...) ndarray"
+    return ""
+
+
+def _scope(posix: str) -> bool:
+    return "raft_tpu/" in posix or "bench" in posix
+
+
+@rule("static-arg-hashability", scope=_scope,
+      doc="unhashable literals in static positions of aot()/jit calls")
+def check_static_args(ctx):
+    consts = {}    # module-level NAME -> tuple/int literal node
+    statics = {}   # callable name -> static argnum tuple
+    for node in ctx.tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if not isinstance(t, ast.Name):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List, ast.Constant)):
+            consts[t.id] = node.value
+        kws = _wrapper_call(node.value)
+        if kws is not None:
+            for kw in kws:
+                if kw.arg == "static_argnums":
+                    nums = _int_tuple(kw.value, consts)
+                    if nums:
+                        statics[t.id] = nums
+    # @aot(static_argnums=...)-decorated defs
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            kws = _wrapper_call(dec)
+            if kws is None:
+                continue
+            for kw in kws:
+                if kw.arg == "static_argnums":
+                    nums = _int_tuple(kw.value, consts)
+                    if nums:
+                        statics[node.name] = nums
+    if not statics:
+        return []
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in statics):
+            continue
+        for pos in statics[node.func.id]:
+            if pos >= len(node.args):
+                continue
+            why = _unhashable(node.args[pos])
+            if not why:
+                continue
+            if ctx.exempt("static-arg-hashability", node.args[pos].lineno):
+                continue
+            findings.append((
+                node.args[pos].lineno,
+                f"{why} passed as static arg {pos} of "
+                f"`{node.func.id}` — static args key the executable "
+                "cache by hash(): unhashables raise at call time and "
+                "fresh ndarrays defeat the cache; pass a tuple/scalar "
+                "(or make the arg dynamic), or mark the line "
+                "exempt(static-arg-hashability)"))
+    return findings

@@ -1,0 +1,64 @@
+"""``trace-impurity``: host-side impurities (``time.*``, ``np.random.*``,
+``print``) inside traced program bodies — functions named ``*_impl`` /
+``*_program`` / ``program`` and ``scan_probe_lists`` tile callbacks.  Those
+bodies execute at TRACE time, not call time: a ``time.time()`` captures the
+compile-time clock as a constant, ``np.random`` bakes one host sample into
+the executable, and ``print`` fires once per (re)trace and then never again
+— all three look like they work under ``jax.jit`` and silently don't.
+Debugging escapes (``jax.debug.print``) lower to host callbacks, which the
+Level-2 HLO auditor bans from hot programs separately."""
+
+from __future__ import annotations
+
+import ast
+
+from raft_tpu.analysis.engine import rule
+from raft_tpu.analysis.rules.probe_scan import scan_callbacks
+
+
+def _is_program_body(node) -> bool:
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    return (node.name.endswith("_impl") or node.name.endswith("_program")
+            or node.name == "program")
+
+
+def _impurity(node):
+    """The impurity this node is, or None: print(...) / time.<attr> /
+    np.random.<attr> / numpy.random.<attr>."""
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id == "print"):
+        return "print"
+    if isinstance(node, ast.Attribute):
+        base = node.value
+        if isinstance(base, ast.Name) and base.id == "time":
+            return f"time.{node.attr}"
+        if (isinstance(base, ast.Attribute) and base.attr == "random"
+                and isinstance(base.value, ast.Name)
+                and base.value.id in ("np", "numpy")):
+            return f"{base.value.id}.random.{node.attr}"
+    return None
+
+
+@rule("trace-impurity", scope=lambda p: "raft_tpu/" in p,
+      doc="time.*/np.random.*/print inside traced program bodies")
+def check_trace_impurity(ctx):
+    bodies = [n for n in ast.walk(ctx.tree) if _is_program_body(n)]
+    bodies.extend(scan_callbacks(ctx.tree))
+    findings, seen = [], set()
+    for body in bodies:
+        for node in ast.walk(body):
+            what = _impurity(node)
+            if what is None or node.lineno in seen:
+                continue
+            if ctx.exempt("trace-impurity", node.lineno):
+                continue
+            seen.add(node.lineno)
+            name = getattr(body, "name", "<tile callback>")
+            findings.append((
+                node.lineno,
+                f"{what} inside traced program body `{name}` — this "
+                "executes at TRACE time (captured as a constant / fires "
+                "once per retrace), not per call; move it outside the "
+                "program or mark the line exempt(trace-impurity)"))
+    return findings

@@ -25,6 +25,7 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.analysis.registry import hlo_program
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.core.logger import traced
@@ -450,6 +451,25 @@ def fused_em_step(x, centroids, sample_weights=None,
     return _fused_em_step(x, centroids, sample_weights, metric,
                           batch_samples, batch_centroids, precision, engine,
                           return_labels)
+
+
+@hlo_program(
+    "cluster.fused_em_step",
+    collectives=0, collective_bytes=0,
+    # carry + one (bs, k) distance tile + M-step partials — NOT an (n, k)
+    # matrix or an (n,) label array (the single-pass contract,
+    # docs/fused_em.md); at this audit shape the CPU-grown row tile is
+    # 16384×64, so (bs, k) f32 = 4 MB plus epilogue scratch
+    transient_bytes=12 << 20,
+    notes="one HBM read of x per EM iteration: E-step argmin + M-step "
+          "partials in a single lax.scan (docs/fused_em.md)")
+def _audit_fused_em_step():
+    x = jax.ShapeDtypeStruct((16384, 64), jnp.float32)
+    c = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    return dict(lowered=_fused_em_step.lower(
+        x, c, None, metric=DistanceType.L2Expanded, batch_samples=2048,
+        batch_centroids=1024, precision="high", engine="xla",
+        return_labels=False))
 
 
 def cluster_cost(min_distances, sample_weights=None):

@@ -24,7 +24,8 @@ populate through:
   (:func:`_scatter_new_impl`); only the (n_lists,)-shaped chunk-table
   bookkeeping (``_common.chunk_layout`` / ``_common.extend_layout``) runs on
   host.  A ci/lint.py rule bans host transfers module-wide outside
-  ``host-ok``-marked bookkeeping lines (the ann_mnmg rule, extended here).
+  bookkeeping lines marked ``exempt(hot-path-host-transfer)`` (the
+  ann_mnmg rule, extended here).
 
 * **In-place extend** — :func:`extend_device` appends new rows into each
   list's free tail slots via a buffer-DONATED scatter when no list overflows
@@ -52,6 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu.analysis.registry import hlo_program
 from raft_tpu.core.aot import MeshAotFunction, aot, aot_dispatchable
 from raft_tpu.neighbors._common import (
     ChunkLayout,
@@ -170,6 +172,31 @@ _scatter_append_dn = jax.jit(_scatter_append_impl, donate_argnums=(0, 1))
 _scatter_append_dn_aot = aot(_scatter_append_impl, donate_argnums=(0, 1))
 
 
+@hlo_program(
+    "build.scatter_append_in_place",
+    collectives=0, collective_bytes=0,
+    # donation audit (PR-7 in-place extend): the donated blocks must land
+    # in input_output_alias or the O(index) copy is back.  XLA:TPU honors
+    # donation as must-alias; XLA:CPU only RECORDS may-alias (a hint the
+    # runtime may ignore) — per docs/static_analysis.md §donation the CPU
+    # status is recorded, not failed.
+    donate_argnums=(0, 1),
+    donation_policy={"cpu": "may-alias", "tpu": "must-alias"},
+    transient_bytes=1 << 20,
+    notes="the in-place extend append-scatter with donated block buffers "
+          "(docs/index_build.md)")
+def _audit_scatter_append():
+    f32, i32 = jnp.float32, jnp.int32
+    datas = (jax.ShapeDtypeStruct((64, 32, 8), f32),)
+    idx = jax.ShapeDtypeStruct((64, 32), i32)
+    payloads = (jax.ShapeDtypeStruct((128, 8), f32),)
+    ids = jax.ShapeDtypeStruct((128,), i32)
+    flat = jax.ShapeDtypeStruct((128,), i32)
+    return dict(fn=_scatter_append_impl,
+                args=(datas, idx, payloads, ids, flat),
+                donate_argnums=(0, 1))
+
+
 # ---------------------------------------------------------------------------
 # the host tile loop
 
@@ -263,10 +290,12 @@ def extend_device(data, idx, list_sizes, chunk_table, payload_new, ids_new,
     n_phys = datas[0].shape[0] - 1
     n_new = payloads_new[0].shape[0]
 
-    counts_old = np.asarray(list_sizes).astype(np.int64)  # host-ok (n_lists,)
+    # exempt(hot-path-host-transfer): (n_lists,) logical sizes table
+    counts_old = np.asarray(list_sizes).astype(np.int64)
     added = (device_counts(labels_new, n_lists) if n_new
              else np.zeros(n_lists, np.int64))
-    table_h = np.asarray(chunk_table)  # host-ok: (n_lists, max_chunks) table
+    # exempt(hot-path-host-transfer): (n_lists, max_chunks) table
+    table_h = np.asarray(chunk_table)
     lay = extend_layout(counts_old, added, cap, table_h, n_phys)
     m = lay.m
 
@@ -425,7 +454,8 @@ def populate_sharded(comms, x, labels, ids, lay: ChunkLayout,
     gather, local_tables, probe_extra, local_rows = ann_mnmg._partition(
         lay.chunk_table, lay.n_phys + 1, world)
 
-    labels_h = np.asarray(labels)  # host-ok: (n,) int32 shard routing table
+    # exempt(hot-path-host-transfer): (n,) int32 shard routing table
+    labels_h = np.asarray(labels)
     idxm, cnt = _shard_rows(labels_h, world)
     rows_max = idxm.shape[1]
     tile = resolve_tile_rows(rows_max, tile_rows)

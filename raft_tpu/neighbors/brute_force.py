@@ -42,6 +42,7 @@ from typing import Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.analysis.registry import hlo_program
 from raft_tpu.core.aot import _bucket_dim, aot, aot_dispatchable
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import auto_sync_handle
@@ -161,6 +162,24 @@ _KNN_STATICS = (2, 3, 4, 5, 6)
 _knn_scan = functools.partial(jax.jit, static_argnums=_KNN_STATICS)(
     _knn_scan_impl)
 _knn_scan_aot = aot(_knn_scan_impl, static_argnums=_KNN_STATICS)
+
+
+@hlo_program(
+    "brute_force.knn_scan",
+    collectives=0, collective_bytes=0,
+    # per-step transient: the (nq, tile) distance tile + select scratch —
+    # NOT the (m, n) matrix the scan exists to avoid (64×4096 f32 ≈ 1 MB
+    # with fusion headroom; a full-matrix regression would be ≥ 4 MB here)
+    transient_bytes=2 << 20,
+    notes="the ServeEngine brute-force backend program (one dispatch per "
+          "super-batch; docs/serving.md)")
+def _audit_knn_scan():
+    q = jax.ShapeDtypeStruct((64, 32), jnp.float32)
+    xs = jax.ShapeDtypeStruct((4096, 32), jnp.float32)
+    return dict(fn=_knn_scan_impl,
+                args=(xs, q, 8, DistanceType.L2SqrtExpanded, 2.0, 1024,
+                      True),
+                static_argnums=_KNN_STATICS)
 
 
 @auto_sync_handle

@@ -31,6 +31,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.analysis.registry import hlo_program
 from raft_tpu.core.aot import _bucket_dim, aot, aot_dispatchable
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import auto_sync_handle
@@ -378,10 +379,11 @@ def _search_batch_impl(queries, index_leaves, metric_val: int, k: int,
 
     def score_tile(rows):
         data = list_data[rows].astype(queries.dtype)        # (nq, cap, dim)
-        # the tile-SCORING GEMM against the gathered rows — O(tile) work
-        # by construction, not per-batch LUT recompute (the ci/lint.py
-        # probe-scan rule's regression class)
-        dots = jnp.einsum("qd,qcd->qc", queries, data,  # adc-exempt
+        # the tile-SCORING GEMM against the gathered rows — O(tile)
+        # work by construction, not per-batch LUT recompute (the
+        # regression class of the probe-scan-closure rule)
+        # exempt(probe-scan-closure): O(tile) scoring over gathered rows
+        dots = jnp.einsum("qd,qcd->qc", queries, data,
                           preferred_element_type=acc_t)
         if is_ip:
             return dots
@@ -411,6 +413,32 @@ _SEARCH_STATICS = (2, 3, 4, 5, 6)
 _search_batch = functools.partial(jax.jit, static_argnums=_SEARCH_STATICS)(
     _search_batch_impl)
 _search_batch_aot = aot(_search_batch_impl, static_argnums=_SEARCH_STATICS)
+
+
+@hlo_program(
+    "ivf_flat.search_batch",
+    collectives=0, collective_bytes=0,
+    # per-probe-step transient: one gathered (nq, cap, dim) tile + its
+    # score epilogue, NOT an (nq, n_rows) matrix — 64×cap×32 f32 with
+    # select scratch stays well under this at the audit shape
+    transient_bytes=4 << 20,
+    notes="the whole per-batch ivf_flat search as ONE program (coarse "
+          "GEMM + top-n_probes + probe scan) — the ServeEngine backend")
+def _audit_search_batch():
+    # build a REAL tiny index so leaf dtypes/layout track the shipped
+    # build path; audit-time only (the registry builder is lazy)
+    import numpy as np
+
+    x = np.random.default_rng(0).standard_normal((2048, 32)
+                                                 ).astype(np.float32)
+    idx = build(IndexParams(n_lists=16), x)
+    leaves = (idx.centers, idx.list_data, idx.list_indices,
+              idx.phys_sizes, idx.chunk_table)
+    q = jax.ShapeDtypeStruct((64, 32), jnp.float32)
+    return dict(fn=_search_batch_impl,
+                args=(q, leaves, int(DistanceType.L2SqrtExpanded), 8, 4,
+                      True, -1),
+                static_argnums=_SEARCH_STATICS)
 
 
 @traced("raft_tpu.neighbors.ivf_flat.search")

@@ -77,6 +77,7 @@ from raft_tpu.core.error import expects
 from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.core.logger import traced
 from raft_tpu.cluster import build_hierarchical, min_cluster_and_distance
+from raft_tpu.analysis.registry import hlo_program
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.distance.pairwise import _l2_expanded, _row_norms
 from raft_tpu.matrix.select_k import select_k
@@ -366,6 +367,7 @@ def _pca_balanced_rotation(resid_sample: np.ndarray, pq_dim: int
     [m·ds, (m+1)·ds)."""
     dim = resid_sample.shape[1]
     ds = dim // pq_dim
+    # exempt(dtype-drift): host-side numpy PCA training; np.cov is f64
     cov = np.cov(resid_sample.T).astype(np.float64)
     w, v = np.linalg.eigh(cov)                       # ascending
     w, v = w[::-1], v[:, ::-1]                       # descending variance
@@ -767,6 +769,51 @@ _CSUM_TILE_STATICS = (5,)
 _csum_tile = functools.partial(jax.jit, static_argnums=_CSUM_TILE_STATICS)(
     _csum_tile_impl)
 _csum_tile_aot = aot(_csum_tile_impl, static_argnums=_CSUM_TILE_STATICS)
+
+
+def _audit_tile_model():
+    """Audit-time model SPECS at the PR-7 bench shape (tile 8192, dim 64,
+    pq_dim 16, 512 lists, 8-bit PER_SUBSPACE) — shapes only, no data."""
+    x_t = jax.ShapeDtypeStruct((8192, 64), jnp.float32)
+    labels = jax.ShapeDtypeStruct((8192,), jnp.int32)
+    centers = jax.ShapeDtypeStruct((512, 64), jnp.float32)
+    rotation = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    codebooks = jax.ShapeDtypeStruct((16, 256, 4), jnp.float32)
+    return x_t, labels, centers, rotation, codebooks
+
+
+@hlo_program(
+    "ivf_pq.encode_tile",
+    collectives=0, collective_bytes=0,
+    # Graduates the PR-7 in-bench O(tile)-transient gate into CI: the
+    # residual→encode→bit-pack fusion measured 4.2 MB/tile at exactly this
+    # shape (vs 1.66 GB monolithic, BENCH_TPU.md PR-7); the ceiling gives
+    # fusion-variance headroom while still catching any (tile, pq_dim,
+    # 2^bits) encode-distance materialization (8192·16·256·4 = 128 MB)
+    transient_bytes=8 << 20,
+    notes="per-tile residual→PQ-encode→bit-pack populate kernel "
+          "(docs/index_build.md)")
+def _audit_encode_tile():
+    x_t, labels, centers, rotation, codebooks = _audit_tile_model()
+    return dict(fn=_encode_tile_impl,
+                args=(x_t, labels, centers, rotation, codebooks, False, 8),
+                static_argnums=_ENC_TILE_STATICS)
+
+
+@hlo_program(
+    "ivf_pq.csum_tile",
+    collectives=0, collective_bytes=0,
+    # the decode-contraction transient at tile size: (tile, pq_dim, 2^bits)
+    # one-hot or gather scratch — bounded by the same O(tile) contract
+    transient_bytes=8 << 20,
+    notes="per-tile list-side ADC csum kernel (its own program for "
+          "bit-identity, docs/index_build.md)")
+def _audit_csum_tile():
+    _, labels, centers, rotation, codebooks = _audit_tile_model()
+    codes_t = jax.ShapeDtypeStruct((8192, 16), jnp.int32)
+    return dict(fn=_csum_tile_impl,
+                args=(codes_t, labels, centers, rotation, codebooks, False),
+                static_argnums=_CSUM_TILE_STATICS)
 
 
 def _encode_rows(model, x, labels, pq_bits: int, per_cluster: bool,
@@ -1203,14 +1250,14 @@ def _search_batch_impl(q, probe_ids, leaves, metric_val: int, k: int,
         # The in-scan codebook einsums below are the SANCTIONED legacy
         # baseline (ci/lint.py forbids new ones in probe-scan callbacks —
         # per-batch-invariant LUT work belongs in _scan_hoisted's batch
-        # stage); hence the adc-exempt markers.
+        # stage); hence the exemption markers.
         if is_ip:
             # score = q·(c + code) = q·c + Σ_m q_m·cb  → LUT of dots
             if per_cluster:
-                lut = jnp.einsum(  # adc-exempt: HOISTED_LUT=0 baseline
+                lut = jnp.einsum(  # exempt(probe-scan-closure): =0 LUT baseline
                     "qmd,qkd->qmk", rot_q.reshape(nq, pq_dim, ds), cb)
             else:
-                lut = jnp.einsum(  # adc-exempt: HOISTED_LUT=0 baseline
+                lut = jnp.einsum(  # exempt(probe-scan-closure): =0 LUT baseline
                     "qmd,mkd->qmk", rot_q.reshape(nq, pq_dim, ds), cb)
             base = jnp.sum(q * centers[lists], axis=-1)    # (nq,)
         else:
@@ -1218,12 +1265,12 @@ def _search_batch_impl(q, probe_ids, leaves, metric_val: int, k: int,
             if per_cluster:
                 lut = (jnp.sum(r ** 2, -1)[:, :, None]
                        + jnp.sum(cb ** 2, -1)[:, None, :]
-                       - 2.0 * jnp.einsum(  # adc-exempt: =0 baseline
+                       - 2.0 * jnp.einsum(  # exempt(probe-scan-closure): =0 base
                            "qmd,qkd->qmk", r, cb))
             else:
                 lut = (jnp.sum(r ** 2, -1)[:, :, None]
                        + jnp.sum(cb ** 2, -1)[None, :, :]
-                       - 2.0 * jnp.einsum(  # adc-exempt: =0 baseline
+                       - 2.0 * jnp.einsum(  # exempt(probe-scan-closure): =0 base
                            "qmd,mkd->qmk", r, cb))
             base = jnp.zeros((nq,), jnp.float32)
         if is_fp8:
@@ -1263,7 +1310,7 @@ def _search_batch_impl(q, probe_ids, leaves, metric_val: int, k: int,
                 lut_m, codes_m = args                      # (nq,kcb),(nq,cap)
                 oh = (codes_m[:, :, None] ==
                       jnp.arange(kcb, dtype=codes_m.dtype)).astype(lut.dtype)
-                return acc + jnp.einsum(  # adc-exempt: =0 baseline lookup
+                return acc + jnp.einsum(  # exempt(probe-scan-closure): =0 lookup
                     "qck,qk->qc", oh, lut_m,
                     preferred_element_type=acc.dtype), None
 
@@ -1321,6 +1368,31 @@ _full_search = functools.partial(
     jax.jit, static_argnums=_FULL_SEARCH_STATICS)(_full_search_impl)
 _full_search_aot = aot(_full_search_impl,
                        static_argnums=_FULL_SEARCH_STATICS)
+
+
+@hlo_program(
+    "ivf_pq.full_search",
+    collectives=0, collective_bytes=0,
+    # hoisted-pipeline per-batch transient: the (nq, pq_dim·2^bits)
+    # combined LUT + one probe tile — the hoisted_batch_cap arithmetic
+    # bounds the big configs; this audit shape sits far below the cap
+    transient_bytes=4 << 20,
+    notes="coarse + top-n_probes + hoisted-ADC probe scan as ONE program "
+          "— the ServeEngine ivf_pq backend (docs/ivf_pq_adc.md)")
+def _audit_full_search():
+    import numpy as np
+
+    x = np.random.default_rng(0).standard_normal((2048, 32)
+                                                 ).astype(np.float32)
+    idx = build(IndexParams(n_lists=16, pq_dim=8, pq_bits=8), x)
+    leaves = (idx.centers, idx.rotation, idx.codebooks, idx.list_codes,
+              idx.list_indices, idx.phys_sizes, idx.chunk_table, idx.owner,
+              idx.list_adc, idx.list_csum)
+    q = jax.ShapeDtypeStruct((64, 32), jnp.float32)
+    return dict(fn=_full_search_impl,
+                args=(q, leaves, int(DistanceType.L2SqrtExpanded), 8, 4,
+                      False, "float32", "float32", 8, True, -1),
+                static_argnums=_FULL_SEARCH_STATICS)
 
 
 def hoisted_batch_cap_dims(metric, per_cluster: bool, n_phys: int,

@@ -75,6 +75,7 @@ class _BruteForceBackend:
     def ingest(self, q):
         """Per-request compute-form conversion (must match what the solo
         path does BEFORE batching, so coalescing cannot change values)."""
+        # exempt(hot-path-host-transfer): host-numpy request ingest
         q = np.asarray(q)
         expects(q.ndim == 2 and q.shape[1] == self.dim,
                 "query must be (n, dim) with the index's dim")
@@ -124,11 +125,13 @@ class _IvfFlatBackend:
         row normalize, must reproduce the solo path's device numerics
         exactly (reduction order differs between numpy and XLA), so only
         that metric pays a per-request device round-trip."""
+        # exempt(hot-path-host-transfer): request ingest of host numpy
         q = np.asarray(q)
         expects(q.ndim == 2 and q.shape[1] == self.dim, "query dim mismatch")
         if q.dtype in (np.int8, np.uint8):
             q = q.astype(np.float32)  # exact widening: matches device cast
         if self.index.metric == DistanceType.CosineExpanded:
+            # exempt(hot-path-host-transfer): cosine solo-numerics
             return np.asarray(ivf_flat._normalize_rows(jnp.asarray(q)))
         return q
 
@@ -178,6 +181,7 @@ class _IvfPqBackend:
         no-op), so the numpy cast is bit-identical to the solo path's
         device cast — no device bounce per request (the dtype-acceptance
         checks mirror ``ivf_pq._ingest_dataset``)."""
+        # exempt(hot-path-host-transfer): request ingest of host numpy
         q = np.asarray(q)
         if q.dtype in (np.int8, np.uint8):
             q_dtype = str(q.dtype)
@@ -248,6 +252,7 @@ class _ShardedBackend:
         ``ann_mnmg._ingest`` (itself mirroring each kind's solo prologue):
         exact host-side widenings stay numpy; only cosine's inexact row
         normalize round-trips the device (the _IvfFlatBackend contract)."""
+        # exempt(hot-path-host-transfer): request ingest of host numpy
         q = np.asarray(q)
         expects(q.ndim == 2 and q.shape[1] == self.dim,
                 "query must be (n, dim) with the index's dim")
@@ -272,6 +277,7 @@ class _ShardedBackend:
         if q.dtype in (np.int8, np.uint8):
             q = q.astype(np.float32)  # exact widening: matches device cast
         if self.sharded.metric == DistanceType.CosineExpanded:
+            # exempt(hot-path-host-transfer): cosine solo-numerics bounce
             return np.asarray(ivf_flat._normalize_rows(jnp.asarray(q)))
         return q
 
@@ -590,6 +596,7 @@ class ServeEngine:
 
         # collect: blocks per batch; later batches keep executing meanwhile
         for _kind, members, out in inflight:
+            # exempt(hot-path-host-transfer): result delivery fetch
             d, i = np.asarray(out[0]), np.asarray(out[1])
             done = time.perf_counter() - t_entry
             for j, start, n in members:

@@ -237,7 +237,7 @@ def solve_lap(costs, epsilon: float = 1e-6, scaling_factor: float = 8.0,
                     and compute_dtype != jnp.float64:
                 # integer-cost callers asked for exactness (ε < 1/n): keep
                 # the guarantee by computing in f64, whose ULP floor at
-                # this spread sits ~2^29 lower
+                # this spread sits ~2^29 lower (x64 checked above)
                 compute_dtype = jnp.float64
             else:
                 log_warn(

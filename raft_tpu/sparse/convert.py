@@ -39,6 +39,7 @@ def from_triplets(rows, cols, vals, shape) -> CSR:
         if native.is_available():
             r, c, v = native.coo_canonicalize_host(rows, cols, vals)
             v = v.astype(vals.dtype if np.issubdtype(vals.dtype, np.floating)
+                         # x64: int vals widen exactly; host-side numpy
                          else np.float64)
         else:
             raise RuntimeError

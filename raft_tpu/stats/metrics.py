@@ -72,6 +72,7 @@ def entropy(labels, n_classes: Optional[int] = None):
     labels = jnp.asarray(labels).astype(jnp.int32)
     if n_classes is None:
         n_classes = int(jnp.max(labels)) + 1
+    # x64: exact counts under jax_enable_x64; demotes harmlessly to f32
     counts = jnp.zeros((n_classes,), jnp.float64).at[labels].add(1.0)
     p = counts / labels.shape[0]
     return -jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.where(p > 0, p, 1.0)), 0.0))
@@ -80,6 +81,7 @@ def entropy(labels, n_classes: Optional[int] = None):
 def mutual_info_score(y_true, y_pred, n_classes: Optional[int] = None):
     """Mutual information (nats) between two labelings
     (reference stats/mutual_info_score.cuh)."""
+    # x64: exact pair counts under jax_enable_x64 (f32 loses ints > 2^24)
     cm = contingency_matrix(y_true, y_pred, n_classes).astype(jnp.float64)
     n = jnp.sum(cm)
     pij = cm / n
@@ -116,6 +118,7 @@ def v_measure(y_true, y_pred, n_classes: Optional[int] = None, beta: float = 1.0
 
 def rand_index(y_true, y_pred):
     """Unadjusted Rand index (reference stats/rand_index.cuh)."""
+    # x64: exact pair counts under jax_enable_x64 (f32 loses ints > 2^24)
     cm = contingency_matrix(y_true, y_pred).astype(jnp.float64)
     n = jnp.sum(cm)
     sum_sq = jnp.sum(cm * cm)
@@ -131,6 +134,7 @@ def rand_index(y_true, y_pred):
 
 def adjusted_rand_index(y_true, y_pred):
     """ARI (reference stats/adjusted_rand_index.cuh)."""
+    # x64: exact pair counts under jax_enable_x64 (f32 loses ints > 2^24)
     cm = contingency_matrix(y_true, y_pred).astype(jnp.float64)
     n = jnp.sum(cm)
 
@@ -252,6 +256,7 @@ def trustworthiness_score(x, x_embedded, n_neighbors: int = 5,
     # k nearest in embedded space
     _, emb_nn = jax.lax.top_k(-d_emb, n_neighbors)
     r = jnp.take_along_axis(ranks, emb_nn, axis=1)  # original ranks of embedded nns
+    # x64: exact rank sums under jax_enable_x64
     penalty = jnp.maximum(r - n_neighbors + 1, 0).astype(jnp.float64)
     t = 1.0 - (2.0 / (n * n_neighbors * (2 * n - 3 * n_neighbors - 1))) * jnp.sum(penalty)
     return t

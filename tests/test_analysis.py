@@ -1,0 +1,617 @@
+"""raft_tpu.analysis (ISSUE 8): quarantine tests per AST rule — each rule
+fires on a violating snippet, passes on the fixed form, and respects the
+unified exemption marker — plus HLO-auditor tests on toy programs with a
+deliberate budget violation and a deliberate dead donation, and a smoke
+audit of the shipped program registry."""
+
+import pathlib
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from raft_tpu.analysis import engine, hlo_audit, registry  # noqa: E402
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def findings(posix, src, rule=None):
+    out = engine.check_source(posix, src)
+    if rule is not None:
+        out = [f for f in out if f.rule == rule]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the unified exemption marker
+
+
+class TestUnifiedMarker:
+    _SRC = ("import jax\n\n\ndef f(v, i):\n"
+            "    return jax.ops.segment_sum(v, i, num_segments=4){}\n")
+
+    def test_fires_bare(self):
+        assert findings("raft_tpu/x/mod.py", self._SRC.format(""),
+                        "raw-segment-sum")
+
+    def test_unified_marker_with_rationale_exempts(self):
+        src = self._SRC.format(
+            "  # exempt(raw-segment-sum): engine A/B baseline")
+        assert not findings("raft_tpu/x/mod.py", src, "raw-segment-sum")
+
+    def test_marker_without_rationale_does_not_exempt(self):
+        src = self._SRC.format("  # exempt(raw-segment-sum):")
+        assert findings("raft_tpu/x/mod.py", src, "raw-segment-sum")
+        # ... and the bare marker is itself flagged (no blanket allowlists)
+        assert findings("raft_tpu/x/mod.py", src, "exemption-hygiene")
+
+    def test_marker_for_other_rule_does_not_exempt(self):
+        src = self._SRC.format("  # exempt(dtype-drift): wrong rule")
+        assert findings("raft_tpu/x/mod.py", src, "raw-segment-sum")
+
+    def test_marker_on_line_above(self):
+        src = ("import jax\n\n\ndef f(v, i):\n"
+               "    # exempt(raw-segment-sum): sanctioned here\n"
+               "    return jax.ops.segment_sum(v, i, num_segments=4)\n")
+        assert not findings("raft_tpu/x/mod.py", src, "raw-segment-sum")
+
+    def test_comma_list_of_rules(self):
+        src = self._SRC.format(
+            "  # exempt(raw-segment-sum, dtype-drift): shared rationale")
+        assert not findings("raft_tpu/x/mod.py", src, "raw-segment-sum")
+
+    def test_legacy_spellings_still_parse(self):
+        # each legacy marker maps onto its rule id (back-compat contract)
+        assert engine.LEGACY_MARKERS == {
+            "adc-exempt": "probe-scan-closure",
+            "serve-exempt": "serve-dispatch",
+            "host-ok": "hot-path-host-transfer"}
+
+    def test_rule_catalog_registered(self):
+        ids = {r.id for r in engine.iter_rules()}
+        assert {"raw-segment-sum", "probe-scan-closure", "serve-dispatch",
+                "hot-path-host-transfer", "collective-discipline",
+                "trace-impurity", "static-arg-hashability",
+                "dtype-drift"} <= ids
+
+
+# ---------------------------------------------------------------------------
+# collective-discipline
+
+
+class TestCollectiveDiscipline:
+    _SRC = ("import jax\n\n\ndef prog(x, axis):\n"
+            "    return jax.lax.psum(x, axis){}\n")
+
+    def test_fires_outside_comms(self):
+        f = findings("raft_tpu/neighbors/mod.py", self._SRC.format(""),
+                     "collective-discipline")
+        assert f and "psum" in f[0].message
+
+    def test_comms_package_is_the_blessed_home(self):
+        assert not findings("raft_tpu/comms/mod.py", self._SRC.format(""),
+                            "collective-discipline")
+
+    def test_from_import_fires(self):
+        src = ("from jax.lax import all_gather\n\n\ndef prog(x, a):\n"
+               "    return all_gather(x, a)\n")
+        f = findings("raft_tpu/cluster/mod.py", src,
+                     "collective-discipline")
+        # both the import and the laundered bare call are flagged
+        assert len(f) == 2
+
+    def test_lax_alias_fires(self):
+        src = ("import jax.lax as L\n\n\ndef prog(x, a):\n"
+               "    return L.ppermute(x, a, [(0, 1)])\n")
+        assert findings("raft_tpu/cluster/mod.py", src,
+                        "collective-discipline")
+
+    def test_axis_index_is_not_banned(self):
+        src = ("import jax\n\n\ndef prog(x, axis):\n"
+               "    return x + jax.lax.axis_index(axis)\n")
+        assert not findings("raft_tpu/neighbors/mod.py", src,
+                            "collective-discipline")
+
+    def test_comms_wrapper_calls_pass(self):
+        src = ("def prog(comms, x):\n"
+               "    return comms.allreduce(x)\n")
+        assert not findings("raft_tpu/cluster/mod.py", src,
+                            "collective-discipline")
+
+    def test_marker_exempts(self):
+        src = self._SRC.format(
+            "  # exempt(collective-discipline): counted by hand here")
+        assert not findings("raft_tpu/neighbors/mod.py", src,
+                            "collective-discipline")
+
+    def test_shipped_tree_clean(self):
+        for f in sorted((REPO / "raft_tpu").rglob("*.py")):
+            src = f.read_text()
+            assert not [x for x in engine.check_source(
+                f.as_posix(), src) if x.rule == "collective-discipline"], f
+
+
+# ---------------------------------------------------------------------------
+# trace-impurity
+
+
+class TestTraceImpurity:
+    def test_time_in_impl_fires(self):
+        src = ("import time\n\n\ndef _search_impl(q):\n"
+               "    t0 = time.perf_counter()\n    return q, t0\n")
+        f = findings("raft_tpu/neighbors/mod.py", src, "trace-impurity")
+        assert f and "time.perf_counter" in f[0].message
+
+    def test_np_random_in_program_fires(self):
+        src = ("import numpy as np\n\n\ndef _em_program(x):\n"
+               "    return x + np.random.rand()\n")
+        assert findings("raft_tpu/cluster/mod.py", src, "trace-impurity")
+
+    def test_print_in_impl_fires(self):
+        src = ("def _scan_impl(x):\n    print(x)\n    return x\n")
+        assert findings("raft_tpu/neighbors/mod.py", src, "trace-impurity")
+
+    def test_scan_probe_lists_callback_covered(self):
+        src = ("def search(probes, idxs, sizes):\n"
+               "    def score_tile(rows):\n"
+               "        print(rows)\n        return rows\n"
+               "    return scan_probe_lists(probes, score_tile, idxs, "
+               "sizes, 5)\n")
+        assert findings("raft_tpu/neighbors/mod.py", src, "trace-impurity")
+
+    def test_host_side_function_passes(self):
+        # impurities OUTSIDE program bodies are not this rule's business
+        src = ("import time\n\n\ndef bench(q):\n"
+               "    return time.perf_counter()\n")
+        assert not findings("raft_tpu/neighbors/mod.py", src,
+                            "trace-impurity")
+
+    def test_marker_exempts(self):
+        src = ("def _scan_impl(x):\n"
+               "    print(x)  # exempt(trace-impurity): debug scaffold\n"
+               "    return x\n")
+        assert not findings("raft_tpu/neighbors/mod.py", src,
+                            "trace-impurity")
+
+
+# ---------------------------------------------------------------------------
+# static-arg-hashability
+
+
+class TestStaticArgHashability:
+    def test_list_in_static_position_fires(self):
+        src = ("F = aot(fn, static_argnums=(1,))\n\n\ndef go(x):\n"
+               "    return F(x, [1, 2])\n")
+        f = findings("raft_tpu/x/mod.py", src, "static-arg-hashability")
+        assert f and "list" in f[0].message
+
+    def test_tuple_in_static_position_passes(self):
+        src = ("F = aot(fn, static_argnums=(1,))\n\n\ndef go(x):\n"
+               "    return F(x, (1, 2))\n")
+        assert not findings("raft_tpu/x/mod.py", src,
+                            "static-arg-hashability")
+
+    def test_module_const_statics_resolve(self):
+        src = ("_S = (2,)\nF = aot(fn, static_argnums=_S)\n\n\n"
+               "def go(x, y):\n    return F(x, y, {'a': 1})\n")
+        f = findings("raft_tpu/x/mod.py", src, "static-arg-hashability")
+        assert f and "dict" in f[0].message
+
+    def test_ndarray_ctor_fires(self):
+        src = ("import jax\nimport jax.numpy as jnp\n"
+               "F = jax.jit(fn, static_argnums=(0,))\n\n\ndef go():\n"
+               "    return F(jnp.zeros((3,)))\n")
+        f = findings("raft_tpu/x/mod.py", src, "static-arg-hashability")
+        assert f and "ndarray" in f[0].message
+
+    def test_partial_jit_form_resolves(self):
+        src = ("import functools\nimport jax\n"
+               "F = functools.partial(jax.jit, static_argnums=(1,))(fn)\n"
+               "\n\ndef go(x):\n    return F(x, [3])\n")
+        assert findings("raft_tpu/x/mod.py", src, "static-arg-hashability")
+
+    def test_dynamic_positions_unchecked(self):
+        src = ("F = aot(fn, static_argnums=(1,))\n\n\ndef go(x):\n"
+               "    return F([1, 2], 7)\n")  # pos 0 is dynamic
+        assert not findings("raft_tpu/x/mod.py", src,
+                            "static-arg-hashability")
+
+    def test_marker_exempts(self):
+        src = ("F = aot(fn, static_argnums=(1,))\n\n\ndef go(x):\n"
+               "    return F(x, [1, 2])  "
+               "# exempt(static-arg-hashability): test fixture\n")
+        assert not findings("raft_tpu/x/mod.py", src,
+                            "static-arg-hashability")
+
+
+# ---------------------------------------------------------------------------
+# dtype-drift
+
+
+class TestDtypeDrift:
+    def test_jnp_float64_fires(self):
+        src = ("import jax.numpy as jnp\n\n\ndef f(x):\n"
+               "    return x.astype(jnp.float64)\n")
+        assert findings("raft_tpu/stats/mod.py", src, "dtype-drift")
+
+    def test_np_float64_fires(self):
+        src = ("import numpy as np\n\n\ndef f(x):\n"
+               "    return np.zeros((3,), np.float64)\n")
+        assert findings("raft_tpu/cluster/mod.py", src, "dtype-drift")
+
+    def test_x64_comment_sanctions(self):
+        src = ("import jax.numpy as jnp\n\n\ndef f(x):\n"
+               "    # x64: exact widening under jax_enable_x64\n"
+               "    return x.astype(jnp.float64)\n")
+        assert not findings("raft_tpu/stats/mod.py", src, "dtype-drift")
+
+    def test_exempt_marker_sanctions(self):
+        src = ("import numpy as np\n\n\ndef f(x):\n"
+               "    return np.float64(x)  "
+               "# exempt(dtype-drift): host-side numpy\n")
+        assert not findings("raft_tpu/cluster/mod.py", src, "dtype-drift")
+
+    def test_native_out_of_scope(self):
+        src = ("import numpy as np\n\n\ndef f(x):\n"
+               "    return np.zeros((3,), np.float64)\n")
+        assert not findings("raft_tpu/native/mod.py", src, "dtype-drift")
+
+    def test_float32_passes(self):
+        src = ("import jax.numpy as jnp\n\n\ndef f(x):\n"
+               "    return x.astype(jnp.float32)\n")
+        assert not findings("raft_tpu/stats/mod.py", src, "dtype-drift")
+
+
+# ---------------------------------------------------------------------------
+# hot-path-host-transfer generalization (beyond the two historical modules)
+
+
+class TestHostTransferRegistry:
+    def test_kmeans_fused_em_scope_fires(self):
+        src = ("import numpy as np\n\n\ndef _fused_em_scan(x):\n"
+               "    return np.asarray(x)\n")
+        assert findings("raft_tpu/cluster/kmeans.py", src,
+                        "hot-path-host-transfer")
+
+    def test_kmeans_outside_hot_functions_passes(self):
+        # the training prologue may touch host numpy — only the declared
+        # fused-EM loop functions are hot
+        src = ("import numpy as np\n\n\ndef _train_prologue(x):\n"
+               "    return np.asarray(x)\n")
+        assert not findings("raft_tpu/cluster/kmeans.py", src,
+                            "hot-path-host-transfer")
+
+    def test_serve_module_wide(self):
+        src = ("import numpy as np\n\n\ndef dispatch(x):\n"
+               "    return np.asarray(x)\n")
+        assert findings("raft_tpu/serve/engine.py", src,
+                        "hot-path-host-transfer")
+
+    def test_knn_mnmg_covered(self):
+        src = ("import jax\n\n\ndef merge(x):\n"
+               "    return jax.device_get(x)\n")
+        assert findings("raft_tpu/neighbors/knn_mnmg.py", src,
+                        "hot-path-host-transfer")
+
+    def test_unregistered_module_passes(self):
+        src = ("import numpy as np\n\n\ndef f(x):\n"
+               "    return np.asarray(x)\n")
+        assert not findings("raft_tpu/stats/mod.py", src,
+                            "hot-path-host-transfer")
+
+    def test_unified_marker_exempts(self):
+        src = ("import numpy as np\n\n\ndef _fused_em_scan(x):\n"
+               "    return np.asarray(x)  "
+               "# exempt(hot-path-host-transfer): (k,) table fetch\n")
+        assert not findings("raft_tpu/cluster/kmeans.py", src,
+                            "hot-path-host-transfer")
+
+    def test_legacy_host_ok_still_exempts(self):
+        src = ("import numpy as np\n\n\ndef _fused_em_scan(x):\n"
+               "    return np.asarray(x)  # host-ok: (k,) table fetch\n")
+        assert not findings("raft_tpu/cluster/kmeans.py", src,
+                            "hot-path-host-transfer")
+
+
+# ---------------------------------------------------------------------------
+# the engine over the shipped tree
+
+
+class TestEngineAtHead:
+    def test_repo_surface_clean(self):
+        # the acceptance contract: level 1 exits 0 at HEAD
+        import io
+
+        bad = engine.run(out=io.StringIO())
+        assert bad == 0
+
+
+# ---------------------------------------------------------------------------
+# HLO auditor — toy programs with deliberate violations
+
+
+def _entry(name, fn, args, **kw):
+    return registry.ProgramEntry(name=name, builder=lambda: dict(
+        fn=fn, args=args, **{k: kw.pop(k) for k in ("donate_argnums",)
+                             if k in kw}), **kw)
+
+
+class TestHloAuditToys:
+    def test_budget_violation_is_a_finding(self):
+        def hog(x):
+            # forces a real (n, n) temp the tiny ceiling cannot hold
+            return (x @ x.T).sum(axis=0)
+
+        e = registry.ProgramEntry(
+            name="toy.budget_violation",
+            builder=lambda: dict(fn=hog, args=(
+                jax.ShapeDtypeStruct((256, 256), jnp.float32),)),
+            transient_bytes=64)
+        r = hlo_audit.audit_program(e)
+        assert r.status == "fail"
+        assert any("ceiling" in f for f in r.findings), r.findings
+
+    def test_budget_holds_when_ceiling_sane(self):
+        def hog(x):
+            return (x @ x.T).sum(axis=0)
+
+        e = registry.ProgramEntry(
+            name="toy.budget_ok",
+            builder=lambda: dict(fn=hog, args=(
+                jax.ShapeDtypeStruct((256, 256), jnp.float32),)),
+            transient_bytes=8 << 20)
+        assert hlo_audit.audit_program(e).status == "ok"
+
+    @pytest.mark.filterwarnings(
+        "ignore:Some donated buffers were not usable")
+    def test_dead_donation_is_a_finding(self):
+        def drops_donation(a, b):
+            return b * 2.0   # a is donated but unusable: no alias emitted
+
+        e = registry.ProgramEntry(
+            name="toy.dead_donation",
+            builder=lambda: dict(
+                fn=drops_donation,
+                args=(jax.ShapeDtypeStruct((128,), jnp.float32),
+                      jax.ShapeDtypeStruct((128,), jnp.float32)),
+                donate_argnums=(0,)),
+            donate_argnums=(0,),
+            donation_policy={"cpu": "must-alias"})
+        r = hlo_audit.audit_program(e)
+        assert r.status == "fail"
+        assert any("dropped" in f or "input_output_alias" in f
+                   for f in r.findings), r.findings
+
+    @pytest.mark.filterwarnings(
+        "ignore:Some donated buffers were not usable")
+    def test_dead_donation_recorded_under_may_alias_policy(self):
+        def drops_donation(a, b):
+            return b * 2.0
+
+        e = registry.ProgramEntry(
+            name="toy.dead_donation_recorded",
+            builder=lambda: dict(
+                fn=drops_donation,
+                args=(jax.ShapeDtypeStruct((128,), jnp.float32),
+                      jax.ShapeDtypeStruct((128,), jnp.float32)),
+                donate_argnums=(0,)),
+            donate_argnums=(0,),
+            donation_policy={"cpu": "may-alias"})
+        r = hlo_audit.audit_program(e)
+        assert r.status == "ok"
+        assert "dropped" in str(r.stats.get("donation_status", ""))
+
+    @pytest.mark.filterwarnings(
+        "ignore:Some donated buffers were not usable")
+    def test_partial_donation_drop_is_a_finding(self):
+        # b's only output is a scalar, so b's donation can never alias:
+        # of 2 donated leaves at most 1 lands in input_output_alias —
+        # a non-emptiness check would miss the dropped half
+        def partial(a, b):
+            return a.at[0].set(1.0), b.sum()
+
+        e = registry.ProgramEntry(
+            name="toy.partial_donation",
+            builder=lambda: dict(
+                fn=partial,
+                args=(jax.ShapeDtypeStruct((128,), jnp.float32),
+                      jax.ShapeDtypeStruct((64,), jnp.float32)),
+                donate_argnums=(0, 1)),
+            donate_argnums=(0, 1),
+            donation_policy={"cpu": "must-alias"})
+        r = hlo_audit.audit_program(e)
+        assert r.status == "fail"
+        assert any("dropped" in f or "input_output_alias" in f
+                   for f in r.findings), r.findings
+
+    def test_host_callback_is_a_finding(self):
+        def impure(x):
+            jax.debug.print("x sum {}", x.sum())
+            return x * 2
+
+        e = registry.ProgramEntry(
+            name="toy.callback",
+            builder=lambda: dict(fn=impure, args=(
+                jax.ShapeDtypeStruct((8,), jnp.float32),)))
+        r = hlo_audit.audit_program(e)
+        assert r.status == "fail"
+        assert any("callback" in f for f in r.findings), r.findings
+
+    def test_device_requirement_skips(self):
+        e = registry.ProgramEntry(
+            name="toy.needs_mesh", builder=lambda: dict(),
+            requires_devices=10**6)
+        assert hlo_audit.audit_program(e).status == "skipped"
+
+    def test_strict_counts_skips_as_failures(self, monkeypatch, capsys):
+        # a preset XLA_FLAGS device count must not silently disable the
+        # sharded audits while the CI gate still exits 0
+        toy = registry.ProgramEntry(
+            name="toy.skipper", builder=lambda: dict(),
+            requires_devices=10**6)
+        monkeypatch.setattr(registry, "iter_programs",
+                            lambda fast_only=False: [toy])
+        _, failed = hlo_audit.run(fast_only=True, strict=True)
+        assert failed == 1
+        _, failed = hlo_audit.run(fast_only=True, strict=False)
+        assert failed == 0
+
+    def test_full_run_enforces_min_verified_floor(self, monkeypatch,
+                                                  capsys):
+        # an emptied registry (or mass-skipping env) must fail the FULL
+        # audit: the >= MIN_VERIFIED acceptance floor is enforced, not
+        # just documented
+        monkeypatch.setattr(registry, "iter_programs",
+                            lambda fast_only=False: [])
+        _, failed = hlo_audit.run()
+        assert failed >= 1
+        assert "floor" in capsys.readouterr().out
+
+    def test_reregistration_same_module_overwrites(self):
+        # module RELOADS re-execute @hlo_program decorators; same-module
+        # re-registration must overwrite, not crash the reload
+        from raft_tpu.analysis.registry import _PROGRAMS, hlo_program
+
+        try:
+            @hlo_program("toy.reload_me")
+            def _b1():
+                return {}
+
+            @hlo_program("toy.reload_me")  # same module: a reload
+            def _b2():
+                return {}
+
+            assert _PROGRAMS["toy.reload_me"].builder is _b2
+        finally:
+            _PROGRAMS.pop("toy.reload_me", None)
+
+
+class TestHloTextParsers:
+    _HLO = """
+HloModule m, input_output_alias={ {0}: (1, {}, may-alias) }
+  %x = f32[8,64]{1,0} parameter(0)
+  %ag = f32[8,8,64]{2,1,0} all-gather(f32[8,1,64]{2,1,0} %x), dimensions={0}
+  %ar = (f32[16]{0}, s32[16]{0}) all-reduce(f32[16]{0} %a, s32[16]{0} %b)
+  %agr-start = f32[32]{0} all-gather-start(f32[4]{0} %x2)
+  %agr-done = f32[32]{0} all-gather-done(f32[32]{0} %agr-start)
+  %t-start = (f32[4]{0}, f32[32]{0}) all-gather-start(f32[4]{0} %x5)
+  %t-done = f32[32]{0} all-gather-done((f32[4]{0}, f32[32]{0}) %t-start)
+  %cc = f32[4]{0} custom-call(f32[4]{0} %x3), custom_call_target="xla_python_cpu_callback"
+  %ok = f32[4]{0} custom-call(f32[4]{0} %x4), custom_call_target="TopK"
+"""
+
+    def test_collective_stats(self):
+        count, nbytes, ops = hlo_audit.collective_stats(self._HLO)
+        # all-gather + tuple all-reduce + 2 async starts (dones never
+        # re-counted); the TUPLE async start counts only its result half
+        # — (operand, result) would otherwise overcount vs the declared
+        # result-payload budgets
+        assert count == 4
+        assert nbytes == ((8 * 8 * 64 * 4) + (16 * 4 + 16 * 4)
+                          + 32 * 4 + 32 * 4)
+
+    def test_host_calls_flag_callbacks_not_compute(self):
+        f = hlo_audit.host_call_findings(self._HLO)
+        assert any("xla_python_cpu_callback" in x for x in f)
+        assert not any("TopK" in x for x in f)
+
+    def test_aliased_params(self):
+        assert hlo_audit.aliased_params(self._HLO) == [(1, "may-alias")]
+
+
+# ---------------------------------------------------------------------------
+# the shipped registry
+
+
+class TestShippedRegistry:
+    def test_catalog(self):
+        entries = {e.name: e for e in registry.iter_programs()}
+        # the acceptance floor: >= 6 hot-path programs declared
+        assert len(entries) >= 6, sorted(entries)
+        for expected in ("brute_force.knn_scan", "ivf_flat.search_batch",
+                         "ivf_pq.full_search", "ivf_pq.encode_tile",
+                         "ivf_pq.csum_tile", "cluster.fused_em_step",
+                         "build.scatter_append_in_place",
+                         "ann_mnmg.ivf_flat_sharded"):
+            assert expected in entries, expected
+        # every single-device entry pins a zero-collective budget; the
+        # sharded entries pin exactly one launch
+        for e in entries.values():
+            if e.requires_devices == 1:
+                assert e.collectives == 0, e.name
+            else:
+                assert e.collectives == 1, e.name
+
+    def test_hotpath_function_scopes_resolve(self):
+        # a registry entry naming a function that does not exist guards
+        # NOTHING — every declared function scope must resolve in its
+        # module (the dead-entry regression class)
+        import ast as ast_mod
+
+        from raft_tpu.analysis import hotpaths
+
+        for hp in hotpaths.HOT_PATHS:
+            if not hp.functions:
+                continue
+            mod = REPO / hp.pattern
+            assert mod.is_file(), hp.pattern
+            defined = {n.name for n in ast_mod.walk(
+                ast_mod.parse(mod.read_text()))
+                if isinstance(n, (ast_mod.FunctionDef,
+                                  ast_mod.AsyncFunctionDef))}
+            missing = set(hp.functions) - defined
+            assert not missing, (hp.pattern, sorted(missing))
+
+    def test_donation_entry_documents_backends(self):
+        e = registry.get_program("build.scatter_append_in_place")
+        assert e.donate_argnums == (0, 1)
+        assert e.donation_policy.get("cpu") == "may-alias"
+        assert e.donation_policy.get("tpu") == "must-alias"
+
+    def test_encode_tile_audit_passes(self):
+        # the graduated PR-7 O(tile)-transient gate, spec-only (cheap)
+        r = hlo_audit.audit_program(registry.get_program(
+            "ivf_pq.encode_tile"))
+        assert r.status == "ok", r.findings
+        assert r.stats["transient_bytes"] <= 8 << 20
+
+    def test_sharded_audit_one_allgather(self, devices):
+        r = hlo_audit.audit_program(registry.get_program(
+            "ann_mnmg.brute_force_sharded"))
+        assert r.status == "ok", r.findings
+        assert r.stats["collectives"] == 1
+
+
+class TestCliArgs:
+    def test_programs_filter_space_form(self, capsys):
+        from raft_tpu.analysis.__main__ import main
+
+        rc = main(["--hlo", "--programs", "ivf_pq.encode_tile"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "ivf_pq.encode_tile" in out
+        assert "knn_scan" not in out
+
+    def test_programs_filter_eq_form(self, capsys):
+        from raft_tpu.analysis.__main__ import main
+
+        rc = main(["--hlo", "--programs=ivf_pq.csum_tile"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "ivf_pq.csum_tile" in out
+        assert "encode_tile" not in out
+
+
+@pytest.mark.slow
+class TestCli:
+    def test_module_cli_exits_zero_at_head(self):
+        # the full two-level gate, as CI runs it
+        p = subprocess.run([sys.executable, "-m", "raft_tpu.analysis"],
+                           cwd=REPO, capture_output=True, text=True,
+                           timeout=600)
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "verified" in p.stdout
