@@ -39,6 +39,8 @@ mods = [
     "raft_tpu.neighbors", "raft_tpu.neighbors.ivf_flat",
     "raft_tpu.neighbors.ivf_pq", "raft_tpu.neighbors.ball_cover",
     "raft_tpu.serve", "raft_tpu.native",
+    "raft_tpu.telemetry", "raft_tpu.telemetry.registry",
+    "raft_tpu.telemetry.spans", "raft_tpu.telemetry.export",
     "raft_tpu.analysis", "raft_tpu.analysis.engine",
     "raft_tpu.analysis.rules", "raft_tpu.analysis.registry",
 ]

@@ -41,6 +41,9 @@ PACKAGES = [
     ("label", "Label relabeling/merging utilities"),
     ("comms", "comms_t-shaped collectives over XLA; host p2p plane; "
               "session bootstrap"),
+    ("telemetry", "Unified runtime telemetry: metrics registry "
+                  "(counters/gauges/log-bucketed histograms), span "
+                  "tracing, Prometheus/JSONL exporters"),
     ("analysis", "Static analysis of hot-path contracts: AST rule engine "
                  "+ lowered-HLO program auditor"),
 ]

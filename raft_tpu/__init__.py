@@ -27,6 +27,8 @@ Layer map (mirrors reference SURVEY.md §1, re-imagined TPU-first):
   solver    linear assignment problem
   label     label utilities
   comms     comms_t-shaped collectives over ICI/DCN (shard_map/pjit)
+  telemetry unified runtime telemetry: metrics registry (counters/gauges/
+            log-bucketed histograms), span tracing, Prometheus/JSONL export
   analysis  static analysis of the hot-path contracts: AST rule engine +
             lowered-HLO program auditor (python -m raft_tpu.analysis)
 """
@@ -59,6 +61,7 @@ _SUBMODULES = (
     "solver",
     "label",
     "comms",
+    "telemetry",
     "analysis",
 )
 

@@ -10,9 +10,10 @@ from raft_tpu.analysis.rules import (  # noqa: F401
     serve_path,
     static_args,
     style,
+    telemetry_discipline,
     trace_purity,
 )
 
 __all__ = ["collectives", "dtype_drift", "host_transfer", "probe_scan",
            "reductions", "serve_path", "static_args", "style",
-           "trace_purity"]
+           "telemetry_discipline", "trace_purity"]

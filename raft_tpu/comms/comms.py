@@ -31,13 +31,15 @@ Usage:
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
-from collections import Counter
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from raft_tpu import telemetry
 import numpy as np
 
 from raft_tpu.core.error import LogicError, expects
@@ -81,6 +83,11 @@ class _Mailboxes:
 
 _mailboxes = _Mailboxes()
 
+#: per-instance ordinal labeling each communicator's collective counters in
+#: the registry (the view keeps per-instance reads private; the label keeps
+#: exports attributable)
+_COMM_IDS = itertools.count()
+
 
 class Comms:
     """``comms_t``-shaped communicator bound to a device mesh axis.
@@ -121,7 +128,18 @@ class Comms:
         # (the sharded-ANN layer asserts bytes, not just counts, so an
         # over-chatty program that splits one allgather into many small
         # ones — or fattens the payload — is caught either way).
-        self.collective_calls: Counter = Counter()
+        #
+        # Registry-backed (telemetry PR): the per-instance read surface is
+        # unchanged (a Counter-shaped view keyed by this instance's
+        # ordinal), mutation is atomic, and the byte/count totals across
+        # every communicator export via telemetry.snapshot() under
+        # raft_tpu_comms_collective_calls{comm,key}.
+        self.collective_calls: telemetry.LegacyCounterView = (
+            telemetry.legacy_counter(
+                "raft_tpu_comms_collective_calls",
+                "trace-time collective launches and payload bytes",
+                labelnames=("comm", "key"),
+                fixed=(next(_COMM_IDS),)))
         # Host p2p plane: TCP mailbox (cross-process, ucp_helper.hpp role)
         # when a coordinator address is configured, else process-local
         # queues.  RAFT_TPU_COORD_ADDR is the ambient default.
@@ -240,10 +258,10 @@ class Comms:
         """Bump the trace-time launch counter AND record the launch's
         per-rank payload bytes under ``f"{name}_bytes"`` (shapes are static
         at trace time, so the byte count is exact even for tracers)."""
-        self.collective_calls[name] += 1
+        self.collective_calls.inc(name)
         itemsize = jnp.dtype(jnp.result_type(x)).itemsize
-        self.collective_calls[f"{name}_bytes"] += int(
-            itemsize * np.prod(jnp.shape(x)))
+        self.collective_calls.inc(f"{name}_bytes", int(
+            itemsize * np.prod(jnp.shape(x))))
 
     def _gather_all(self, x):
         """all_gather over the FULL axis (grouped selection is masked on top)."""

@@ -20,13 +20,15 @@ of distinct executables for ragged workloads.
 
 from __future__ import annotations
 
-import collections
 import functools
 import os
+import zlib
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from raft_tpu import telemetry
 
 #: Compile/lower counters (the ``Comms.collective_calls`` /
 #: ``ivf_pq.lut_trace_counters`` pattern): every :meth:`AotFunction.compiled`
@@ -36,7 +38,17 @@ import jax.numpy as jnp
 #: ``aot_compile_counters["compiles"]`` after ``ServeEngine.warmup()``, serve
 #: traffic, and require the counter unchanged (tests/test_serve.py).  Never
 #: reset in library code — tests snapshot-and-diff.
-aot_compile_counters: collections.Counter = collections.Counter()
+#:
+#: Registry-backed since the telemetry PR: the mapping reads exactly like
+#: the old ``collections.Counter`` but lives in the metrics registry
+#: (``raft_tpu_aot_compiles{key}``), increments are ATOMIC
+#: (:meth:`~raft_tpu.telemetry.LegacyCounterView.inc` — plain ``c[k] += 1``
+#: raced under concurrent ``ServeEngine.search``), and the values ride in
+#: ``telemetry.snapshot()`` / ``telemetry.prometheus_text()`` for free.
+#: Counting stays live even under ``RAFT_TPU_TELEMETRY=0`` — it is a
+#: contract instrument, not just telemetry.
+aot_compile_counters: telemetry.LegacyCounterView = telemetry.legacy_counter(
+    "raft_tpu_aot_compiles", "AOT lower+compile cache misses by key")
 
 
 def _machine_fingerprint() -> str:
@@ -239,6 +251,7 @@ class AotFunction:
                              "bucket=True (padding would donate a fresh "
                              "pad buffer, not the caller's)")
         self._cache: Dict[Any, Any] = {}
+        self._name = getattr(fn, "__qualname__", repr(fn))
         functools.update_wrapper(self, fn)
 
     def _bucket_shape(self, shape):
@@ -287,18 +300,19 @@ class AotFunction:
         shape, dtype = self._leaf_spec(leaf)
         return jax.ShapeDtypeStruct(self._bucket_shape(shape), dtype)
 
-    def compiled(self, *args):
-        """Return the compiled executable for this signature (compiling on
-        miss) without running it."""
-        sig = self._signature(args)
+    def _entry(self, sig, args):
+        """(executable, sig_label) for *sig*, compiling on miss.  The label
+        is a stable 8-hex digest of the signature, computed once per cache
+        entry, so per-signature dispatch latency can be recorded without
+        re-hashing the signature on the hot path."""
         entry = self._cache.get(sig)
         if entry is None:
             # every lower+compile is observable: zero-retrace serving is
             # asserted by diffing this counter around steady-state traffic
-            aot_compile_counters["compiles"] += 1
-            aot_compile_counters[
-                f"compiles:{getattr(self._fn, '__qualname__', repr(self._fn))}"
-            ] += 1
+            # (.inc is the atomic form — `c[k] += 1` races under threads)
+            aot_compile_counters.inc("compiles")
+            aot_compile_counters.inc(
+                f"compiles:{getattr(self._fn, '__qualname__', repr(self._fn))}")
             _ensure_persistent_cache()
             jitted = jax.jit(self._fn, static_argnums=self._static,
                              donate_argnums=self._donate)
@@ -306,12 +320,22 @@ class AotFunction:
                 a if i in self._static
                 else jax.tree_util.tree_map(self._leaf_struct, a)
                 for i, a in enumerate(args)]
-            entry = jitted.lower(*lower_args).compile()
+            exe = jitted.lower(*lower_args).compile()
+            sig_label = f"{zlib.crc32(repr(sig).encode()) & 0xFFFFFFFF:08x}"
+            entry = (exe, sig_label)
             self._cache[sig] = entry
         return entry
 
+    def compiled(self, *args):
+        """Return the compiled executable for this signature (compiling on
+        miss) without running it."""
+        return self._entry(self._signature(args), args)[0]
+
     def __call__(self, *args):
-        exe = self.compiled(*args)
+        sig = self._signature(args)
+        cold = sig not in self._cache
+        exe, sig_label = self._entry(sig, args)
+        t0 = telemetry.now()
 
         def prep(leaf):
             leaf = jnp.asarray(leaf)
@@ -323,7 +347,13 @@ class AotFunction:
 
         call_args = [jax.tree_util.tree_map(prep, a)
                      for i, a in enumerate(args) if i not in self._static]
-        return exe(*call_args)
+        out = exe(*call_args)
+        # per-AotFunction warm/cold dispatch counts + per-signature latency
+        # (host-side dispatch time: the executable call is async) — no-op
+        # under RAFT_TPU_TELEMETRY=0
+        telemetry.record_dispatch(self._name, sig_label, cold,
+                                  telemetry.now() - t0)
+        return out
 
     @property
     def cache_size(self) -> int:
@@ -383,9 +413,15 @@ class MeshAotFunction(AotFunction):
                                     sharding=self._leaf_sharding(leaf))
 
     def __call__(self, *args):
-        exe = self.compiled(*args)
-        return exe(*[a for i, a in enumerate(args)
-                     if i not in self._static])
+        sig = self._signature(args)
+        cold = sig not in self._cache
+        exe, sig_label = self._entry(sig, args)
+        t0 = telemetry.now()
+        out = exe(*[a for i, a in enumerate(args)
+                    if i not in self._static])
+        telemetry.record_dispatch(self._name, sig_label, cold,
+                                  telemetry.now() - t0)
+        return out
 
 
 def mesh_aot(fn: Callable, *, static_argnums: Tuple[int, ...] = ()

@@ -11,8 +11,9 @@ from __future__ import annotations
 
 import logging
 import sys
-import time
 from typing import Callable, Optional
+
+from raft_tpu import telemetry
 
 # Level values mirror reference core/logger.hpp:36-46 (RAFT_LEVEL_*).
 OFF = 0
@@ -192,32 +193,35 @@ _PERF_TIMERS: dict = {}
 
 class time_range:
     """Profiler range annotation — counterpart of NVTX ranges
-    (reference core/nvtx.hpp:95 ``common::nvtx::range``).  Emits a
-    ``jax.profiler.TraceAnnotation`` so ranges appear in TPU profiler traces,
-    and optionally logs elapsed wall time at TRACE level."""
+    (reference core/nvtx.hpp:95 ``common::nvtx::range``).
+
+    A thin wrapper over :func:`raft_tpu.telemetry.span` since the telemetry
+    PR: the range still emits a ``jax.profiler.TraceAnnotation`` (now via
+    the span's CACHED module-level profiler import — the old form paid a
+    per-``__enter__`` ``import jax.profiler`` machinery lookup, real
+    per-request work once ranges sit on the serve hot path), and
+    additionally records wall time into the registry span histogram.
+    ``log=True`` keeps the elapsed-time TRACE log line.  Under
+    ``RAFT_TPU_TELEMETRY=0`` the span half is a no-op and only the
+    (optional) TRACE log remains."""
 
     def __init__(self, name: str, log: bool = False):
         self._name = name
         self._log = log
-        self._ann = None
+        self._span = None
         self._t0 = 0.0
 
     def __enter__(self):
-        try:
-            import jax.profiler
-
-            self._ann = jax.profiler.TraceAnnotation(self._name)
-            self._ann.__enter__()
-        except Exception:  # pragma: no cover - profiler unavailable
-            self._ann = None
-        self._t0 = time.perf_counter()
+        self._span = telemetry.span(self._name)
+        self._span.__enter__()
+        self._t0 = telemetry.now()
         return self
 
     def __exit__(self, *exc):
         if self._log:
-            log_trace("%s: %.3f ms", self._name, (time.perf_counter() - self._t0) * 1e3)
-        if self._ann is not None:
-            self._ann.__exit__(*exc)
+            log_trace("%s: %.3f ms", self._name,
+                      (telemetry.now() - self._t0) * 1e3)
+        self._span.__exit__(*exc)
         return False
 
 

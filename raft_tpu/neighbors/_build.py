@@ -45,7 +45,6 @@ live in their own modules and thread through as callables + AOT handles.
 
 from __future__ import annotations
 
-import collections
 import os
 from typing import Callable, Optional, Tuple
 
@@ -54,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.analysis.registry import hlo_program
+from raft_tpu import telemetry
 from raft_tpu.core.aot import MeshAotFunction, aot, aot_dispatchable
 from raft_tpu.neighbors._common import (
     ChunkLayout,
@@ -67,7 +67,10 @@ from raft_tpu.neighbors._common import (
 #: key increments once per TRACE of the named program, so tests can assert
 #: that warm builds/extends trace nothing (``aot_compile_counters`` pins the
 #: compile side; these pin the trace side even for jit fallbacks).
-build_trace_counters: collections.Counter = collections.Counter()
+#: Registry-backed (telemetry PR): same read surface, atomic increments,
+#: exported as ``raft_tpu_build_trace{key}``.
+build_trace_counters: telemetry.LegacyCounterView = telemetry.legacy_counter(
+    "raft_tpu_build_trace", "index build/extend program trace events")
 
 #: Default per-tile row count for the build/extend populate loop.  At the
 #: default IVF-PQ shapes (pq_dim 16–32, 8-bit codebooks) the per-tile encode
@@ -118,7 +121,7 @@ def _list_slots_impl(labels, fill0, table, cap: int, n_lists: int):
     resolved through the chunk table.  The rank/scatter machinery of
     ``pack_lists_chunked``, now one device program — no per-row data
     touches host."""
-    build_trace_counters["list_slots"] += 1
+    build_trace_counters.inc("list_slots")
     n = labels.shape[0]
     rank = fill0[labels] + _ranks_within(labels, n, n_lists)
     phys = table[labels, rank // cap]
@@ -128,7 +131,7 @@ def _list_slots_impl(labels, fill0, table, cap: int, n_lists: int):
 def _scatter_new_impl(payloads: Tuple, ids, flat, n_rows: int, cap: int):
     """Build fresh (n_rows, cap, …) padded blocks from per-row payloads +
     precomputed flat slots.  Out-of-range slots (sharded pads) drop."""
-    build_trace_counters["scatter_new"] += 1
+    build_trace_counters.inc("scatter_new")
     datas = []
     for p in payloads:
         tail = p.shape[1:]
@@ -145,7 +148,7 @@ def _scatter_append_impl(datas: Tuple, idx, payloads: Tuple, ids, flat):
     """Append per-row payloads into EXISTING blocks at precomputed flat
     slots.  Compiled with donated block buffers (the in-place extend path)
     or without (the functional copy path) — same trace either way."""
-    build_trace_counters["scatter_append"] += 1
+    build_trace_counters.inc("scatter_append")
     out = []
     for d, p in zip(datas, payloads):
         tail = d.shape[2:]
@@ -217,19 +220,26 @@ def run_tiles(tile_jit: Callable, tile_aot: Callable, x, labels,
     n = x.shape[0]
     tile = resolve_tile_rows(n, tile_rows)
     outs = []
-    for t0 in range(0, n, tile):
-        t1 = min(t0 + tile, n)
-        w = t1 - t0
-        xt, lt = x[t0:t1], labels[t0:t1]
-        if w < tile:
-            xt = jnp.pad(xt, ((0, tile - w),) + ((0, 0),) * (xt.ndim - 1))
-            lt = jnp.pad(lt, ((0, tile - w),))
-        res = _dispatch(tile_jit, tile_aot, xt, lt, *extra_args, *statics)
-        if not isinstance(res, tuple):
-            res = (res,)
-        if w < tile:
-            res = tuple(r[:w] for r in res)
-        outs.append(res)
+    # span taxonomy (docs/observability.md): the whole host tile loop under
+    # build.run_tiles, each fixed-shape dispatch under build.tile — host
+    # wall time only, the dispatches themselves stay async
+    with telemetry.span("build.run_tiles"):
+        for t0 in range(0, n, tile):
+            t1 = min(t0 + tile, n)
+            w = t1 - t0
+            xt, lt = x[t0:t1], labels[t0:t1]
+            if w < tile:
+                xt = jnp.pad(xt,
+                             ((0, tile - w),) + ((0, 0),) * (xt.ndim - 1))
+                lt = jnp.pad(lt, ((0, tile - w),))
+            with telemetry.span("build.tile"):
+                res = _dispatch(tile_jit, tile_aot, xt, lt,
+                                *extra_args, *statics)
+            if not isinstance(res, tuple):
+                res = (res,)
+            if w < tile:
+                res = tuple(r[:w] for r in res)
+            outs.append(res)
     if not outs:
         raise ValueError("run_tiles: empty dataset")
     if len(outs) == 1:

@@ -61,7 +61,6 @@ carries a ``dataset_dtype`` tag enforcing extend/search consistency.
 
 from __future__ import annotations
 
-import collections
 import dataclasses
 import enum
 import functools
@@ -76,6 +75,7 @@ from raft_tpu.core.aot import _bucket_dim, aot, aot_dispatchable
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import auto_sync_handle
 from raft_tpu.core.logger import traced
+from raft_tpu import telemetry
 from raft_tpu.cluster import build_hierarchical, min_cluster_and_distance
 from raft_tpu.analysis.registry import hlo_program
 from raft_tpu.distance.distance_types import DistanceType
@@ -111,7 +111,11 @@ _FP8_PEAK = 440.0
 #: legacy per-tile recompute path, ``hoisted_lut_builds`` once per trace of
 #: the per-batch hoisted build.  A hoisted-path trace bumping the in-scan
 #: counter would mean codebook einsums crept back into the scan body.
-lut_trace_counters: collections.Counter = collections.Counter()
+#: Registry-backed (telemetry PR): same read surface, atomic increments,
+#: exported as ``raft_tpu_ivf_pq_lut_trace{key}``.
+lut_trace_counters: telemetry.LegacyCounterView = telemetry.legacy_counter(
+    "raft_tpu_ivf_pq_lut_trace",
+    "IVF-PQ LUT build sites observed at search-program trace time")
 
 
 def hoisted_lut_enabled() -> bool:
@@ -740,7 +744,7 @@ def _encode_tile_impl(x_t, labels_t, centers, rotation, codebooks,
     dataset size).  Also returns the raw (tile, pq_dim) codes for the
     csum stage.  Row-local math only: the same kernel runs per shard
     inside ``build_sharded``'s shard_map populate."""
-    build_trace_counters["pq_encode_tile"] += 1
+    build_trace_counters.inc("pq_encode_tile")
     resid = (x_t - centers[labels_t]) @ rotation
     codes = _encode(resid, codebooks, labels_t, per_cluster)
     packed = _pack_codes(codes, pq_bits)
@@ -756,7 +760,7 @@ def _csum_tile_impl(codes_t, labels_t, centers, rotation, codebooks,
     and would break the tiled ≡ monolithic bit-identity contract.  As a
     standalone trace it is the monolithic program at tile shapes, and the
     contraction is row-local, so row tiling is exact."""
-    build_trace_counters["pq_csum_tile"] += 1
+    build_trace_counters.inc("pq_csum_tile")
     return (_csum_for_codes(codes_t, labels_t, centers, rotation, codebooks,
                             per_cluster),)
 
@@ -1087,7 +1091,7 @@ def _scan_hoisted(q, probe_ids, rot_q, rot_centers, centers, codebooks,
     the pq_dim sequential one-hot scan steps of the legacy path, plus the
     csum gather and the threaded base add.  Per-probe work drops from
     O(pq_dim·2^bits·ds) einsum flops + epilogues to a pure table lookup."""
-    lut_trace_counters["hoisted_lut_builds"] += 1
+    lut_trace_counters.inc("hoisted_lut_builds")
     q_sub = rot_q.reshape(nq, pq_dim, ds)
     # combined list+query LUT for compressed dtypes (quantization needs the
     # small-dynamic-range combined entries); csum path for exact f32
@@ -1240,7 +1244,7 @@ def _search_batch_impl(q, probe_ids, leaves, metric_val: int, k: int,
             best_d = jnp.sqrt(jnp.maximum(best_d, 0))
         return best_d, best_i
 
-    lut_trace_counters["in_scan_lut_builds"] += 1
+    lut_trace_counters.inc("in_scan_lut_builds")
 
     def score_tile(rows):
         lists = owner[rows]                                # logical list ids

@@ -29,6 +29,14 @@ closes that gap (docs/serving.md):
   (or the engine's ``max_batch``) is served solo through the backend's
   public entry point and counted in :attr:`ServeEngine.stats`, never
   crashed and never silently recompiled into the coalesced path.
+* **Telemetry** — the request lifecycle runs under nested
+  ``raft_tpu.telemetry`` spans (``serve.request`` → ingest/coalesce/
+  assemble/dispatch/deliver), per-request completion latency lands in a
+  fixed-memory histogram + bounded reservoir
+  (:meth:`ServeEngine.latency_quantiles`), and ``stats`` is a
+  registry-backed atomic counter view — all host-side wall-time only
+  (zero device syncs), no-ops under ``RAFT_TPU_TELEMETRY=0``, overhead
+  gated < 3% qps in-bench (docs/observability.md).
 
 Hot-path rule (ci/lint.py): nothing in this package may call ``jax.jit``
 or ``jax.lax`` — every device computation must route through the
@@ -38,19 +46,31 @@ guarantee silently erodes.
 
 from __future__ import annotations
 
+import itertools
 import threading
-import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu import telemetry
 from raft_tpu.core.aot import _bucket_dim
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import Handle
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.neighbors import ann_mnmg, brute_force, ivf_flat, ivf_pq
+
+#: Bound on the per-call latency list AND the cumulative latency reservoir:
+#: the pre-telemetry ``last_latencies`` attribute kept one float per request
+#: of the last call UNBOUNDED (a single huge ``search()`` call — or an
+#: engine polled only via the attribute — grew it without limit); the
+#: replacement keeps at most this many samples while the full distribution
+#: lives in the fixed-memory latency histogram.
+LATENCY_RESERVOIR = 4096
+
+#: per-instance ordinal labeling each engine's metrics in the registry
+_ENGINE_IDS = itertools.count()
 
 
 class _BruteForceBackend:
@@ -375,15 +395,30 @@ class ServeEngine:
         self._handle = handle if handle is not None else Handle(n_streams=2)
         self._warmed: Dict[Any, set] = {}  # dtype(str) -> {buckets}
         self._lock = threading.Lock()
-        self.stats: Dict[str, int] = {
-            "requests": 0, "queries": 0, "super_batches": 0,
-            "solo_fallbacks": 0, "coalesced_requests": 0, "refreshes": 0,
-        }
-        #: Per-request completion latency (seconds, relative to the
-        #: enclosing ``search()`` entry) of the LAST search call — request
-        #: j completes when its super-batch's results land on the host.
-        #: Telemetry for the serve bench's p50/p99 replay numbers.
-        self.last_latencies: List[float] = []
+        #: Serving statistics — the same keys and read surface as the
+        #: pre-telemetry plain dict, now a Counter-shaped view over the
+        #: registry (``raft_tpu_serve_engine_stats{engine,key}``): reads
+        #: (``stats["requests"]``, ``dict(stats)``, iteration) are
+        #: unchanged, mutation is atomic, and every engine's stats export
+        #: via ``telemetry.snapshot()`` / ``prometheus_text()``.
+        self._engine_id = str(next(_ENGINE_IDS))
+        self.stats: telemetry.LegacyCounterView = telemetry.legacy_counter(
+            "raft_tpu_serve_engine_stats", "ServeEngine serving statistics",
+            labelnames=("engine", "key"), fixed=(self._engine_id,))
+        for key in ("requests", "queries", "super_batches",
+                    "solo_fallbacks", "coalesced_requests", "refreshes"):
+            self.stats[key] = 0
+        #: Fixed-memory per-request completion-latency distribution
+        #: (request j completes when its super-batch's results land on the
+        #: host, measured from ``search()`` entry) + a bounded
+        #: LATENCY_RESERVOIR-sample uniform reservoir for exact-sample
+        #: percentiles — the bounded replacement of the old unbounded
+        #: ``last_latencies`` list (see :meth:`latency_quantiles`).
+        self.latency_hist: telemetry.Histogram = telemetry.histogram(
+            "raft_tpu_serve_request_latency_seconds",
+            "per-request completion latency within one search() call",
+            labelnames=("engine",), reservoir=LATENCY_RESERVOIR)
+        self._last_latencies: List[float] = []
 
     @property
     def backend(self) -> str:
@@ -392,6 +427,26 @@ class ServeEngine:
     @property
     def k(self) -> int:
         return self._backend.k
+
+    # -- latency telemetry --------------------------------------------------
+    @property
+    def last_latencies(self) -> List[float]:
+        """Per-request completion latencies (seconds) of the LAST
+        ``search()`` call — the legacy read surface, now BOUNDED: at most
+        :data:`LATENCY_RESERVOIR` samples are retained per call (the full
+        distribution is in :attr:`latency_hist`; long-running engines no
+        longer accumulate one float per request forever)."""
+        return list(self._last_latencies)
+
+    def latency_quantiles(self, qs: Sequence[float] = (0.5, 0.99)
+                          ) -> List[Optional[float]]:
+        """Completion-latency quantile estimates over the engine's WHOLE
+        serving history, from the fixed-memory log-bucketed histogram
+        (within ~one bucket ratio of exact; the serve bench reports its
+        p50/p99 from here).  ``None`` entries when nothing was recorded
+        (e.g. telemetry disabled)."""
+        return [self.latency_hist.quantile(q, (self._engine_id,))
+                for q in qs]
 
     # -- warmup / pinning ---------------------------------------------------
     def warmup(self, buckets: Optional[Sequence[int]] = None,
@@ -449,6 +504,10 @@ class ServeEngine:
         unaffected.  ``max_batch`` re-derives from the requested bound and
         the NEW index's transient cap; warmed buckets above it are
         dropped (requests that needed them fall back to solo, counted)."""
+        with telemetry.span("serve.refresh"):
+            self._refresh(index, params)
+
+    def _refresh(self, index, params):
         with self._lock:  # snapshot under the lock: warmup() mutates it
             c = dict(self._ctor)
             snapshot = {dt: set(bs) for dt, bs in self._warmed.items()}
@@ -480,7 +539,7 @@ class ServeEngine:
             self._ctor = dict(c, params=params)
             self.max_batch = max_batch
             self._warmed = warmed
-            self.stats["refreshes"] += 1
+            self.stats.inc("refreshes")
 
     # -- the request path ---------------------------------------------------
     def _plan(self, sizes: List[int], max_bucket: int
@@ -531,38 +590,50 @@ class ServeEngine:
         assembly + pad to the warmed bucket, ONE device transfer, ONE fused
         async dispatch recorded on the next pool stream (assembly of batch
         i+1 overlaps execution of batch i) → collect host results → slice
-        per request."""
+        per request.
+
+        Each phase runs under a nested telemetry span
+        (``serve.request`` → ``serve.ingest`` / ``serve.coalesce`` /
+        ``serve.assemble`` / ``serve.dispatch`` / ``serve.deliver``) — wall
+        time only, no device syncs, no-ops under ``RAFT_TPU_TELEMETRY=0``
+        (docs/observability.md has the span taxonomy)."""
         with self._lock:
-            return self._search_locked(requests)
+            with telemetry.span("serve.request"):
+                return self._search_locked(requests)
 
     def _search_locked(self, requests):
-        t_entry = time.perf_counter()
+        t_entry = telemetry.now()
         be = self._backend
-        ingested = [be.ingest(q) for q in requests]
-        self.stats["requests"] += len(ingested)
-        self.stats["queries"] += sum(int(q.shape[0]) for q in ingested)
+        with telemetry.span("serve.ingest"):
+            ingested = [be.ingest(q) for q in requests]
+        self.stats.inc("requests", len(ingested))
+        self.stats.inc("queries", sum(int(q.shape[0]) for q in ingested))
         results: List[Optional[Tuple[np.ndarray, np.ndarray]]] = (
             [None] * len(ingested))
         latencies = [0.0] * len(ingested)
 
         # group by compute dtype (the engine IS the (index, k, params) key;
         # dtype is the one per-request signature dimension left)
-        by_dtype: Dict[str, List[int]] = {}
-        for j, q in enumerate(ingested):
-            if q.shape[0] == 0:
-                results[j] = (np.zeros((0, be.k), np.float32),
-                              np.full((0, be.k), -1, np.int32))
-                continue
-            by_dtype.setdefault(str(q.dtype), []).append(j)
+        with telemetry.span("serve.coalesce"):
+            by_dtype: Dict[str, List[int]] = {}
+            for j, q in enumerate(ingested):
+                if q.shape[0] == 0:
+                    results[j] = (np.zeros((0, be.k), np.float32),
+                                  np.full((0, be.k), -1, np.int32))
+                    continue
+                by_dtype.setdefault(str(q.dtype), []).append(j)
+            plans = []
+            for dt, idxs in by_dtype.items():
+                warmed = self._warmed.get(dt, set())
+                max_bucket = (min(max(warmed), self.max_batch) if warmed
+                              else self.max_batch)
+                sizes = [int(ingested[j].shape[0]) for j in idxs]
+                batches, solo = self._plan(sizes, max_bucket)
+                plans.append((idxs, warmed, batches, solo))
 
         inflight = []  # (kind, payload...) in dispatch order
         lane = 0
-        for dt, idxs in by_dtype.items():
-            warmed = self._warmed.get(dt, set())
-            max_bucket = (min(max(warmed), self.max_batch) if warmed
-                          else self.max_batch)
-            sizes = [int(ingested[j].shape[0]) for j in idxs]
-            batches, solo = self._plan(sizes, max_bucket)
+        for idxs, warmed, batches, solo in plans:
             for batch in batches:
                 members = [(idxs[jj], start, n) for jj, start, n in batch]
                 total = members[-1][1] + members[-1][2]
@@ -572,37 +643,46 @@ class ServeEngine:
                 # pure host work the double-buffering can overlap with the
                 # previous batch's device execution (and dispatches no
                 # per-shape concat/pad programs on device)
-                block = np.zeros((bucket, be.dim), ingested[idxs[0]].dtype)
-                for j, start, n in members:
-                    block[start:start + n] = ingested[j]
-                out = be.dispatch(jnp.asarray(block))  # async
-                self._handle.get_next_usable_stream(lane).record(out)
+                with telemetry.span("serve.assemble"):
+                    block = np.zeros((bucket, be.dim),
+                                     ingested[idxs[0]].dtype)
+                    for j, start, n in members:
+                        block[start:start + n] = ingested[j]
+                with telemetry.span("serve.dispatch"):
+                    out = be.dispatch(jnp.asarray(block))  # async
+                    self._handle.get_next_usable_stream(lane).record(out)
                 lane += 1
                 inflight.append(("coalesced", members, out))
-                self.stats["super_batches"] += 1
-                self.stats["coalesced_requests"] += len(members)
+                self.stats.inc("super_batches")
+                self.stats.inc("coalesced_requests", len(members))
             for jj in solo:
                 j = idxs[jj]
                 # the RAW request, not the ingested form: the public entry
                 # point applies its own ingest prologue, and re-ingesting
                 # (e.g. normalizing an already-normalized cosine query)
                 # would break the identical-to-solo contract at ulp level
-                out = be.solo(requests[j])  # public path: compiles allowed
-                self._handle.get_next_usable_stream(lane).record(out)
+                with telemetry.span("serve.dispatch"):
+                    out = be.solo(requests[j])  # public: compiles allowed
+                    self._handle.get_next_usable_stream(lane).record(out)
                 lane += 1
                 inflight.append(("solo", [(j, 0, ingested[j].shape[0])],
                                  out))
-                self.stats["solo_fallbacks"] += 1
+                self.stats.inc("solo_fallbacks")
 
         # collect: blocks per batch; later batches keep executing meanwhile
-        for _kind, members, out in inflight:
-            # exempt(hot-path-host-transfer): result delivery fetch
-            d, i = np.asarray(out[0]), np.asarray(out[1])
-            done = time.perf_counter() - t_entry
-            for j, start, n in members:
-                results[j] = (d[start:start + n], i[start:start + n])
-                latencies[j] = done
-        self.last_latencies = latencies
+        with telemetry.span("serve.deliver"):
+            for _kind, members, out in inflight:
+                # exempt(hot-path-host-transfer): result delivery fetch
+                d, i = np.asarray(out[0]), np.asarray(out[1])
+                done = telemetry.now() - t_entry
+                for j, start, n in members:
+                    results[j] = (d[start:start + n], i[start:start + n])
+                    latencies[j] = done
+        eng = (self._engine_id,)
+        for v in latencies:
+            self.latency_hist.observe(v, eng)
+        # the legacy per-call read surface, BOUNDED (see last_latencies)
+        self._last_latencies = latencies[:LATENCY_RESERVOIR]
         return results
 
     def sync(self) -> None:
@@ -614,4 +694,4 @@ class ServeEngine:
         return (f"ServeEngine(backend={self.backend}, k={self.k}, "
                 f"max_batch={self.max_batch}, "
                 f"warmed={ {d: sorted(b) for d, b in self._warmed.items()} },"
-                f" stats={self.stats})")
+                f" stats={dict(self.stats)})")

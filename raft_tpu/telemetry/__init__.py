@@ -1,0 +1,129 @@
+"""Unified runtime telemetry: metrics registry, span tracing, exporters.
+
+The operational-telemetry layer the serving story demands
+(docs/observability.md): ONE process-wide registry of labeled counters,
+gauges and fixed-memory log-bucketed histograms (:mod:`.registry`), nested
+host-side span tracing that also feeds the TPU profiler
+(:mod:`.spans`), and exporters — plain-dict :func:`snapshot`, Prometheus
+text exposition :func:`prometheus_text`, and an opt-in JSONL span event
+sink (:func:`set_jsonl_sink`).
+
+The five pre-registry telemetry fragments (``Comms.collective_calls``,
+``core.aot.aot_compile_counters``, ``ivf_pq.lut_trace_counters``,
+``neighbors._build.build_trace_counters``, ``ServeEngine.stats``) are all
+registry-backed now, behind their exact legacy read surfaces
+(:class:`LegacyCounterView`), with mutation made atomic
+(``view.inc``) so concurrent ``ServeEngine.search()`` callers stop racing
+plain Counters.
+
+Global off switch: ``RAFT_TPU_TELEMETRY=0`` (or :func:`set_enabled`) turns
+spans, histograms, gauges, reservoirs and the JSONL sink into no-ops;
+counters stay live because they are contract instruments (zero-compile
+serve gates, collective-call budgets), not just telemetry — see
+:mod:`.registry` for the rationale.  The serve bench A/B gates the
+telemetry-on overhead at < 3% qps (bench.py ``serve``).
+
+Quick tour::
+
+    from raft_tpu import telemetry
+
+    with telemetry.span("serve.dispatch"):
+        ...                                   # timed, nested, profiled
+
+    telemetry.counter("my_events", labelnames=("kind",)).inc(
+        1, ("cache_miss",))
+
+    telemetry.snapshot()                      # plain dict, JSON-safe
+    print(telemetry.prometheus_text())        # Prometheus scrape body
+    telemetry.set_jsonl_sink("/tmp/spans.jsonl")   # span event stream
+"""
+
+from __future__ import annotations
+
+from raft_tpu.telemetry.export import prometheus_text, snapshot  # noqa: F401
+from raft_tpu.telemetry.registry import (  # noqa: F401
+    HIST_BUCKETS,
+    HIST_MAX,
+    HIST_MIN,
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    LegacyCounterView,
+    Registry,
+    Reservoir,
+    bucket_index,
+    bucket_upper,
+    enabled,
+    set_enabled,
+)
+from raft_tpu.telemetry.spans import (  # noqa: F401
+    Span,
+    current_span,
+    now,
+    set_jsonl_sink,
+    span,
+)
+
+
+def counter(name: str, help: str = "", labelnames=()) -> Counter:
+    """Get-or-create a labeled counter on the default registry."""
+    return REGISTRY.counter(name, help, labelnames)
+
+
+def gauge(name: str, help: str = "", labelnames=()) -> Gauge:
+    """Get-or-create a labeled gauge on the default registry."""
+    return REGISTRY.gauge(name, help, labelnames)
+
+
+def histogram(name: str, help: str = "", labelnames=(),
+              reservoir: int = 0) -> Histogram:
+    """Get-or-create a labeled log-bucketed histogram on the default
+    registry (optional bounded uniform *reservoir* per label set)."""
+    return REGISTRY.histogram(name, help, labelnames, reservoir=reservoir)
+
+
+def legacy_counter(name: str, help: str = "", labelnames=("key",),
+                   fixed=()) -> LegacyCounterView:
+    """A :class:`LegacyCounterView` over ``name{*labelnames}`` — the
+    migration shim the five legacy fragments sit behind.  *labelnames*
+    must end in ``"key"`` (the view's mapping key); *fixed* pins every
+    label before it (e.g. a per-instance ordinal), so per-instance views
+    like ``Comms.collective_calls`` read privately while the registry and
+    every exporter see all instances."""
+    metric = REGISTRY.counter(name, help, tuple(labelnames))
+    return LegacyCounterView(metric, tuple(str(v) for v in fixed))
+
+
+# ---------------------------------------------------------------------------
+# instrument helpers for the aot dispatch path (kept here so core/aot.py —
+# imported by everything — adds exactly one cheap call per dispatch)
+
+_dispatch_total = None
+_dispatch_seconds = None
+
+
+def _dispatch_metrics():
+    global _dispatch_total, _dispatch_seconds
+    if _dispatch_total is None:
+        _dispatch_total = REGISTRY.counter(
+            "raft_tpu_aot_dispatch_total",
+            "AOT executable dispatches by function and warm/cold state",
+            labelnames=("fn", "temp"))
+        _dispatch_seconds = REGISTRY.histogram(
+            "raft_tpu_aot_dispatch_seconds",
+            "host-side dispatch latency per AOT function and signature",
+            labelnames=("fn", "sig"))
+    return _dispatch_total, _dispatch_seconds
+
+
+def record_dispatch(fn: str, sig: str, cold: bool, seconds: float) -> None:
+    """One AOT executable dispatch: bump the per-function warm/cold count
+    and record the host-side dispatch latency under the (fn, sig) pair.
+    No-op when telemetry is disabled — this is per-dispatch (per
+    super-batch/tile), not per query, and costs two lock-guarded updates."""
+    if not enabled():
+        return
+    total, hist = _dispatch_metrics()
+    total.inc(1, (fn, "cold" if cold else "warm"))
+    hist.observe(seconds, (fn, sig))

@@ -1,0 +1,239 @@
+"""Host-side span tracing.
+
+The reference instruments every algorithm entry point with NVTX ranges
+(core/nvtx.hpp:95); raft_tpu's production analogue is a nested host span
+that does three things at once:
+
+* records wall time into the registry histogram
+  ``raft_tpu_span_seconds{span=<name>}`` (fixed memory, exportable), and
+  bumps ``raft_tpu_span_total{span=<name>}``;
+* emits a ``jax.profiler.TraceAnnotation`` so the span shows up in TPU
+  profiler traces exactly like the old ``core.logger.time_range`` (which
+  is now a thin wrapper over this);
+* optionally appends one JSON line per completed span to the opt-in JSONL
+  sink (:func:`set_jsonl_sink`), carrying the span's name, parent chain,
+  depth, thread, wall-clock start, duration and error flag — the event
+  stream a trace viewer or log pipeline ingests.
+
+Spans nest per thread (a thread-local stack carries the context across the
+serve request lifecycle: ingest → coalesce → assemble → dispatch →
+deliver) and are exception-safe: the exit path records the histogram and
+pops the stack whether or not the body raised, and never swallows the
+exception.
+
+Hot-path discipline: entering a span is two perf_counter reads, a list
+push/pop and one histogram observation — no device work, no syncs, no
+allocation beyond the span object.  With telemetry disabled
+(``RAFT_TPU_TELEMETRY=0``) :func:`span` returns a shared no-op context
+manager: zero work, no profiler import, no timing.
+
+``jax.profiler`` is imported ONCE at first use and cached module-level
+(the old ``time_range`` paid an import-machinery lookup on every
+``__enter__`` — inside the serve hot path that lookup is real per-request
+work).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import IO, List, Optional, Union
+
+from raft_tpu.telemetry import registry as _registry
+
+#: the monotonic clock every raft_tpu timing site routes through (the
+#: ``telemetry-discipline`` analysis rule bans raw ``time.perf_counter`` /
+#: ``time.monotonic`` in hot-path-registry modules so timing stays
+#: swappable and accounted here).
+now = time.perf_counter
+
+# -- cached profiler import (satellite: hoisted out of time_range.__enter__)
+_PROFILER_TRACE = None
+_PROFILER_TRIED = False
+
+
+def _trace_annotation_cls():
+    """``jax.profiler.TraceAnnotation`` or None, resolved once per process
+    — a cached module-level try-import instead of a per-``__enter__``
+    ``import jax.profiler`` (import machinery is a dict-lookup cascade that
+    the serve hot path would pay per request)."""
+    global _PROFILER_TRACE, _PROFILER_TRIED
+    if not _PROFILER_TRIED:
+        _PROFILER_TRIED = True
+        try:
+            from jax.profiler import TraceAnnotation
+
+            _PROFILER_TRACE = TraceAnnotation
+        except Exception:  # pragma: no cover - profiler unavailable
+            _PROFILER_TRACE = None
+    return _PROFILER_TRACE
+
+
+# -- the per-thread span stack ----------------------------------------------
+
+_TLS = threading.local()
+
+
+def _stack() -> List[str]:
+    s = getattr(_TLS, "stack", None)
+    if s is None:
+        s = _TLS.stack = []
+    return s
+
+
+def current_span() -> Optional[str]:
+    """Name of the innermost open span on this thread, or None."""
+    s = _stack()
+    return s[-1] if s else None
+
+
+# -- the JSONL event sink ----------------------------------------------------
+
+_SINK_LOCK = threading.Lock()
+_SINK: Optional[IO[str]] = None
+_SINK_OWNED = False
+
+
+def set_jsonl_sink(sink: Union[None, str, IO[str]]) -> None:
+    """Install (or with None, remove) the opt-in span event sink.
+
+    *sink* is a path (opened append, line-buffered writes, closed on
+    replacement) or an open text file-like.  Each completed span appends
+    one JSON object::
+
+        {"span": "serve.dispatch", "parent": "serve.request", "depth": 1,
+         "thread": 140211, "start": 1722772800.123, "dur_s": 0.0042,
+         "error": false}
+
+    Span completion order is exit order (children before parents), the
+    natural order for rebuilding the tree from parent back-pointers."""
+    global _SINK, _SINK_OWNED
+    with _SINK_LOCK:
+        if _SINK is not None and _SINK_OWNED:
+            try:
+                _SINK.close()
+            except Exception:  # pragma: no cover - best-effort close
+                pass
+        if sink is None:
+            _SINK, _SINK_OWNED = None, False
+        elif isinstance(sink, str):
+            _SINK, _SINK_OWNED = open(sink, "a"), True
+        else:
+            _SINK, _SINK_OWNED = sink, False
+
+
+def _emit_event(event: dict) -> None:
+    with _SINK_LOCK:
+        if _SINK is None:
+            return
+        _SINK.write(json.dumps(event) + "\n")
+        _SINK.flush()
+
+
+# -- the span metrics (created lazily so import stays cheap) -----------------
+
+_span_seconds = None
+_span_total = None
+
+
+def _metrics():
+    global _span_seconds, _span_total
+    if _span_seconds is None:
+        _span_seconds = _registry.REGISTRY.histogram(
+            "raft_tpu_span_seconds", "wall time of host-side spans",
+            labelnames=("span",))
+        _span_total = _registry.REGISTRY.counter(
+            "raft_tpu_span_total", "completed host-side spans",
+            labelnames=("span",))
+    return _span_seconds, _span_total
+
+
+class Span:
+    """One live span — returned by :func:`span`; use as a context manager.
+
+    Re-entrant use of a single instance is not supported (make a new span);
+    the object is deliberately tiny (``__slots__``) because the serve path
+    creates a handful per request batch."""
+
+    __slots__ = ("name", "_t0", "_start_wall", "_ann", "_parent", "_depth")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0 = 0.0
+        self._start_wall = 0.0
+        self._ann = None
+        self._parent: Optional[str] = None
+        self._depth = 0
+
+    def __enter__(self) -> "Span":
+        stack = _stack()
+        self._parent = stack[-1] if stack else None
+        self._depth = len(stack)
+        stack.append(self.name)
+        cls = _trace_annotation_cls()
+        if cls is not None:
+            try:
+                self._ann = cls(self.name)
+                self._ann.__enter__()
+            except Exception:  # pragma: no cover - profiler unavailable
+                self._ann = None
+        # wall-clock start is only consumed by the JSONL sink — skip the
+        # third clock read on the default (no-sink) path
+        self._start_wall = time.time() if _SINK is not None else 0.0
+        self._t0 = now()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        # EXCEPTION SAFETY: every recording step runs regardless of exc and
+        # none may raise past this frame; the stack pop is unconditional.
+        dur = now() - self._t0
+        stack = _stack()
+        if stack and stack[-1] == self.name:
+            stack.pop()
+        elif self.name in stack:  # pragma: no cover - misnested defensive
+            stack.remove(self.name)
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(exc_type, exc, tb)
+            except Exception:  # pragma: no cover - profiler teardown
+                pass
+        hist, total = _metrics()
+        hist.observe(dur, (self.name,))
+        total.inc(1, (self.name,))
+        if _SINK is not None:
+            _emit_event({
+                "span": self.name, "parent": self._parent,
+                "depth": self._depth,
+                "thread": threading.get_ident(),
+                "start": round(self._start_wall, 6),
+                "dur_s": round(dur, 9),
+                "error": exc_type is not None,
+            })
+        return False  # never swallow
+
+
+class _NoopSpan:
+    """Shared do-nothing span for the disabled mode — one instance, zero
+    per-call work."""
+
+    __slots__ = ()
+    name = "<disabled>"
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+def span(name: str) -> Union[Span, _NoopSpan]:
+    """Open a nested host-side span (context manager) — see the module
+    docstring for what a span records.  With telemetry disabled this is a
+    shared no-op object."""
+    if not _registry.enabled():
+        return _NOOP
+    return Span(name)

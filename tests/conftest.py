@@ -109,6 +109,8 @@ _FAST_TESTS = {
     "test_spectral.py::test_partition_recovers_planted_blocks",
     "test_stats.py::TestContingency::test_rand_indices",
     "test_stats.py::TestSummary::test_meanvar_stddev",
+    "test_telemetry.py::TestHistogram::test_quantile_oracle_vs_np_percentile",
+    "test_telemetry.py::test_disabled_mode_identity",
 }
 
 

@@ -76,7 +76,7 @@ class TestUnifiedMarker:
         assert {"raw-segment-sum", "probe-scan-closure", "serve-dispatch",
                 "hot-path-host-transfer", "collective-discipline",
                 "trace-impurity", "static-arg-hashability",
-                "dtype-drift"} <= ids
+                "dtype-drift", "telemetry-discipline"} <= ids
 
 
 # ---------------------------------------------------------------------------
@@ -176,6 +176,71 @@ class TestTraceImpurity:
                "    return x\n")
         assert not findings("raft_tpu/neighbors/mod.py", src,
                             "trace-impurity")
+
+
+# ---------------------------------------------------------------------------
+# telemetry-discipline
+
+
+class TestTelemetryDiscipline:
+    _CLOCK = ("import time\n\n\ndef plan(reqs):\n"
+              "    t0 = time.perf_counter(){}\n    return t0\n")
+
+    def test_clock_in_hot_path_module_fires(self):
+        f = findings("raft_tpu/serve/engine.py", self._CLOCK.format(""),
+                     "telemetry-discipline")
+        assert f and "time.perf_counter" in f[0].message
+
+    def test_monotonic_fires(self):
+        src = self._CLOCK.replace("perf_counter", "monotonic")
+        assert findings("raft_tpu/neighbors/ann_mnmg.py", src.format(""),
+                        "telemetry-discipline")
+
+    def test_from_import_laundering_fires(self):
+        src = ("from time import perf_counter\n\n\ndef plan():\n"
+               "    return perf_counter()\n")
+        assert findings("raft_tpu/neighbors/_build.py", src,
+                        "telemetry-discipline")
+
+    def test_module_level_counter_fires(self):
+        src = "import collections\n\nstats = collections.Counter()\n"
+        f = findings("raft_tpu/serve/engine.py", src,
+                     "telemetry-discipline")
+        assert f and "Counter" in f[0].message
+
+    def test_bare_counter_name_fires(self):
+        src = "from collections import Counter\n\nstats = Counter()\n"
+        assert findings("raft_tpu/neighbors/knn_mnmg.py", src,
+                        "telemetry-discipline")
+
+    def test_telemetry_package_is_the_blessed_home(self):
+        assert not findings("raft_tpu/telemetry/spans.py",
+                            self._CLOCK.format(""), "telemetry-discipline")
+
+    def test_non_hot_path_module_passes(self):
+        # timing in a training prologue module off the registry is fine
+        assert not findings("raft_tpu/stats/mod.py", self._CLOCK.format(""),
+                            "telemetry-discipline")
+
+    def test_telemetry_now_and_span_pass(self):
+        src = ("from raft_tpu import telemetry\n\n\ndef plan(reqs):\n"
+               "    t0 = telemetry.now()\n"
+               "    with telemetry.span('serve.plan'):\n"
+               "        return t0\n")
+        assert not findings("raft_tpu/serve/engine.py", src,
+                            "telemetry-discipline")
+
+    def test_marker_exempts(self):
+        src = self._CLOCK.format(
+            "  # exempt(telemetry-discipline): bench-only scaffold")
+        assert not findings("raft_tpu/serve/engine.py", src,
+                            "telemetry-discipline")
+
+    def test_shipped_tree_clean(self):
+        for f in sorted((REPO / "raft_tpu").rglob("*.py")):
+            assert not [x for x in engine.check_source(
+                f.as_posix(), f.read_text())
+                if x.rule == "telemetry-discipline"], f
 
 
 # ---------------------------------------------------------------------------

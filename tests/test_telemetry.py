@@ -1,0 +1,468 @@
+"""Unified runtime telemetry (ISSUE 9): histogram bucket oracle vs
+np.percentile, snapshot/prometheus exporters, disabled-mode no-op identity,
+legacy-surface back-compat (the five migrated fragments), span
+nesting/exception safety + JSONL sink, the N-thread warmed-ServeEngine
+counter-exactness regression, and the sharded-serve snapshot acceptance."""
+
+import io
+import json
+import pathlib
+import re
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from raft_tpu import telemetry  # noqa: E402
+from raft_tpu.core.aot import aot_compile_counters  # noqa: E402
+from raft_tpu.neighbors import ivf_flat, ivf_pq, knn  # noqa: E402
+from raft_tpu.serve import ServeEngine  # noqa: E402
+
+
+@pytest.fixture
+def enabled_telemetry():
+    """Force-enable around a test and restore the ambient state."""
+    prev = telemetry.set_enabled(True)
+    yield
+    telemetry.set_enabled(prev)
+
+
+# ---------------------------------------------------------------------------
+# histograms
+
+
+class TestHistogram:
+    def _fill(self, name, samples, reservoir=0):
+        h = telemetry.histogram(name, "t", reservoir=reservoir)
+        for s in samples:
+            h.observe(float(s))
+        return h
+
+    def test_quantile_oracle_vs_np_percentile(self, enabled_telemetry):
+        """Bucket-boundary oracle: the log-bucket geometry (64 buckets over
+        1 µs–100 s, ratio ~×1.33 per bucket) bounds the quantile estimate
+        within one bucket ratio of the exact sample quantile, across
+        scales from ~100 µs to ~1 s and several distribution widths."""
+        rng = np.random.default_rng(0)
+        for i, (mu, sigma) in enumerate(
+                [(-9, 0.5), (-6, 1.5), (-3, 1.0), (-1, 0.3)]):
+            samples = np.exp(rng.normal(mu, sigma, 20000))
+            h = self._fill(f"t_hist_oracle_{i}", samples)
+            for q in (0.01, 0.25, 0.5, 0.9, 0.99):
+                est = h.quantile(q)
+                exact = float(np.percentile(samples, q * 100))
+                assert exact / 1.34 <= est <= exact * 1.34, \
+                    (mu, sigma, q, est, exact)
+
+    def test_bucket_boundaries(self):
+        """The bucket index function is exact at its own edges and clamps
+        under/overflow into the edge bins (fixed memory, no tails)."""
+        assert telemetry.bucket_index(0.0) == 0
+        assert telemetry.bucket_index(1e-9) == 0
+        assert telemetry.bucket_index(1e9) == telemetry.HIST_BUCKETS - 1
+        for i in range(telemetry.HIST_BUCKETS - 1):
+            up = telemetry.bucket_upper(i)
+            assert telemetry.bucket_index(up * 1.0000001) == i + 1
+            assert telemetry.bucket_index(up * 0.9999999) == i
+        # monotone edges spanning the documented 1 µs – 100 s range
+        assert telemetry.bucket_upper(-1 + 1) > telemetry.HIST_MIN
+        assert abs(telemetry.bucket_upper(telemetry.HIST_BUCKETS - 1)
+                   - telemetry.HIST_MAX) / telemetry.HIST_MAX < 1e-9
+
+    def test_quantile_clamps_to_observed_range(self, enabled_telemetry):
+        h = self._fill("t_hist_clamp", [0.25] * 1000)
+        assert h.quantile(0.01) == 0.25
+        assert h.quantile(0.99) == 0.25
+
+    def test_empty_quantile_is_none(self):
+        h = telemetry.histogram("t_hist_empty", "t")
+        assert h.quantile(0.5) is None
+        assert h.count() == 0
+
+    def test_reservoir_bounded_and_counts_all(self, enabled_telemetry):
+        h = self._fill("t_hist_res", np.linspace(1e-4, 1e-2, 10000),
+                       reservoir=256)
+        r = h.reservoir()
+        assert len(r) == 256  # bounded no matter the observation count
+        assert h.count() == 10000
+        # uniform reservoir: the sample median should sit near the true one
+        assert abs(float(np.median(r)) - 5.05e-3) < 2e-3
+
+
+# ---------------------------------------------------------------------------
+# exporters
+
+
+class TestExporters:
+    def test_snapshot_round_trip(self, enabled_telemetry):
+        c = telemetry.counter("t_snap_counter", "help text",
+                              labelnames=("kind",))
+        c.inc(3, ("a",))
+        h = telemetry.histogram("t_snap_hist", "h")
+        for v in (1e-4, 2e-4, 5e-3):
+            h.observe(v)
+        snap = telemetry.snapshot()
+        # plain dict, JSON-round-trippable EXACTLY
+        assert json.loads(json.dumps(snap)) == snap
+        assert snap["t_snap_counter"]["values"]["kind=a"] == 3
+        cell = snap["t_snap_hist"]["values"][""]
+        assert cell["count"] == 3 and abs(cell["sum"] - 5.3e-3) < 1e-9
+        assert cell["min"] == 1e-4 and cell["max"] == 5e-3
+        assert sum(n for _, n in cell["buckets"]) == 3
+        assert cell["p50"] is not None
+
+    def test_prometheus_text_format(self, enabled_telemetry):
+        telemetry.counter("t_prom_counter", "counts things",
+                          labelnames=("who",)).inc(2, ('say "hi"\n',))
+        h = telemetry.histogram("t_prom_hist", "times things")
+        for v in (1e-4, 1e-4, 3e-2):
+            h.observe(v)
+        text = telemetry.prometheus_text()
+        assert "# HELP t_prom_counter counts things" in text
+        assert "# TYPE t_prom_counter counter" in text
+        assert "# TYPE t_prom_hist histogram" in text
+        # label values escaped per the exposition format
+        assert 't_prom_counter{who="say \\"hi\\"\\n"} 2' in text
+        # cumulative buckets ending at +Inf == _count
+        buckets = re.findall(
+            r't_prom_hist_bucket\{le="([^"]+)"\} (\d+)', text)
+        assert buckets[-1][0] == "+Inf" and buckets[-1][1] == "3"
+        counts = [int(n) for _, n in buckets]
+        assert counts == sorted(counts), "bucket series must be cumulative"
+        finite = [float(le) for le, _ in buckets[:-1]]
+        assert finite == sorted(finite)
+        assert re.search(r"t_prom_hist_count(\{\})? 3", text)
+        assert "t_prom_hist_sum" in text
+
+    def test_exporters_work_while_disabled(self):
+        telemetry.counter("t_disabled_counter", "c").inc(1)
+        prev = telemetry.set_enabled(False)
+        try:
+            snap = telemetry.snapshot()
+            assert snap["t_disabled_counter"]["values"][""] >= 1
+            assert "t_disabled_counter" in telemetry.prometheus_text()
+        finally:
+            telemetry.set_enabled(prev)
+
+
+# ---------------------------------------------------------------------------
+# spans
+
+
+class TestSpans:
+    def test_nesting_and_jsonl_sink(self, enabled_telemetry):
+        sink = io.StringIO()
+        telemetry.set_jsonl_sink(sink)
+        try:
+            with telemetry.span("outer"):
+                assert telemetry.current_span() == "outer"
+                with telemetry.span("inner"):
+                    assert telemetry.current_span() == "inner"
+            assert telemetry.current_span() is None
+        finally:
+            telemetry.set_jsonl_sink(None)
+        events = [json.loads(ln) for ln in
+                  sink.getvalue().strip().splitlines()]
+        # children complete (and therefore emit) before their parents
+        assert [e["span"] for e in events] == ["inner", "outer"]
+        inner, outer = events
+        assert inner["parent"] == "outer" and inner["depth"] == 1
+        assert outer["parent"] is None and outer["depth"] == 0
+        assert not inner["error"] and inner["dur_s"] >= 0
+
+    def test_exception_safety(self, enabled_telemetry):
+        sink = io.StringIO()
+        telemetry.set_jsonl_sink(sink)
+        before = telemetry.REGISTRY.get(
+            "raft_tpu_span_seconds").count(("t_exc",))
+        try:
+            with pytest.raises(ValueError, match="boom"):
+                with telemetry.span("t_exc"):
+                    raise ValueError("boom")
+        finally:
+            telemetry.set_jsonl_sink(None)
+        # stack restored, wall time still recorded, error flagged, the
+        # exception itself propagated (never swallowed)
+        assert telemetry.current_span() is None
+        assert telemetry.REGISTRY.get(
+            "raft_tpu_span_seconds").count(("t_exc",)) == before + 1
+        event = json.loads(sink.getvalue().strip().splitlines()[-1])
+        assert event["span"] == "t_exc" and event["error"] is True
+
+    def test_span_records_histogram_and_counter(self, enabled_telemetry):
+        with telemetry.span("t_span_rec"):
+            pass
+        snap = telemetry.snapshot()
+        assert snap["raft_tpu_span_total"]["values"]["span=t_span_rec"] == 1
+        assert snap["raft_tpu_span_seconds"]["values"][
+            "span=t_span_rec"]["count"] == 1
+
+    def test_disabled_span_is_noop(self):
+        prev = telemetry.set_enabled(False)
+        try:
+            with telemetry.span("t_span_off"):
+                assert telemetry.current_span() is None  # no stack push
+        finally:
+            telemetry.set_enabled(prev)
+        snap = telemetry.snapshot()
+        assert "span=t_span_off" not in snap.get(
+            "raft_tpu_span_total", {}).get("values", {})
+
+    def test_threads_have_independent_stacks(self, enabled_telemetry):
+        seen = {}
+
+        def worker():
+            with telemetry.span("t_thread_inner"):
+                seen["inner"] = telemetry.current_span()
+
+        with telemetry.span("t_thread_outer"):
+            th = threading.Thread(target=worker)
+            th.start()
+            th.join()
+            assert telemetry.current_span() == "t_thread_outer"
+        assert seen["inner"] == "t_thread_inner"
+
+
+# ---------------------------------------------------------------------------
+# disabled-mode identity + legacy surfaces
+
+
+_N, _DIM, _K = 400, 16, 5
+
+
+def _data():
+    rng = np.random.default_rng(3)
+    x = rng.normal(0, 1, (_N, _DIM)).astype(np.float32)
+    q = rng.normal(0, 1, (17, _DIM)).astype(np.float32)
+    return x, q
+
+
+def test_disabled_mode_identity():
+    """RAFT_TPU_TELEMETRY=0 must be a pure observability switch: search
+    results (brute force, ivf_flat, and a coalesced ServeEngine replay)
+    are bit-identical with telemetry on vs off."""
+    x, q = _data()
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=8,
+                                                kmeans_n_iters=4), x)
+    eng = ServeEngine(x, _K, max_batch=32)
+    eng.warmup()
+    reqs = [q[:3], q[3:10], q[10:]]
+
+    def run_all():
+        d1, i1 = knn(x, q, _K)
+        d2, i2 = ivf_flat.search(ivf_flat.SearchParams(n_probes=4),
+                                 index, q, _K)
+        serve = eng.search(reqs)
+        return ([np.asarray(a) for a in (d1, i1, d2, i2)],
+                [(np.asarray(d), np.asarray(i)) for d, i in serve])
+
+    prev = telemetry.set_enabled(True)
+    try:
+        on_solo, on_serve = run_all()
+        telemetry.set_enabled(False)
+        off_solo, off_serve = run_all()
+    finally:
+        telemetry.set_enabled(prev)
+    for a, b in zip(on_solo, off_solo):
+        np.testing.assert_array_equal(a, b)
+    for (da, ia), (db, ib) in zip(on_serve, off_serve):
+        np.testing.assert_array_equal(da, db)
+        np.testing.assert_array_equal(ia, ib)
+
+
+def test_disabled_mode_keeps_contract_counters_live():
+    """The legacy counters are contract instruments (zero-compile serve
+    gates, LUT trace asserts) — they keep counting with telemetry off."""
+    prev = telemetry.set_enabled(False)
+    try:
+        c0 = aot_compile_counters["compiles"]
+        from raft_tpu.core.aot import aot
+
+        f = aot(lambda v: v + 1)
+        f(jnp.zeros((4,)))
+        assert aot_compile_counters["compiles"] == c0 + 1
+        # ...but histograms/reservoirs do NOT record
+        h = telemetry.histogram("t_disabled_hist", "h")
+        h.observe(1.0)
+        assert h.count() == 0
+    finally:
+        telemetry.set_enabled(prev)
+
+
+class TestLegacySurfaces:
+    def test_counter_view_reads_like_a_counter(self):
+        v = telemetry.legacy_counter("t_legacy_view", "t")
+        assert v["missing"] == 0  # Counter contract: missing → 0
+        v.inc("a")
+        v.inc("a")
+        v.inc("b", 10)
+        v["c"] = 7  # absolute item assignment still works
+        assert v["a"] == 2 and v["b"] == 10 and v["c"] == 7
+        assert dict(v) == {"a": 2, "b": 10, "c": 7}
+        assert sorted(v) == ["a", "b", "c"] and len(v) == 3
+        assert v.get("a", 0) == 2 and v.get("zz", 5) == 5
+        # the snapshot-and-diff idiom every counter-assert test uses
+        before = dict(v)
+        v.inc("a")
+        delta = {k: v[k] - before.get(k, 0) for k in v
+                 if v[k] != before.get(k, 0)}
+        assert delta == {"a": 1}
+
+    def test_aot_compile_counters_is_registry_backed(self):
+        assert isinstance(aot_compile_counters, telemetry.LegacyCounterView)
+        assert "raft_tpu_aot_compiles" in telemetry.snapshot()
+
+    def test_lut_and_build_trace_counters_registry_backed(self):
+        from raft_tpu.neighbors._build import build_trace_counters
+
+        assert isinstance(ivf_pq.lut_trace_counters,
+                          telemetry.LegacyCounterView)
+        assert isinstance(build_trace_counters, telemetry.LegacyCounterView)
+
+    def test_comms_views_are_per_instance(self):
+        from jax.sharding import Mesh
+        from raft_tpu.comms import build_comms
+
+        mesh = Mesh(np.array(jax.devices()[:1]), ("world",))
+        a, b = build_comms(mesh), build_comms(mesh)
+        before_b = dict(b.collective_calls)
+        a.collective_calls.inc("allreduce")
+        a.collective_calls.inc("allreduce_bytes", 4096)
+        assert a.collective_calls["allreduce"] == 1
+        assert dict(b.collective_calls) == before_b, \
+            "instance views must not alias"
+        # ...while the registry aggregates across instances
+        snap = telemetry.snapshot()
+        vals = snap["raft_tpu_comms_collective_calls"]["values"]
+        assert any(k.endswith("key=allreduce_bytes") for k in vals)
+
+    def test_serve_stats_reads_like_the_old_dict(self):
+        x, q = _data()
+        eng = ServeEngine(x, _K, max_batch=32)
+        assert dict(eng.stats) == {
+            "requests": 0, "queries": 0, "super_batches": 0,
+            "solo_fallbacks": 0, "coalesced_requests": 0, "refreshes": 0}
+        eng.warmup()
+        eng.search([q[:2], q[2:5]])
+        assert eng.stats["requests"] == 2
+        assert eng.stats["queries"] == 5
+        assert eng.stats["super_batches"] == 1
+
+    def test_last_latencies_bounded(self):
+        from raft_tpu.serve.engine import LATENCY_RESERVOIR
+
+        x, q = _data()
+        eng = ServeEngine(x, _K, max_batch=32)
+        eng.warmup()
+        eng.search([q[:2], q[2:4], q[4:5]])
+        lat = eng.last_latencies
+        assert len(lat) == 3 and all(t >= 0.0 for t in lat)
+        assert LATENCY_RESERVOIR == 4096
+        # the histogram carries the full distribution for quantile reads
+        prev = telemetry.set_enabled(True)
+        try:
+            eng.search([q[:2]])
+            p50, p99 = eng.latency_quantiles((0.5, 0.99))
+            assert p50 is not None and p99 is not None and p99 >= p50 > 0
+        finally:
+            telemetry.set_enabled(prev)
+
+
+# ---------------------------------------------------------------------------
+# thread-safety regression (satellite: the Counter read-modify-write race)
+
+
+class TestThreadSafety:
+    def test_counter_inc_exact_under_contention(self):
+        v = telemetry.legacy_counter("t_hammer_counter", "t")
+        n_threads, n_inc = 8, 20000
+
+        def worker():
+            for _ in range(n_inc):
+                v.inc("hits")
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert v["hits"] == n_threads * n_inc  # EXACT, no lost updates
+
+    def test_warmed_engine_hammered_from_threads(self):
+        """Satellite regression: N threads hammer a WARMED ServeEngine;
+        every counter total must be exact (requests/queries/super-batches)
+        and the steady state must stay zero-compile — the old plain-dict /
+        Counter storage could lose increments under this load."""
+        x, q = _data()
+        eng = ServeEngine(x, _K, max_batch=32)
+        eng.warmup()
+        reqs = [q[:3], q[3:8]]
+        eng.search(reqs)  # plumbing warm call
+        base = dict(eng.stats)
+        c0 = aot_compile_counters["compiles"]
+        n_threads, n_calls = 6, 8
+        errs = []
+
+        def worker():
+            try:
+                for _ in range(n_calls):
+                    out = eng.search(reqs)
+                    assert len(out) == 2
+            except Exception as e:  # surfaced below, not swallowed
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        total_calls = n_threads * n_calls
+        assert eng.stats["requests"] - base["requests"] == 2 * total_calls
+        assert eng.stats["queries"] - base["queries"] == 8 * total_calls
+        assert (eng.stats["super_batches"] - base["super_batches"]
+                == total_calls)
+        assert aot_compile_counters["compiles"] == c0, \
+            "warmed engine must not compile under concurrent serving"
+
+
+# ---------------------------------------------------------------------------
+# acceptance: snapshot after a warmed sharded serve replay
+
+
+def test_snapshot_after_warmed_sharded_serve(enabled_telemetry):
+    """ISSUE 9 acceptance: after a warmed sharded serve replay the
+    snapshot carries (a) serve latency histograms, (b) per-program AOT
+    dispatch counts, (c) collective byte totals."""
+    from jax.sharding import Mesh
+    from raft_tpu.comms import build_comms
+
+    x, q = _data()
+    comms = build_comms(Mesh(np.array(jax.devices()[:1]), ("world",)))
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=8,
+                                                kmeans_n_iters=4), x)
+    sharded = index.shard(comms)
+    eng = ServeEngine(sharded, _K, ivf_flat.SearchParams(n_probes=4),
+                      max_batch=32)
+    eng.warmup()
+    eng.search([q[:3], q[3:9], q[9:]])
+    snap = telemetry.snapshot()
+
+    lat = snap["raft_tpu_serve_request_latency_seconds"]["values"]
+    assert any(cell["count"] >= 3 for cell in lat.values()), lat
+    dispatch = snap["raft_tpu_aot_dispatch_total"]["values"]
+    assert any("temp=warm" in k for k in dispatch), dispatch
+    coll = snap["raft_tpu_comms_collective_calls"]["values"]
+    assert any("key=allgather_bytes" in k and v > 0
+               for k, v in coll.items()), coll
+    # and the prometheus rendering of the same state is non-trivial
+    text = telemetry.prometheus_text()
+    assert "raft_tpu_serve_request_latency_seconds_bucket" in text
