@@ -374,3 +374,47 @@ def serve_request_stream(seed: int, n_requests: int, dim: int,
             s = int(rng.integers(129, 701))
         reqs.append(rng.random((s, dim)).astype(dtype))
     return reqs
+
+
+def telemetry_bench_section():
+    """Operational-counter section persisted into every bench.py JSON row
+    (ISSUE 10): a compact digest of the process telemetry snapshot —
+    compile/dispatch counts, device-sample stats, collective bytes — so
+    the BENCH_* trajectory carries what the run DID, not just its qps.
+    Read-only over the registry; safe whatever subset of metrics exists."""
+    from raft_tpu import telemetry
+
+    snap = telemetry.snapshot()
+
+    def values(name):
+        return snap.get(name, {}).get("values", {})
+
+    disp = values("raft_tpu_aot_dispatch_total")
+    dev = values("raft_tpu_device_seconds")
+    coll = values("raft_tpu_comms_collective_calls")
+    device_samples = sum(int(c["count"]) for c in dev.values())
+    section = {
+        "compiles": int(values("raft_tpu_aot_compiles").get(
+            "key=compiles", 0)),
+        "dispatch_warm": int(sum(v for k, v in disp.items()
+                                 if k.endswith("temp=warm"))),
+        "dispatch_cold": int(sum(v for k, v in disp.items()
+                                 if k.endswith("temp=cold"))),
+        "device_samples": device_samples,
+        "device_sampled_fns": len(dev),
+        "device_sample_every": telemetry.sample_every(),
+        # trace-time collective payload across every communicator: the
+        # "<name>_bytes" keys of Comms.collective_calls
+        "collective_bytes": int(sum(
+            v for k, v in coll.items()
+            if k.rsplit("key=", 1)[-1].endswith("_bytes"))),
+        "collective_launches": int(sum(
+            v for k, v in coll.items()
+            if not k.rsplit("key=", 1)[-1].endswith("_bytes"))),
+    }
+    if device_samples:
+        # best achieved device seconds summary per sampled fn (p50 of the
+        # per-fn histograms via the snapshot's convenience estimates)
+        section["device_p50_s"] = {
+            k.split("fn=", 1)[-1]: c["p50"] for k, c in dev.items()}
+    return section

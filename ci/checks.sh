@@ -41,6 +41,8 @@ mods = [
     "raft_tpu.serve", "raft_tpu.native",
     "raft_tpu.telemetry", "raft_tpu.telemetry.registry",
     "raft_tpu.telemetry.spans", "raft_tpu.telemetry.export",
+    "raft_tpu.telemetry.device", "raft_tpu.telemetry.aggregate",
+    "raft_tpu.telemetry.http",
     "raft_tpu.analysis", "raft_tpu.analysis.engine",
     "raft_tpu.analysis.rules", "raft_tpu.analysis.registry",
 ]

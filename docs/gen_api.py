@@ -43,7 +43,8 @@ PACKAGES = [
               "session bootstrap"),
     ("telemetry", "Unified runtime telemetry: metrics registry "
                   "(counters/gauges/log-bucketed histograms), span "
-                  "tracing, Prometheus/JSONL exporters"),
+                  "tracing, Prometheus/JSONL exporters, device-cost "
+                  "attribution, fleet aggregation, live scrape endpoints"),
     ("analysis", "Static analysis of hot-path contracts: AST rule engine "
                  "+ lowered-HLO program auditor"),
 ]
@@ -112,6 +113,10 @@ _SUBMODULES = {
     # the analysis package is fully lazy (stdlib registry importable from
     # hot modules at zero cost) — its whole surface lives on submodules
     "analysis": ["engine", "hotpaths", "registry", "hlo_audit"],
+    # device attribution / fleet aggregation re-export through the package
+    # namespace, but http (the scrape server + flight recorder) is a lazy
+    # submodule — rendered as its own section alongside the other two
+    "telemetry": ["device", "aggregate", "http"],
 }
 
 

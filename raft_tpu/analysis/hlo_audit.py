@@ -30,6 +30,13 @@ COMPILED artifact is checked statically:
     .temp_size_in_bytes`` must not exceed the declared ceiling
     (graduating the PR-7 in-bench O(tile)-transient assert into CI).
 
+(e) **static cost attribution** — every audited program's
+    ``cost_analysis()`` flops / bytes accessed are recorded in the report
+    AND published to the ``raft_tpu_program_*`` telemetry gauges (under
+    ``sig="audit"``), with optional declared ``flops_budget`` /
+    ``bytes_budget`` ceilings (e.g. the fused-EM "x read from HBM once"
+    contract as a bytes bound) checked at the audit shape.
+
 Run via ``python -m raft_tpu.analysis`` (both levels) or programmatically
 through :func:`run`.
 """
@@ -261,6 +268,30 @@ def audit_program(entry: registry.ProgramEntry) -> ProgramReport:
                     f"donation lowered as may-alias on {backend}, but the "
                     "entry declares must-alias there")
 
+    # (e) static device-cost attribution + optional flops/bytes budgets —
+    # ONE cost_analysis call feeds both the audit columns and the live
+    # raft_tpu_program_* telemetry gauges (sig="audit"), so the numbers an
+    # operator scrapes are the numbers CI proved budgets against
+    from raft_tpu import telemetry
+
+    costs = telemetry.record_program_costs(entry.name, "audit", compiled)
+    stats["flops"] = costs["flops"]
+    stats["bytes_accessed"] = costs["bytes_accessed"]
+    for budget, measured, what in (
+            (entry.flops_budget, costs["flops"], "flops"),
+            (entry.bytes_budget, costs["bytes_accessed"], "bytes accessed")):
+        if budget is None:
+            continue
+        if measured is None:
+            # a declared budget that cannot be MEASURED is a finding, not
+            # a silent pass (the transient-ceiling rule, applied here)
+            findings.append(
+                f"{what} budget declared but cost_analysis is unavailable "
+                "on this backend — the budget went unchecked")
+        elif measured > budget:
+            findings.append(
+                f"{what} {measured:.0f} exceeds declared budget {budget}")
+
     # (d) transient ceiling
     if entry.transient_bytes is not None:
         try:
@@ -325,6 +356,16 @@ def run(names: Optional[List[str]] = None, *, fast_only: bool = False,
         if r.stats.get("transient_bytes") is not None:
             extra.append(f"temp {r.stats['transient_bytes']}B"
                          f"<={e.transient_bytes}B")
+        if r.stats.get("flops") is not None:
+            flops_s = f"flops {r.stats['flops']:.3g}"
+            if e.flops_budget is not None:
+                flops_s += f"<={e.flops_budget:.3g}"
+            extra.append(flops_s)
+        if r.stats.get("bytes_accessed") is not None:
+            bytes_s = f"hbm {r.stats['bytes_accessed']:.3g}B"
+            if e.bytes_budget is not None:
+                bytes_s += f"<={e.bytes_budget:.3g}B"
+            extra.append(bytes_s)
         if "donation_status" in r.stats:
             extra.append(f"donation: {r.stats['donation_status']}")
         if "reason" in r.stats:

@@ -52,6 +52,11 @@ class ProgramEntry:
     (the static mirror of ``Comms.collective_calls``'s runtime counters).
     ``transient_bytes`` caps ``compiled.memory_analysis().temp_size_in_
     bytes``; None skips the check (shape-dependent scratch programs).
+    ``flops_budget`` / ``bytes_budget`` cap the compiled program's
+    ``cost_analysis()`` flops / bytes accessed at the audit shape — the
+    static compute/HBM contract (e.g. the fused-EM single-pass "x read
+    once" bound), fed from the SAME cost_analysis call that populates the
+    ``raft_tpu_program_*`` telemetry gauges; None skips.
     ``donate_argnums`` names argnums whose buffers the program declares
     donated; ``donation_policy`` maps backend name → "must-alias" (a
     missing ``input_output_alias`` is a FINDING) or "may-alias" (recorded
@@ -68,6 +73,8 @@ class ProgramEntry:
     collectives: int = 0
     collective_bytes: int = 0
     transient_bytes: Optional[int] = None
+    flops_budget: Optional[int] = None
+    bytes_budget: Optional[int] = None
     donate_argnums: Tuple[int, ...] = ()
     donation_policy: Mapping[str, str] = dataclasses.field(
         default_factory=dict)
@@ -82,6 +89,8 @@ _PROGRAMS: Dict[str, ProgramEntry] = {}
 def hlo_program(name: str, *, collectives: int = 0,
                 collective_bytes: int = 0,
                 transient_bytes: Optional[int] = None,
+                flops_budget: Optional[int] = None,
+                bytes_budget: Optional[int] = None,
                 donate_argnums: Tuple[int, ...] = (),
                 donation_policy: Optional[Mapping[str, str]] = None,
                 requires_devices: int = 1, fast: bool = True,
@@ -101,6 +110,7 @@ def hlo_program(name: str, *, collectives: int = 0,
             name=name, builder=builder, collectives=collectives,
             collective_bytes=collective_bytes,
             transient_bytes=transient_bytes,
+            flops_budget=flops_budget, bytes_budget=bytes_budget,
             donate_argnums=tuple(donate_argnums),
             donation_policy=dict(donation_policy or {}),
             requires_devices=requires_devices, fast=fast, notes=notes)

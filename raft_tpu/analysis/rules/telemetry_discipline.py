@@ -1,7 +1,8 @@
 """``telemetry-discipline``: ad-hoc instrumentation in hot-path-registry
-modules must route through :mod:`raft_tpu.telemetry`.
+modules must route through :mod:`raft_tpu.telemetry`, and metric/scrape
+endpoints must live in :mod:`raft_tpu.telemetry.http`.
 
-Two shapes are flagged, in any module the hot-path registry
+Two shapes are flagged in any module the hot-path registry
 (:mod:`raft_tpu.analysis.hotpaths`) covers:
 
 * **raw clock reads** — ``time.perf_counter`` / ``time.monotonic`` (and
@@ -19,12 +20,24 @@ Two shapes are flagged, in any module the hot-path registry
   ``telemetry.legacy_counter(...)`` (same read surface, atomic ``inc``)
   or a registry counter.
 
-The rule is module-wide even for function-scoped registry entries: timing
-a training prologue through telemetry costs nothing, and a module on the
-hot-path registry is exactly where stray instrumentation tends to creep
-into the request path.  ``raft_tpu/telemetry/`` itself is the blessed
-implementation home and is out of scope.  Sanctioned uses carry the
-unified marker (``# exempt(telemetry-discipline): why``).
+And one shape is flagged ANYWHERE in the library (``raft_tpu/``, not just
+hot-path modules):
+
+* **raw ``http.server`` endpoints** — ``import http.server`` /
+  ``from http.server import ...`` outside ``raft_tpu/telemetry/``.  A
+  hand-rolled ``/metrics`` endpoint forks the scrape surface: it serves
+  whatever its author exported, not the registry, and bypasses the
+  torn-read-safe handlers, the health-readiness shape and the bounded
+  flight recorder.  Serve scrapes through
+  :class:`raft_tpu.telemetry.http.TelemetryServer` (or
+  ``ServeEngine.serve_http``).
+
+The clock/Counter checks are module-wide even for function-scoped registry
+entries: timing a training prologue through telemetry costs nothing, and a
+module on the hot-path registry is exactly where stray instrumentation
+tends to creep into the request path.  ``raft_tpu/telemetry/`` itself is
+the blessed implementation home and is out of scope.  Sanctioned uses
+carry the unified marker (``# exempt(telemetry-discipline): why``).
 """
 
 from __future__ import annotations
@@ -38,8 +51,10 @@ _CLOCKS = ("perf_counter", "monotonic", "perf_counter_ns", "monotonic_ns")
 
 
 def _scope(posix: str) -> bool:
+    # the http.server-endpoint check covers the whole library; the
+    # clock/Counter checks gate on the hot-path registry inside the rule
     return ("raft_tpu/telemetry/" not in posix
-            and hotpaths.match(posix) is not None)
+            and ("raft_tpu/" in posix or hotpaths.match(posix) is not None))
 
 
 def _clock_read(node):
@@ -72,13 +87,54 @@ def _module_counter_bind(node):
             and f.value.id == "collections")
 
 
+def _http_server_use(node):
+    """The raw ``http.server`` spelling this node is, or None — plain and
+    from-imports (``import http.server [as x]``, ``from http.server
+    import ThreadingHTTPServer``, ``from http import server``)."""
+    if isinstance(node, ast.Import):
+        for a in node.names:
+            if a.name == "http.server" or a.name.startswith("http.server."):
+                return "import http.server"
+    if isinstance(node, ast.ImportFrom):
+        if node.module is not None and (
+                node.module == "http.server"
+                or node.module.startswith("http.server.")):
+            return f"from {node.module} import ..."
+        if node.module == "http":
+            for a in node.names:
+                if a.name == "server":
+                    return "from http import server"
+    return None
+
+
 @rule("telemetry-discipline", scope=_scope,
       doc="raw time.perf_counter/monotonic and module-level Counter() "
           "telemetry in hot-path-registry modules (route through "
-          "raft_tpu.telemetry)")
+          "raft_tpu.telemetry), and raw http.server metric endpoints "
+          "anywhere in the library outside raft_tpu/telemetry/ (use "
+          "telemetry.http.TelemetryServer / ServeEngine.serve_http)")
 def check_telemetry_discipline(ctx):
     findings, seen = [], set()
+    hot = hotpaths.match(ctx.posix) is not None
+    in_library = "raft_tpu/" in ctx.posix
     for node in ast.walk(ctx.tree):
+        if in_library:
+            what = _http_server_use(node)
+            if what is not None and node.lineno not in seen:
+                if not ctx.exempt("telemetry-discipline", node.lineno):
+                    seen.add(node.lineno)
+                    findings.append((
+                        node.lineno,
+                        f"{what} outside raft_tpu/telemetry/ — a "
+                        "hand-rolled metric/scrape endpoint forks the "
+                        "scrape surface (serves ad-hoc state, bypasses "
+                        "the torn-read-safe handlers, /healthz shape and "
+                        "the bounded flight recorder); use "
+                        "telemetry.http.TelemetryServer or "
+                        "ServeEngine.serve_http, or mark the line "
+                        "exempt(telemetry-discipline)"))
+        if not hot:
+            continue
         what = _clock_read(node)
         if what is None or node.lineno in seen:
             continue
@@ -92,18 +148,19 @@ def check_telemetry_discipline(ctx):
             "RAFT_TPU_TELEMETRY kill switch; use telemetry.now() / "
             "telemetry.span(...), or mark the line "
             "exempt(telemetry-discipline)"))
-    for node in ctx.tree.body:
-        if not _module_counter_bind(node) or node.lineno in seen:
-            continue
-        if ctx.exempt("telemetry-discipline", node.lineno):
-            continue
-        seen.add(node.lineno)
-        findings.append((
-            node.lineno,
-            "module-level Counter() telemetry in a hot-path-registry "
-            "module — plain Counters race under concurrent serve callers "
-            "and are invisible to telemetry.snapshot(); use "
-            "telemetry.legacy_counter(...) (same read surface, atomic "
-            "inc) or a registry counter, or mark the line "
-            "exempt(telemetry-discipline)"))
+    if hot:
+        for node in ctx.tree.body:
+            if not _module_counter_bind(node) or node.lineno in seen:
+                continue
+            if ctx.exempt("telemetry-discipline", node.lineno):
+                continue
+            seen.add(node.lineno)
+            findings.append((
+                node.lineno,
+                "module-level Counter() telemetry in a hot-path-registry "
+                "module — plain Counters race under concurrent serve "
+                "callers and are invisible to telemetry.snapshot(); use "
+                "telemetry.legacy_counter(...) (same read surface, atomic "
+                "inc) or a registry counter, or mark the line "
+                "exempt(telemetry-discipline)"))
     return sorted(findings)

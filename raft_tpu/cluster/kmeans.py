@@ -461,6 +461,11 @@ def fused_em_step(x, centroids, sample_weights=None,
     # docs/fused_em.md); at this audit shape the CPU-grown row tile is
     # 16384×64, so (bs, k) f32 = 4 MB plus epilogue scratch
     transient_bytes=12 << 20,
+    # the single-pass HBM contract as a static budget: x (16384×64 f32 =
+    # 4 MB) read ONCE plus tiles/partials/epilogue — measured 41 MB at
+    # this shape; a regression to per-cluster re-reads or a materialized
+    # (n, k) distance matrix blows far past the 2x-headroom ceiling
+    bytes_budget=80 << 20,
     notes="one HBM read of x per EM iteration: E-step argmin + M-step "
           "partials in a single lax.scan (docs/fused_em.md)")
 def _audit_fused_em_step():

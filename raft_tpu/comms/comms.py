@@ -535,6 +535,10 @@ class Comms:
         return None
 
     # -- host p2p plane (UCX's role; reference isend/irecv/waitall) ----------
+    # Control-plane traffic only — besides library algorithms, this is the
+    # plane ``raft_tpu.telemetry.gather`` rides for the fleet snapshot
+    # exchange (tag 0x7E1E, reserved; docs/observability.md §fleet
+    # aggregation).
     def isend(self, obj, dst: int, tag: int = 0) -> Request:
         if self._mailbox is not None:
             try:

@@ -324,6 +324,11 @@ class AotFunction:
             sig_label = f"{zlib.crc32(repr(sig).encode()) & 0xFFFFFFFF:08x}"
             entry = (exe, sig_label)
             self._cache[sig] = entry
+            # device-cost attribution, static half: harvest this
+            # executable's cost_analysis/memory_analysis into the
+            # raft_tpu_program_* gauges (once per compile miss — never on
+            # the dispatch path; docs/observability.md §device attribution)
+            telemetry.record_program_costs(self._name, sig_label, exe)
         return entry
 
     def compiled(self, *args):
@@ -347,12 +352,28 @@ class AotFunction:
 
         call_args = [jax.tree_util.tree_map(prep, a)
                      for i, a in enumerate(args) if i not in self._static]
-        out = exe(*call_args)
-        # per-AotFunction warm/cold dispatch counts + per-signature latency
-        # (host-side dispatch time: the executable call is async) — no-op
-        # under RAFT_TPU_TELEMETRY=0
+        # device-cost attribution, sampled half: every Nth warm dispatch
+        # (RAFT_TPU_DEVICE_SAMPLE, default 1/64) blocks on the output and
+        # records true device execution time — executables dispatch async,
+        # so the host-side latency below cannot see it.  The host-dispatch
+        # latency is stamped BEFORE the block, so a sampled dispatch does
+        # not leak ms-scale device time into the µs-scale
+        # raft_tpu_aot_dispatch_seconds distribution.
+        if not cold and telemetry.device_sample_due(self._name):
+            t_dev = telemetry.now()
+            out = exe(*call_args)
+            t_submitted = telemetry.now()
+            jax.block_until_ready(out)
+            telemetry.record_device_sample(self._name, sig_label,
+                                           telemetry.now() - t_dev)
+        else:
+            out = exe(*call_args)
+            t_submitted = telemetry.now()
+        # per-AotFunction warm/cold dispatch counts (live even under
+        # RAFT_TPU_TELEMETRY=0 — contract instrument) + per-signature
+        # host-side dispatch latency (gated: the executable call is async)
         telemetry.record_dispatch(self._name, sig_label, cold,
-                                  telemetry.now() - t0)
+                                  t_submitted - t0)
         return out
 
     @property
@@ -417,10 +438,21 @@ class MeshAotFunction(AotFunction):
         cold = sig not in self._cache
         exe, sig_label = self._entry(sig, args)
         t0 = telemetry.now()
-        out = exe(*[a for i, a in enumerate(args)
-                    if i not in self._static])
+        call_args = [a for i, a in enumerate(args) if i not in self._static]
+        # sampled/unsampled split mirrors AotFunction.__call__: the host
+        # dispatch latency is stamped before the sampled block so device
+        # time never contaminates raft_tpu_aot_dispatch_seconds
+        if not cold and telemetry.device_sample_due(self._name):
+            out = exe(*call_args)
+            t_submitted = telemetry.now()
+            jax.block_until_ready(out)
+            telemetry.record_device_sample(self._name, sig_label,
+                                           telemetry.now() - t0)
+        else:
+            out = exe(*call_args)
+            t_submitted = telemetry.now()
         telemetry.record_dispatch(self._name, sig_label, cold,
-                                  telemetry.now() - t0)
+                                  t_submitted - t0)
         return out
 
 

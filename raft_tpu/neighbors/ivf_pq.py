@@ -795,6 +795,11 @@ def _audit_tile_model():
     # fusion-variance headroom while still catching any (tile, pq_dim,
     # 2^bits) encode-distance materialization (8192·16·256·4 = 128 MB)
     transient_bytes=8 << 20,
+    # static compute budget at the audit shape: the mul-reduce encode is
+    # tile·pq_dim·2^bits·(3·ds) ≈ 0.8 GFLOP — a lowering regression that
+    # re-materializes per-codeword distances (or re-encodes per chunk)
+    # multiplies this; ~1.5x headroom for fusion variance
+    flops_budget=1_200_000_000,
     notes="per-tile residual→PQ-encode→bit-pack populate kernel "
           "(docs/index_build.md)")
 def _audit_encode_tile():

@@ -37,6 +37,10 @@ closes that gap (docs/serving.md):
   registry-backed atomic counter view — all host-side wall-time only
   (zero device syncs), no-ops under ``RAFT_TPU_TELEMETRY=0``, overhead
   gated < 3% qps in-bench (docs/observability.md).
+  :meth:`ServeEngine.serve_http` adds the live scrape surface on top:
+  ``/metrics``, ``/healthz`` (readiness: warmed buckets, refresh in
+  flight), ``/varz`` and ``/debug/slow`` (bounded flight-recorder ring of
+  slow-request span trees).
 
 Hot-path rule (ci/lint.py): nothing in this package may call ``jax.jit``
 or ``jax.lax`` — every device computation must route through the
@@ -395,6 +399,14 @@ class ServeEngine:
         self._handle = handle if handle is not None else Handle(n_streams=2)
         self._warmed: Dict[Any, set] = {}  # dtype(str) -> {buckets}
         self._lock = threading.Lock()
+        # guards in-place _warmed mutation against the LOCKLESS /healthz
+        # reader (_health must not queue behind an in-flight search() on
+        # self._lock, and must never iterate a set mid-add); writers
+        # already hold self._lock, so ordering is always _lock → this
+        self._warmed_mut = threading.Lock()
+        self._refreshing = False  # /healthz: refresh in flight
+        self._recorder = None     # slow-request flight recorder (serve_http)
+        self._http = None         # the live scrape server, if started
         #: Serving statistics — the same keys and read surface as the
         #: pre-telemetry plain dict, now a Counter-shaped view over the
         #: registry (``raft_tpu_serve_engine_stats{engine,key}``): reads
@@ -472,13 +484,13 @@ class ServeEngine:
         with self._lock:
             for dt in dtypes:
                 dt = jnp.dtype(dt)
-                warmed = self._warmed.setdefault(str(dt), set())
                 for b in sorted(set(int(x) for x in buckets)):
                     expects(8 <= b <= self.max_batch,
                             f"bucket {b} outside [8, max_batch="
                             f"{self.max_batch}]")
                     self._backend.warm(b, dt)
-                    warmed.add(b)
+                    with self._warmed_mut:
+                        self._warmed.setdefault(str(dt), set()).add(b)
                     n += 1
         return n
 
@@ -504,8 +516,12 @@ class ServeEngine:
         unaffected.  ``max_batch`` re-derives from the requested bound and
         the NEW index's transient cap; warmed buckets above it are
         dropped (requests that needed them fall back to solo, counted)."""
-        with telemetry.span("serve.refresh"):
-            self._refresh(index, params)
+        self._refreshing = True  # /healthz reports the swap in flight
+        try:
+            with telemetry.span("serve.refresh"):
+                self._refresh(index, params)
+        finally:
+            self._refreshing = False
 
     def _refresh(self, index, params):
         with self._lock:  # snapshot under the lock: warmup() mutates it
@@ -540,6 +556,59 @@ class ServeEngine:
             self.max_batch = max_batch
             self._warmed = warmed
             self.stats.inc("refreshes")
+
+    # -- live scrape surface ------------------------------------------------
+    def _health(self) -> Dict[str, Any]:
+        """The /healthz body: ready iff at least one (bucket, dtype)
+        signature is warmed (steady-state serving cannot compile) and no
+        index refresh is mid-swap.  Deliberately does NOT take the engine
+        lock (a probe must not queue behind an in-flight search); the
+        warmed map is copied under its mutation lock so a scrape racing
+        warmup() never iterates a set mid-add."""
+        with self._warmed_mut:
+            warmed = {dt: sorted(bs) for dt, bs in self._warmed.items()}
+        ready = any(warmed.values()) and not self._refreshing
+        return {"ready": bool(ready), "backend": self.backend, "k": self.k,
+                "max_batch": self.max_batch, "warmed": warmed,
+                "refresh_in_flight": bool(self._refreshing),
+                "stats": dict(self.stats)}
+
+    def serve_http(self, port: int = 0, host: str = "127.0.0.1", *,
+                   slow_threshold_s: Optional[float] = None,
+                   slow_cap: Optional[int] = None):
+        """Start the live scrape surface for this engine
+        (docs/observability.md §scrape endpoints): ``/metrics`` (Prometheus
+        text over the whole process registry), ``/healthz`` (engine
+        readiness: warmed buckets present, no refresh in flight — 503 until
+        :meth:`warmup` ran), ``/varz`` (snapshot JSON) and ``/debug/slow``
+        (a bounded flight-recorder ring of span trees for ``search()``
+        calls slower than *slow_threshold_s*; recording costs one
+        thread-local list per request and only while telemetry is
+        enabled).  ``port=0`` binds an ephemeral port — read it from the
+        returned server's ``.port``.  Idempotent: a second call returns
+        the running server; ``close()`` (or the server's own ``close()``)
+        stops it."""
+        from raft_tpu.telemetry import http as telemetry_http
+
+        with self._lock:
+            if self._http is None:
+                self._recorder = telemetry_http.FlightRecorder(
+                    telemetry_http.DEFAULT_SLOW_THRESHOLD_S
+                    if slow_threshold_s is None else slow_threshold_s,
+                    telemetry_http.DEFAULT_SLOW_CAP
+                    if slow_cap is None else slow_cap)
+                self._http = telemetry_http.TelemetryServer(
+                    port, host, health=self._health,
+                    recorder=self._recorder).start()
+            return self._http
+
+    def close(self) -> None:
+        """Stop the scrape server (if :meth:`serve_http` started one) and
+        drop the flight recorder.  The engine itself stays serveable."""
+        with self._lock:
+            http, self._http, self._recorder = self._http, None, None
+        if http is not None:
+            http.close()
 
     # -- the request path ---------------------------------------------------
     def _plan(self, sizes: List[int], max_bucket: int
@@ -596,10 +665,26 @@ class ServeEngine:
         (``serve.request`` → ``serve.ingest`` / ``serve.coalesce`` /
         ``serve.assemble`` / ``serve.dispatch`` / ``serve.deliver``) — wall
         time only, no device syncs, no-ops under ``RAFT_TPU_TELEMETRY=0``
-        (docs/observability.md has the span taxonomy)."""
+        (docs/observability.md has the span taxonomy).  With
+        :meth:`serve_http` running, a call slower than the flight
+        recorder's threshold leaves its span tree in the bounded
+        ``/debug/slow`` ring."""
+        rec = self._recorder
+        if rec is None or not telemetry.enabled():
+            with self._lock:
+                with telemetry.span("serve.request"):
+                    return self._search_locked(requests)
         with self._lock:
-            with telemetry.span("serve.request"):
-                return self._search_locked(requests)
+            t0 = telemetry.now()
+            with telemetry.collect_spans() as col:
+                with telemetry.span("serve.request"):
+                    out = self._search_locked(requests)
+            dur = telemetry.now() - t0
+            if dur >= rec.threshold_s:
+                rec.record(col.events, dur_s=round(dur, 6),
+                           requests=len(requests),
+                           queries=sum(int(np.shape(q)[0]) for q in requests))
+            return out
 
     def _search_locked(self, requests):
         t_entry = telemetry.now()

@@ -16,12 +16,32 @@ registry-backed now, behind their exact legacy read surfaces
 (``view.inc``) so concurrent ``ServeEngine.search()`` callers stop racing
 plain Counters.
 
+Beyond the host-process layer, three fleet-grade pieces
+(docs/observability.md):
+
+* **Device-cost attribution** (:mod:`.device`) — compile-time
+  ``cost_analysis``/``memory_analysis`` harvest into per-program
+  ``raft_tpu_program_{flops,bytes_accessed,temp_bytes}{fn,sig}`` gauges,
+  plus sampled true device execution time (every Nth warm dispatch,
+  ``RAFT_TPU_DEVICE_SAMPLE``) into ``raft_tpu_device_seconds{fn}`` with
+  derived achieved FLOP/s / bytes/s gauges.
+* **Fleet aggregation** (:mod:`.aggregate`) — :func:`merge` folds
+  snapshots (histograms bucket-wise EXACT on the shared log-bucket
+  geometry) and :func:`gather` collects per-host snapshots over a
+  communicator's host p2p plane into one fleet view.
+* **Live scrape surface** (:mod:`.http`, lazy import) — stdlib
+  ``ThreadingHTTPServer`` serving ``/metrics`` (Prometheus), ``/healthz``,
+  ``/varz`` and ``/debug/slow`` (a bounded flight-recorder ring of slow-
+  request span trees); ``ServeEngine.serve_http(port)`` wires it to a
+  serving engine.
+
 Global off switch: ``RAFT_TPU_TELEMETRY=0`` (or :func:`set_enabled`) turns
-spans, histograms, gauges, reservoirs and the JSONL sink into no-ops;
-counters stay live because they are contract instruments (zero-compile
-serve gates, collective-call budgets), not just telemetry — see
-:mod:`.registry` for the rationale.  The serve bench A/B gates the
-telemetry-on overhead at < 3% qps (bench.py ``serve``).
+spans, histograms, gauges, reservoirs, device sampling and the JSONL sink
+into no-ops; counters stay live because they are contract instruments
+(zero-compile serve gates, collective-call budgets), not just telemetry —
+see :mod:`.registry` for the rationale.  The serve bench A/B gates the
+telemetry-on overhead — device sampling at the default rate included — at
+< 3% qps (bench.py ``serve``).
 
 Quick tour::
 
@@ -40,6 +60,13 @@ Quick tour::
 
 from __future__ import annotations
 
+from raft_tpu.telemetry.aggregate import gather, merge  # noqa: F401
+from raft_tpu.telemetry.device import (  # noqa: F401
+    sample_every,
+    set_sample_every,
+)
+from raft_tpu.telemetry.device import program_costs  # noqa: F401
+from raft_tpu.telemetry import device as _device
 from raft_tpu.telemetry.export import prometheus_text, snapshot  # noqa: F401
 from raft_tpu.telemetry.registry import (  # noqa: F401
     HIST_BUCKETS,
@@ -59,11 +86,24 @@ from raft_tpu.telemetry.registry import (  # noqa: F401
 )
 from raft_tpu.telemetry.spans import (  # noqa: F401
     Span,
+    collect_spans,
     current_span,
     now,
     set_jsonl_sink,
     span,
 )
+
+
+def __getattr__(name):
+    # the scrape-surface module pulls in stdlib http.server (socketserver
+    # and friends) — loaded lazily so `import raft_tpu.telemetry`, which
+    # core.aot (and therefore everything) pays, stays cheap
+    if name == "http":
+        import importlib
+
+        return importlib.import_module("raft_tpu.telemetry.http")
+    raise AttributeError(f"module 'raft_tpu.telemetry' has no "
+                         f"attribute {name!r}")
 
 
 def counter(name: str, help: str = "", labelnames=()) -> Counter:
@@ -120,10 +160,37 @@ def _dispatch_metrics():
 def record_dispatch(fn: str, sig: str, cold: bool, seconds: float) -> None:
     """One AOT executable dispatch: bump the per-function warm/cold count
     and record the host-side dispatch latency under the (fn, sig) pair.
-    No-op when telemetry is disabled — this is per-dispatch (per
-    super-batch/tile), not per query, and costs two lock-guarded updates."""
-    if not enabled():
-        return
+
+    The COUNTER stays live under ``RAFT_TPU_TELEMETRY=0`` — the module
+    contract is that counters are contract instruments (warm/cold dispatch
+    totals back the zero-compile serve gates exactly like
+    ``aot_compile_counters``), so only the latency-histogram observation
+    is gated (``Histogram.observe`` no-ops itself when disabled).  This is
+    per-dispatch (per super-batch/tile), not per query."""
     total, hist = _dispatch_metrics()
     total.inc(1, (fn, "cold" if cold else "warm"))
     hist.observe(seconds, (fn, sig))
+
+
+def record_program_costs(fn: str, sig: str, compiled):
+    """Compile-time device-cost attribution hook (see
+    :mod:`raft_tpu.telemetry.device`): harvest *compiled*'s
+    ``cost_analysis``/``memory_analysis`` into the per-(fn, sig)
+    ``raft_tpu_program_*`` gauges.  Called by ``core.aot`` on every
+    compile miss — never on the dispatch path."""
+    return _device.record_program_costs(fn, sig, compiled)
+
+
+def device_sample_due(fn: str) -> bool:
+    """Dispatch-time gate: True when this warm dispatch of *fn* should
+    block on its output for a device-time sample (every
+    ``RAFT_TPU_DEVICE_SAMPLE``-th; default 1/64, first warm dispatch
+    always).  Always False with telemetry disabled."""
+    return _device.sample_due(fn)
+
+
+def record_device_sample(fn: str, sig: str, seconds: float) -> None:
+    """Record one blocked-dispatch device-time sample into
+    ``raft_tpu_device_seconds{fn}`` and refresh the achieved FLOP/s and
+    bytes/s gauges from the program's static costs."""
+    _device.record_sample(fn, sig, seconds)

@@ -1,0 +1,211 @@
+"""Device-cost attribution: what the accelerator did, per program.
+
+Host spans (:mod:`.spans`) time the REQUEST path; this module attributes
+cost to the DEVICE programs behind it, in two halves (docs/observability.md
+§device attribution):
+
+* **Static, at compile time** — every AOT cache miss harvests the compiled
+  executable's ``cost_analysis()`` / ``memory_analysis()`` into
+  per-(fn, sig) gauges:
+
+  - ``raft_tpu_program_flops{fn,sig}``
+  - ``raft_tpu_program_bytes_accessed{fn,sig}``
+  - ``raft_tpu_program_temp_bytes{fn,sig}``
+
+  This is the same static analysis the HLO auditor proves transient/budget
+  ceilings against (:mod:`raft_tpu.analysis.hlo_audit` feeds its audit
+  shapes through :func:`record_program_costs` too, under ``sig="audit"``),
+  now exported live so an operator can read each serving program's cost
+  model off ``/metrics`` instead of re-deriving it.
+
+* **Sampled, at dispatch time** — compiled executables dispatch
+  asynchronously, so host-side dispatch latency says nothing about device
+  time.  Every Nth WARM dispatch of each function
+  (``RAFT_TPU_DEVICE_SAMPLE``, default 1/64; the FIRST warm dispatch is
+  always sampled so every program reports promptly) blocks on its output
+  and records true submit→complete wall time into
+  ``raft_tpu_device_seconds{fn}``.  Combining the sample with the static
+  half yields roofline-style achieved rates:
+
+  - ``raft_tpu_device_flops_per_second{fn}``
+  - ``raft_tpu_device_bytes_per_second{fn}``
+
+Hot-path discipline: the per-dispatch cost when a dispatch is NOT sampled
+is one enabled() check + one lock-guarded counter bump + a modulo; a
+sampled dispatch additionally blocks on an output the caller was about to
+consume anyway (the serve engine fetches results host-side right after
+dispatch).  ``RAFT_TPU_TELEMETRY=0`` turns sampling off entirely, and the
+serve bench's telemetry-on A/B gates the whole instrumented path —
+device sampling at the default rate included — at < 3% qps overhead.
+
+Sampling measures from just before the executable call to output
+readiness, so a sample includes submit overhead; at the >= millisecond
+program scale this attributes, that bias is noise.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+from raft_tpu.telemetry import registry as _registry
+
+#: default sampling period: one blocked (device-timed) dispatch per this
+#: many warm dispatches of each function
+DEFAULT_SAMPLE_EVERY = 64
+
+_sample_every: Optional[int] = None
+
+#: guards the per-fn dispatch counters and the static-cost table (NOT the
+#: metrics — those take the registry lock themselves)
+_LOCK = threading.Lock()
+_dispatch_counts: Dict[str, int] = {}
+#: (fn, sig) → (flops, bytes_accessed) harvested at compile time, read at
+#: sample time to derive achieved rates
+_static_costs: Dict[Tuple[str, str], Tuple[Optional[float],
+                                           Optional[float]]] = {}
+
+_program_flops = None
+_program_bytes = None
+_program_temp = None
+_device_seconds = None
+_device_flops_rate = None
+_device_bytes_rate = None
+
+
+def sample_every() -> int:
+    """The device-sampling period N (one blocked dispatch per N warm
+    dispatches per function).  ``RAFT_TPU_DEVICE_SAMPLE`` at first use, or
+    :func:`set_sample_every`; ``0`` disables sampling."""
+    global _sample_every
+    if _sample_every is None:
+        try:
+            _sample_every = int(os.environ.get(
+                "RAFT_TPU_DEVICE_SAMPLE", str(DEFAULT_SAMPLE_EVERY)))
+        except ValueError:
+            _sample_every = DEFAULT_SAMPLE_EVERY
+    return _sample_every
+
+
+def set_sample_every(n: int) -> int:
+    """Set the sampling period at runtime (0 disables).  Returns the
+    previous value — tests and the bench A/B save/restore with it."""
+    global _sample_every
+    prev = sample_every()
+    _sample_every = max(0, int(n))
+    return prev
+
+
+def _metrics():
+    global _program_flops, _program_bytes, _program_temp
+    global _device_seconds, _device_flops_rate, _device_bytes_rate
+    if _program_flops is None:
+        reg = _registry
+        _program_flops = reg.REGISTRY.gauge(
+            "raft_tpu_program_flops",
+            "XLA cost_analysis flops per compiled program (fn, signature)",
+            labelnames=("fn", "sig"))
+        _program_bytes = reg.REGISTRY.gauge(
+            "raft_tpu_program_bytes_accessed",
+            "XLA cost_analysis bytes accessed per compiled program",
+            labelnames=("fn", "sig"))
+        _program_temp = reg.REGISTRY.gauge(
+            "raft_tpu_program_temp_bytes",
+            "memory_analysis transient (temp) bytes per compiled program",
+            labelnames=("fn", "sig"))
+        _device_seconds = reg.REGISTRY.histogram(
+            "raft_tpu_device_seconds",
+            "sampled device execution wall time per AOT function",
+            labelnames=("fn",))
+        _device_flops_rate = reg.REGISTRY.gauge(
+            "raft_tpu_device_flops_per_second",
+            "achieved FLOP/s of the latest device sample (static flops / "
+            "sampled device seconds)",
+            labelnames=("fn",))
+        _device_bytes_rate = reg.REGISTRY.gauge(
+            "raft_tpu_device_bytes_per_second",
+            "achieved bytes/s of the latest device sample (static bytes "
+            "accessed / sampled device seconds)",
+            labelnames=("fn",))
+    return (_program_flops, _program_bytes, _program_temp,
+            _device_seconds, _device_flops_rate, _device_bytes_rate)
+
+
+def program_costs(compiled) -> Dict[str, Optional[float]]:
+    """Harvest ``{"flops", "bytes_accessed", "temp_bytes"}`` from one
+    compiled executable — robust to backends where either analysis is
+    unavailable (a missing number is None, never an exception).  jax
+    returns ``cost_analysis()`` as a per-device list on some versions and
+    a plain dict on others; both shapes are accepted."""
+    flops = nbytes = temp = None
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if isinstance(ca, dict):
+            if ca.get("flops") is not None:
+                flops = float(ca["flops"])
+            if ca.get("bytes accessed") is not None:
+                nbytes = float(ca["bytes accessed"])
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            temp = float(ma.temp_size_in_bytes)
+    except Exception:
+        pass
+    return {"flops": flops, "bytes_accessed": nbytes, "temp_bytes": temp}
+
+
+def record_program_costs(fn: str, sig: str,
+                         compiled) -> Dict[str, Optional[float]]:
+    """Compile-time half of the attribution: harvest *compiled*'s static
+    costs into the per-(fn, sig) gauges and cache the (flops, bytes) pair
+    for dispatch-time rate derivation.  Called once per AOT cache miss
+    (and by the HLO auditor under ``sig="audit"``) — never on the dispatch
+    path.  Returns the harvested dict."""
+    costs = program_costs(compiled)
+    with _LOCK:
+        _static_costs[(fn, sig)] = (costs["flops"], costs["bytes_accessed"])
+    g_flops, g_bytes, g_temp = _metrics()[:3]
+    labels = (fn, sig)
+    if costs["flops"] is not None:
+        g_flops.set(costs["flops"], labels)
+    if costs["bytes_accessed"] is not None:
+        g_bytes.set(costs["bytes_accessed"], labels)
+    if costs["temp_bytes"] is not None:
+        g_temp.set(costs["temp_bytes"], labels)
+    return costs
+
+
+def sample_due(fn: str) -> bool:
+    """Per-WARM-dispatch gate: bump *fn*'s dispatch count and return True
+    when this dispatch should block for a device-time sample (count 0,
+    then every Nth).  False whenever telemetry is disabled or sampling is
+    off — the not-sampled cost is this check plus one locked add."""
+    if not _registry.enabled():
+        return False
+    n = sample_every()
+    if n <= 0:
+        return False
+    with _LOCK:
+        c = _dispatch_counts.get(fn, 0)
+        _dispatch_counts[fn] = c + 1
+    return c % n == 0
+
+
+def record_sample(fn: str, sig: str, seconds: float) -> None:
+    """Record one blocked-dispatch device-time sample and refresh the
+    achieved-rate gauges from the (fn, sig) static costs."""
+    _, _, _, hist, g_fr, g_br = _metrics()
+    hist.observe(seconds, (fn,))
+    if seconds <= 0.0:
+        return
+    with _LOCK:
+        flops, nbytes = _static_costs.get((fn, sig), (None, None))
+    if flops is not None:
+        g_fr.set(flops / seconds, (fn,))
+    if nbytes is not None:
+        g_br.set(nbytes / seconds, (fn,))

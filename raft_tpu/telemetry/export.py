@@ -28,7 +28,7 @@ def _label_key(labelnames: Tuple[str, ...], labels: Tuple[str, ...]) -> str:
     return ",".join(f"{k}={v}" for k, v in zip(labelnames, labels))
 
 
-def snapshot() -> Dict[str, dict]:
+def snapshot(registry=None) -> Dict[str, dict]:
     """The whole registry as one plain, JSON-serializable dict.
 
     ``{metric_name: {"type", "help", "labelnames", "values"}}`` where
@@ -37,9 +37,12 @@ def snapshot() -> Dict[str, dict]:
     ``sum``, ``min``, ``max``, the non-empty ``buckets`` as
     ``[[upper_bound_s, count], ...]`` and convenience ``p50``/``p99``
     estimates.  ``json.loads(json.dumps(snapshot()))`` reproduces it
-    exactly (tests/test_telemetry.py pins the round trip)."""
+    exactly (tests/test_telemetry.py pins the round trip).  *registry*
+    defaults to the process-wide one; passing another
+    :class:`~raft_tpu.telemetry.Registry` snapshots that instead (the
+    fleet merge property tests build per-shard registries this way)."""
     out: Dict[str, dict] = {}
-    for m in REGISTRY.metrics():
+    for m in (REGISTRY if registry is None else registry).metrics():
         entry = {"type": m.kind, "help": m.help,
                  "labelnames": list(m.labelnames)}
         values: Dict[str, object] = {}

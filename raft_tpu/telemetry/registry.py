@@ -102,6 +102,31 @@ def bucket_upper(i: int) -> float:
     return math.exp(_LOG_MIN + (i + 1) * _LOG_STEP)
 
 
+def quantile_from_counts(counts: Sequence[int], total: int, lo: float,
+                         hi: float, q: float) -> Optional[float]:
+    """THE bucket-quantile rule — interpolate within the hit bucket, clamp
+    to the observed [lo, hi] — over a raw bucket-count vector.  The ONE
+    implementation behind both :meth:`Histogram.quantile` and the fleet
+    merge's re-estimated p50/p99 (:mod:`raft_tpu.telemetry.aggregate`), so
+    a rollup's quantiles can never silently diverge from per-host ones.
+    None when *total* is zero."""
+    if total <= 0:
+        return None
+    target = q * total
+    acc = 0.0
+    for i, n in enumerate(counts):
+        if n == 0:
+            continue
+        if acc + n >= target:
+            # linear interpolation within the (log-spaced) bucket
+            lower = HIST_MIN if i == 0 else bucket_upper(i - 1)
+            frac = (target - acc) / n
+            est = lower + frac * (bucket_upper(i) - lower)
+            return min(max(est, lo), hi)
+        acc += n
+    return hi
+
+
 class Reservoir:
     """Bounded uniform sample (Vitter's algorithm R) — the exact-sample
     companion of a histogram: at most *cap* floats no matter how many
@@ -283,19 +308,7 @@ class Histogram(Metric):
                 return None
             counts = list(cell.counts)
             total, lo, hi = cell.count, cell.min, cell.max
-        target = q * total
-        acc = 0.0
-        for i, n in enumerate(counts):
-            if n == 0:
-                continue
-            if acc + n >= target:
-                # linear interpolation within the (log-spaced) bucket
-                lower = HIST_MIN if i == 0 else bucket_upper(i - 1)
-                frac = (target - acc) / n
-                est = lower + frac * (bucket_upper(i) - lower)
-                return min(max(est, lo), hi)
-            acc += n
-        return hi
+        return quantile_from_counts(counts, total, lo, hi, q)
 
     def items(self) -> List[Tuple[Tuple[str, ...], _HistState]]:
         with _LOCK:
@@ -398,6 +411,13 @@ class LegacyCounterView(Mapping):
             raise ValueError(
                 f"view over {metric.name}{metric.labelnames} needs "
                 f"{len(metric.labelnames) - 1} fixed label(s)")
+
+    @property
+    def fixed_labels(self) -> Tuple[str, ...]:
+        """The pinned label prefix (e.g. this instance's ordinal) — lets a
+        holder locate its own rows in a snapshot/fleet rollup, where keys
+        render as ``"label=value,...,key=<k>"``."""
+        return self._fixed
 
     # -- writes ----------------------------------------------------------
     def inc(self, key: str, amount: float = 1) -> None:

@@ -88,6 +88,28 @@ def current_span() -> Optional[str]:
     return s[-1] if s else None
 
 
+class collect_spans:
+    """Capture completed span EVENTS on this thread (context manager) —
+    the same dicts the JSONL sink receives, appended to ``self.events`` in
+    completion order (children before parents).  The serve engine's slow-
+    request flight recorder wraps each request in one of these and keeps
+    the event list only when the request breaches its latency threshold
+    (:class:`raft_tpu.telemetry.http.FlightRecorder`).  Nests: an inner
+    collector shadows the outer one for its duration."""
+
+    __slots__ = ("events", "_prev")
+
+    def __enter__(self) -> "collect_spans":
+        self.events: List[dict] = []
+        self._prev = getattr(_TLS, "collect", None)
+        _TLS.collect = self.events
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _TLS.collect = self._prev
+        return False
+
+
 # -- the JSONL event sink ----------------------------------------------------
 
 _SINK_LOCK = threading.Lock()
@@ -178,9 +200,12 @@ class Span:
                 self._ann.__enter__()
             except Exception:  # pragma: no cover - profiler unavailable
                 self._ann = None
-        # wall-clock start is only consumed by the JSONL sink — skip the
-        # third clock read on the default (no-sink) path
-        self._start_wall = time.time() if _SINK is not None else 0.0
+        # wall-clock start is only consumed by the event path (JSONL sink
+        # / span collector) — skip the third clock read otherwise
+        self._start_wall = (
+            time.time()
+            if _SINK is not None or getattr(_TLS, "collect", None) is not None
+            else 0.0)
         self._t0 = now()
         return self
 
@@ -201,15 +226,20 @@ class Span:
         hist, total = _metrics()
         hist.observe(dur, (self.name,))
         total.inc(1, (self.name,))
-        if _SINK is not None:
-            _emit_event({
+        collect = getattr(_TLS, "collect", None)
+        if _SINK is not None or collect is not None:
+            event = {
                 "span": self.name, "parent": self._parent,
                 "depth": self._depth,
                 "thread": threading.get_ident(),
                 "start": round(self._start_wall, 6),
                 "dur_s": round(dur, 9),
                 "error": exc_type is not None,
-            })
+            }
+            if collect is not None:
+                collect.append(event)
+            if _SINK is not None:
+                _emit_event(event)
         return False  # never swallow
 
 

@@ -111,6 +111,8 @@ _FAST_TESTS = {
     "test_stats.py::TestSummary::test_meanvar_stddev",
     "test_telemetry.py::TestHistogram::test_quantile_oracle_vs_np_percentile",
     "test_telemetry.py::test_disabled_mode_identity",
+    "test_telemetry_fleet.py::TestMerge::test_merge_equals_union_stream",
+    "test_telemetry_fleet.py::TestScrapeServer::test_metrics_round_trip",
 }
 
 

@@ -242,6 +242,42 @@ class TestTelemetryDiscipline:
                 f.as_posix(), f.read_text())
                 if x.rule == "telemetry-discipline"], f
 
+    # -- raw http.server endpoints outside raft_tpu/telemetry/ (ISSUE 10)
+    _HTTP = ("from http.server import ThreadingHTTPServer{}\n\n\n"
+             "def serve_metrics(port):\n"
+             "    return ThreadingHTTPServer(('', port), None)\n")
+
+    def test_http_server_outside_telemetry_fires(self):
+        f = findings("raft_tpu/serve/engine.py", self._HTTP.format(""),
+                     "telemetry-discipline")
+        assert f and "http.server" in f[0].message
+
+    def test_http_server_fires_off_the_hot_path_registry_too(self):
+        # the endpoint check covers the WHOLE library, not just hot paths
+        src = "import http.server\n"
+        assert findings("raft_tpu/stats/mod.py", src,
+                        "telemetry-discipline")
+        # ...including the `from http import server` spelling
+        assert findings("raft_tpu/stats/mod.py", "from http import server\n",
+                        "telemetry-discipline")
+
+    def test_http_client_does_not_fire(self):
+        # http.client (outbound) is not an endpoint; only the server half
+        # forks the scrape surface
+        assert not findings("raft_tpu/stats/mod.py",
+                            "import http.client\n",
+                            "telemetry-discipline")
+
+    def test_http_server_in_telemetry_package_passes(self):
+        assert not findings("raft_tpu/telemetry/http.py",
+                            self._HTTP.format(""), "telemetry-discipline")
+
+    def test_http_server_marker_exempts(self):
+        src = self._HTTP.format(
+            "  # exempt(telemetry-discipline): debug-only local tool")
+        assert not findings("raft_tpu/serve/engine.py", src,
+                            "telemetry-discipline")
+
 
 # ---------------------------------------------------------------------------
 # static-arg-hashability

@@ -295,6 +295,38 @@ def test_disabled_mode_keeps_contract_counters_live():
         telemetry.set_enabled(prev)
 
 
+def _disabled_dispatch_probe(v):
+    return v * 2
+
+
+def test_disabled_mode_keeps_dispatch_counter_live():
+    """ISSUE 10 satellite regression: ``record_dispatch`` used to drop the
+    whole record — including ``raft_tpu_aot_dispatch_total`` — under
+    RAFT_TPU_TELEMETRY=0, violating the module contract that COUNTERS stay
+    live (warm/cold dispatch totals back zero-compile gates).  Only the
+    latency-histogram observe may be gated."""
+    from raft_tpu.core.aot import aot
+
+    prev = telemetry.set_enabled(False)
+    try:
+        f = aot(_disabled_dispatch_probe)
+        x = jnp.zeros((8,))
+        f(x)  # cold
+        f(x)  # warm
+        f(x)  # warm
+        snap = telemetry.snapshot()
+        disp = snap["raft_tpu_aot_dispatch_total"]["values"]
+        assert disp.get("fn=_disabled_dispatch_probe,temp=cold") == 1, disp
+        assert disp.get("fn=_disabled_dispatch_probe,temp=warm") == 2, disp
+        # ...while the per-signature latency HISTOGRAM stayed silent
+        lat = snap.get("raft_tpu_aot_dispatch_seconds",
+                       {}).get("values", {})
+        assert not any(k.startswith("fn=_disabled_dispatch_probe")
+                       for k in lat), lat
+    finally:
+        telemetry.set_enabled(prev)
+
+
 class TestLegacySurfaces:
     def test_counter_view_reads_like_a_counter(self):
         v = telemetry.legacy_counter("t_legacy_view", "t")
