@@ -15,8 +15,15 @@ python -m compileall -q raft_tpu tests bench ci docs bench.py __graft_entry__.py
 
 echo "== style / contracts (analysis level 1) =="
 # stdlib AST rule engine (ci/checks/style.sh role + the hot-path contract
-# rules; ci/lint.py remains a back-compatible shim over the same engine)
+# rules, dataflow-powered since ISSUE 12 — single-hop laundering fires;
+# ci/lint.py remains a back-compatible shim over the same engine)
 python -m raft_tpu.analysis --ast
+
+echo "== stale exemptions (warning) =="
+# exempt() markers whose rule no longer fires on the marked line — dead
+# exemptions accumulate as the rules sharpen.  WARNING pass for now
+# (always exit 0); promote to a hard gate once the marker set stabilizes.
+python -m raft_tpu.analysis --stale-exemptions
 
 echo "== blacklist =="
 # only real imports/usages count — docstrings cite reference CUDA symbols
@@ -45,21 +52,30 @@ mods = [
     "raft_tpu.telemetry.http",
     "raft_tpu.analysis", "raft_tpu.analysis.engine",
     "raft_tpu.analysis.rules", "raft_tpu.analysis.registry",
+    "raft_tpu.analysis.dataflow", "raft_tpu.analysis.fingerprint",
+    "raft_tpu.analysis.retrace",
 ]
 for m in mods:
     importlib.import_module(m)
 print(f"{len(mods)} modules import cleanly")
 EOF
 
-echo "== hlo audit (analysis level 2) =="
+echo "== hlo audit + lowering locks (analysis level 2) =="
 # Lower every registered hot-path program and statically check host
 # purity, collective launch/byte budgets, donation aliasing and transient
-# ceilings (docs/static_analysis.md).  The FULL registry (incl. the
-# sharded one-allgather programs on the forced 8-device mesh) runs in
-# single-digit seconds on CPU; --fast restricts to the single-device
-# subset for constrained environments.  --strict: a skipped program (bad
-# device env) fails the gate instead of silently shrinking it.
-JAX_PLATFORMS=cpu python -m raft_tpu.analysis --hlo --strict
+# ceilings; then DIFF each program's structural fingerprint (op-class
+# histogram, fusion count, collectives+bytes, dtype set, donation
+# aliases, transients) against the committed goldens in
+# raft_tpu/analysis/goldens/ (intended lowering changes regenerate via
+# --update-goldens and land as a reviewable diff), and run the static
+# retrace-closure certifier over the serving layer
+# (docs/static_analysis.md).  The FULL registry (incl. the sharded
+# one-allgather programs on the forced 8-device mesh) runs in
+# single-digit seconds on CPU.  --strict: a skipped program (bad device
+# env) fails the gate instead of silently shrinking it — exit 2 when
+# strict skips are the ONLY failure; both audit and fingerprint passes
+# enforce the >=6-verified acceptance floor on full runs.
+JAX_PLATFORMS=cpu python -m raft_tpu.analysis --hlo --fingerprints --retrace --strict
 
 echo "== tests =="
 # Shard per-file across workers when the host has the cores for it (the

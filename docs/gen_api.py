@@ -112,7 +112,8 @@ _SUBMODULES = {
     "cluster": ["kmeans_mnmg"],
     # the analysis package is fully lazy (stdlib registry importable from
     # hot modules at zero cost) — its whole surface lives on submodules
-    "analysis": ["engine", "hotpaths", "registry", "hlo_audit"],
+    "analysis": ["engine", "dataflow", "hotpaths", "registry", "hlo_audit",
+                 "fingerprint", "retrace"],
     # device attribution / fleet aggregation re-export through the package
     # namespace, but http (the scrape server + flight recorder) is a lazy
     # submodule — rendered as its own section alongside the other two

@@ -21,12 +21,26 @@ Two levels, both runnable from ``python -m raft_tpu.analysis``:
   ``input_output_alias``, and ``memory_analysis()`` transients under the
   declared ceiling.
 
+* **Regression locks** (ISSUE 12): :mod:`raft_tpu.analysis.fingerprint`
+  diffs every registered program's structural fingerprint (op-class
+  histogram, fusion count, collectives + payload bytes, dtype set,
+  donation aliases, transients) against golden JSON artifacts committed
+  under ``raft_tpu/analysis/goldens/`` (``--update-goldens`` regenerates
+  them deterministically so the diff rides the PR review surface);
+  :mod:`raft_tpu.analysis.retrace` statically certifies the serving
+  layer's zero-retrace closure (warm/dispatch congruence, planner bucket
+  closure, static-arg value cardinality at ``aot()`` call sites); and
+  :mod:`raft_tpu.analysis.dataflow` gives the Level-1 rules shared
+  intra-procedural value-flow so single-hop laundering (aliased imports,
+  local rebinds, helper returns) no longer defeats them.
+
 This module imports NOTHING heavy at package-import time (``registry`` is
 stdlib-only, so hot modules can declare audit entries for free); the jax
 machinery loads only when the auditor actually runs.
 """
 
-_SUBMODULES = ("engine", "hotpaths", "registry", "rules", "hlo_audit")
+_SUBMODULES = ("dataflow", "engine", "fingerprint", "hotpaths", "registry",
+               "retrace", "rules", "hlo_audit")
 
 
 def __getattr__(name):

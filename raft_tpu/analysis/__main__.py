@@ -1,20 +1,39 @@
 """CLI: ``python -m raft_tpu.analysis [options] [paths...]``.
 
-Default: BOTH levels — the AST rule engine over the repo surface, then the
-HLO auditor over every registered hot-path program.  Exit 1 on any
-finding.
+Default: ALL passes — the AST rule engine over the repo surface, the HLO
+auditor over every registered hot-path program, the golden-fingerprint
+diff, and the retrace-closure certifier.
 
 Options:
-  --ast             Level 1 only (stdlib-fast; what ci/lint.py shims to)
-  --hlo             Level 2 only
-  --fast            restrict the HLO audit to the fast (single-device)
-                    program subset
-  --strict          CI mode: a SKIPPED program counts as a failure (a
-                    preset XLA_FLAGS device count must not silently
-                    disable the sharded audits)
-  --programs a,b    audit only the named programs
-  --list            list registered rules and programs, run nothing
-  paths...          restrict the AST level to these files/dirs
+  --ast               Level 1 only (stdlib-fast; what ci/lint.py shims to)
+  --hlo               HLO budget audit only
+  --fingerprints      golden HLO fingerprint diff only
+  --retrace           retrace-closure certifier only
+                      (the pass flags COMPOSE: --hlo --fingerprints runs
+                      exactly those two)
+  --update-goldens    REGENERATE the golden fingerprints (sorted keys, no
+                      timestamps — the diff is the PR review surface),
+                      prune stale ones, then verify a clean diff
+  --stale-exemptions  report exempt() markers whose rule no longer fires
+                      on the marked line (warning pass: always exit 0)
+  --fast              restrict the HLO audit to the fast (single-device)
+                      program subset
+  --strict            CI mode: a SKIPPED program counts as a failure (a
+                      preset XLA_FLAGS device count must not silently
+                      disable the sharded audits)
+  --programs a,b      audit/fingerprint only the named programs; the
+                      certifier keeps obligations whose id contains one
+                      of the names
+  --list              list registered rules and programs, run nothing
+  paths...            restrict the AST level to these files/dirs
+
+Exit codes (pinned by tests/test_analysis.py::TestExitCodes and
+documented in docs/static_analysis.md §exit codes):
+  0  clean — every requested pass passed
+  1  findings — AST findings, HLO budget failures, fingerprint drift,
+     certifier violations, or an acceptance-floor miss
+  2  strict-skip only — the ONLY failures are programs skipped under
+     ``--strict`` (the device environment shrank; nothing else drifted)
 """
 
 from __future__ import annotations
@@ -36,22 +55,20 @@ if "xla_force_host_platform_device_count" not in os.environ.get(
 
 def main(argv) -> int:
     args = list(argv)
-    do_ast = do_hlo = True
-    fast_only = False
+
+    def flag(name):
+        if name in args:
+            args.remove(name)
+            return True
+        return False
+
+    only = {p for p in ("ast", "hlo", "fingerprints", "retrace")
+            if flag(f"--{p}")}
+    update_goldens = flag("--update-goldens")
+    stale = flag("--stale-exemptions")
+    fast_only = flag("--fast")
+    strict = flag("--strict")
     names = None
-    if "--ast" in args:
-        args.remove("--ast")
-        do_hlo = False
-    if "--hlo" in args:
-        args.remove("--hlo")
-        do_ast = False
-    if "--fast" in args:
-        args.remove("--fast")
-        fast_only = True
-    strict = False
-    if "--strict" in args:
-        args.remove("--strict")
-        strict = True
     if "--programs" in args:
         i = args.index("--programs")
         args.pop(i)
@@ -62,6 +79,16 @@ def main(argv) -> int:
             if a.startswith("--programs="):
                 args.remove(a)
                 names = a.split("=", 1)[1].split(",")
+    if update_goldens:
+        only.add("fingerprints")
+    if stale and not only and not update_goldens:
+        # --stale-exemptions alone is the warning pass, nothing else
+        from raft_tpu.analysis import engine
+
+        print("== analysis: stale exemptions ==")
+        engine.scan_stale_exemptions(args or None)
+        return 0
+    run_all = not only
     if "--list" in args:
         from raft_tpu.analysis import engine, registry
 
@@ -82,21 +109,52 @@ def main(argv) -> int:
         return 0
 
     bad = 0
-    if do_ast:
+    strict_skips = 0
+    if run_all or "ast" in only:
         from raft_tpu.analysis import engine
 
         print("== analysis: AST rules ==")
         bad += engine.run(args or None)
-    if do_hlo:
+    if run_all or "hlo" in only:
         from raft_tpu.analysis import hlo_audit
 
         print("== analysis: HLO audit ==")
-        _, failed = hlo_audit.run(names, fast_only=fast_only,
-                                  strict=strict)
+        reports, failed = hlo_audit.run(names, fast_only=fast_only,
+                                        strict=strict)
+        if strict:
+            strict_skips += sum(r.status == "skipped" for r in reports)
         bad += failed
+    if run_all or "fingerprints" in only:
+        from raft_tpu.analysis import fingerprint
+
+        print("== analysis: HLO fingerprints =="
+              + (" (updating goldens)" if update_goldens else ""))
+        reports, failed = fingerprint.run(names, update=update_goldens,
+                                          strict=strict)
+        if strict:
+            strict_skips += sum(r.status == "skipped" for r in reports)
+        bad += failed
+        if update_goldens and not failed:
+            # the other half of the update flow: the fresh goldens must
+            # diff clean against the very lowering that produced them
+            reports, failed = fingerprint.run(names, strict=strict)
+            bad += failed
+    if run_all or "retrace" in only:
+        from raft_tpu.analysis import retrace
+
+        print("== analysis: retrace closure ==")
+        _, failed = retrace.run(names)
+        bad += failed
+    if stale:
+        from raft_tpu.analysis import engine
+
+        print("== analysis: stale exemptions ==")
+        engine.scan_stale_exemptions(args or None)
     if bad:
         print(f"analysis: {bad} failure(s)", file=sys.stderr)
-        return 1
+        # exit 2 iff the ONLY failures are strict-counted skips — the
+        # device environment shrank but no contract actually drifted
+        return 2 if strict_skips and bad == strict_skips else 1
     return 0
 
 

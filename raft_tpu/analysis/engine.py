@@ -139,20 +139,39 @@ def module_level_names(tree: ast.Module) -> set:
 
 
 class FileContext:
-    """One parsed file, shared by every rule that runs on it."""
+    """One parsed file, shared by every rule that runs on it.
 
-    def __init__(self, posix: str, src: str):
+    ``ignore_exemptions`` makes :meth:`exempt` always answer False — the
+    stale-exemption scan re-runs the rules in this mode to learn which
+    findings each marker WOULD sanction (a marker sanctioning nothing is
+    dead weight; see :func:`scan_stale_exemptions`)."""
+
+    def __init__(self, posix: str, src: str, *,
+                 ignore_exemptions: bool = False):
         self.posix = posix
         self.src = src
         self.lines = src.splitlines()
         self.tree = ast.parse(src)
+        self.ignore_exemptions = ignore_exemptions
         self._module_names: Optional[set] = None
+        self._flow = None
 
     @property
     def module_names(self) -> set:
         if self._module_names is None:
             self._module_names = module_level_names(self.tree)
         return self._module_names
+
+    @property
+    def flow(self):
+        """The file's shared intra-procedural value-flow index
+        (:class:`raft_tpu.analysis.dataflow.ValueFlow`), built lazily once
+        and reused by every dataflow-ported rule."""
+        if self._flow is None:
+            from raft_tpu.analysis import dataflow
+
+            self._flow = dataflow.ValueFlow(self.tree)
+        return self._flow
 
     def _marker_lines(self, lineno: int) -> List[str]:
         # the flagged line and the line above carry markers (historical
@@ -163,6 +182,8 @@ class FileContext:
     def exempt(self, rule_id: str, lineno: int) -> bool:
         """True when *lineno* (or the line above) sanctions *rule_id* via
         the unified marker, a legacy spelling, or ``noqa``."""
+        if self.ignore_exemptions:
+            return False
         legacy = {m for m, rid in LEGACY_MARKERS.items() if rid == rule_id}
         r = _RULES.get(rule_id)
         if r is not None:
@@ -208,12 +229,16 @@ def _check_marker_hygiene(ctx: FileContext) -> List[Finding]:
 # runners
 
 
-def check_source(posix: str, src: str) -> List[Finding]:
+def check_source(posix: str, src: str, *,
+                 respect_exemptions: bool = True) -> List[Finding]:
     """Run every in-scope rule over one source blob (the quarantine-test
-    entry point: no file needs to exist)."""
+    entry point: no file needs to exist).  ``respect_exemptions=False``
+    returns the RAW findings a marker-less file would produce — the
+    stale-exemption scan's substrate."""
     _ensure_rules_loaded()
     try:
-        ctx = FileContext(posix, src)
+        ctx = FileContext(posix, src,
+                          ignore_exemptions=not respect_exemptions)
     except SyntaxError as e:
         return [Finding("syntax", e.lineno or 0, f"syntax error: {e.msg}")]
     findings = _check_marker_hygiene(ctx)
@@ -228,6 +253,95 @@ def check_source(posix: str, src: str) -> List[Finding]:
 def check_file(path: pathlib.Path) -> List[Finding]:
     path = pathlib.Path(path)
     return check_source(path.as_posix(), path.read_text())
+
+
+# ---------------------------------------------------------------------------
+# stale-exemption scan: markers whose rule no longer fires are dead weight
+
+
+@dataclasses.dataclass(frozen=True)
+class StaleMarker:
+    lineno: int
+    rules: Tuple[str, ...]   # the marker's rule ids that no longer fire
+    text: str                # the marker line, stripped
+
+
+def _comment_tokens(src: str) -> List[Tuple[int, str]]:
+    """(lineno, text) of the GENUINE comment tokens — a marker quoted
+    inside a string literal (quarantine-test snippets, docstrings citing
+    the syntax) is not a marker and must not be scanned."""
+    import io
+    import tokenize
+
+    out = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type == tokenize.COMMENT:
+                out.append((tok.start[0], tok.string))
+    except (tokenize.TokenError, IndentationError):
+        pass  # partial files: whatever tokenized before the error stands
+    return out
+
+
+def scan_stale_source(posix: str, src: str) -> List[StaleMarker]:
+    """Markers in one source blob that sanction NOTHING anymore: the rules
+    are re-run with exemptions ignored, and a marker at line L is live only
+    if a raw finding of one of its rules lands at L or L+1 (the two lines
+    :meth:`FileContext.exempt` lets it cover).  Dead exemptions accumulate
+    as the rules sharpen — each one is a line a future reader must
+    re-justify, and a rationale pointing at code that moved on.  Legacy
+    spellings are scanned through their rule-id mapping; bare ``noqa`` is
+    NOT scanned (it also silences external linters)."""
+    try:
+        raw = check_source(posix, src, respect_exemptions=False)
+    except RecursionError:  # pathological file: skip, never crash the scan
+        return []
+    fired: Dict[int, set] = {}
+    for f in raw:
+        fired.setdefault(f.lineno, set()).add(f.rule)
+    known = {r.id for r in iter_rules()} | set(LEGACY_MARKERS.values())
+    lines = src.splitlines()
+    stale: List[StaleMarker] = []
+    for i, comment in _comment_tokens(src):
+        ids: set = set()
+        m = _EXEMPT_RE.search(comment)
+        if m is not None and m.group(2).strip():
+            ids.update(p.strip() for p in m.group(1).split(","))
+        for legacy, rid in LEGACY_MARKERS.items():
+            if legacy in comment:
+                ids.add(rid)
+        # a marker naming an UNKNOWN rule id is hygiene's problem (typo),
+        # not staleness — scan only ids a rule actually owns
+        ids &= known
+        if not ids:
+            continue
+        covered = fired.get(i, set()) | fired.get(i + 1, set())
+        dead = tuple(sorted(r for r in ids if r not in covered))
+        if len(dead) == len(ids):
+            # every rule the marker names is silent — the whole marker is
+            # stale (a PARTIALLY live comma-list still earns its keep)
+            text = lines[i - 1].strip() if i <= len(lines) else comment
+            stale.append(StaleMarker(i, dead, text[:120]))
+    return stale
+
+
+def scan_stale_exemptions(roots: Optional[Sequence[str]] = None, *,
+                          out=sys.stdout) -> int:
+    """Report stale exemption markers under *roots* (default: the repo
+    surface).  Returns the stale-marker count; prints one line each.
+    Wired into ci/checks.sh as a WARNING (non-fatal) first — the count is
+    informational until the marker set stabilizes."""
+    if roots is None:
+        roots = [str(REPO_ROOT / r) for r in DEFAULT_ROOTS]
+    n = 0
+    for f in collect_files(roots):
+        for sm in scan_stale_source(f.as_posix(), f.read_text()):
+            print(f"{f}:{sm.lineno}: stale exemption "
+                  f"({', '.join(sm.rules)}) — the rule no longer fires "
+                  f"here: {sm.text}", file=out)
+            n += 1
+    print(f"stale-exemptions: {n} stale marker(s)", file=out)
+    return n
 
 
 DEFAULT_ROOTS = ("raft_tpu", "tests", "bench", "ci", "docs", "bench.py",

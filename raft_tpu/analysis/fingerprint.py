@@ -1,0 +1,330 @@
+"""Golden HLO fingerprints: structural regression locks per hot-path program.
+
+The Level-2 auditor (:mod:`raft_tpu.analysis.hlo_audit`) checks DECLARED
+budgets — collective count/bytes, transient ceiling, donation aliasing —
+which bound the failure modes someone thought to declare.  This module
+locks the rest of the lowered STRUCTURE: for every registered
+``@hlo_program`` it extracts a fingerprint from the compiled module —
+
+* **op-class histogram** — instruction count per HLO opcode (``fusion``,
+  ``dot``, ``scatter``, ``while``, ...): the shape of the computation;
+* **fusion count** — the XLA-fusion structure SURVEY §7 names as the
+  hard-won part of the port (a broken fusion shows up as fewer fusions
+  and more loose elementwise ops long before a bench regresses);
+* **collectives + payload bytes** — the exact-match mirror of the
+  declared budget (a budget of "≤1" hides a 0→1 drift; the golden pins
+  the actual count);
+* **dtype set** — every element type appearing in the module (an
+  f32→f64 upcast, or a lost 8-bit path, changes this set);
+* **donation aliases** — the ``input_output_alias`` table entries;
+* **transient bytes** — ``memory_analysis().temp_size_in_bytes``.
+
+— and diffs it against a GOLDEN JSON committed under
+``raft_tpu/analysis/goldens/``.  Exact-match fields (collectives, bytes,
+dtypes, aliases) fail on ANY drift; counting fields (op histogram,
+fusions, transients) get per-field tolerances (:data:`TOLERANCES`) so an
+XLA point release's fusion jitter doesn't cry wolf while a structural
+break still fails.  ``--update-goldens`` regenerates the artifacts —
+deterministically (sorted keys, no timestamps, one trailing newline) so
+the diff lands in the PR review surface, which is the point: an intended
+lowering change is REVIEWED as a golden diff, an unintended one fails CI.
+
+Goldens are per-backend (the fingerprint of an XLA:CPU lowering says
+nothing about the TPU module): a golden recorded on another backend is
+reported as skipped, never silently compared.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+import re
+from typing import Dict, List, Optional, Tuple
+
+from raft_tpu.analysis import hlo_audit, registry
+
+#: committed golden artifacts, one ``<program>.json`` per registry entry
+GOLDEN_DIR = pathlib.Path(__file__).resolve().parent / "goldens"
+
+#: bump when the fingerprint layout changes; a schema-mismatched golden
+#: is a finding asking for --update-goldens, never a silent pass
+SCHEMA = 1
+
+#: instruction line: ``[ROOT] %name = <shape|tuple> opcode(...)`` — the
+#: same skeleton hlo_audit's collective parser matches
+_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*"
+                       r"(\([^)]*\)|\S+)\s+([\w\-]+)\(")
+
+#: Per-field drift tolerances: ``(rel, abs)`` — a counting field may move
+#: by up to max(rel · golden, abs) before the diff fails.  Exact-match
+#: fields (collectives, collective_bytes, dtypes, donation_aliases) are
+#: deliberately NOT here: any drift in those is a contract change.
+TOLERANCES: Dict[str, Tuple[float, int]] = {
+    "ops": (0.25, 2),              # per-opcode count (fusion jitter)
+    "fusions": (0.25, 1),
+    "transient_bytes": (0.25, 4096),
+}
+
+
+@dataclasses.dataclass
+class FingerprintReport:
+    name: str
+    status: str                 # "ok" | "fail" | "skipped" | "updated"
+    findings: List[str]
+    fingerprint: Optional[dict] = None
+
+
+def op_histogram(hlo_text: str) -> Dict[str, int]:
+    """Instruction count per opcode over the whole module text (fused
+    computations included — their bodies ARE the structure being locked).
+    Parameter/constant bookkeeping ops are skipped: their count tracks
+    arity, not structure."""
+    hist: Dict[str, int] = {}
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        op = m.group(2)
+        if op in ("parameter", "constant", "get-tuple-element", "tuple"):
+            continue
+        hist[op] = hist.get(op, 0) + 1
+    return hist
+
+
+def dtype_set(hlo_text: str) -> List[str]:
+    """Sorted set of element dtypes appearing in instruction RESULT shapes
+    (operand repetitions ride along — the set is what matters: an f64 or
+    a lost f8 anywhere in the module changes it)."""
+    out = set()
+    for m in hlo_audit._SHAPE_RE.finditer(hlo_text):
+        if m.group(1) in hlo_audit._DTYPE_BYTES:
+            out.add(m.group(1))
+    return sorted(out)
+
+
+def extract(entry: registry.ProgramEntry) -> dict:
+    """The structural fingerprint of one registry entry's compiled module
+    (compiles via the entry's own builder — the same artifact the budget
+    auditor checks)."""
+    import jax
+
+    compiled, _spec = hlo_audit._compile_entry(entry)
+    text = compiled.as_text()
+    ops = op_histogram(text)
+    count, nbytes, _ = hlo_audit.collective_stats(text)
+    try:
+        temp = int(compiled.memory_analysis().temp_size_in_bytes)
+    except Exception:
+        temp = None
+    return {
+        "schema": SCHEMA,
+        "program": entry.name,
+        "backend": jax.default_backend(),
+        # x64 changes lowered index dtypes (s32→s64 tables) — it is part
+        # of the fingerprint's environment, like the backend
+        "x64": bool(jax.config.jax_enable_x64),
+        "ops": {k: ops[k] for k in sorted(ops)},
+        "fusions": ops.get("fusion", 0),
+        "collectives": count,
+        "collective_bytes": nbytes,
+        "dtypes": dtype_set(text),
+        "donation_aliases": [[i, kind] for i, kind
+                             in hlo_audit.aliased_params(text)],
+        "transient_bytes": temp,
+    }
+
+
+def golden_path(name: str) -> pathlib.Path:
+    return GOLDEN_DIR / f"{name}.json"
+
+
+def dumps(fp: dict) -> str:
+    """Deterministic serialization: sorted keys, fixed indent, one
+    trailing newline, NO timestamps/environment — regenerating an
+    unchanged lowering must produce a byte-identical file."""
+    return json.dumps(fp, indent=2, sort_keys=True) + "\n"
+
+
+def _within(golden_v: int, current_v: int, field: str) -> bool:
+    rel, abs_ = TOLERANCES[field]
+    return abs(current_v - golden_v) <= max(abs_, rel * golden_v)
+
+
+def diff(golden: dict, current: dict) -> List[str]:
+    """Findings where *current* drifts outside *golden*'s tolerances.
+    Empty list == the lowering contract holds."""
+    if golden.get("schema") != current.get("schema"):
+        return [f"golden schema {golden.get('schema')} != "
+                f"{current.get('schema')} — regenerate with "
+                "--update-goldens"]
+    findings: List[str] = []
+
+    # exact-match fields: ANY drift is a contract change
+    if golden["collectives"] != current["collectives"]:
+        findings.append(
+            f"collective launches {current['collectives']} != golden "
+            f"{golden['collectives']} — the program grew or lost a "
+            "collective (the one-launch-per-batch discipline drifted)")
+    if golden["collective_bytes"] != current["collective_bytes"]:
+        findings.append(
+            f"collective payload {current['collective_bytes']} B != "
+            f"golden {golden['collective_bytes']} B")
+    g_dt, c_dt = set(golden["dtypes"]), set(current["dtypes"])
+    if g_dt != c_dt:
+        grew, lost = sorted(c_dt - g_dt), sorted(g_dt - c_dt)
+        bits = []
+        if grew:
+            bits.append(f"gained {grew}")
+        if lost:
+            bits.append(f"lost {lost}")
+        findings.append(
+            f"dtype set drifted ({'; '.join(bits)}) — an upcast (f64 "
+            "appearing) or a lost compressed path (f8/s8 vanishing) "
+            "changes the program's arithmetic contract")
+    if golden["donation_aliases"] != current["donation_aliases"]:
+        findings.append(
+            f"donation aliases {current['donation_aliases']} != golden "
+            f"{golden['donation_aliases']} — an input_output_alias "
+            "appeared or was dropped")
+
+    # tolerance fields
+    if not _within(golden["fusions"], current["fusions"], "fusions"):
+        findings.append(
+            f"fusion count {current['fusions']} outside tolerance of "
+            f"golden {golden['fusions']} — the fusion structure broke "
+            "(loose elementwise ops now pay their own HBM round-trips)")
+    gt, ct = golden.get("transient_bytes"), current.get("transient_bytes")
+    if gt is not None and ct is not None and not _within(
+            gt, ct, "transient_bytes"):
+        findings.append(
+            f"transient {ct} B outside tolerance of golden {gt} B")
+    g_ops, c_ops = golden["ops"], current["ops"]
+    for op in sorted(set(g_ops) | set(c_ops)):
+        gv, cv = g_ops.get(op, 0), c_ops.get(op, 0)
+        if not _within(gv, cv, "ops"):
+            findings.append(
+                f"op-class `{op}` count {cv} outside tolerance of "
+                f"golden {gv}")
+    return findings
+
+
+def run(names: Optional[List[str]] = None, *, update: bool = False,
+        strict: bool = False, out=None,
+        golden_dir: Optional[pathlib.Path] = None
+        ) -> Tuple[List[FingerprintReport], int]:
+    """Fingerprint the registered programs (all, or *names*) and diff each
+    against its committed golden — or rewrite the goldens when *update*.
+    Returns (reports, failure count).  Mirrors the auditor's run contract:
+    a program whose device requirement isn't met is skipped (counted as a
+    failure under ``strict``), full runs enforce the
+    :data:`~raft_tpu.analysis.hlo_audit.MIN_VERIFIED` floor, and STALE
+    goldens (no matching registry entry) fail — a renamed program must
+    move its golden, not orphan it."""
+    import sys
+
+    import jax
+
+    out = out or sys.stdout
+    gdir = pathlib.Path(golden_dir) if golden_dir is not None else GOLDEN_DIR
+    if names:
+        entries = []
+        for n in names:
+            e = registry.get_program(n)
+            if e is None:
+                raise KeyError(
+                    f"unknown hlo program {n!r} (registered: "
+                    f"{[p.name for p in registry.iter_programs()]})")
+            entries.append(e)
+    else:
+        entries = registry.iter_programs()
+    reports, failed = [], 0
+    if update:
+        gdir.mkdir(parents=True, exist_ok=True)
+    for e in entries:
+        if len(jax.devices()) < e.requires_devices:
+            reports.append(FingerprintReport(
+                e.name, "skipped",
+                [], None))
+            print(f"  [skipped] {e.name:32s} needs >= "
+                  f"{e.requires_devices} devices", file=out)
+            continue
+        try:
+            fp = extract(e)
+        except Exception as ex:
+            reports.append(FingerprintReport(
+                e.name, "fail", [f"fingerprint extraction failed: {ex!r}"]))
+            failed += 1
+            print(f"  [   fail] {e.name:32s} extraction failed: {ex!r}",
+                  file=out)
+            continue
+        path = gdir / f"{e.name}.json"
+        if update:
+            path.write_text(dumps(fp))
+            reports.append(FingerprintReport(e.name, "updated", [], fp))
+            print(f"  [updated] {e.name:32s} -> {path.name}", file=out)
+            continue
+        if not path.exists():
+            reports.append(FingerprintReport(
+                e.name, "fail",
+                ["no golden committed — run `python -m raft_tpu.analysis "
+                 "--update-goldens` and commit the artifact"], fp))
+            failed += 1
+            print(f"  [   fail] {e.name:32s} no golden", file=out)
+            continue
+        golden = json.loads(path.read_text())
+        if (golden.get("backend"), golden.get("x64")) != (
+                fp["backend"], fp["x64"]):
+            reports.append(FingerprintReport(
+                e.name, "skipped", [], fp))
+            print(f"  [skipped] {e.name:32s} golden is for "
+                  f"backend={golden.get('backend')!r} "
+                  f"x64={golden.get('x64')}, running with "
+                  f"backend={fp['backend']!r} x64={fp['x64']}", file=out)
+            continue
+        findings = diff(golden, fp)
+        status = "fail" if findings else "ok"
+        failed += status == "fail"
+        reports.append(FingerprintReport(e.name, status, findings, fp))
+        summary = (f"ops {sum(fp['ops'].values())} fus {fp['fusions']} "
+                   f"coll {fp['collectives']}/{fp['collective_bytes']}B "
+                   f"dtypes {','.join(fp['dtypes'])}")
+        print(f"  [{status:>7}] {e.name:32s} {summary}", file=out)
+        for f in findings:
+            print(f"           - {f}", file=out)
+    # stale goldens: artifacts for programs that no longer exist
+    if names is None and not update and gdir.is_dir():
+        known = {e.name for e in entries}
+        for stale in sorted(gdir.glob("*.json")):
+            if stale.stem not in known:
+                failed += 1
+                print(f"  [   fail] {stale.stem:32s} STALE golden (no "
+                      "registered program) — delete it or re-run "
+                      "--update-goldens", file=out)
+                reports.append(FingerprintReport(
+                    stale.stem, "fail", ["stale golden artifact"]))
+    verified = sum(r.status == "ok" for r in reports)
+    updated = sum(r.status == "updated" for r in reports)
+    skipped = sum(r.status == "skipped" for r in reports)
+    print(f"fingerprint: {verified} verified, {updated} updated, "
+          f"{failed} failed, {skipped} skipped", file=out)
+    if strict and skipped:
+        print(f"fingerprint: STRICT — {skipped} skipped program(s) count "
+              "as failures", file=out)
+        failed += skipped
+    if names is None and not update and \
+            verified < hlo_audit.MIN_VERIFIED:
+        print(f"fingerprint: only {verified} verified < the "
+              f"{hlo_audit.MIN_VERIFIED}-program acceptance floor for a "
+              "full run", file=out)
+        failed += 1
+    if update:
+        # update is only half the flow: prune goldens orphaned by renames
+        known = {e.name for e in entries}
+        if names is None:
+            for stale in sorted(gdir.glob("*.json")):
+                if stale.stem not in known:
+                    stale.unlink()
+                    print(f"  [ pruned] {stale.stem:32s} stale golden "
+                          "removed", file=out)
+    return reports, failed

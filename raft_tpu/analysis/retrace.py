@@ -1,0 +1,603 @@
+"""Static retrace-closure certifier: prove zero-compile serving from source.
+
+The runtime zero-retrace asserts (``aot_compile_counters`` diffs around
+steady-state traffic) catch a compile-per-request bug only when a bench or
+test actually drives the leaking signature.  This module proves the
+closure STATICALLY, from the AST of the serving layer, so the class of bug
+that turns zero-compile serving into a compile-per-request outage fails CI
+before any traffic exists.  Three certificate families
+(docs/static_analysis.md §retrace certifier):
+
+1. **Warm/dispatch congruence** (``serve.warm_dispatch.<Class>``) —
+   every backend class (and the :class:`ShardedSearcher` they delegate
+   to) must build its ``warm()`` lowering and its ``dispatch()`` call
+   from the SAME terminal callee and the SAME argument skeleton, with
+   only the query leaf differing (a ``ShapeDtypeStruct``/``_q_spec``
+   spec on the warm side, the request batch on the dispatch side).  The
+   calls are normalized — the warm-side spec and every dispatch-side
+   query-derived name (value-flow taint from the method's parameters)
+   collapse to one QUERY marker, a trailing ``.compiled`` is stripped —
+   and compared structurally.  If they match, the steady-state dispatch
+   signature space differs from the warmable space only in the query
+   leaf's (bucket, dtype): exactly what ``warmup()`` enumerates.
+
+2. **Bucket closure** (``serve.bucket_closure``) — the engine's planner
+   must only emit query buckets ``warmup()`` can pre-lower: ``warmup``'s
+   default enumeration is the power-of-two ladder up to ``max_batch``,
+   ``_bucket_for`` picks ``_bucket_dim`` (the same ladder) clamped to
+   ``max_batch`` or a member of the warmed set, the assembled super-batch
+   block is allocated AT that bucket and is what ``dispatch`` receives,
+   and oversized requests fall back to the backend's public ``solo``
+   entry point (where compiles are sanctioned).  Each of these is one
+   named obligation; refactoring the engine incompatibly fails the
+   certificate loudly — that is the lock working.
+
+3. **Static-arg cardinality** (``retrace.static_cardinality``) — every
+   call site of a module-level ``aot()``-wrapped function is scanned:
+   a STATIC argument position fed a value of unbounded cardinality
+   (``.shape``/``.size``/``.ndim`` extraction, ``len(...)`` — data-
+   dependent numbers that vary per request) mints one executable per
+   distinct value.  Passing such a value through a declared BOUNDING
+   function (``_bucket_dim``'s power-of-two ladder, ``min``/``max``
+   against a bounded cap) restores a finite signature space and passes.
+
+The certifier is STDLIB-static: it parses source, lowers nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from raft_tpu.analysis import dataflow
+from raft_tpu.analysis.engine import REPO_ROOT, collect_files
+
+#: the serving layer whose closure is certified: backend adapters +
+#: engine live here, the sharded searcher they delegate to lives there
+SERVE_MODULES = ("raft_tpu/serve/engine.py",
+                 "raft_tpu/neighbors/ann_mnmg.py")
+
+#: functions that map an unbounded value onto a finite signature ladder
+BOUNDING_FNS = frozenset({"_bucket_dim", "bucket_dim"})
+
+#: attribute/introspection surfaces that extract per-request-varying
+#: numbers from dynamic data
+_UNBOUNDED_ATTRS = frozenset({"shape", "size", "ndim", "nbytes"})
+
+
+@dataclasses.dataclass
+class ObligationReport:
+    name: str
+    status: str            # "ok" | "fail"
+    findings: List[str]
+    detail: str = ""
+
+
+# ---------------------------------------------------------------------------
+# certificate 1: warm/dispatch congruence
+
+
+def _method(cls: ast.ClassDef, name: str) -> Optional[ast.FunctionDef]:
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node
+    return None
+
+
+def _terminal_call(fn: ast.FunctionDef) -> Optional[ast.Call]:
+    """The method's LAST top-level call statement — ``return f(...)`` or a
+    bare ``f(...)`` expression (warm() lowers for effect)."""
+    for node in reversed(fn.body):
+        if isinstance(node, ast.Return) and isinstance(node.value,
+                                                       ast.Call):
+            return node.value
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            return node.value
+    return None
+
+
+def _normalize(node, query_names: frozenset) -> str:
+    """Structural skeleton of a call/expression with the query leaf
+    collapsed to QUERY and ``.compiled`` stripped — the comparable form of
+    a warm lowering vs a dispatch call."""
+    if isinstance(node, ast.Call):
+        callee = _normalize(node.func, query_names)
+        if callee.endswith((".ShapeDtypeStruct", "._q_spec")) \
+                or callee == "ShapeDtypeStruct":
+            return "QUERY"
+        if callee.endswith(".compiled"):
+            callee = callee[:-len(".compiled")]
+        args = [_normalize(a, query_names) for a in node.args]
+        kws = [f"{kw.arg}={_normalize(kw.value, query_names)}"
+               for kw in node.keywords]
+        return f"{callee}({', '.join(args + kws)})"
+    if isinstance(node, ast.Starred):
+        return f"*{_normalize(node.value, query_names)}"
+    if isinstance(node, ast.Attribute):
+        return f"{_normalize(node.value, query_names)}.{node.attr}"
+    if isinstance(node, ast.Name):
+        return "QUERY" if node.id in query_names else node.id
+    if isinstance(node, ast.Constant):
+        return repr(node.value)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return f"({', '.join(_normalize(e, query_names) for e in node.elts)})"
+    return ast.dump(node)
+
+
+def _query_names(fn: ast.FunctionDef, flow: dataflow.ValueFlow
+                 ) -> frozenset:
+    """The method's parameters plus every local name value-flow-derived
+    from them (``q = ...globalize(jnp.asarray(qb), ...)`` → q) — the
+    names that ARE the query on the dispatch side."""
+    params = {a.arg for a in fn.args.args if a.arg != "self"}
+    derived = set(params)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            roots = flow.param_roots(node.value)
+            if roots & params:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        derived.add(t.id)
+    return frozenset(derived)
+
+
+def _delegation(call: ast.Call) -> Optional[Tuple[str, str]]:
+    """(base skeleton, method) when the call is ``<base>.<method>(...)`` —
+    the delegating-adapter form (``self.searcher.warm(...)``)."""
+    if isinstance(call.func, ast.Attribute):
+        return (_normalize(call.func.value, frozenset()), call.func.attr)
+    return None
+
+
+def certify_warm_dispatch(files: Dict[str, ast.Module],
+                          flows: Dict[str, dataflow.ValueFlow]
+                          ) -> List[ObligationReport]:
+    reports: List[ObligationReport] = []
+    pairs = 0
+    for posix, tree in files.items():
+        flow = flows[posix]
+        for cls in [n for n in ast.walk(tree)
+                    if isinstance(n, ast.ClassDef)]:
+            warm, disp = _method(cls, "warm"), _method(cls, "dispatch")
+            if warm is None and disp is None:
+                continue
+            name = f"serve.warm_dispatch.{cls.name}"
+            findings: List[str] = []
+            if warm is None or disp is None:
+                missing = "warm" if warm is None else "dispatch"
+                reports.append(ObligationReport(
+                    name, "fail",
+                    [f"class defines {'dispatch' if warm is None else 'warm'}"
+                     f" but no {missing}() — its signatures can never be "
+                     "pre-lowered (every dispatch is a potential compile)"]))
+                continue
+            wc, dc = _terminal_call(warm), _terminal_call(disp)
+            if wc is None or dc is None:
+                reports.append(ObligationReport(
+                    name, "fail",
+                    ["warm()/dispatch() terminal call not found — the "
+                     "certifier cannot prove the pair congruent"]))
+                continue
+            wdel, ddel = _delegation(wc), _delegation(dc)
+            if (wdel and ddel and wdel[0] == ddel[0]
+                    and wdel[1] == "warm" and ddel[1] == "dispatch"):
+                pairs += 1
+                reports.append(ObligationReport(
+                    name, "ok", [],
+                    f"delegates both to `{wdel[0]}` (certified at its "
+                    "class)"))
+                continue
+            wn = _normalize(wc, frozenset())
+            dn = _normalize(dc, _query_names(disp, flow))
+            if wn != dn:
+                findings.append(
+                    f"warm() lowers `{wn}` but dispatch() calls `{dn}` — "
+                    "the steady-state signature space is NOT the warmed "
+                    "space (a dispatch-only static/arg mints executables "
+                    "warmup never pre-lowered)")
+            if "QUERY" not in wn:
+                findings.append(
+                    "warm() lowering has no ShapeDtypeStruct/_q_spec "
+                    "query spec — it cannot enumerate (bucket, dtype) "
+                    "signatures")
+            pairs += 1
+            reports.append(ObligationReport(
+                name, "fail" if findings else "ok", findings,
+                "" if findings else f"`{wn}`"))
+    if pairs == 0:
+        reports.append(ObligationReport(
+            "serve.warm_dispatch", "fail",
+            ["no warm/dispatch class pairs found in the serving layer — "
+             "the certificate has nothing to prove (moved modules? update "
+             "SERVE_MODULES)"]))
+    return reports
+
+
+def certify_backend_coverage(files: Dict[str, ast.Module]
+                             ) -> List[ObligationReport]:
+    """Every class ``_make_backend`` can return must BE one of the
+    certified warm/dispatch classes — a new backend kind cannot ship
+    without entering the certificate."""
+    tree = files.get("raft_tpu/serve/engine.py")
+    if tree is None:
+        return [ObligationReport(
+            "serve.backends_cover", "fail",
+            ["raft_tpu/serve/engine.py not found"])]
+    classes = {n.name for n in ast.walk(tree)
+               if isinstance(n, ast.ClassDef)}
+    maker = None
+    for n in ast.walk(tree):
+        if isinstance(n, ast.FunctionDef) and n.name == "_make_backend":
+            maker = n
+            break
+    if maker is None:
+        return [ObligationReport(
+            "serve.backends_cover", "fail",
+            ["_make_backend not found — backend construction moved; "
+             "update the certificate"])]
+    findings = []
+    returned = []
+    for n in ast.walk(maker):
+        if isinstance(n, ast.Return) and isinstance(n.value, ast.Call) \
+                and isinstance(n.value.func, ast.Name):
+            returned.append(n.value.func.id)
+            if n.value.func.id not in classes:
+                findings.append(
+                    f"_make_backend returns `{n.value.func.id}` which is "
+                    "not a class in the serving module — the certifier "
+                    "cannot see its warm/dispatch pair")
+    if not returned:
+        findings.append("_make_backend has no class-constructor returns")
+    return [ObligationReport(
+        "serve.backends_cover", "fail" if findings else "ok", findings,
+        f"backends: {', '.join(returned)}")]
+
+
+# ---------------------------------------------------------------------------
+# certificate 2: bucket closure in ServeEngine
+
+
+def _engine_obligations(cls: ast.ClassDef) -> List[ObligationReport]:
+    out: List[ObligationReport] = []
+
+    def obligation(name, ok, why_fail, detail=""):
+        out.append(ObligationReport(
+            f"serve.bucket_closure.{name}", "ok" if ok else "fail",
+            [] if ok else [why_fail], detail))
+
+    # warmup(): default enumeration is the power-of-two ladder capped at
+    # max_batch, and every bucket is both pre-lowered (backend.warm) and
+    # recorded in the warmed registry
+    warmup = _method(cls, "warmup")
+    if warmup is None:
+        obligation("warmup", False,
+                   "ServeEngine.warmup() not found — the warmable set has "
+                   "no definition to certify against")
+    else:
+        src_dump = ast.dump(warmup)
+        ladder = ("LShift" in src_dump or "Mult" in src_dump) \
+            and any(isinstance(n, ast.While) for n in ast.walk(warmup))
+        obligation(
+            "warmup.ladder", ladder,
+            "warmup()'s default bucket enumeration no longer doubles up "
+            "to max_batch — it must generate the SAME ladder _bucket_for "
+            "picks from, or the planner emits unwarmed buckets")
+        warms = any(isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "warm" for n in ast.walk(warmup))
+        obligation(
+            "warmup.prelowers", warms,
+            "warmup() never calls the backend's warm() — nothing is "
+            "pre-lowered")
+        records = any(isinstance(n, ast.Attribute)
+                      and n.attr == "_warmed" for n in ast.walk(warmup))
+        obligation(
+            "warmup.records", records,
+            "warmup() does not record buckets in the warmed registry — "
+            "_bucket_for cannot see what was pinned")
+
+    # _bucket_for(): ladder pick clamped to max_batch, or a warmed member
+    bucket_for = _method(cls, "_bucket_for")
+    if bucket_for is None:
+        obligation("bucket_for", False,
+                   "ServeEngine._bucket_for() not found — bucket choice "
+                   "moved; re-prove the closure and update the certifier")
+    else:
+        uses_ladder = any(
+            isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+            and n.func.id in BOUNDING_FNS for n in ast.walk(bucket_for))
+        obligation(
+            "bucket_for.ladder", uses_ladder,
+            "_bucket_for no longer derives its bucket from _bucket_dim — "
+            "the planner's buckets and warmup()'s ladder diverged")
+        clamps = any(isinstance(n, ast.Attribute) and n.attr == "max_batch"
+                     for n in ast.walk(bucket_for))
+        obligation(
+            "bucket_for.clamped", clamps,
+            "_bucket_for does not clamp to max_batch — it can emit a "
+            "bucket above every warmed signature")
+
+    # _search_locked(): the dispatched block is allocated AT the chosen
+    # bucket, and oversize requests take the public solo path
+    search = _method(cls, "_search_locked") or _method(cls, "search")
+    if search is None:
+        obligation("dispatch_path", False,
+                   "ServeEngine._search_locked()/search() not found")
+    else:
+        bucket_names = set()
+        for n in ast.walk(search):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                f = n.value.func
+                if isinstance(f, ast.Attribute) and f.attr == "_bucket_for":
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            bucket_names.add(t.id)
+        obligation(
+            "dispatch.bucket_chosen", bool(bucket_names),
+            "_search_locked never consults _bucket_for — dispatch shapes "
+            "are no longer drawn from the certified ladder")
+        block_names = set()
+        for n in ast.walk(search):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                args = n.value.args
+                if args and isinstance(args[0], (ast.Tuple, ast.List)) \
+                        and args[0].elts \
+                        and isinstance(args[0].elts[0], ast.Name) \
+                        and args[0].elts[0].id in bucket_names:
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            block_names.add(t.id)
+        obligation(
+            "dispatch.block_at_bucket", bool(block_names),
+            "the assembled super-batch block is not allocated at the "
+            "chosen bucket — dispatch sees raw ragged shapes (one "
+            "executable per distinct total)")
+        dispatched = False
+        for n in ast.walk(search):
+            if isinstance(n, ast.Call) and isinstance(n.func,
+                                                      ast.Attribute) \
+                    and n.func.attr == "dispatch":
+                names = {x.id for x in ast.walk(n)
+                         if isinstance(x, ast.Name)}
+                if names & block_names:
+                    dispatched = True
+        obligation(
+            "dispatch.receives_block", dispatched,
+            "backend.dispatch() does not receive the bucket-shaped "
+            "block — the padded assembly and the dispatch diverged")
+        solo = any(isinstance(n, ast.Call)
+                   and isinstance(n.func, ast.Attribute)
+                   and n.func.attr == "solo" for n in ast.walk(search))
+        obligation(
+            "dispatch.solo_fallback", solo,
+            "no solo fallback call — oversize requests would dispatch "
+            "through the coalesced path with an unwarmed bucket")
+    return out
+
+
+def certify_bucket_closure(files: Dict[str, ast.Module]
+                           ) -> List[ObligationReport]:
+    tree = files.get("raft_tpu/serve/engine.py")
+    if tree is None:
+        return [ObligationReport(
+            "serve.bucket_closure", "fail",
+            ["raft_tpu/serve/engine.py not found"])]
+    for n in ast.walk(tree):
+        if isinstance(n, ast.ClassDef) and n.name == "ServeEngine":
+            return _engine_obligations(n)
+    return [ObligationReport(
+        "serve.bucket_closure", "fail",
+        ["class ServeEngine not found — the engine moved; update the "
+         "certificate"])]
+
+
+# ---------------------------------------------------------------------------
+# certificate 3: static-arg value cardinality at aot() call sites
+
+
+def _aot_statics(tree: ast.Module, flow: dataflow.ValueFlow
+                 ) -> Dict[str, Tuple[int, ...]]:
+    """Module-level names bound to ``aot()``/``mesh_aot()``/
+    ``AotFunction``/``MeshAotFunction`` wrappers → their static argnums
+    (value-flow-resolved through module constants)."""
+    out: Dict[str, Tuple[int, ...]] = {}
+
+    def wrapper_statics(call) -> Optional[Tuple[int, ...]]:
+        if not isinstance(call, ast.Call):
+            return None
+        f = call.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if fname not in ("aot", "mesh_aot", "AotFunction",
+                         "MeshAotFunction"):
+            return None
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                v = flow.const_value(kw.value)
+                if isinstance(v, int):
+                    return (v,)
+                if isinstance(v, tuple) and all(
+                        isinstance(x, int) for x in v):
+                    return v
+                return None
+        # positional static_argnums (AotFunction(fn, statics))
+        if fname in ("AotFunction", "MeshAotFunction") \
+                and len(call.args) >= 2:
+            v = flow.const_value(call.args[1])
+            if isinstance(v, tuple) and all(isinstance(x, int) for x in v):
+                return v
+        return ()
+
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            nums = wrapper_statics(node.value)
+            if nums:
+                out[node.targets[0].id] = nums
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                nums = wrapper_statics(dec)
+                if nums:
+                    out[node.name] = nums
+    return out
+
+
+def _bounded(expr: ast.AST, flow: dataflow.ValueFlow, hops: int = 8,
+             seen: Optional[frozenset] = None) -> bool:
+    """True when the expression's VALUE cardinality is finite over a
+    serving process's lifetime: constants, caller-owned parameters passed
+    verbatim, module symbols, and anything routed through a bounding
+    ladder.  ``.shape``/``.size``/``len()`` extractions are per-request-
+    varying data unless a bounding call wraps them.  A name whose binding
+    chain loops back to ITSELF (``metric = DistanceType(metric)`` — the
+    coercion-rebind idiom) roots at the caller-owned parameter and is
+    bounded."""
+    if hops <= 0:
+        return False
+    seen = seen or frozenset()
+
+    def rec(e):
+        return _bounded(e, flow, hops - 1, seen)
+
+    if isinstance(expr, ast.Constant):
+        return True
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in _UNBOUNDED_ATTRS:
+            return False
+        return True  # config/self attributes: per-object, finite
+    if isinstance(expr, ast.Subscript):
+        return rec(expr.value)
+    if isinstance(expr, ast.Name):
+        if expr.id in seen:
+            return True          # self-referential rebind: caller-owned
+        scope = flow.scope_of(expr)
+        bound = scope.lookup(expr.id)
+        if bound is None:
+            return True          # builtins/globals: finite
+        kind, val = bound
+        if kind in ("mod", "fn", "param"):
+            return True          # verbatim pass-through: caller-owned
+        return _bounded(val, flow, hops - 1, seen | {expr.id})
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if fname in BOUNDING_FNS:
+            return True          # the power-of-two ladder: log-many values
+        if fname == "len":
+            return False
+        if fname in ("min", "max"):
+            # a bounded cap bounds the whole expression
+            return any(rec(a) for a in expr.args)
+        return all(rec(a) for a in expr.args)
+    if isinstance(expr, ast.BinOp):
+        return rec(expr.left) and rec(expr.right)
+    if isinstance(expr, ast.UnaryOp):
+        return rec(expr.operand)
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return all(rec(e) for e in expr.elts)
+    if isinstance(expr, ast.IfExp):
+        return rec(expr.body) and rec(expr.orelse)
+    return True
+
+
+def scan_static_cardinality(posix: str, tree: ast.Module,
+                            flow: dataflow.ValueFlow, lines: List[str]
+                            ) -> List[str]:
+    """Findings for unbounded-cardinality static args at this file's
+    aot-wrapper call sites.  The unified exemption marker
+    (``# exempt(retrace-unbounded-static): why``) sanctions a site."""
+    statics = _aot_statics(tree, flow)
+    if not statics:
+        return []
+
+    def exempt(lineno):
+        for ln in lines[max(0, lineno - 2):lineno]:
+            if "exempt(retrace-unbounded-static)" in ln and ":" in ln:
+                return True
+        return False
+
+    findings = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in statics):
+            continue
+        for pos in statics[node.func.id]:
+            if pos >= len(node.args):
+                continue
+            arg = node.args[pos]
+            if _bounded(arg, flow):
+                continue
+            if exempt(arg.lineno):
+                continue
+            findings.append(
+                f"{posix}:{arg.lineno}: static arg {pos} of "
+                f"`{node.func.id}` has unbounded value cardinality "
+                f"(`{ast.dump(arg)[:80]}`) — a data-dependent static "
+                "mints one executable per distinct value "
+                "(compile-per-request); route it through _bucket_dim or "
+                "a bounded cap, or mark the line "
+                "exempt(retrace-unbounded-static) with why")
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# the runner
+
+
+def run(names: Optional[Sequence[str]] = None, *, out=None,
+        roots: Optional[Sequence[str]] = None
+        ) -> Tuple[List[ObligationReport], int]:
+    """Run the certificates; *names* filters obligations by substring
+    (the ``--programs`` contract), *roots* overrides the cardinality
+    scan's file set (quarantine tests point it at a tmp module)."""
+    import sys
+
+    out = out or sys.stdout
+    serve_files: Dict[str, ast.Module] = {}
+    serve_flows: Dict[str, dataflow.ValueFlow] = {}
+    for rel in SERVE_MODULES:
+        p = REPO_ROOT / rel
+        if p.is_file():
+            tree = ast.parse(p.read_text())
+            serve_files[rel] = tree
+            serve_flows[rel] = dataflow.ValueFlow(tree)
+    reports: List[ObligationReport] = []
+    reports.extend(certify_warm_dispatch(serve_files, serve_flows))
+    reports.extend(certify_backend_coverage(serve_files))
+    reports.extend(certify_bucket_closure(serve_files))
+
+    # cardinality scan over the library (or the caller-supplied roots)
+    card_findings: List[str] = []
+    scan_roots = list(roots) if roots is not None else [
+        str(REPO_ROOT / "raft_tpu")]
+    for f in collect_files(scan_roots):
+        try:
+            tree = ast.parse(f.read_text())
+        except SyntaxError:
+            continue
+        flow = dataflow.ValueFlow(tree)
+        card_findings.extend(scan_static_cardinality(
+            f.as_posix(), tree, flow, f.read_text().splitlines()))
+    reports.append(ObligationReport(
+        "retrace.static_cardinality",
+        "fail" if card_findings else "ok", card_findings,
+        f"{len(scan_roots)} root(s) scanned"))
+
+    if names:
+        reports = [r for r in reports
+                   if any(n in r.name for n in names)]
+    failed = 0
+    for r in reports:
+        failed += r.status == "fail"
+        print(f"  [{r.status:>7}] {r.name:44s} {r.detail}", file=out)
+        for f in r.findings:
+            print(f"           - {f}", file=out)
+    ok = sum(r.status == "ok" for r in reports)
+    print(f"retrace: {ok} obligation(s) certified, {failed} failed",
+          file=out)
+    return reports, failed

@@ -7,7 +7,14 @@ benches assert their launch budgets against (one-allreduce-per-EM-
 iteration, one-allgather-per-search-batch).  A raw ``lax.psum`` in a shard
 program is invisible to that counter: the budget assert still passes while
 the program grows chattier.  ``jax.lax.axis_index`` is NOT banned (rank
-lookup moves no payload)."""
+lookup moves no payload).
+
+Dataflow-ported (docs/static_analysis.md §dataflow engine): the callee of
+every call is resolved through the file's value-flow, so single-hop
+laundering — ``g = jax.lax.psum; g(x)``, ``from jax.lax import psum as
+p``, a helper whose body returns the primitive — fires at the CALL line,
+not just (if at all) at the rebind.  The syntactic attribute/import
+matchers remain as a second net for un-called references."""
 
 from __future__ import annotations
 
@@ -22,16 +29,29 @@ BANNED_COLLECTIVES = frozenset({
     "all_to_all",
 })
 
+#: canonical dotted paths the value-flow resolves laundered callees to
+_BANNED_PATHS = frozenset(f"jax.lax.{c}" for c in BANNED_COLLECTIVES)
+
 
 def _scope(posix: str) -> bool:
     return "raft_tpu/" in posix and "raft_tpu/comms/" not in posix
 
 
 @rule("collective-discipline", scope=_scope,
-      doc="raw jax.lax collectives outside comms/ escape the "
-          "collective_calls accounting")
+      doc="raw jax.lax collectives outside comms/ (incl. laundered "
+          "aliases) escape the collective_calls accounting")
 def check_collectives(ctx):
-    findings = []
+    found = {}  # (lineno, name) -> message  (dedupe syntactic vs dataflow)
+
+    def add(lineno, name, how):
+        if ctx.exempt("collective-discipline", lineno):
+            return
+        found.setdefault((lineno, name), (
+            f"raw collective {name}{how} outside comms/ — it escapes the "
+            "Comms.collective_calls byte/count accounting (launch/payload "
+            "budget asserts go blind); route it through the Comms "
+            "wrappers, or mark the line exempt(collective-discipline)"))
+
     lax_aliases = set()      # names that mean jax.lax in this module
     direct_imports = set()   # collective names imported bare
     for node in ast.walk(ctx.tree):
@@ -43,13 +63,14 @@ def check_collectives(ctx):
                         direct_imports.add(a.asname or a.name)
                         if not ctx.exempt("collective-discipline",
                                           node.lineno):
-                            findings.append((
-                                node.lineno,
-                                f"`from jax.lax import {a.name}` outside "
-                                "comms/ — collectives must launch through "
-                                "the Comms wrappers so collective_calls "
-                                "byte/count accounting sees them, or mark "
-                                "the line exempt(collective-discipline)"))
+                            found.setdefault(
+                                (node.lineno, a.name), (
+                                    f"`from jax.lax import {a.name}` "
+                                    "outside comms/ — collectives must "
+                                    "launch through the Comms wrappers so "
+                                    "collective_calls byte/count "
+                                    "accounting sees them, or mark the "
+                                    "line exempt(collective-discipline)"))
             elif node.module == "jax":
                 for a in node.names:
                     if a.name == "lax":
@@ -60,24 +81,27 @@ def check_collectives(ctx):
                     lax_aliases.add(a.asname)
     lax_aliases.add("lax")
     for node in ast.walk(ctx.tree):
-        name = None
-        if isinstance(node, ast.Attribute) and node.attr in BANNED_COLLECTIVES:
+        if isinstance(node, ast.Attribute) \
+                and node.attr in BANNED_COLLECTIVES:
             base = node.value
             if ((isinstance(base, ast.Attribute) and base.attr == "lax")
                     or (isinstance(base, ast.Name)
                         and base.id in lax_aliases)):
-                name = f"lax.{node.attr}"
-        elif (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
-              and node.func.id in direct_imports):
-            name = node.func.id
-        if name is None:
-            continue
-        if ctx.exempt("collective-discipline", node.lineno):
-            continue
-        findings.append((
-            node.lineno,
-            f"raw collective {name} outside comms/ — it escapes the "
-            "Comms.collective_calls byte/count accounting (launch/payload "
-            "budget asserts go blind); route it through the Comms "
-            "wrappers, or mark the line exempt(collective-discipline)"))
-    return findings
+                add(node.lineno, f"lax.{node.attr}", "")
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in direct_imports:
+                add(node.lineno, f.id, "")
+                continue
+            # the dataflow net: resolve the callee through assignment
+            # chains / aliased imports / helper returns
+            path = ctx.flow.resolve_call(node)
+            if path in _BANNED_PATHS:
+                label = path[len("jax."):]  # "lax.psum"
+                spelled = (f.id if isinstance(f, ast.Name)
+                           else getattr(f, "attr", "?"))
+                how = ("" if spelled == path.rsplit(".", 1)[-1]
+                       else f" (laundered as `{spelled}`)")
+                add(node.lineno, label, how)
+    return [(lineno, msg)
+            for (lineno, _), msg in sorted(found.items())]

@@ -8,13 +8,24 @@ preceding comment (the codebase's existing idiom: "exact f64 widening
 under x64"), or carry the unified exemption marker with a rationale
 (host-side numpy code that never becomes device constants).
 ``raft_tpu/native/`` is out of scope — host FFI marshaling is definitionally
-host-side."""
+host-side.
+
+Dataflow-ported (docs/static_analysis.md §dataflow engine): any NAME or
+attribute that resolves through the file's value-flow to
+``numpy.float64`` / ``jax.numpy.float64`` fires at its USE line — so
+``f64 = np.float64; x.astype(f64)``, ``from numpy import float64 as wide``
+and helper-returned dtypes no longer slip past the literal matcher."""
 
 from __future__ import annotations
 
 import ast
 
 from raft_tpu.analysis.engine import rule
+
+#: canonical paths that mean "a 64-bit float dtype object"
+_F64_PATHS = frozenset({
+    "numpy.float64", "jax.numpy.float64", "jax.float64", "numpy.double",
+})
 
 
 def _scope(posix: str) -> bool:
@@ -32,29 +43,56 @@ def _x64_marked(lines, lineno: int) -> bool:
 
 
 @rule("dtype-drift", scope=_scope,
-      doc="float64 in library code outside x64-marked lines")
+      doc="float64 (incl. laundered aliases) in library code outside "
+          "x64-marked lines")
 def check_dtype_drift(ctx):
-    findings = []
-    for node in ast.walk(ctx.tree):
-        name = None
-        if isinstance(node, ast.Attribute) and node.attr == "float64":
-            base = node.value
-            if isinstance(base, ast.Name) and base.id in ("np", "numpy",
-                                                          "jnp", "jax"):
-                name = f"{base.id}.float64"
-        elif (isinstance(node, ast.Constant)
-              and node.value == "float64"):
-            name = '"float64"'
-        if name is None:
-            continue
-        if _x64_marked(ctx.lines, node.lineno):
-            continue
-        if ctx.exempt("dtype-drift", node.lineno):
-            continue
-        findings.append((
-            node.lineno,
+    found = {}  # (lineno, name) -> message
+
+    def add(lineno, name):
+        if _x64_marked(ctx.lines, lineno):
+            return
+        if ctx.exempt("dtype-drift", lineno):
+            return
+        found.setdefault((lineno, name), (
             f"{name} outside an x64-marked line — without jax_enable_x64 "
             "this silently demotes to float32 (and on TPU f64 leaves the "
             "MXU); if the line is genuinely x64-gated note `x64` in its "
             "comment, otherwise mark it exempt(dtype-drift) with why"))
-    return findings
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Attribute) and node.attr == "float64":
+            base = node.value
+            if isinstance(base, ast.Name) and base.id in ("np", "numpy",
+                                                          "jnp", "jax"):
+                add(node.lineno, f"{base.id}.float64")
+                continue
+            # laundered base: `x = jnp; x.float64`
+            path = ctx.flow.resolve(node)
+            if path in _F64_PATHS:
+                add(node.lineno, f"{path} (via `{base.id}.float64`)"
+                    if isinstance(base, ast.Name) else path)
+        elif isinstance(node, ast.Constant) and node.value == "float64":
+            add(node.lineno, '"float64"')
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            # a bare name that RESOLVES to the f64 dtype: from-import
+            # aliases, local rebinds, helper returns.  A sanction marker
+            # at any laundering HOP (an x64-marked conditional rebind, an
+            # exempt-marked alias line) sanctions the uses too — the hop
+            # is where the justification lives.
+            hops: list = []
+            path = ctx.flow.resolve(node, trace=hops)
+            if path in _F64_PATHS and not any(
+                    _x64_marked(ctx.lines, h)
+                    or ctx.exempt("dtype-drift", h) for h in hops):
+                add(node.lineno, f"{path} (laundered as `{node.id}`)")
+    # aliased from-imports fire at the import line too: the binding is
+    # the laundering hop
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) and node.module in (
+                "numpy", "jax.numpy"):
+            for a in node.names:
+                if a.name in ("float64", "double"):
+                    add(node.lineno,
+                        f"`from {node.module} import {a.name}`")
+    return [(lineno, msg)
+            for (lineno, _), msg in sorted(found.items())]

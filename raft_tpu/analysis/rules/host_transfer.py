@@ -7,7 +7,12 @@ Registry entries may scope the ban to named functions (only the fused-EM
 loop of ``kmeans.py`` is hot, not its training prologue); sanctioned
 bookkeeping fetches carry the unified marker with a rationale.  Pure-numpy
 table arithmetic on host data (np.arange/zeros/...) is not a transfer and
-is not flagged."""
+is not flagged.
+
+Dataflow-ported (docs/static_analysis.md §dataflow engine): call callees
+resolve through the file's value-flow, so ``g = np.asarray; g(x)``,
+``from numpy import asarray as pull`` and helper-returned fetchers fire
+at the call line the syntactic matcher missed."""
 
 from __future__ import annotations
 
@@ -22,8 +27,14 @@ from raft_tpu.analysis.engine import call_name, rule
 _HOST_TRANSFER_CALLS = ("asarray", "array", "device_get",
                         "addressable_data", "block_until_ready")
 
+#: canonical paths the value-flow resolves laundered fetch callees to
+_HOST_TRANSFER_PATHS = frozenset({
+    "numpy.asarray", "numpy.array", "jax.device_get",
+    "jax.block_until_ready",
+})
 
-def _transfer_name(node):
+
+def _transfer_name(node, flow=None):
     """The banned-surface name this node uses, or None."""
     if isinstance(node, ast.Call):
         cname = call_name(node)
@@ -36,6 +47,16 @@ def _transfer_name(node):
                     and isinstance(f.value, ast.Name)
                     and f.value.id == "np"):
                 return f"np.{cname}"
+        if flow is not None:
+            # the dataflow net: laundered callees (aliased from-imports,
+            # local rebinds, helper returns) resolve to canonical paths
+            path = flow.resolve_call(node)
+            if path in _HOST_TRANSFER_PATHS:
+                spelled = call_name(node)
+                tail = path.rsplit(".", 1)[-1]
+                if spelled == tail:
+                    return path
+                return f"{path} (laundered as `{spelled}`)"
     elif (isinstance(node, ast.Attribute)
           and node.attr in ("addressable_data", "block_until_ready")):
         return node.attr
@@ -54,9 +75,11 @@ def _function_spans(tree, names):
 
 
 def check_host_transfers(tree, lines, posix="raft_tpu/neighbors/ann_mnmg.py",
-                         exempt=None):
+                         exempt=None, flow=None):
     """(tree, lines) form kept for the ci/lint.py shim.  *posix* selects
-    the registry entries (default: the historical ann_mnmg scope)."""
+    the registry entries (default: the historical ann_mnmg scope); *flow*
+    is the file's shared ValueFlow (built here when the shim calls without
+    one)."""
     hits = hotpaths.match(posix)
     if not hits:
         return []
@@ -64,6 +87,10 @@ def check_host_transfers(tree, lines, posix="raft_tpu/neighbors/ann_mnmg.py",
         def exempt(lineno):
             ctx = lines[max(0, lineno - 2):lineno]
             return any("host-ok" in ln or "noqa" in ln for ln in ctx)
+    if flow is None:
+        from raft_tpu.analysis import dataflow
+
+        flow = dataflow.ValueFlow(tree)
 
     # module-wide if ANY matching entry is; else the union of function spans
     module_wide = any(not hp.functions for hp in hits)
@@ -75,7 +102,7 @@ def check_host_transfers(tree, lines, posix="raft_tpu/neighbors/ann_mnmg.py",
 
     found = {}
     for node in ast.walk(tree):
-        name = _transfer_name(node)
+        name = _transfer_name(node, flow)
         if name is None or not in_scope(node.lineno):
             continue
         if exempt(node.lineno):
@@ -93,8 +120,10 @@ def check_host_transfers(tree, lines, posix="raft_tpu/neighbors/ann_mnmg.py",
 @rule("hot-path-host-transfer",
       scope=lambda p: hotpaths.match(p) is not None,
       legacy_markers=("host-ok",),
-      doc="host fetches inside a declared hot path (hotpaths.HOT_PATHS)")
+      doc="host fetches (incl. laundered aliases) inside a declared hot "
+          "path (hotpaths.HOT_PATHS)")
 def _rule(ctx):
     return check_host_transfers(
         ctx.tree, ctx.lines, ctx.posix,
-        exempt=lambda ln: ctx.exempt("hot-path-host-transfer", ln))
+        exempt=lambda ln: ctx.exempt("hot-path-host-transfer", ln),
+        flow=ctx.flow)

@@ -620,12 +620,15 @@ def _audit_sharded(kind: str):
     executable for a (64, dim) f32 query bucket, k=8."""
     rng = np.random.default_rng(0)
     comms = Comms()
+    x = rng.standard_normal((1024, 16)).astype(np.float32)
     if kind == "ivf_flat":
-        x = rng.standard_normal((1024, 16)).astype(np.float32)
         sharded = shard_ivf_flat(
             ivf_flat.build(ivf_flat.IndexParams(n_lists=8), x), comms)
+    elif kind == "ivf_pq":
+        sharded = shard_ivf_pq(
+            ivf_pq.build(ivf_pq.IndexParams(n_lists=8, pq_dim=4), x),
+            comms)
     else:
-        x = rng.standard_normal((1024, 16)).astype(np.float32)
         sharded = shard_brute_force(x, comms)
     s = ShardedSearcher(sharded, 8)
     return dict(compiled=s.fn.compiled(
@@ -656,3 +659,15 @@ def _audit_sharded_ivf_flat():
           "allgather merge (docs/sharded_ann.md)")
 def _audit_sharded_brute_force():
     return _audit_sharded("brute_force")
+
+
+@hlo_program(
+    "ann_mnmg.ivf_pq_sharded",
+    collectives=1, collective_bytes=_SHARDED_AUDIT_BYTES,
+    requires_devices=8, fast=False,
+    notes="whole sharded ivf_pq batch search (hoisted-LUT pipeline) as "
+          "ONE shard_map program: replicated coarse + per-shard ADC probe "
+          "scan + ONE allgather merge — completes the three serve "
+          "backends in sharded form (docs/sharded_ann.md)")
+def _audit_sharded_ivf_pq():
+    return _audit_sharded("ivf_pq")

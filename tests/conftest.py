@@ -84,6 +84,10 @@ _FAST_TESTS = {
     "test_ivf_flat.py::test_extend_lists_chunked_matches_full_repack",
     "test_ivf_build.py::test_search_identity_tiled_vs_monolithic",
     "test_ivf_build.py::test_serve_engine_refresh_zero_compile",
+    "test_lowering_locks.py::TestRetraceCertifier::"
+    "test_head_closure_certified",
+    "test_lowering_locks.py::TestShippedGoldens::"
+    "test_every_registered_program_has_a_committed_golden",
     "test_serve.py::test_zero_compiles_after_warmup",
     "test_serve.py::test_out_of_bucket_range_request_served_solo",
     "test_ivf_pq.py::test_ivf_pq_recall_pq_bits",

@@ -1,0 +1,731 @@
+"""Lowering-contract locks (ISSUE 12): the dataflow value-flow engine +
+laundering trios for the three ported rules, golden HLO fingerprints
+(units, seeded regressions, the --update-goldens round-trip, determinism,
+shipped-golden acceptance), the static retrace-closure certifier
+(positive at HEAD, negative on a synthetic unbounded-static-arg module),
+and the stale-exemption scan."""
+
+import ast
+import contextlib
+import io
+import json
+import pathlib
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from raft_tpu.analysis import (  # noqa: E402
+    dataflow,
+    engine,
+    fingerprint,
+    registry,
+    retrace,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def findings(posix, src, rule=None):
+    out = engine.check_source(posix, src)
+    if rule is not None:
+        out = [f for f in out if f.rule == rule]
+    return out
+
+
+def flow_of(src):
+    return dataflow.ValueFlow(ast.parse(src))
+
+
+# ---------------------------------------------------------------------------
+# the dataflow engine
+
+
+class TestValueFlow:
+    def test_import_alias_resolution(self):
+        src = "import numpy as np\nx = np.asarray\n"
+        f = flow_of(src)
+        assign = ast.parse(src).body  # re-parse loses identity; use f's tree
+        tree = f.module_scope.node
+        val = tree.body[1].value  # np.asarray
+        assert f.resolve(val) == "numpy.asarray"
+
+    def test_assignment_chain(self):
+        src = ("import jax\n"
+               "a = jax.lax.psum\n"
+               "b = a\n"
+               "c = b\n")
+        f = flow_of(src)
+        tree = f.module_scope.node
+        assert f.resolve(tree.body[3].value) == "jax.lax.psum"
+
+    def test_tuple_unpacking(self):
+        src = ("import numpy as np\n"
+               "g, h = np.asarray, np.array\n"
+               "u = g\nv = h\n")
+        f = flow_of(src)
+        tree = f.module_scope.node
+        assert f.resolve(tree.body[2].value) == "numpy.asarray"
+        assert f.resolve(tree.body[3].value) == "numpy.array"
+
+    def test_from_import_alias(self):
+        src = "from jax.lax import all_gather as ag\nx = ag\n"
+        f = flow_of(src)
+        tree = f.module_scope.node
+        assert f.resolve(tree.body[1].value) == "jax.lax.all_gather"
+
+    def test_helper_return(self):
+        src = ("import numpy as np\n"
+               "def _fetch():\n"
+               "    return np.asarray\n"
+               "x = _fetch()\n")
+        f = flow_of(src)
+        tree = f.module_scope.node
+        assert f.resolve(tree.body[2].value) == "numpy.asarray"
+
+    def test_class_bindings_do_not_leak_into_methods(self):
+        # Python scoping: a class-body name is NOT visible in its methods
+        src = ("import numpy as np\n"
+               "class C:\n"
+               "    g = np.asarray\n"
+               "    def m(self, x):\n"
+               "        return g(x)\n")
+        f = flow_of(src)
+        tree = f.module_scope.node
+        call = tree.body[1].body[1].body[0].value
+        assert f.resolve_call(call) is None
+
+    def test_param_taint(self):
+        src = ("import jax.numpy as jnp\n"
+               "def dispatch(self, qb):\n"
+               "    q = jnp.asarray(qb)\n"
+               "    return q\n")
+        f = flow_of(src)
+        tree = f.module_scope.node
+        ret = tree.body[1].body[1].value  # the returned `q`
+        assert f.param_roots(ret) == {"qb"}
+
+    def test_const_value_through_names(self):
+        src = "_S = (1, 2)\nT = _S\n"
+        f = flow_of(src)
+        tree = f.module_scope.node
+        assert f.const_value(tree.body[1].value) == (1, 2)
+
+    def test_cycle_is_bounded(self):
+        src = "a = b\nb = a\nx = a\n"
+        f = flow_of(src)
+        tree = f.module_scope.node
+        assert f.resolve(tree.body[2].value) is None  # terminates, no hang
+
+
+# ---------------------------------------------------------------------------
+# laundering trios: the three dataflow-ported rules catch what the
+# syntactic matchers miss — fire / fixed / marker for each laundering form
+
+
+class TestHostTransferLaundering:
+    def test_aliased_from_import_fires(self):
+        src = ("from numpy import asarray as pull\n\n"
+               "def _fused_em_scan(x):\n    return pull(x)\n")
+        f = findings("raft_tpu/cluster/kmeans.py", src,
+                     "hot-path-host-transfer")
+        assert f and "laundered" in f[0].message
+
+    def test_local_rebind_fires_at_call_line(self):
+        src = ("import numpy as np\n\ndef deliver(x):\n"
+               "    g = np.asarray\n    return g(x)\n")
+        f = findings("raft_tpu/serve/engine.py", src,
+                     "hot-path-host-transfer")
+        assert [x.lineno for x in f] == [5]
+
+    def test_helper_return_fires(self):
+        src = ("import numpy as np\n\ndef _fetch():\n"
+               "    return np.asarray\n\n\ndef dispatch(x):\n"
+               "    return _fetch()(x)\n")
+        assert findings("raft_tpu/serve/engine.py", src,
+                        "hot-path-host-transfer")
+
+    def test_fixed_form_passes(self):
+        src = ("import numpy as np\n\ndef deliver(x):\n"
+               "    return x\n")
+        assert not findings("raft_tpu/serve/engine.py", src,
+                            "hot-path-host-transfer")
+
+    def test_marker_exempts_laundered_call(self):
+        src = ("from numpy import asarray as pull\n\n"
+               "def _fused_em_scan(x):\n"
+               "    return pull(x)  "
+               "# exempt(hot-path-host-transfer): (k,) table fetch\n")
+        assert not findings("raft_tpu/cluster/kmeans.py", src,
+                            "hot-path-host-transfer")
+
+    def test_off_hot_path_laundering_passes(self):
+        src = ("from numpy import asarray as pull\n\ndef f(x):\n"
+               "    return pull(x)\n")
+        assert not findings("raft_tpu/stats/mod.py", src,
+                            "hot-path-host-transfer")
+
+
+class TestCollectiveLaundering:
+    def test_local_rebind_fires_at_call_line(self):
+        src = ("import jax\n\ndef prog(x, a):\n"
+               "    g = jax.lax.psum\n    return g(x, a)\n")
+        f = findings("raft_tpu/neighbors/mod.py", src,
+                     "collective-discipline")
+        assert 5 in [x.lineno for x in f]          # the laundered CALL
+        assert any("laundered" in x.message for x in f)
+
+    def test_helper_return_fires(self):
+        src = ("import jax\n\ndef _get():\n"
+               "    return jax.lax.all_gather\n\n\ndef prog(x, a):\n"
+               "    return _get()(x, a)\n")
+        f = findings("raft_tpu/cluster/mod.py", src,
+                     "collective-discipline")
+        assert 8 in [x.lineno for x in f]   # the laundered CALL line
+
+    def test_aliased_from_import_call_still_fires(self):
+        src = ("from jax.lax import ppermute as shift\n\n"
+               "def prog(x, a):\n    return shift(x, a, [(0, 1)])\n")
+        f = findings("raft_tpu/neighbors/mod.py", src,
+                     "collective-discipline")
+        assert {1, 4} <= {x.lineno for x in f}
+
+    def test_fixed_form_passes(self):
+        src = ("def prog(comms, x):\n    return comms.allreduce(x)\n")
+        assert not findings("raft_tpu/neighbors/mod.py", src,
+                            "collective-discipline")
+
+    def test_marker_on_call_line_exempts(self):
+        src = ("import jax\n\ndef prog(x, a):\n"
+               "    g = jax.lax.psum  "
+               "# exempt(collective-discipline): counted by hand\n"
+               "    return g(x, a)  "
+               "# exempt(collective-discipline): counted by hand\n")
+        assert not findings("raft_tpu/neighbors/mod.py", src,
+                            "collective-discipline")
+
+    def test_comms_home_laundering_allowed(self):
+        src = ("import jax\n\ndef prog(x, a):\n"
+               "    g = jax.lax.psum\n    return g(x, a)\n")
+        assert not findings("raft_tpu/comms/mod.py", src,
+                            "collective-discipline")
+
+
+class TestDtypeDriftLaundering:
+    def test_from_import_fires_at_import_and_use(self):
+        src = ("from numpy import float64\n\ndef f(x):\n"
+               "    return float64(x)\n")
+        f = findings("raft_tpu/stats/mod.py", src, "dtype-drift")
+        assert {1, 4} <= {x.lineno for x in f}  # import line + use line
+
+    def test_local_rebind_fires_at_use(self):
+        src = ("import jax.numpy as jnp\n\ndef f(x):\n"
+               "    wide = jnp.float64\n    return x.astype(wide)\n")
+        f = findings("raft_tpu/cluster/mod.py", src, "dtype-drift")
+        assert 4 in [x.lineno for x in f]
+
+    def test_x64_marker_at_hop_sanctions_uses(self):
+        # the solver idiom: a conditional x64-gated rebind must not
+        # re-fire at every later use of the name
+        src = ("import jax.numpy as jnp\n\ndef f(x, c):\n"
+               "    dt = jnp.float32\n"
+               "    if c:\n"
+               "        # x64: integer exactness requires f64 here\n"
+               "        dt = jnp.float64\n"
+               "    return x.astype(dt)\n")
+        assert not findings("raft_tpu/solver/mod.py", src, "dtype-drift")
+
+    def test_exempt_marker_at_hop_sanctions_uses(self):
+        src = ("import numpy as np\n\ndef f(x):\n"
+               "    wide = np.float64  "
+               "# exempt(dtype-drift): host-side accumulator\n"
+               "    return wide(x)\n")
+        assert not findings("raft_tpu/stats/mod.py", src, "dtype-drift")
+
+    def test_fixed_form_passes(self):
+        src = ("import jax.numpy as jnp\n\ndef f(x):\n"
+               "    return x.astype(jnp.float32)\n")
+        assert not findings("raft_tpu/stats/mod.py", src, "dtype-drift")
+
+
+# ---------------------------------------------------------------------------
+# fingerprint units
+
+
+_TOY_HLO = """
+HloModule toy, input_output_alias={ {0}: (1, {}, may-alias) }
+  %p = f32[8,64]{1,0} parameter(0)
+  %c = f32[] constant(0)
+  %f1 = f32[8,64]{1,0} fusion(f32[8,64]{1,0} %p), kind=kLoop
+  %f2 = f32[8]{0} fusion(f32[8,64]{1,0} %f1), kind=kInput
+  %d = f32[8,8]{1,0} dot(f32[8,64]{1,0} %f1, f32[8,64]{1,0} %f1)
+  %ag = f32[2,8]{1,0} all-gather(f32[1,8]{1,0} %x), dimensions={0}
+  %i = s32[8]{0} iota(), iota_dimension=0
+  ROOT %t = (f32[8]{0}, s32[8]{0}) tuple(f32[8]{0} %f2, s32[8]{0} %i)
+"""
+
+
+class TestFingerprintUnits:
+    def test_op_histogram(self):
+        h = fingerprint.op_histogram(_TOY_HLO)
+        assert h["fusion"] == 2
+        assert h["dot"] == 1
+        assert h["all-gather"] == 1
+        # bookkeeping ops are structure-noise, excluded
+        assert "parameter" not in h and "constant" not in h
+        assert "tuple" not in h
+
+    def test_dtype_set(self):
+        assert fingerprint.dtype_set(_TOY_HLO) == ["f32", "s32"]
+
+    def test_dumps_deterministic_no_timestamps(self):
+        fp = {"schema": 1, "b": 2, "a": 1}
+        s1, s2 = fingerprint.dumps(fp), fingerprint.dumps(dict(fp))
+        assert s1 == s2
+        assert s1.endswith("\n")
+        assert json.loads(s1) == fp
+        assert list(json.loads(s1)) == sorted(fp)  # sorted keys on disk
+
+
+def _fp(**over):
+    base = {
+        "schema": fingerprint.SCHEMA, "program": "toy", "backend": "cpu",
+        "ops": {"fusion": 20, "dot": 4, "add": 10},
+        "fusions": 20, "collectives": 1, "collective_bytes": 4096,
+        "dtypes": ["f32", "s32"], "donation_aliases": [[0, "may-alias"]],
+        "transient_bytes": 1 << 20,
+    }
+    base.update(over)
+    return base
+
+
+class TestSeededRegressions:
+    """The quarantine seeds: each regression class must FAIL the diff."""
+
+    def test_clean_diff(self):
+        assert fingerprint.diff(_fp(), _fp()) == []
+
+    def test_extra_collective_fails(self):
+        bad = _fp(collectives=2, collective_bytes=8192,
+                  ops={"fusion": 20, "dot": 4, "add": 10})
+        out = fingerprint.diff(_fp(), bad)
+        assert any("collective launches" in f for f in out), out
+
+    def test_collective_bytes_exact(self):
+        out = fingerprint.diff(_fp(), _fp(collective_bytes=4097))
+        assert any("payload" in f for f in out), out
+
+    def test_broken_fusion_fails(self):
+        # the fusion structure scattering into loose elementwise ops
+        bad = _fp(fusions=5, ops={"fusion": 5, "dot": 4, "add": 40})
+        out = fingerprint.diff(_fp(), bad)
+        assert any("fusion count" in f for f in out), out
+
+    def test_f64_upcast_fails(self):
+        out = fingerprint.diff(_fp(), _fp(dtypes=["f32", "f64", "s32"]))
+        assert any("dtype set" in f and "f64" in f for f in out), out
+
+    def test_lost_compressed_path_fails(self):
+        g = _fp(dtypes=["f32", "s32", "u8"])
+        out = fingerprint.diff(g, _fp(dtypes=["f32", "s32"]))
+        assert any("lost" in f for f in out), out
+
+    def test_dropped_donation_fails(self):
+        out = fingerprint.diff(_fp(), _fp(donation_aliases=[]))
+        assert any("alias" in f for f in out), out
+
+    def test_small_op_jitter_within_tolerance_passes(self):
+        ok = _fp(ops={"fusion": 20, "dot": 4, "add": 12})  # +2 abs slack
+        assert fingerprint.diff(_fp(), ok) == []
+
+    def test_transient_tolerance(self):
+        assert fingerprint.diff(_fp(), _fp(
+            transient_bytes=int(1.2 * (1 << 20)))) == []
+        out = fingerprint.diff(_fp(), _fp(transient_bytes=2 << 20))
+        assert any("transient" in f for f in out), out
+
+    def test_schema_mismatch_is_a_finding(self):
+        out = fingerprint.diff(_fp(schema=0), _fp())
+        assert any("schema" in f for f in out), out
+
+
+def _toy_entry(name="toy.fp", regress=False):
+    def clean(x):
+        return (x @ x.T).sum(axis=0)
+
+    def upcast(x):
+        # the seeded dtype regression: bf16 appears in the module
+        return (x @ x.T).astype(jnp.bfloat16).astype(jnp.float32).sum(axis=0)
+
+    fn = upcast if regress else clean
+    return registry.ProgramEntry(
+        name=name, builder=lambda: dict(fn=fn, args=(
+            jax.ShapeDtypeStruct((64, 64), jnp.float32),)))
+
+
+class TestGoldenRoundTrip:
+    """update → clean diff → seeded regression → failing diff → update →
+    clean: the whole --update-goldens flow on a toy registry."""
+
+    def _run(self, monkeypatch, tmp_path, entry, **kw):
+        monkeypatch.setattr(registry, "iter_programs",
+                            lambda fast_only=False: [entry])
+        return fingerprint.run(out=io.StringIO(),
+                               golden_dir=tmp_path / "goldens", **kw)
+
+    def test_round_trip(self, monkeypatch, tmp_path):
+        clean = _toy_entry()
+        # 1. no golden yet: the diff FAILS asking for --update-goldens
+        _, failed = self._run(monkeypatch, tmp_path, clean)
+        assert failed >= 1
+        # 2. update writes the golden...
+        reports, failed = self._run(monkeypatch, tmp_path, clean,
+                                    update=True)
+        assert failed == 0 and reports[0].status == "updated"
+        golden_file = tmp_path / "goldens" / "toy.fp.json"
+        assert golden_file.is_file()
+        # ...deterministically: a second update is byte-identical
+        before = golden_file.read_bytes()
+        self._run(monkeypatch, tmp_path, clean, update=True)
+        assert golden_file.read_bytes() == before
+        # 3. clean diff against the committed golden
+        reports, failed = self._run(monkeypatch, tmp_path, clean)
+        # (floor applies to full runs; toy registry has 1 program)
+        assert reports[0].status == "ok", reports[0].findings
+        # 4. the seeded regression (bf16 appearing) FAILS the gate
+        reports, _ = self._run(monkeypatch, tmp_path, _toy_entry(
+            regress=True))
+        assert reports[0].status == "fail"
+        assert any("dtype set" in f for f in reports[0].findings)
+        # 5. --update-goldens restores a clean run for the new lowering
+        self._run(monkeypatch, tmp_path, _toy_entry(regress=True),
+                  update=True)
+        reports, _ = self._run(monkeypatch, tmp_path, _toy_entry(
+            regress=True))
+        assert reports[0].status == "ok", reports[0].findings
+
+    def test_stale_golden_fails_and_update_prunes(self, monkeypatch,
+                                                  tmp_path):
+        clean = _toy_entry()
+        self._run(monkeypatch, tmp_path, clean, update=True)
+        orphan = tmp_path / "goldens" / "toy.renamed_away.json"
+        orphan.write_text(fingerprint.dumps(_fp()))
+        reports, failed = self._run(monkeypatch, tmp_path, clean)
+        assert any(r.name == "toy.renamed_away" and r.status == "fail"
+                   for r in reports)
+        self._run(monkeypatch, tmp_path, clean, update=True)
+        assert not orphan.exists()  # update prunes orphaned goldens
+
+    def test_backend_mismatch_skips(self, monkeypatch, tmp_path):
+        clean = _toy_entry()
+        self._run(monkeypatch, tmp_path, clean, update=True)
+        golden_file = tmp_path / "goldens" / "toy.fp.json"
+        g = json.loads(golden_file.read_text())
+        g["backend"] = "tpu"
+        golden_file.write_text(fingerprint.dumps(g))
+        reports, failed = self._run(monkeypatch, tmp_path, clean)
+        assert reports[0].status == "skipped"
+
+    def test_strict_counts_skips(self, monkeypatch, tmp_path):
+        needy = registry.ProgramEntry(
+            name="toy.needs_mesh", builder=lambda: dict(),
+            requires_devices=10 ** 6)
+        _, failed = self._run(monkeypatch, tmp_path, needy, strict=True)
+        assert failed >= 1
+        _, failed = self._run(monkeypatch, tmp_path, needy, strict=False)
+        # only the floor can fail a skipped-only run without strict
+        assert all("skipped" == r.status for r in
+                   self._run(monkeypatch, tmp_path, needy)[0])
+
+
+@contextlib.contextmanager
+def _x64_off():
+    """The committed goldens are recorded in the CI environment (x64
+    off — the CLI default); the test session runs x64 ON (conftest), so
+    golden comparisons extract under the goldens' environment."""
+    prev = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", False)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_x64", prev)
+
+
+class TestShippedGoldens:
+    def test_every_registered_program_has_a_committed_golden(self):
+        for e in registry.iter_programs():
+            assert fingerprint.golden_path(e.name).is_file(), e.name
+
+    def test_goldens_are_deterministic_serializations(self):
+        # committed artifacts are byte-exact re-serializations: sorted
+        # keys, no timestamps, trailing newline (the review-surface
+        # contract) — recorded for the CI environment (cpu, x64 off)
+        for p in sorted(fingerprint.GOLDEN_DIR.glob("*.json")):
+            raw = p.read_text()
+            assert raw == fingerprint.dumps(json.loads(raw)), p.name
+            g = json.loads(raw)
+            assert g["backend"] == "cpu" and g["x64"] is False, p.name
+
+    def test_fast_subset_diffs_clean_at_head(self):
+        # the single-device programs re-fingerprint and diff clean in-test
+        # (the full 10-program pass incl. the 8-device sharded entries is
+        # CI's job: checks.sh --fingerprints --strict)
+        with _x64_off():
+            for e in registry.iter_programs(fast_only=True):
+                fp = fingerprint.extract(e)
+                golden = json.loads(
+                    fingerprint.golden_path(e.name).read_text())
+                assert fingerprint.diff(golden, fp) == [], e.name
+
+    def test_sharded_ivf_pq_golden_one_allgather(self, devices):
+        # the new third sharded backend: its committed golden pins the
+        # one-allgather contract exactly
+        golden = json.loads(fingerprint.golden_path(
+            "ann_mnmg.ivf_pq_sharded").read_text())
+        assert golden["collectives"] == 1
+        assert golden["collective_bytes"] == 8 * 64 * 2 * 8 * 4
+        with _x64_off():
+            fp = fingerprint.extract(registry.get_program(
+                "ann_mnmg.ivf_pq_sharded"))
+        assert fingerprint.diff(golden, fp) == []
+
+    def test_programs_filter_honored(self):
+        # the --programs contract extends to the fingerprint pass: only
+        # the named program is fingerprinted (and the full-run-only
+        # checks — floor, stale goldens — stay out of filtered runs)
+        with _x64_off():
+            out = io.StringIO()
+            reports, failed = fingerprint.run(["ivf_pq.csum_tile"],
+                                              out=out)
+        assert [r.name for r in reports] == ["ivf_pq.csum_tile"]
+        assert failed == 0
+        assert "knn_scan" not in out.getvalue()
+
+    def test_unknown_program_name_raises(self):
+        with pytest.raises(KeyError):
+            fingerprint.run(["no.such_program"], out=io.StringIO())
+
+    def test_x64_mismatch_skips_not_fails(self, monkeypatch, tmp_path):
+        # a golden recorded under another x64 setting must be SKIPPED —
+        # comparing lowerings across environments is noise, not signal
+        entry = _toy_entry()
+        monkeypatch.setattr(registry, "iter_programs",
+                            lambda fast_only=False: [entry])
+        gdir = tmp_path / "goldens"
+        fingerprint.run(update=True, out=io.StringIO(), golden_dir=gdir)
+        g = json.loads((gdir / "toy.fp.json").read_text())
+        g["x64"] = not g["x64"]
+        (gdir / "toy.fp.json").write_text(fingerprint.dumps(g))
+        reports, failed = fingerprint.run(out=io.StringIO(),
+                                          golden_dir=gdir)
+        assert reports[0].status == "skipped"
+
+
+# ---------------------------------------------------------------------------
+# the retrace certifier
+
+
+class TestRetraceCertifier:
+    def test_head_closure_certified(self):
+        # the acceptance contract: serve steady-state signature closure
+        # PROVEN at HEAD — every obligation ok, zero failures
+        reports, failed = retrace.run(out=io.StringIO())
+        assert failed == 0, [
+            (r.name, r.findings) for r in reports if r.status == "fail"]
+        names = {r.name for r in reports}
+        # the certificate actually covers the serving layer
+        assert any(n.startswith("serve.warm_dispatch._") for n in names)
+        assert "serve.backends_cover" in names
+        assert any(n.startswith("serve.bucket_closure") for n in names)
+        assert "retrace.static_cardinality" in names
+
+    def test_every_backend_class_certified(self):
+        reports, _ = retrace.run(out=io.StringIO())
+        certified = {r.name.rsplit(".", 1)[-1] for r in reports
+                     if r.name.startswith("serve.warm_dispatch.")}
+        for cls in ("_BruteForceBackend", "_IvfFlatBackend",
+                    "_IvfPqBackend", "_ShardedBackend", "ShardedSearcher"):
+            assert cls in certified, certified
+
+    def test_synthetic_unbounded_static_arg_flagged(self, tmp_path):
+        (tmp_path / "leaky.py").write_text(
+            "from raft_tpu.core.aot import aot\n\n"
+            "def fn(q, n):\n    return q[:n]\n\n"
+            "F = aot(fn, static_argnums=(1,))\n\n"
+            "def serve(q):\n"
+            "    return F(q, q.shape[0])\n")
+        reports, failed = retrace.run(
+            ["static_cardinality"], roots=[str(tmp_path)],
+            out=io.StringIO())
+        assert failed == 1
+        assert any("unbounded" in f for f in reports[-1].findings)
+
+    def test_bucket_dim_bounds_the_same_module(self, tmp_path):
+        (tmp_path / "fixed.py").write_text(
+            "from raft_tpu.core.aot import aot, _bucket_dim\n\n"
+            "def fn(q, n):\n    return q[:n]\n\n"
+            "F = aot(fn, static_argnums=(1,))\n\n"
+            "def serve(q):\n"
+            "    return F(q, _bucket_dim(q.shape[0]))\n")
+        _, failed = retrace.run(["static_cardinality"],
+                                roots=[str(tmp_path)], out=io.StringIO())
+        assert failed == 0
+
+    def test_min_against_cap_bounds(self, tmp_path):
+        (tmp_path / "capped.py").write_text(
+            "from raft_tpu.core.aot import aot\n\n"
+            "def fn(q, t):\n    return q[:t]\n\n"
+            "F = aot(fn, static_argnums=(1,))\n\n"
+            "def serve(q):\n"
+            "    return F(q, min(16384, q.shape[0]))\n")
+        _, failed = retrace.run(["static_cardinality"],
+                                roots=[str(tmp_path)], out=io.StringIO())
+        assert failed == 0
+
+    def test_len_is_unbounded(self, tmp_path):
+        (tmp_path / "leaky2.py").write_text(
+            "from raft_tpu.core.aot import aot\n\n"
+            "def fn(q, n):\n    return q[:n]\n\n"
+            "F = aot(fn, static_argnums=(1,))\n\n"
+            "def serve(batches):\n"
+            "    return F(batches, len(batches))\n")
+        _, failed = retrace.run(["static_cardinality"],
+                                roots=[str(tmp_path)], out=io.StringIO())
+        assert failed == 1
+
+    def test_verbatim_param_passthrough_is_callers_cardinality(
+            self, tmp_path):
+        (tmp_path / "keyed.py").write_text(
+            "from raft_tpu.core.aot import aot\n\n"
+            "def fn(q, k):\n    return q[:k]\n\n"
+            "F = aot(fn, static_argnums=(1,))\n\n"
+            "def knn(q, k):\n"
+            "    return F(q, k)\n")
+        _, failed = retrace.run(["static_cardinality"],
+                                roots=[str(tmp_path)], out=io.StringIO())
+        assert failed == 0
+
+    def test_coercion_rebind_is_bounded(self, tmp_path):
+        # the pairwise.py idiom: metric = DistanceType(metric) re-binds a
+        # caller-owned param through an enum coercion
+        (tmp_path / "coerce.py").write_text(
+            "from raft_tpu.core.aot import aot\n\n"
+            "def fn(q, m, a):\n    return q\n\n"
+            "F = aot(fn, static_argnums=(2, 3))\n\n"
+            "def distance(x, metric, arg):\n"
+            "    metric = DistanceType(metric)\n"
+            "    arg = float(arg)\n"
+            "    return F(x, x, metric, arg)\n")
+        _, failed = retrace.run(["static_cardinality"],
+                                roots=[str(tmp_path)], out=io.StringIO())
+        assert failed == 0
+
+    def test_exempt_marker_sanctions(self, tmp_path):
+        (tmp_path / "sanctioned.py").write_text(
+            "from raft_tpu.core.aot import aot\n\n"
+            "def fn(q, n):\n    return q[:n]\n\n"
+            "F = aot(fn, static_argnums=(1,))\n\n"
+            "def rebuild(q):\n"
+            "    # exempt(retrace-unbounded-static): one-shot build path\n"
+            "    return F(q, q.shape[0])\n")
+        _, failed = retrace.run(["static_cardinality"],
+                                roots=[str(tmp_path)], out=io.StringIO())
+        assert failed == 0
+
+    def test_names_filter(self):
+        reports, _ = retrace.run(["bucket_closure"], out=io.StringIO())
+        assert reports
+        assert all("bucket_closure" in r.name for r in reports)
+
+    def test_incongruent_warm_dispatch_fails(self, monkeypatch, tmp_path):
+        # a backend whose dispatch passes a static warm() never lowered:
+        # the congruence certificate must fail
+        mod = tmp_path / "engine.py"
+        mod.write_text(
+            "import jax\n\n"
+            "class _LeakyBackend:\n"
+            "    def warm(self, bucket, dtype):\n"
+            "        self.fn.compiled(*self._args(\n"
+            "            jax.ShapeDtypeStruct((bucket, self.dim), dtype)))\n"
+            "    def dispatch(self, qb):\n"
+            "        return self.fn(*self._args(qb), qb.dtype)\n")
+        import ast as ast_mod
+
+        tree = ast_mod.parse(mod.read_text())
+        flow = dataflow.ValueFlow(tree)
+        reports = retrace.certify_warm_dispatch(
+            {"engine.py": tree}, {"engine.py": flow})
+        leaky = [r for r in reports
+                 if r.name == "serve.warm_dispatch._LeakyBackend"]
+        assert leaky and leaky[0].status == "fail"
+
+    def test_missing_warm_fails(self):
+        import ast as ast_mod
+
+        src = ("class _NoWarm:\n"
+               "    def dispatch(self, qb):\n"
+               "        return self.fn(qb)\n")
+        tree = ast_mod.parse(src)
+        reports = retrace.certify_warm_dispatch(
+            {"m.py": tree}, {"m.py": dataflow.ValueFlow(tree)})
+        assert reports and reports[0].status == "fail"
+
+
+# ---------------------------------------------------------------------------
+# stale-exemption scan
+
+
+class TestStaleExemptions:
+    def test_stale_marker_reported(self):
+        src = ("def f(v):\n"
+               "    return v + 1  # exempt(raw-segment-sum): outdated\n")
+        stale = engine.scan_stale_source("raft_tpu/x/mod.py", src)
+        assert len(stale) == 1
+        assert stale[0].rules == ("raw-segment-sum",)
+
+    def test_live_marker_not_reported(self):
+        src = ("import jax\n\n\ndef f(v, i):\n"
+               "    return jax.ops.segment_sum(v, i, num_segments=4)"
+               "  # exempt(raw-segment-sum): engine baseline\n")
+        assert not engine.scan_stale_source("raft_tpu/x/mod.py", src)
+
+    def test_marker_above_live_finding_not_reported(self):
+        src = ("import jax\n\n\ndef f(v, i):\n"
+               "    # exempt(raw-segment-sum): sanctioned here\n"
+               "    return jax.ops.segment_sum(v, i, num_segments=4)\n")
+        assert not engine.scan_stale_source("raft_tpu/x/mod.py", src)
+
+    def test_marker_inside_string_literal_not_scanned(self):
+        # quarantine tests quote markers in snippets — not markers
+        src = ('SRC = "x = 1  # exempt(raw-segment-sum): quoted"\n')
+        assert not engine.scan_stale_source("tests/test_x.py", src)
+
+    def test_legacy_spelling_scanned_via_mapping(self):
+        src = ("def f(x):\n"
+               "    return x  # host-ok: stale legacy marker\n")
+        stale = engine.scan_stale_source(
+            "raft_tpu/neighbors/ann_mnmg.py", src)
+        assert stale and stale[0].rules == ("hot-path-host-transfer",)
+
+    def test_partially_live_comma_list_kept(self):
+        src = ("import jax\n\n\ndef f(v, i):\n"
+               "    return jax.ops.segment_sum(v, i, num_segments=4)"
+               "  # exempt(raw-segment-sum, dtype-drift): shared\n")
+        assert not engine.scan_stale_source("raft_tpu/x/mod.py", src)
+
+    def test_unknown_rule_id_not_staleness(self):
+        # a typo'd id is exemption-hygiene's problem, not staleness
+        src = ("def f(x):\n"
+               "    return x  # exempt(no-such-rule): typo\n")
+        assert not engine.scan_stale_source("raft_tpu/x/mod.py", src)
+
+    def test_shipped_tree_has_no_stale_markers(self):
+        n = engine.scan_stale_exemptions(out=io.StringIO())
+        assert n == 0
+
+
+# (fast-tier registration lives in tests/conftest.py::_FAST_TESTS —
+# test_head_closure_certified + the committed-golden catalog check)
