@@ -695,6 +695,102 @@ def bench_knn_bruteforce():
     }
 
 
+def bench_select_k():
+    """select_k A/B (ISSUE 13): jax.lax.top_k engine vs the blockwise
+    Pallas kernel, plus the IVF-PQ LUT-in-VMEM scoring engine A/B.
+
+    The tracked value is the XLA engine's throughput at the headline
+    (512 × 16384, k=64) shape; the Pallas rows run INTERPRET mode off-TPU
+    and are recorded CORRECTNESS-ONLY (the interpreter executes the
+    bitonic network as unfused XLA ops — meaningless as a speed number;
+    the compiled-TPU A/B belongs to the measurement session).  Gates
+    asserted in-bench: blockwise positions+values BIT-IDENTICAL to the
+    XLA engine, IVF-PQ pallas-engine top-k within the documented bounded
+    error of the hoisted scan, and ZERO compiles on warm replays of both
+    engines through the aot cache.
+    """
+    import jax
+
+    from bench.common import timed_chained
+    from raft_tpu.core.aot import aot_compile_counters
+    from raft_tpu.matrix.select_k import select_k
+
+    rows, n, k = 512, 16384, 64
+    rng = np.random.default_rng(0)
+    x = jax.device_put(rng.random((rows, n), dtype=np.float32))
+    best = timed_chained(lambda v: select_k(v, k, engine="xla"), x,
+                         lambda v, out: v + 1e-9 * out[0][0, 0], iters=5)
+    xla_rows_s = rows / best
+
+    # -- blockwise engine: identity + zero-compile gates (interpret off-TPU;
+    # smaller shape bounds the interpreter's unrolled-network trace time)
+    pr, pn, pk = 128, 4096, 64
+    xp = jax.device_put(rng.random((pr, pn), dtype=np.float32))
+    v_x, p_x = select_k(xp, pk, engine="xla")
+    t0 = time.perf_counter()
+    v_p, p_p = select_k(xp, pk, engine="pallas")
+    jax.block_until_ready(v_p)
+    pallas_cold_s = time.perf_counter() - t0
+    assert np.array_equal(np.asarray(p_p), np.asarray(p_x)), \
+        "blockwise select_k positions diverged from the XLA engine"
+    assert np.array_equal(np.asarray(v_p), np.asarray(v_x)), \
+        "blockwise select_k values diverged from the XLA engine"
+    c0 = aot_compile_counters["compiles"]
+    t0 = time.perf_counter()
+    out = select_k(jax.device_put(rng.random((pr, pn), dtype=np.float32)),
+                   pk, engine="pallas")
+    jax.block_until_ready(out[0])
+    pallas_warm_s = time.perf_counter() - t0
+    assert aot_compile_counters["compiles"] == c0, \
+        "warm blockwise select_k dispatch compiled"
+
+    # -- IVF-PQ LUT-in-VMEM engine A/B on a small index (interpret off-TPU)
+    from raft_tpu.neighbors import ivf_pq
+
+    xs = rng.random((10_000, 64), dtype=np.float32)
+    q = rng.random((256, 64), dtype=np.float32)
+    idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=64, pq_dim=8, pq_bits=8),
+                       xs)
+    sp = ivf_pq.SearchParams(n_probes=8)
+    d0, i0 = map(np.asarray, ivf_pq.search(sp, idx, q, 10))
+    os.environ["RAFT_TPU_PALLAS_PQ_LUT"] = "force"
+    try:
+        t0 = time.perf_counter()
+        d1, i1 = map(np.asarray, ivf_pq.search(sp, idx, q, 10))
+        pq_cold_s = time.perf_counter() - t0
+        c0 = aot_compile_counters["compiles"]
+        t0 = time.perf_counter()
+        out = ivf_pq.search(sp, idx, q + 0.25, 10)
+        jax.block_until_ready(out[0])
+        pq_warm_s = time.perf_counter() - t0
+        assert aot_compile_counters["compiles"] == c0, \
+            "warm pallas ivf_pq search compiled"
+    finally:
+        os.environ.pop("RAFT_TPU_PALLAS_PQ_LUT", None)
+    overlap = float(np.mean([len(set(i0[r]) & set(i1[r])) / i0.shape[1]
+                             for r in range(i0.shape[0])]))
+    assert overlap >= 0.95, \
+        f"ivf_pq VMEM-kernel top-k overlap {overlap} below the bounded-" \
+        "error gate"
+    np.testing.assert_allclose(d0, d1, rtol=1e-4, atol=1e-4)
+
+    interpret = jax.default_backend() != "tpu"
+    return {
+        "metric": f"select_k_{rows}x{n // 1000}k_k{k}_f32",
+        "value": round(xla_rows_s, 1),
+        "unit": "rows/s",
+        "pallas_identity": True,
+        "pallas_zero_compile_warm": True,
+        "pallas_interpret": interpret,
+        # correctness-only when interpret (see docstring)
+        "pallas_warm_rows_s": round(pr / pallas_warm_s, 1),
+        "pallas_cold_s": round(pallas_cold_s, 3),
+        "ivf_pq_vmem_overlap": round(overlap, 4),
+        "ivf_pq_vmem_warm_qps": round(len(q) / pq_warm_s, 1),
+        "ivf_pq_vmem_cold_s": round(pq_cold_s, 3),
+    }
+
+
 def bench_lanczos():
     """BASELINE config[3]: Lanczos smallest-eigenpairs on a sparse graph."""
     import scipy.sparse as sp
@@ -739,7 +835,8 @@ _METRICS = {"pairwise": bench_pairwise, "kmeans": bench_kmeans,
             "ivf_pq_search": bench_ivf_pq_search,
             "ivf_build": bench_ivf_build,
             "lanczos": bench_lanczos, "knn_bruteforce": bench_knn_bruteforce,
-            "serve": bench_serve, "ann_sharded": bench_ann_sharded}
+            "serve": bench_serve, "ann_sharded": bench_ann_sharded,
+            "select_k": bench_select_k}
 
 
 def _orphan_watchdog():

@@ -1,0 +1,100 @@
+"""select_k engine microbenches (ISSUE 13; reference
+cpp/bench/matrix/select_k.cu — the warpsort/radix engine A/B grid).
+
+``lax_topk_*`` cases time the XLA engine over the n×k grid, including the
+IVF probe-tile shape (nq × cap with the scan's k) — the shapes the probe
+scans actually dispatch.  The ``blockwise_*`` cases run the Pallas kernel:
+off-TPU they execute under the Pallas INTERPRETER and the numbers are
+CORRECTNESS-ONLY (identity vs the XLA engine is asserted in the workload,
+which is the point of running them in CI at all); on a real TPU backend
+they time the compiled kernel behind the r5 experimental gate — the
+measurement session's A/B instrument (bench/tpu_session.py precedent:
+this case sets the engine env itself, ADVICE r5).
+"""
+
+import numpy as np
+
+from bench.common import case, main_for
+from bench.sizes import size
+
+_ROWS = size(512, 128)
+_N = size(16384, 2048)
+_K = 64
+#: the IVF probe-scan tile shape: (nq, cap) rows with the scan's k
+_PROBE_ROWS = size(512, 64)
+_PROBE_CAP = 1024
+_PROBE_K = 32
+
+
+def _x(rows, n, seed=0):
+    import jax
+
+    rng = np.random.default_rng(seed)
+    return jax.device_put(rng.random((rows, n), dtype=np.float32))
+
+
+def _topk_case(rows, n, k, engine):
+    from raft_tpu.matrix.select_k import select_k
+
+    x = _x(rows, n)
+    if engine == "pallas":
+        # identity gate: the whole reason the interpret run is in CI
+        from raft_tpu.matrix.select_k import select_k as sk
+
+        v_p, p_p = sk(x, k, engine="pallas")
+        v_x, p_x = sk(x, k, engine="xla")
+        assert np.array_equal(np.asarray(p_p), np.asarray(p_x))
+        assert np.array_equal(np.asarray(v_p), np.asarray(v_x))
+    return (lambda: select_k(x, k, engine=engine)), {"items": rows}
+
+
+@case("select_k/lax_topk")
+def bench_lax_topk():
+    return _topk_case(_ROWS, _N, _K, "xla")
+
+
+@case("select_k/blockwise")
+def bench_blockwise():
+    """Interpret-mode off-TPU: correctness-only (module docstring)."""
+    return _topk_case(_ROWS, _N, _K, "pallas")
+
+
+@case("select_k/lax_topk_probe_shape")
+def bench_lax_topk_probe():
+    return _topk_case(_PROBE_ROWS, _PROBE_CAP, _PROBE_K, "xla")
+
+
+@case("select_k/blockwise_probe_shape")
+def bench_blockwise_probe():
+    return _topk_case(_PROBE_ROWS, _PROBE_CAP, _PROBE_K, "pallas")
+
+
+@case("select_k/ivf_pq_vmem_lut")
+def bench_ivf_pq_vmem():
+    """The LUT-in-VMEM scoring kernel on a standalone (codes, LUT) tile —
+    the scan-body primitive, isolated from index build noise.  Off-TPU:
+    interpret, correctness-only (bounded-error gate vs the gather-sum)."""
+    import jax
+
+    from raft_tpu.kernels.ivf_pq_lut import lut_score
+
+    nq, cap, pq_dim, bits = size(256, 32), 1024, 8, 8
+    kcb = 1 << bits
+    rng = np.random.default_rng(0)
+    codes = jax.device_put(
+        rng.integers(0, kcb, (nq, cap, pq_dim)).astype(np.uint8))
+    lut = jax.device_put(
+        rng.random((nq, pq_dim * kcb)).astype(np.float32))
+    out = np.asarray(lut_score(codes, lut, pq_dim, bits, kcb))
+    flat = (np.asarray(codes).astype(np.int64)
+            + np.arange(pq_dim) * kcb).reshape(nq * cap, pq_dim)
+    ref = np.take_along_axis(
+        np.repeat(np.asarray(lut), cap, axis=0), flat, axis=1
+    ).sum(-1).reshape(nq, cap)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    return (lambda: lut_score(codes, lut, pq_dim, bits, kcb)), {
+        "items": nq, "bytes": codes.size + lut.size * 4 + nq * cap * 4}
+
+
+if __name__ == "__main__":
+    main_for("bench.bench_select_k")

@@ -10,6 +10,7 @@ from bench.common import run_registered
 for mod in ("bench.bench_distance", "bench.bench_kmeans",
             "bench.bench_neighbors", "bench.bench_ivf_pq",
             "bench.bench_ivf_build", "bench.bench_serve",
+            "bench.bench_select_k",
             "bench.bench_sparse", "bench.bench_linalg"):
     __import__(mod)
 

@@ -46,6 +46,9 @@ mods = [
     "raft_tpu.neighbors", "raft_tpu.neighbors.ivf_flat",
     "raft_tpu.neighbors.ivf_pq", "raft_tpu.neighbors.ball_cover",
     "raft_tpu.serve", "raft_tpu.native",
+    "raft_tpu.kernels", "raft_tpu.kernels.engine",
+    "raft_tpu.kernels.select_k", "raft_tpu.kernels.fused_l2nn",
+    "raft_tpu.kernels.ivf_pq_lut", "raft_tpu.kernels.pairwise",
     "raft_tpu.telemetry", "raft_tpu.telemetry.registry",
     "raft_tpu.telemetry.spans", "raft_tpu.telemetry.export",
     "raft_tpu.telemetry.device", "raft_tpu.telemetry.aggregate",
@@ -70,8 +73,9 @@ echo "== hlo audit + lowering locks (analysis level 2) =="
 # --update-goldens and land as a reviewable diff), and run the static
 # retrace-closure certifier over the serving layer
 # (docs/static_analysis.md).  The FULL registry (incl. the sharded
-# one-allgather programs on the forced 8-device mesh) runs in
-# single-digit seconds on CPU.  --strict: a skipped program (bad device
+# one-allgather programs on the forced 8-device mesh AND the three
+# graduated Pallas kernels' interpret lowerings — catalog floor 13,
+# ISSUE 13) runs in seconds on CPU.  --strict: a skipped program (bad device
 # env) fails the gate instead of silently shrinking it — exit 2 when
 # strict skips are the ONLY failure; both audit and fingerprint passes
 # enforce the >=6-verified acceptance floor on full runs.

@@ -34,6 +34,10 @@ PACKAGES = [
                   "eps-neighborhood, haversine"),
     ("serve", "Batched query serving: request coalescing, executable "
               "warmup/pinning, double-buffered dispatch"),
+    ("kernels", "First-class Pallas kernel layer: blockwise select_k, "
+                "tiled fused-L2-NN with M-step partials, IVF-PQ "
+                "LUT-in-VMEM scoring, pairwise accumulate; ONE "
+                "engine-policy home (resolve_engine)"),
     ("sparse", "COO/CSR containers, conversions, sparse linalg/distances/"
                "neighbors/solvers"),
     ("spectral", "Spectral partitioning and modularity maximization"),

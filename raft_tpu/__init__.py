@@ -61,6 +61,7 @@ _SUBMODULES = (
     "solver",
     "label",
     "comms",
+    "kernels",
     "telemetry",
     "analysis",
 )

@@ -40,6 +40,9 @@ DECLARING_MODULES = (
     "raft_tpu.neighbors._build",
     "raft_tpu.neighbors.ann_mnmg",
     "raft_tpu.cluster.kmeans",
+    "raft_tpu.kernels.select_k",
+    "raft_tpu.kernels.fused_l2nn",
+    "raft_tpu.kernels.ivf_pq_lut",
 )
 
 

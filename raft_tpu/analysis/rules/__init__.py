@@ -5,6 +5,7 @@ from raft_tpu.analysis.rules import (  # noqa: F401
     collectives,
     dtype_drift,
     host_transfer,
+    pallas_discipline,
     probe_scan,
     reductions,
     serve_path,
@@ -14,6 +15,6 @@ from raft_tpu.analysis.rules import (  # noqa: F401
     trace_purity,
 )
 
-__all__ = ["collectives", "dtype_drift", "host_transfer", "probe_scan",
-           "reductions", "serve_path", "static_args", "style",
-           "telemetry_discipline", "trace_purity"]
+__all__ = ["collectives", "dtype_drift", "host_transfer",
+           "pallas_discipline", "probe_scan", "reductions", "serve_path",
+           "static_args", "style", "telemetry_discipline", "trace_purity"]

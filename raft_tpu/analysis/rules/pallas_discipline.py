@@ -1,0 +1,119 @@
+"""``pallas-discipline``: hand-written kernels live in ONE home with
+declared resource contracts.
+
+Three coupled checks over every shipped module:
+
+1. **Home**: ``pl.pallas_call`` may only appear under ``raft_tpu/kernels/``
+   (or carry ``# exempt(pallas-discipline): why``).  A kernel outside the
+   home ships without the layer's contracts — no registered VMEM ceiling,
+   no ``@hlo_program`` golden, no engine-policy resolution — which is
+   exactly how the r4/r5 experimental scaffolds drifted.
+2. **Registered ceiling**: inside the home, every ``pallas_call``'s
+   enclosing function must be a key of its module's ``VMEM_CEILINGS``
+   dict — the declared VMEM budget the design note's arithmetic commits
+   to (and the audit entries cross-reference).
+3. **Static block shapes**: ``BlockSpec`` shape tuples must be built from
+   statics (literals, module constants, locals derived from
+   ``_bucket_dim``-bounded static args) — an inline ``x.shape[...]``
+   attribute INSIDE the BlockSpec call is the tell for a block geometry
+   keyed on raw runtime shape, the compile-per-request hazard the retrace
+   certifier polices everywhere else.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from raft_tpu.analysis.engine import call_name, rule
+
+_HOME = "raft_tpu/kernels/"
+
+
+def _vmem_ceiling_keys(tree: ast.Module) -> set:
+    keys = set()
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "VMEM_CEILINGS"
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.add(k.value)
+    return keys
+
+
+def _blockspec_shape_violations(call: ast.Call):
+    """Inline ``.shape`` attribute expressions inside a BlockSpec shape
+    argument of this pallas_call."""
+    out = []
+    for node in ast.walk(call):
+        if not (isinstance(node, ast.Call)
+                and call_name(node) == "BlockSpec" and node.args):
+            continue
+        shape_arg = node.args[0]
+        for sub in ast.walk(shape_arg):
+            if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+                out.append((node.lineno,
+                            "BlockSpec shape derives from a runtime "
+                            "`.shape` inline — declare block shapes from "
+                            "_bucket_dim-bounded statics (bind the dim to "
+                            "a local first so the geometry is auditably "
+                            "static)"))
+                break
+    return out
+
+
+@rule("pallas-discipline",
+      scope=lambda p: ("raft_tpu/" in p and "/tests/" not in p),
+      doc="pl.pallas_call only under raft_tpu/kernels/ with a registered "
+          "VMEM_CEILINGS entry and static BlockSpec shapes")
+def _rule(ctx):
+    findings = []
+    in_home = _HOME in ctx.posix
+    ceilings = _vmem_ceiling_keys(ctx.tree) if in_home else set()
+
+    def walk(node, enclosing):
+        for child in ast.iter_child_nodes(node):
+            enc = child.name if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)) else enclosing
+            if (isinstance(child, ast.Call)
+                    and call_name(child) == "pallas_call"
+                    and not ctx.exempt("pallas-discipline", child.lineno)):
+                if not in_home:
+                    findings.append((
+                        child.lineno,
+                        "pl.pallas_call outside raft_tpu/kernels/ — "
+                        "hand-written kernels live in the kernels package "
+                        "(engine policy, VMEM ceilings, golden "
+                        "fingerprints), or mark the line "
+                        "exempt(pallas-discipline) with a rationale"))
+                else:
+                    # the ceiling keys the KERNEL BODY: the callable in
+                    # the pallas_call's first arg (usually via
+                    # functools.partial(_kernel, ...)); the enclosing
+                    # wrapper name is accepted too
+                    kernel_names = {enclosing} if enclosing else set()
+                    if child.args:
+                        kernel_names.update(
+                            n.id for n in ast.walk(child.args[0])
+                            if isinstance(n, ast.Name))
+                    if not (kernel_names & ceilings):
+                        findings.append((
+                            child.lineno,
+                            f"pallas_call in {enclosing or '<module>'!r} "
+                            "has no registered VMEM ceiling — add the "
+                            "kernel body function to this module's "
+                            "VMEM_CEILINGS with its budget arithmetic"))
+                    findings.extend(_blockspec_shape_violations(child))
+            walk(child, enc)
+
+    walk(ctx.tree, None)
+    # dedupe (a BlockSpec violation walked from nested calls repeats)
+    seen, out = set(), []
+    for f in findings:
+        if f not in seen:
+            seen.add(f)
+            out.append(f)
+    return out

@@ -54,10 +54,11 @@ def min_cluster_and_distance(x, centroids, metric: DistanceType = DistanceType.L
     reference, which runs k-means on squared distances), cosine distance for
     CosineExpanded; batched over (batch_samples × batch_centroids) tiles.
 
-    ``engine``: "xla" (default) or "pallas" (fused Pallas kernel, L2 family
-    only — r5: an EXPERIMENTAL scaffold on TPU, where it is known to fail
-    to compile over the axon tunnel; selecting it on a TPU backend requires
-    ``RAFT_TPU_PALLAS_EXPERIMENTAL=1`` alongside ``RAFT_TPU_PALLAS_NN=1``).
+    ``engine``: "xla" (default) or "pallas" (the fused kernel from
+    :mod:`raft_tpu.kernels.fused_l2nn` — a first-class engine with an
+    interpret-mode CPU contract, L2 family only; the compiled-TPU route
+    sits behind the single r5 demotion gate in
+    :mod:`raft_tpu.kernels.engine`, ``RAFT_TPU_PALLAS_EXPERIMENTAL=1``).
     The env default is resolved here, OUTSIDE the jit cache, so flipping
     the variable between calls takes effect (an ``engine=None`` cache key
     would silently keep the first-compiled engine).
@@ -71,32 +72,14 @@ def min_cluster_and_distance(x, centroids, metric: DistanceType = DistanceType.L
 
 def _resolve_engine(engine: Optional[str], metric: DistanceType) -> str:
     """Resolve/validate the E-step engine knob (shared by the unfused
-    :func:`min_cluster_and_distance` and :func:`fused_em_step`) — env
-    defaults resolved OUTSIDE any jit cache, see the caller docstrings."""
-    if engine is None:
-        from raft_tpu.distance import pallas_fused_l2nn
+    :func:`min_cluster_and_distance`, :func:`fused_em_step` and the MNMG
+    fit loops) — a thin delegate to the ONE policy home,
+    :func:`raft_tpu.kernels.resolve_engine` (env defaults resolved OUTSIDE
+    any jit cache, see the caller docstrings; the r5 TPU demotion gate
+    lives there too)."""
+    from raft_tpu.kernels.engine import resolve_engine
 
-        engine = "pallas" if (metric in _L2_METRICS
-                              and pallas_fused_l2nn.is_enabled()) else "xla"
-    elif engine == "pallas" and metric not in _L2_METRICS:
-        raise ValueError(
-            f"engine='pallas' supports only the L2 metric family, got {metric}")
-    elif engine not in ("xla", "pallas"):
-        raise ValueError(f"unknown engine {engine!r}; expected 'xla' or 'pallas'")
-    if engine == "pallas":
-        from raft_tpu.distance import pallas_fused_l2nn
-
-        # r5 demotion: the Pallas kernel failed to compile on the only real
-        # TPU path ever exercised (axon tunnel, BENCH_TPU.md r4b), so the
-        # compiled-TPU route needs the explicit experimental flag.  Off-TPU
-        # the kernel runs under the interpreter (CI numerics) — allowed.
-        if (jax.default_backend() == "tpu"
-                and not pallas_fused_l2nn.experimental_unlocked()):
-            raise ValueError(
-                "engine='pallas' is an experimental scaffold on TPU: the "
-                "kernel failed to compile on the real device (BENCH_TPU.md "
-                "r4b). Set RAFT_TPU_PALLAS_EXPERIMENTAL=1 to probe it.")
-    return engine
+    return resolve_engine("l2nn", metric=metric, engine=engine)
 
 
 # k-means E-steps default to "high" (bf16x3) matmul precision: measured ~2x
@@ -110,20 +93,20 @@ def _min_cluster_and_distance(x, centroids, metric: DistanceType,
                               precision: str, engine: str) -> KeyValuePair:
     m, dim = x.shape
     if metric in _L2_METRICS:
-        from raft_tpu.distance import pallas_fused_l2nn
-
         if engine == "pallas":
-            # Fused Pallas engine: the (block, k) distance tile never
-            # leaves VMEM (the jnp path's XLA lowering round-trips it
-            # through HBM before the argmin).  Single-pass bf16 only for
-            # precision="default" — "high" promises bf16x3-quality argmins
-            # (zero flips, see module comment), which single-pass bf16
-            # does not deliver.
+            # Fused Pallas engine (raft_tpu.kernels.fused_l2nn): the
+            # (block, k) distance tile never leaves VMEM (the jnp path's
+            # XLA lowering round-trips it through HBM before the argmin).
+            # Single-pass bf16 only for precision="default" — "high"
+            # promises bf16x3-quality argmins (zero flips, see module
+            # comment), which single-pass bf16 does not deliver.
             from raft_tpu.distance.pairwise import accum_dtype
+            from raft_tpu.kernels import fused_l2nn as pallas_fused
+            from raft_tpu.kernels.engine import interpret_requested
 
-            val, idx = pallas_fused_l2nn.fused_l2_nn_pallas(
+            val, idx = pallas_fused.fused_l2_nn_pallas(
                 x, centroids, bf16_dot=(precision == "default"),
-                interpret=pallas_fused_l2nn.interpret_requested())
+                interpret=interpret_requested())
             # distances flow in the accumulation dtype (f32 for half data
             # — the while_loop inertia carry expects it)
             return KeyValuePair(key=idx, value=val.astype(accum_dtype(x.dtype)))
@@ -323,10 +306,12 @@ def _fused_em_scan(x, centroids, weights, metric: DistanceType,
     l2_nn_tile` for the L2 family, a hoisted-stats
     ``distance_with_stats`` + argmin for every other metric.  Per-tile
     M-step: :func:`_mstep_tile_partials` (one-hot MXU matmul / scatter per
-    the linalg engine heuristic).  ``engine="pallas"`` composes instead of
-    forking: the experimental Pallas kernel produces the labels whole-array
-    and the partials run chunked over them (not single-pass — it is a
-    scaffold, see min_cluster_and_distance).
+    the linalg engine heuristic).  ``engine="pallas"`` runs the WHOLE
+    E-step in VMEM: the single-pass kernel
+    :func:`raft_tpu.kernels.fused_l2nn.fused_l2_nn_partials` computes the
+    argmin AND accumulates the M-step partials while each row block's
+    distance tile and one-hot are still resident — the labels never
+    round-trip HBM (the graduated ISSUE 13 engine; interpret mode off-TPU).
 
     Padding rows of the ragged final tile are discarded by weight-0
     (weighted) or by the ``n_clusters`` discard label + masked distance
@@ -342,15 +327,13 @@ def _fused_em_scan(x, centroids, weights, metric: DistanceType,
     k = centroids.shape[0]
     acc_t = accum_dtype(x.dtype)
     if engine == "pallas":
-        from raft_tpu.distance import pallas_fused_l2nn
+        from raft_tpu.kernels import fused_l2nn as pallas_fused
 
-        val, idx = pallas_fused_l2nn.fused_l2_nn_pallas(
-            x, centroids, bf16_dot=(precision == "default"),
-            interpret=pallas_fused_l2nn.interpret_requested())
+        val, idx, sums, wsum, inertia = pallas_fused.fused_l2_nn_partials(
+            x, centroids, weights, bf16_dot=(precision == "default"))
         val = val.astype(acc_t)
-        sums, wsum = _weighted_cluster_sums(x, idx, weights, k)
-        inertia = jnp.sum(val if weights is None else val * weights)
-        return EMPartials(sums, wsum, inertia,
+        return EMPartials(sums.astype(acc_t), wsum.astype(acc_t),
+                          inertia.astype(acc_t),
                           idx if return_labels else None,
                           val if return_labels else None)
     backend = jax.default_backend()

@@ -275,9 +275,11 @@ def fit(params: KMeansParams, comms: Comms, x, centroids=None,
         from raft_tpu.cluster.kmeans import fused_em_enabled
 
         fused = fused_em_enabled()
-    from raft_tpu.cluster.kmeans import _resolve_engine
+    # the ONE engine-policy home (kernels.engine): same resolution as the
+    # single-device fit, outside the program cache
+    from raft_tpu.kernels.engine import resolve_engine
 
-    engine = _resolve_engine(None, params.metric)
+    engine = resolve_engine("l2nn", metric=params.metric)
     expects(sync_every >= 1, f"sync_every must be >= 1, got {sync_every}")
     x = jnp.asarray(x)
     n, dim = x.shape

@@ -95,7 +95,9 @@ def prewarm(shapes: Sequence[Tuple[int, int, int]] = DEFAULT_SHAPES,
     for (rows_, cols_, k) in select_k_shapes:
         v = jax.ShapeDtypeStruct((rows_, cols_), np.float32)
         note(f"select_k ({rows_},{cols_}) k={k}")
-        _select_k_aot.compiled(v, k, True)
+        # engine static must match the public dispatch verbatim ("xla" is
+        # the resolved default; pallas signatures warm via their own path)
+        _select_k_aot.compiled(v, k, True, "xla")
         n += 1
     for fn in (extra or ()):
         fn()

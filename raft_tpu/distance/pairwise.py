@@ -296,7 +296,10 @@ _PALLAS_OPS = {
 
 
 def _try_pallas(x, y, metric: DistanceType):
-    """Opt-in Pallas engine for the VPU metrics (see pallas_kernels)."""
+    """Opt-in Pallas engine for the VPU metrics
+    (:mod:`raft_tpu.kernels.pairwise`; policy in
+    :func:`raft_tpu.kernels.resolve_engine` — the one env/demotion-gate
+    home)."""
     entry = _PALLAS_OPS.get(metric)
     if entry is None:
         return None
@@ -304,9 +307,12 @@ def _try_pallas(x, y, metric: DistanceType):
         # the kernel accumulates in the input dtype; half inputs take the
         # _blocked_reduce path, which upcasts tiles to f32 in-register
         return None
-    from raft_tpu.distance import pallas_kernels as pk
+    from raft_tpu.kernels import pairwise as pk
+    from raft_tpu.kernels.engine import resolve_engine
 
-    if not pk.is_enabled(x.shape[1]):
+    if x.shape[1] > pk._MAX_K:   # unrolled-k compile-time cap
+        return None
+    if resolve_engine("pairwise", metric=metric, dtype=x.dtype) != "pallas":
         return None
     acc = pk.pairwise_accumulate(x, y, entry[0])
     return entry[1](acc) if entry[1] is not None else acc

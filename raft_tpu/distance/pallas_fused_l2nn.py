@@ -1,136 +1,48 @@
-"""Pallas TPU kernel for fused L2 nearest-neighbor (distance + argmin).
-
-Counterpart of the reference's flagship fused kernel ``fusedL2NN``
-(distance/detail/fused_l2_nn.cuh:132 — GEMM tile + per-row KVP argmin with
-atomics/mutexes).  TPUs have no cross-grid atomics; instead the grid is
-(row blocks × centroid blocks) executed sequentially over the centroid
-axis, with the per-row running (min, argmin) held in a REVISITED output
-block (SURVEY.md §7 hard-parts plan: "keep running KVP min per row-block
-in VMEM, tree-merge across grid steps").
-
-Why a hand-written kernel at all: the jnp path (``_fused_l2_nn``) makes
-XLA materialize each (bm, k) distance block to HBM before the argmin
-reduces it — ~2× the matmul's own HBM traffic on the k-means E-step.
-Here the (bm, bn) distance tile never leaves VMEM.
-
-Status (r5): DOCUMENTED SCAFFOLD, not a user-selectable engine.  On the
-only real-TPU path ever exercised (the axon tunnel, r4b session) this
-kernel FAILED TO COMPILE (``remote_compile HTTP 500: tpu_compile_helper
-subprocess exit code 1``), so selecting it on a TPU backend now requires
-``RAFT_TPU_PALLAS_EXPERIMENTAL=1`` in addition to ``RAFT_TPU_PALLAS_NN=1``
-/ ``engine="pallas"`` — the measurement session sets it for the
-pallas_probe/A-B stages (bench/tpu_session.py), which remain armed to
-re-promote the kernel if a future window shows it compiling AND winning
-the sweep.  Numerics stay validated against the jnp path in
-tests/test_pallas_kernels.py via interpret mode (CPU).
+"""Back-compat shim: the fused-L2-NN Pallas kernel GRADUATED to
+:mod:`raft_tpu.kernels.fused_l2nn` (ISSUE 13 — one ``raft_tpu/kernels/``
+home for every ``pl.pallas_call``, plus the new M-step partials hook the
+fused-EM pallas engine runs on).  This module keeps the historical import
+surface (``fused_l2_nn_pallas``, the r5 gates) as thin delegates; the
+gates themselves now parse env in ONE place,
+:mod:`raft_tpu.kernels.engine` — ``is_enabled`` here remains the
+monkeypatch seam ``kernels.engine.resolve_engine("l2nn", ...)`` consults
+for the env default (tests steer engine selection through it).
 """
 
 from __future__ import annotations
 
-import functools
-import os
-
-import jax
-import jax.numpy as jnp
-from jax.experimental import pallas as pl
-
-_BM = 256    # row block
-_BN = 512    # centroid block (bn*d + bm*d + bm*bn f32 must fit VMEM)
-_MAX_D = 2048
-
-
-def _kernel(x_ref, y_ref, yn_ref, val_ref, idx_ref, *, bn: int,
-            bf16_dot: bool):
-    j = pl.program_id(1)
-
-    @pl.when(j == 0)
-    def _():
-        val_ref[...] = jnp.full(val_ref.shape, jnp.inf, val_ref.dtype)
-        idx_ref[...] = jnp.zeros(idx_ref.shape, idx_ref.dtype)
-
-    x = x_ref[...]                                     # (bm, d) f32
-    y = y_ref[...]                                     # (bn, d) f32
-    xn = jnp.sum(x * x, axis=1)                        # (bm,)
-    if bf16_dot:
-        x, y = x.astype(jnp.bfloat16), y.astype(jnp.bfloat16)
-    xy = jax.lax.dot_general(x, y, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    d2 = xn[:, None] + yn_ref[...][None, :] - 2.0 * xy  # (bm, bn) in VMEM
-    d2 = jnp.maximum(d2, 0.0)  # expanded-form rounding can dip negative
-    # (jnp engine clamps identically, fused_l2_nn.py)
-    loc = jnp.argmin(d2, axis=1)                        # (bm,)
-    new_val = jnp.min(d2, axis=1)
-    new_idx = (loc + j * bn).astype(idx_ref.dtype)
-    cur = val_ref[...]
-    better = new_val < cur                              # strict: first block
-    val_ref[...] = jnp.where(better, new_val, cur)      # wins ties (matches
-    idx_ref[...] = jnp.where(better, new_idx, idx_ref[...])  # jnp argmin)
-
-
-@functools.partial(jax.jit, static_argnames=("bm", "bn", "bf16_dot",
-                                             "interpret"))
-def fused_l2_nn_pallas(x, y, bm: int = _BM, bn: int = _BN,
-                       bf16_dot: bool = True, interpret: bool = False):
-    """Per-row (squared L2 distance, index) of the nearest row of *y*.
-
-    Returns (val [m] f32, idx [m] int32).  ``bf16_dot`` runs the MXU
-    contraction in single-pass bfloat16 with f32 accumulation — FASTER but
-    looser than the jnp path's precision="high" (bf16x3): plain bf16 flips
-    ~1% of argmins on adversarial data (pairwise.py measurement), so the
-    k-means wiring maps it to precision="default" only.
-    """
-    m, d = x.shape
-    k = y.shape[0]
-    if d > _MAX_D:
-        raise ValueError(f"fused_l2_nn_pallas: d={d} > {_MAX_D}")
-    bm, bn = min(bm, m), min(bn, k)
-    mp = -(-m // bm) * bm
-    kp = -(-k // bn) * bn
-    xp = jnp.pad(jnp.asarray(x, jnp.float32), ((0, mp - m), (0, 0)))
-    yp = jnp.pad(jnp.asarray(y, jnp.float32), ((0, kp - k), (0, 0)))
-    # padded centroids get +inf norm => +inf distance => never selected
-    yn = jnp.pad(jnp.sum(jnp.asarray(y, jnp.float32) ** 2, axis=1),
-                 (0, kp - k), constant_values=jnp.inf)
-    val, idx = pl.pallas_call(
-        functools.partial(_kernel, bn=bn, bf16_dot=bf16_dot),
-        grid=(mp // bm, kp // bn),
-        in_specs=[
-            pl.BlockSpec((bm, d), lambda i, j: (i, 0)),
-            pl.BlockSpec((bn, d), lambda i, j: (j, 0)),
-            pl.BlockSpec((bn,), lambda i, j: (j,)),
-        ],
-        out_specs=[
-            pl.BlockSpec((bm,), lambda i, j: (i,)),
-            pl.BlockSpec((bm,), lambda i, j: (i,)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((mp,), jnp.float32),
-            jax.ShapeDtypeStruct((mp,), jnp.int32),
-        ],
-        interpret=interpret,
-    )(xp, yp, yn)
-    return val[:m], idx[:m]
+from raft_tpu.kernels.fused_l2nn import (  # noqa: F401
+    _BM,
+    _BN,
+    _MAX_D,
+    fused_l2_nn_pallas,
+)
 
 
 def experimental_unlocked() -> bool:
-    """r5 demotion gate: compiling this kernel on a TPU backend is known
-    to fail over the axon tunnel (module docstring) — the experimental
+    """r5 demotion gate (see kernels.engine): compiling this kernel on a
+    TPU backend is known to fail over the axon tunnel — the experimental
     env var is the explicit acknowledgement the caller is probing that."""
-    return os.environ.get("RAFT_TPU_PALLAS_EXPERIMENTAL", "") == "1"
+    from raft_tpu.kernels.engine import experimental_unlocked as _impl
+
+    return _impl()
 
 
 def is_enabled() -> bool:
-    """Env opt-in, gated on a real TPU backend AND the experimental flag
-    (r5: the kernel is a scaffold until a live A/B re-promotes it).  On
-    CPU the kernel would run under the Pallas interpreter — orders of
-    magnitude slower than the XLA engine it replaces."""
-    return (os.environ.get("RAFT_TPU_PALLAS_NN", "") == "1"
-            and experimental_unlocked()
-            and jax.default_backend() == "tpu")
+    """Env opt-in for the l2nn kind (kernels.engine policy): gated on a
+    real TPU backend AND the experimental flag (r5), or ``force``."""
+    from raft_tpu.kernels.engine import env_enabled
+
+    return env_enabled("l2nn")
 
 
 def interpret_requested() -> bool:
-    """Interpret mode: forced via env, or automatic off-TPU (the compiled
-    Mosaic path is TPU-only; interpret keeps the engine testable on CPU)."""
-    return (os.environ.get("RAFT_TPU_PALLAS_NN_INTERPRET", "") == "1"
-            or jax.default_backend() != "tpu")
+    """Interpret mode: forced via env, or automatic off-TPU (see
+    kernels.engine.interpret_requested)."""
+    from raft_tpu.kernels.engine import interpret_requested as _impl
+
+    return _impl()
+
+
+__all__ = ["fused_l2_nn_pallas", "is_enabled", "experimental_unlocked",
+           "interpret_requested", "_MAX_D", "_BM", "_BN"]

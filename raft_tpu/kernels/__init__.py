@@ -1,0 +1,40 @@
+"""First-class Pallas kernel layer (ISSUE 13; docs/pallas_kernels.md).
+
+The hand-written TPU kernels SURVEY §7 calls the "hard parts" of RAFT —
+the warpsort-equivalent blockwise :mod:`~raft_tpu.kernels.select_k`, the
+tiled :mod:`~raft_tpu.kernels.fused_l2nn` KVP-argmin (with the fused-EM
+M-step partials hook), the :mod:`~raft_tpu.kernels.ivf_pq_lut`
+LUT-in-VMEM scoring engine and the :mod:`~raft_tpu.kernels.pairwise`
+VPU-metric accumulator — each an ENGINE next to an XLA path that computes
+the same thing, selected through the ONE policy home
+:func:`raft_tpu.kernels.engine.resolve_engine`.
+
+Contracts every kernel here ships with:
+
+* an interpret-mode CPU path (tier-1 testable — the continuously-verified
+  numerics oracle, tests/test_pallas_engines.py);
+* bit-identity (select_k, fused_l2_nn) or documented bounded error
+  (the quantized ivf_pq_lut dot paths) against its XLA engine;
+* an ``@hlo_program`` audit entry + committed golden fingerprint
+  (transient ceilings, zero collectives);
+* a registered VMEM ceiling (``VMEM_CEILINGS``) and ``_bucket_dim``-
+  bounded static block shapes — enforced by the ``pallas-discipline``
+  analysis rule, which also keeps ``pl.pallas_call`` out of every other
+  shipped module.
+"""
+
+from raft_tpu.kernels import (  # noqa: F401
+    engine,
+    fused_l2nn,
+    ivf_pq_lut,
+    pairwise,
+    select_k,
+)
+from raft_tpu.kernels.engine import (  # noqa: F401
+    experimental_unlocked,
+    interpret_requested,
+    resolve_engine,
+)
+
+__all__ = ["engine", "fused_l2nn", "ivf_pq_lut", "pairwise", "select_k",
+           "experimental_unlocked", "interpret_requested", "resolve_engine"]

@@ -1,0 +1,166 @@
+"""THE engine-policy home for the Pallas kernel layer.
+
+Every hand-written kernel in :mod:`raft_tpu.kernels` is an *engine choice*
+next to an XLA path that computes the same thing.  Which engine runs is a
+policy question (metric/dtype/k support, env opt-ins, the r5 TPU demotion
+gate), and before this module that policy was re-parsed ad hoc by kmeans
+(``_resolve_engine``), kmeans_mnmg, pairwise (``pallas_kernels.is_enabled``)
+and the fused-L2-NN scaffold (``is_enabled``/``experimental_unlocked``/
+``interpret_requested``) — four slightly different spellings of one
+contract.  :func:`resolve_engine` is now the single implementation; the
+legacy module-level gates survive as thin delegating wrappers (and as the
+monkeypatch seams existing tests rely on).
+
+Env gates (resolved OUTSIDE any jit cache — callers thread the resolved
+string through their programs as a static arg, so flipping a variable
+between calls takes effect and never silently reuses the other engine's
+executable):
+
+``RAFT_TPU_PALLAS``            pairwise VPU-metric accumulate kernel
+``RAFT_TPU_PALLAS_NN``         fused L2 NN / fused-EM E-step kernel
+``RAFT_TPU_PALLAS_SELECT_K``   blockwise select_k (matrix + probe scans)
+``RAFT_TPU_PALLAS_PQ_LUT``     IVF-PQ LUT-in-VMEM scoring kernel
+
+Each accepts ``1`` (enable on a real TPU backend, still behind the
+experimental gate below) or ``force`` (enable on ANY backend — off-TPU the
+kernel runs under the Pallas interpreter; the bench A/B and the multichip
+battery use this to exercise the kernel path on CPU).
+
+``RAFT_TPU_PALLAS_EXPERIMENTAL=1`` is the ONE r5 demotion gate: compiling
+a Pallas kernel on a real TPU backend is known to have failed on the only
+real-TPU path ever exercised (the axon tunnel, BENCH_TPU.md r4b), so the
+compiled-TPU route for EVERY kind requires this explicit acknowledgement.
+Interpret-mode execution (CPU CI, ``force``) does not — interpret is the
+continuously-verified contract (docs/pallas_kernels.md).
+
+``RAFT_TPU_PALLAS_INTERPRET=1`` (or the legacy
+``RAFT_TPU_PALLAS_NN_INTERPRET=1``) forces interpret mode even on TPU.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+#: kernel kinds with a pallas engine and their env opt-in variable
+ENV_GATES = {
+    "pairwise": "RAFT_TPU_PALLAS",
+    "l2nn": "RAFT_TPU_PALLAS_NN",
+    "select_k": "RAFT_TPU_PALLAS_SELECT_K",
+    "pq_lut": "RAFT_TPU_PALLAS_PQ_LUT",
+}
+
+_ENGINES = ("xla", "pallas")
+
+
+def experimental_unlocked() -> bool:
+    """The r5 demotion gate (see module docstring): required for the
+    compiled-TPU route of every kernel kind."""
+    return os.environ.get("RAFT_TPU_PALLAS_EXPERIMENTAL", "") == "1"
+
+
+def env_value(kind: str) -> str:
+    """Raw opt-in env value for *kind* ('' when unset)."""
+    return os.environ.get(ENV_GATES[kind], "")
+
+
+def env_enabled(kind: str) -> bool:
+    """Legacy ``is_enabled`` semantics: the kind's env opt-in is set AND
+    the backend route is viable — a real TPU backend with the experimental
+    acknowledgement, or any backend under ``force`` (interpret)."""
+    import jax
+
+    v = env_value(kind)
+    if v == "force":
+        return True
+    if v != "1":
+        return False
+    return experimental_unlocked() and jax.default_backend() == "tpu"
+
+
+def interpret_requested() -> bool:
+    """Interpret mode: forced via env, or automatic off-TPU (the compiled
+    Mosaic path is TPU-only; interpret keeps every engine testable on
+    CPU)."""
+    import jax
+
+    return (os.environ.get("RAFT_TPU_PALLAS_INTERPRET", "") == "1"
+            or os.environ.get("RAFT_TPU_PALLAS_NN_INTERPRET", "") == "1"
+            or jax.default_backend() != "tpu")
+
+
+def resolve_engine(kind: str, metric=None, dtype=None,
+                   backend: Optional[str] = None,
+                   engine: Optional[str] = None) -> str:
+    """Resolve/validate the engine knob for one kernel *kind* — the single
+    policy function consumed by kmeans, kmeans_mnmg, pairwise, matrix
+    select_k, the IVF probe scans and the serve backends.
+
+    ``engine=None`` resolves the kind's env default (outside any jit
+    cache; see module docstring).  Explicit ``engine="pallas"`` validates
+    support (the L2-family restriction for ``l2nn``) and enforces the r5
+    demotion gate on a compiled-TPU backend; off-TPU it selects the
+    interpret path (CI numerics) without further ceremony.  *metric* /
+    *dtype* narrow the env default — an unsupported combination falls back
+    to "xla" silently rather than crashing an env-opted-in process.
+    """
+    import jax
+
+    if kind not in ENV_GATES:
+        raise ValueError(f"unknown kernel kind {kind!r}; "
+                         f"expected one of {sorted(ENV_GATES)}")
+    backend = backend or jax.default_backend()
+    if engine is None:
+        if kind == "l2nn":
+            # the historically patchable seam: tests monkeypatch
+            # pallas_fused_l2nn.is_enabled to steer the env default
+            from raft_tpu.distance import pallas_fused_l2nn
+
+            on = pallas_fused_l2nn.is_enabled()
+        else:
+            on = env_enabled(kind)
+        if on and _supported(kind, metric, dtype):
+            return "pallas"
+        return "xla"
+    if engine not in _ENGINES:
+        raise ValueError(
+            f"unknown engine {engine!r}; expected 'xla' or 'pallas'")
+    if engine == "pallas":
+        if kind == "l2nn" and not _supported(kind, metric, None):
+            raise ValueError(
+                "engine='pallas' supports only the L2 metric family, "
+                f"got {metric}")
+        # dtype/k narrowing is NOT an error for an explicit choice: the
+        # kernel wrappers fall back to the XLA path per call shape (an
+        # engine string threaded through a generic search program must
+        # not crash on the one unsupported select inside it)
+        if backend == "tpu" and not experimental_unlocked():
+            # r5 demotion: the Pallas kernels failed to compile on the only
+            # real TPU path ever exercised (axon tunnel, BENCH_TPU.md r4b);
+            # the compiled-TPU route needs the explicit experimental flag.
+            # Off-TPU the kernel runs under the interpreter (CI) — allowed.
+            raise ValueError(
+                "engine='pallas' is an experimental scaffold on TPU: the "
+                "kernel failed to compile on the real device (BENCH_TPU.md "
+                "r4b). Set RAFT_TPU_PALLAS_EXPERIMENTAL=1 to probe it.")
+    return engine
+
+
+def _supported(kind: str, metric, dtype) -> bool:
+    """Static support matrix per kind (metric families, dtypes)."""
+    if kind == "l2nn" and metric is not None:
+        from raft_tpu.distance.distance_types import DistanceType
+
+        if metric not in (DistanceType.L2Expanded,
+                          DistanceType.L2SqrtExpanded,
+                          DistanceType.L2Unexpanded,
+                          DistanceType.L2SqrtUnexpanded):
+            return False
+    if kind == "select_k" and dtype is not None:
+        import jax.numpy as jnp
+
+        # the blockwise kernel's lexicographic comparator is validated for
+        # the floating dtypes the search paths emit; ints fall back to XLA
+        if not jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+            return False
+    return True

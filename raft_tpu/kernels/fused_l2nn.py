@@ -1,0 +1,268 @@
+"""Tiled Pallas fused L2 nearest-neighbor: distance + KVP-argmin (+ the
+fused-EM M-step partials) with the distance tile never leaving VMEM.
+
+Counterpart of the reference's flagship fused kernel ``fusedL2NN``
+(distance/detail/fused_l2_nn.cuh:132 — GEMM tile + per-row KVP argmin with
+atomics/mutexes).  TPUs have no cross-grid atomics; instead the grid is
+(row blocks × centroid blocks) executed sequentially over the centroid
+axis, with the per-row running (min, argmin) held in a REVISITED output
+block (SURVEY.md §7 hard-parts plan: "keep running KVP min per row-block
+in VMEM, tree-merge across grid steps").
+
+Why a hand-written kernel at all: the jnp path (``distance.fused_l2_nn``)
+makes XLA materialize each (bm, k) distance block to HBM before the argmin
+reduces it — ~2× the matmul's own HBM traffic on the k-means E-step.
+Here the (bm, bn) distance tile never leaves VMEM.
+
+:func:`fused_l2_nn_partials` is the promoted form ISSUE 13 graduates: the
+M-step partials HOOK.  At each row block's LAST centroid step the finished
+argmin is still live in VMEM, so the kernel builds the (bm, k) one-hot and
+accumulates the fused-EM carry — (k, d) weighted sums and (k,) weights —
+into constant-mapped output blocks, letting ``cluster.fused_em_step`` run
+its whole E-step (and the M-step contraction) without the labels ever
+round-tripping HBM.  Inertia derives outside from the (m,) values already
+emitted (one elementwise pass, no second read of x).
+
+Engine status: interpret mode is the continuously-verified contract; the
+compiled-TPU route sits behind the single r5 demotion gate in
+:mod:`raft_tpu.kernels.engine` (the kernel failed to compile on the only
+real-TPU path ever exercised — the axon tunnel, BENCH_TPU.md r4b — and the
+measurement session stays armed to re-promote it).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from raft_tpu.analysis.registry import hlo_program
+
+_BM = 256    # row block
+_BN = 512    # centroid block (bn*d + bm*d + bm*bn f32 must fit VMEM)
+_MAX_D = 2048
+
+#: declared VMEM ceilings per kernel body (pallas-discipline contract):
+#: x/y tiles + the (bm, bn) distance tile (+ the (kp, d) partials block
+#: for the partials form), f32
+VMEM_CEILINGS = {
+    "_kernel": (_BM + _BN) * _MAX_D * 4 + _BM * _BN * 4,
+    "_em_kernel": (_BM + 2 * _BN) * _MAX_D * 4 + 2 * _BM * _BN * 4,
+}
+
+
+def _kernel(x_ref, y_ref, yn_ref, val_ref, idx_ref, *, bn: int,
+            bf16_dot: bool):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        val_ref[...] = jnp.full(val_ref.shape, jnp.inf, val_ref.dtype)
+        idx_ref[...] = jnp.zeros(idx_ref.shape, idx_ref.dtype)
+
+    x = x_ref[...]                                     # (bm, d) f32
+    y = y_ref[...]                                     # (bn, d) f32
+    xn = jnp.sum(x * x, axis=1)                        # (bm,)
+    if bf16_dot:
+        x, y = x.astype(jnp.bfloat16), y.astype(jnp.bfloat16)
+    xy = jax.lax.dot_general(x, y, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    d2 = xn[:, None] + yn_ref[...][None, :] - 2.0 * xy  # (bm, bn) in VMEM
+    d2 = jnp.maximum(d2, 0.0)  # expanded-form rounding can dip negative
+    # (jnp engine clamps identically, distance.fused_l2_nn)
+    loc = jnp.argmin(d2, axis=1)                        # (bm,)
+    new_val = jnp.min(d2, axis=1)
+    new_idx = (loc + j * bn).astype(idx_ref.dtype)
+    cur = val_ref[...]
+    better = new_val < cur                              # strict: first block
+    val_ref[...] = jnp.where(better, new_val, cur)      # wins ties (matches
+    idx_ref[...] = jnp.where(better, new_idx, idx_ref[...])  # jnp argmin)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "bf16_dot",
+                                             "interpret"))
+def fused_l2_nn_pallas(x, y, bm: int = _BM, bn: int = _BN,
+                       bf16_dot: bool = True, interpret: bool = False):
+    """Per-row (squared L2 distance, index) of the nearest row of *y*.
+
+    Returns (val [m] f32, idx [m] int32).  ``bf16_dot`` runs the MXU
+    contraction in single-pass bfloat16 with f32 accumulation — FASTER but
+    looser than the jnp path's precision="high" (bf16x3): plain bf16 flips
+    ~1% of argmins on adversarial data (pairwise.py measurement), so the
+    k-means wiring maps it to precision="default" only.
+    """
+    m, d = x.shape
+    k = y.shape[0]
+    if d > _MAX_D:
+        raise ValueError(f"fused_l2_nn_pallas: d={d} > {_MAX_D}")
+    bm, bn = min(bm, m), min(bn, k)
+    mp = -(-m // bm) * bm
+    kp = -(-k // bn) * bn
+    xp = jnp.pad(jnp.asarray(x, jnp.float32), ((0, mp - m), (0, 0)))
+    yp = jnp.pad(jnp.asarray(y, jnp.float32), ((0, kp - k), (0, 0)))
+    # padded centroids get +inf norm => +inf distance => never selected
+    yn = jnp.pad(jnp.sum(jnp.asarray(y, jnp.float32) ** 2, axis=1),
+                 (0, kp - k), constant_values=jnp.inf)
+    val, idx = pl.pallas_call(
+        functools.partial(_kernel, bn=bn, bf16_dot=bf16_dot),
+        grid=(mp // bm, kp // bn),
+        in_specs=[
+            pl.BlockSpec((bm, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((bn,), lambda i, j: (j,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm,), lambda i, j: (i,)),
+            pl.BlockSpec((bm,), lambda i, j: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp,), jnp.float32),
+            jax.ShapeDtypeStruct((mp,), jnp.int32),
+        ],
+        interpret=interpret,
+    )(xp, yp, yn)
+    return val[:m], idx[:m]
+
+
+# ---------------------------------------------------------------------------
+# the M-step partials hook (ISSUE 13): E-step argmin + fused-EM carry in
+# ONE kernel pass over x
+# ---------------------------------------------------------------------------
+
+
+def _em_kernel(x_ref, w_ref, y_ref, yn_ref, val_ref, idx_ref, sums_ref,
+               wsum_ref, *, bn: int, bf16_dot: bool):
+    i, j = pl.program_id(0), pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when((i == 0) & (j == 0))
+    def _():
+        sums_ref[...] = jnp.zeros(sums_ref.shape, sums_ref.dtype)
+        wsum_ref[...] = jnp.zeros(wsum_ref.shape, wsum_ref.dtype)
+
+    @pl.when(j == 0)
+    def _():
+        val_ref[...] = jnp.full(val_ref.shape, jnp.inf, val_ref.dtype)
+        idx_ref[...] = jnp.zeros(idx_ref.shape, idx_ref.dtype)
+
+    x = x_ref[...]                                     # (bm, d) f32
+    y = y_ref[...]                                     # (bn, d) f32
+    xn = jnp.sum(x * x, axis=1)
+    xd, yd = (x.astype(jnp.bfloat16), y.astype(jnp.bfloat16)) \
+        if bf16_dot else (x, y)
+    xy = jax.lax.dot_general(xd, yd, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    d2 = jnp.maximum(xn[:, None] + yn_ref[...][None, :] - 2.0 * xy, 0.0)
+    loc = jnp.argmin(d2, axis=1)
+    new_val = jnp.min(d2, axis=1)
+    new_idx = (loc + j * bn).astype(idx_ref.dtype)
+    cur = val_ref[...]
+    better = new_val < cur
+    val_ref[...] = jnp.where(better, new_val, cur)
+    idx_ref[...] = jnp.where(better, new_idx, idx_ref[...])
+
+    @pl.when(j == nj - 1)
+    def _():
+        # the row block's argmin is FINAL here and still lives in VMEM:
+        # build its one-hot and fold the M-step partials before the tile
+        # retires — the labels never round-trip HBM (docs/fused_em.md).
+        # Padding rows carry weight 0 (the caller's contract), touching
+        # neither the sums nor the weights.
+        idx = idx_ref[...]                             # (bm,) final labels
+        w = w_ref[...]                                 # (bm,) f32
+        kp_total = sums_ref.shape[0]
+        oh = (idx[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (idx.shape[0], kp_total), 1)).astype(jnp.float32)
+        ohw = oh * w[:, None]
+        sums_ref[...] += jax.lax.dot_general(
+            ohw, x, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (kp_total, d)
+        wsum_ref[...] += jnp.sum(ohw, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "bf16_dot",
+                                             "interpret"))
+def _fused_l2_nn_partials(x, y, w, bm: int = _BM, bn: int = _BN,
+                          bf16_dot: bool = False, interpret: bool = False):
+    m, d = x.shape
+    k = y.shape[0]
+    if d > _MAX_D:
+        raise ValueError(f"fused_l2_nn_partials: d={d} > {_MAX_D}")
+    bm, bn = min(bm, m), min(bn, k)
+    mp = -(-m // bm) * bm
+    kp = -(-k // bn) * bn
+    xp = jnp.pad(jnp.asarray(x, jnp.float32), ((0, mp - m), (0, 0)))
+    yp = jnp.pad(jnp.asarray(y, jnp.float32), ((0, kp - k), (0, 0)))
+    yn = jnp.pad(jnp.sum(jnp.asarray(y, jnp.float32) ** 2, axis=1),
+                 (0, kp - k), constant_values=jnp.inf)
+    # padding rows weigh 0: they reach SOME argmin but contribute nothing
+    wp = jnp.pad(jnp.asarray(w, jnp.float32), (0, mp - m))
+    val, idx, sums, wsum = pl.pallas_call(
+        functools.partial(_em_kernel, bn=bn, bf16_dot=bf16_dot),
+        grid=(mp // bm, kp // bn),
+        in_specs=[
+            pl.BlockSpec((bm, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((bm,), lambda i, j: (i,)),
+            pl.BlockSpec((bn, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((bn,), lambda i, j: (j,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm,), lambda i, j: (i,)),
+            pl.BlockSpec((bm,), lambda i, j: (i,)),
+            pl.BlockSpec((kp, d), lambda i, j: (0, 0)),
+            pl.BlockSpec((kp,), lambda i, j: (0,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp,), jnp.float32),
+            jax.ShapeDtypeStruct((mp,), jnp.int32),
+            jax.ShapeDtypeStruct((kp, d), jnp.float32),
+            jax.ShapeDtypeStruct((kp,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xp, wp, yp, yn)
+    return val[:m], idx[:m], sums[:k], wsum[:k]
+
+
+def fused_l2_nn_partials(x, y, weights=None, bf16_dot: bool = False,
+                         interpret: bool = None):
+    """Single-pass fused E-step + M-step partials: per-row nearest
+    centroid (value, index) AND the fused-EM carry ((k, d) Σ w·x per
+    cluster, (k,) Σ w, () Σ w·dist²) from ONE kernel pass over x — the
+    engine ``cluster.fused_em_step(engine="pallas")`` dispatches.
+
+    *weights* defaults to all-ones (unweighted).  Returns
+    ``(val (m,) f32, idx (m,) int32, sums (k, d) f32, wsum (k,) f32,
+    inertia () f32)``.  Traceable (the k-means fit loop jits over it).
+    """
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if interpret is None:
+        from raft_tpu.kernels.engine import interpret_requested
+
+        interpret = interpret_requested()
+    w = (jnp.ones((x.shape[0],), jnp.float32) if weights is None
+         else jnp.asarray(weights, jnp.float32))
+    val, idx, sums, wsum = _fused_l2_nn_partials(
+        x, y, w, bf16_dot=bool(bf16_dot), interpret=bool(interpret))
+    inertia = jnp.sum(val * w)
+    return val, idx, sums, wsum, inertia
+
+
+@hlo_program(
+    "kernels.fused_l2_nn",
+    collectives=0, collective_bytes=0,
+    # interpret-mode lowering at the audit shape: padded x/w copies + one
+    # (bm, d) row tile + the (k, d) partials block (the compiled-TPU VMEM
+    # story is VMEM_CEILINGS; this audits the shipped CPU/CI lowering)
+    transient_bytes=8 << 20,
+    notes="tiled fused-L2-NN KVP-argmin with the M-step partials hook — "
+          "the pallas engine behind cluster.fused_em_step "
+          "(docs/pallas_kernels.md)")
+def _audit_fused_l2_nn():
+    x = jax.ShapeDtypeStruct((2048, 64), jnp.float32)
+    y = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    w = jax.ShapeDtypeStruct((2048,), jnp.float32)
+    return dict(lowered=_fused_l2_nn_partials.lower(
+        x, y, w, bm=_BM, bn=_BN, bf16_dot=False, interpret=True))

@@ -1,0 +1,168 @@
+"""IVF-PQ LUT-in-VMEM scoring kernel: packed codes × resident lookup table.
+
+Counterpart of the reference's shared-memory LUT scoring loop
+(ivf_pq_search.cuh:594-738 — the LUT is staged into smem once per probe
+and every packed code scores against it with 8/4-bit dot paths, SURVEY §7
+"hard parts").  The XLA hoisted-ADC engine (docs/ivf_pq_adc.md) already
+builds the (nq, pq_dim·2^bits) LUT once per batch, but its scan body
+round-trips two index-wide intermediates through HBM per probe tile: the
+bit-UNPACKED (nq, cap, pq_dim) int32 code tensor and the materialized
+one-hot it feeds the MXU.  Here both exist only tile-at-a-time in VMEM:
+
+* grid = (query blocks × candidate blocks); the LUT block's index map is
+  ``(i, j) → (i, 0)`` so one (bq, pq_dim·2^bits) LUT stays RESIDENT in
+  VMEM across the whole candidate axis — the smem-LUT analogue;
+* each step unpacks its (bq, bc, code_bytes) packed-code block with VPU
+  shift/mask ops and contracts the one-hot against the LUT in the LUT's
+  OWN dtype (bf16/fp8 one-hots ride the MXU 8/16-bit dot paths with f32
+  accumulation via ``preferred_element_type`` — the §7 "8/4-bit paths");
+* scores land in f32; the caller's dequant epilogue (affine inverse +
+  base add) is unchanged.
+
+Accuracy contract: the one-hot contraction sums the same pq_dim LUT
+entries as the XLA engine's gather-sum but in a different association
+order, so f32 scores agree to ~1 ulp·pq_dim (BOUNDED error, documented in
+docs/pallas_kernels.md §error bounds); the int8/fp8 LUT dtypes were
+already quantized upstream and dequantize identically.  Top-k agreement
+is pinned by tests/test_pallas_engines.py.
+
+VMEM per grid step (defaults, fp8 LUT): LUT 8·4096 ≈ 32 KB + codes block
++ the (bq, bc, pq_dim·2^bits) one-hot ≈ 8·128·4096 ≈ 4 MB — registered in
+:data:`VMEM_CEILINGS`, audited via the ``kernels.ivf_pq_lut`` entry.
+
+Engine status: interpret mode is the continuously-verified contract; the
+compiled-TPU route sits behind the single r5 demotion gate in
+:mod:`raft_tpu.kernels.engine`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from raft_tpu.analysis.registry import hlo_program
+
+_BQ = 8      # query block
+_BC = 128    # candidate block
+#: largest flattened LUT row (pq_dim · 2^bits) the engine accepts — the
+#: one-hot block must fit VMEM next to the resident LUT
+MAX_LUT_WIDTH = 4096
+
+#: declared VMEM ceilings per kernel body (pallas-discipline contract):
+#: resident LUT + packed-code block + the one-hot at its f32 worst case
+VMEM_CEILINGS = {
+    "_lut_kernel": (_BQ * MAX_LUT_WIDTH * 4
+                    + _BQ * _BC * MAX_LUT_WIDTH * 4 + _BQ * _BC * 64),
+}
+
+
+def _unpack_block(packed, pq_dim: int, pq_bits: int):
+    """(…, code_bytes) uint8 → (…, pq_dim) int32 — VPU shift/mask only
+    (mirrors ``ivf_pq._unpack_codes``; lives here so the kernel body has
+    no cross-module trace dependency)."""
+    if pq_bits == 8:
+        return packed.astype(jnp.int32)
+    lead = packed.shape[:-1]
+    bits = (packed.astype(jnp.int32)[..., :, None]
+            >> jnp.arange(8, dtype=jnp.int32)) & 1
+    bits = bits.reshape(lead + (packed.shape[-1] * 8,))[
+        ..., :pq_dim * pq_bits]
+    bits = bits.reshape(lead + (pq_dim, pq_bits))
+    return jnp.sum(bits << jnp.arange(pq_bits, dtype=jnp.int32), axis=-1)
+
+
+def _lut_kernel(codes_ref, lut_ref, o_ref, *, pq_dim: int, pq_bits: int,
+                kcb: int, f32_dot: bool):
+    codes = _unpack_block(codes_ref[...], pq_dim, pq_bits)  # (bq, bc, pq_dim)
+    bq, bc = codes.shape[0], codes.shape[1]
+    lut = lut_ref[...]                                      # (bq, F) resident
+    # per-subspace one-hots; flattening the (pq_dim, kcb) tail places
+    # subspace m's hot lane in the m-th kcb segment — one block-diagonal
+    # (bc, pq_dim·kcb) multi-hot, ONE MXU contraction per step in the
+    # LUT's own dtype (8/16-bit dot paths)
+    f = pq_dim * kcb
+    oh = (codes[:, :, :, None]
+          == jax.lax.broadcasted_iota(jnp.int32, (bq, bc, pq_dim, kcb), 3))
+    dot_t = jnp.float32 if f32_dot else lut.dtype
+    o_ref[...] = jax.lax.dot_general(
+        oh.reshape(bq, bc, f).astype(dot_t), lut.astype(dot_t),
+        (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)                 # (bq, bc)
+
+
+@functools.partial(jax.jit, static_argnames=("pq_dim", "pq_bits", "kcb",
+                                             "bq", "bc", "interpret"))
+def _lut_score_pallas(codes_packed, lut, pq_dim: int, pq_bits: int,
+                      kcb: int, bq: int = _BQ, bc: int = _BC,
+                      interpret: bool = False):
+    """Scores (nq, cap) f32 of packed codes against a per-query flattened
+    LUT: out[q, c] = Σ_m lut[q, m·kcb + code[q, c, m]].
+
+    *codes_packed* (nq, cap, code_bytes) uint8; *lut* (nq, pq_dim·kcb) in
+    the LUT dtype.  Query/candidate dims pad to block multiples; padded
+    candidates score garbage rows that the caller's live-slot mask
+    discards (``scan_probe_lists`` masks by list size before select).
+    """
+    nq, cap, nbytes = codes_packed.shape
+    f = pq_dim * kcb
+    bq = min(bq, max(1, nq))
+    bc = min(bc, max(8, -(-cap // 8) * 8))
+    qp = -(-nq // bq) * bq
+    cp = -(-cap // bc) * bc
+    codes_p = jnp.pad(codes_packed, ((0, qp - nq), (0, cp - cap), (0, 0)))
+    lut_p = jnp.pad(lut, ((0, qp - nq), (0, 0)))
+    # fp8 operand dots are a TPU MXU path; the interpret/CPU contract
+    # upcasts to f32 (XLA:CPU has no f8 dot) — compiled TPU keeps the
+    # narrow dtype end to end
+    f32_dot = interpret or jnp.dtype(lut.dtype).itemsize < 2
+    out = pl.pallas_call(
+        functools.partial(_lut_kernel, pq_dim=pq_dim, pq_bits=pq_bits,
+                          kcb=kcb, f32_dot=f32_dot),
+        grid=(qp // bq, cp // bc),
+        in_specs=[
+            pl.BlockSpec((bq, bc, nbytes), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((bq, f), lambda i, j: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bq, bc), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((qp, cp), jnp.float32),
+        interpret=interpret,
+    )(codes_p, lut_p)
+    return out[:nq, :cap]
+
+
+def supports(pq_dim: int, kcb: int) -> bool:
+    """The one-hot block must fit VMEM next to the resident LUT."""
+    return pq_dim * kcb <= MAX_LUT_WIDTH
+
+
+def lut_score(codes_packed, lut, pq_dim: int, pq_bits: int, kcb: int,
+              interpret: bool = None):
+    """Public entry (traceable — the probe scan's tile callback calls it
+    per step; eager callers reach it through the search paths' AOT
+    caches).  Returns (nq, cap) f32 scores."""
+    if interpret is None:
+        from raft_tpu.kernels.engine import interpret_requested
+
+        interpret = interpret_requested()
+    return _lut_score_pallas(codes_packed, lut, int(pq_dim), int(pq_bits),
+                             int(kcb), interpret=bool(interpret))
+
+
+@hlo_program(
+    "kernels.ivf_pq_lut",
+    collectives=0, collective_bytes=0,
+    # interpret-mode lowering at the audit shape: padded code/LUT copies +
+    # one (bq, bc, F) one-hot tile (the compiled-TPU VMEM story is
+    # VMEM_CEILINGS; this audits the shipped CPU/CI lowering)
+    transient_bytes=8 << 20,
+    notes="IVF-PQ LUT-in-VMEM scoring: resident per-query LUT × packed "
+          "codes via one-hot MXU dots (docs/pallas_kernels.md)")
+def _audit_ivf_pq_lut():
+    codes = jax.ShapeDtypeStruct((64, 64, 8), jnp.uint8)
+    lut = jax.ShapeDtypeStruct((64, 8 * 256), jnp.float32)
+    return dict(lowered=_lut_score_pallas.lower(
+        codes, lut, pq_dim=8, pq_bits=8, kcb=256, bq=_BQ, bc=_BC,
+        interpret=True))

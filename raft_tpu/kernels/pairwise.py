@@ -1,0 +1,127 @@
+"""Pallas TPU kernels for the VPU-engine pairwise distances.
+
+Counterpart of the reference's tiled ``PairwiseDistances`` CUDA kernel
+template (distance/detail/pairwise_distance_base.cuh:76 — smem tiles +
+per-metric CoreLambda): a Pallas kernel with a (rows, cols, k) grid where
+each instance holds a (bm, bk) x-tile and (bn, bk) y-tile in VMEM and
+accumulates the metric's elementwise reduction into a revisited (bm, bn)
+output block.  The k-chunk loop is unrolled so every step is one
+broadcast VPU op over the (bm, bn) tile — the Pallas analogue of the
+reference's per-register accumulate lambdas.
+
+Only the *accumulation* runs in the kernel; each metric's finalization
+(sqrt, ^1/p, /k) is fused by XLA outside — the reference's
+EpilogueLambda/fin_op split.
+
+Covers metrics with no inner-product form (L1, unexpanded L2, Linf,
+Canberra, Lp, Hamming); MXU metrics stay on ``x @ y.T``.
+
+Status: OPT-IN (``RAFT_TPU_PALLAS=1``; engine policy lives in
+:mod:`raft_tpu.kernels.engine`).  Measured on v5e, XLA's own fusion of
+the jnp ``_blocked_reduce`` tiling matches or beats this kernel
+(Canberra 5000×5000×50: 12.7 ms jnp vs 15.5 ms Pallas) — the broadcast
+elementwise-reduce pattern is one XLA already schedules optimally on the
+VPU, unlike the gather-heavy PQ scoring where the hand-written one-hot
+contraction wins 6×.  The kernel is kept as the scaffold for ops XLA
+cannot fuse (and as the reference point those measurements came from).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_BM = 128   # output row block (bm·k + bn·k + bm·bn tiles must fit VMEM)
+_BN = 128   # output col block
+
+#: declared VMEM ceiling per kernel body (pallas-discipline contract):
+#: full-k x/y tiles + the output tile at the k cap, f32
+VMEM_CEILINGS = {
+    "_kernel": (_BM + _BN) * 512 * 4 + _BM * _BN * 4,
+}
+
+# (elementwise accumulate, merge, init, needs_power_epilogue)
+_OPS = {
+    "l1": (lambda xv, yv, p: jnp.abs(xv - yv), "add"),
+    "l2": (lambda xv, yv, p: (xv - yv) ** 2, "add"),
+    "linf": (lambda xv, yv, p: jnp.abs(xv - yv), "max"),
+    "lp": (lambda xv, yv, p: jnp.abs(xv - yv) ** p, "add"),
+    "hamming": (lambda xv, yv, p: (xv != yv).astype(xv.dtype), "add"),
+    "canberra": (lambda xv, yv, p: _canberra_elem(xv, yv), "add"),
+}
+
+
+def _canberra_elem(xv, yv):
+    num = jnp.abs(xv - yv)
+    den = jnp.abs(xv) + jnp.abs(yv)
+    return jnp.where(den > 0, num / jnp.where(den > 0, den, 1.0), 0.0)
+
+
+_MAX_K = 512  # above this the unrolled k loop bloats compile time → jnp path
+
+
+def _kernel(x_ref, y_ref, o_ref, *, op: str, p: float, k: int):
+    elem, merge = _OPS[op]
+    x = x_ref[...]                       # (bm, K)
+    y = y_ref[...]                       # (bn, K)
+    acc = jnp.zeros_like(o_ref)
+    # Unrolled k loop: each step is one broadcast VPU op on the full
+    # (bm, bn) tile (the reference's per-veclen accumulate lambda).
+    for kk in range(k):
+        part = elem(x[:, kk][:, None], y[:, kk][None, :], p)
+        acc = acc + part if merge == "add" else jnp.maximum(acc, part)
+    o_ref[...] = acc
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("op", "p", "bm", "bn", "interpret"))
+def _pairwise_pallas(x, y, op: str, p: float = 2.0, bm: int = _BM,
+                     bn: int = _BN, interpret: bool = False):
+    """Accumulated metric over all pairs: out[i, j] = Σ/max_k elem(x_ik, y_jk).
+
+    Row/col dims are padded to block multiples; padded entries contribute
+    elem(0, 0) = 0 for every supported op, so no in-kernel masking is
+    needed and the padding is sliced off at the end.  Each grid instance
+    holds full-k x/y tiles in VMEM (k ≤ _MAX_K by dispatch).
+    """
+    m, k = x.shape
+    n = y.shape[0]
+    bm = min(bm, max(8, -(-m // 8) * 8))
+    bn = min(bn, max(128, -(-n // 128) * 128))
+    mp = -(-m // bm) * bm
+    np_ = -(-n // bn) * bn
+    xp = jnp.pad(x, ((0, mp - m), (0, 0)))
+    yp = jnp.pad(y, ((0, np_ - n), (0, 0)))
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, op=op, p=p, k=k),
+        grid=(mp // bm, np_ // bn),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, k), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), x.dtype),
+        interpret=interpret,
+    )(xp, yp)
+    return out[:m, :n]
+
+
+def is_enabled(k: int = 0) -> bool:
+    """Env opt-in for the pairwise kind (kernels.engine policy) plus the
+    unrolled-k compile-time cap."""
+    from raft_tpu.kernels.engine import env_enabled
+
+    if k and k > _MAX_K:
+        return False
+    return env_enabled("pairwise")
+
+
+def pairwise_accumulate(x, y, op: str, p: float = 2.0,
+                        interpret: bool = False):
+    """Public entry: raw accumulated values (finalization is the caller's,
+    matching the reference CoreLambda/EpilogueLambda split)."""
+    return _pairwise_pallas(x, y, op, p, interpret=interpret)

@@ -1,0 +1,247 @@
+"""Blockwise Pallas ``select_k`` — the TPU equivalent of RAFT's warpsort.
+
+Counterpart of the reference's warp-sort top-k engine
+(matrix/detail/select_k... topk/warpsort_topk.cuh): each CUDA warp keeps a
+sorted per-thread queue and bitonic-merges candidate batches into it.
+TPUs have no warps; the analogue here is a Pallas kernel over a
+(row blocks × column blocks) grid whose per-row running top-k lives in a
+REVISITED (bm, kp) output block in VMEM:
+
+1. each grid step bitonic-SORTS its (bm, bn) tile along the lane axis on
+   the lexicographic key ``(value, position)`` — all keys are distinct, so
+   the total order equals the stable order ``jax.lax.top_k`` implements
+   (ties → lowest position), and
+2. the tile's best kp lanes bitonic-MERGE with the running run (carry
+   positions are always lower than the tile's, so the position tie-break
+   reproduces the run-a-wins contract of ``matrix.select_k.
+   merge_sorted_runs`` for free).
+
+Compare-exchange partners are reached with lane ``roll``s (partner of lane
+``p`` at distance ``s`` is ``p ^ s``), so no lane-axis reshapes are needed.
+NaN ranks as the WORST value with ties by position — the same preorder the
+XLA engine's filtered path uses — and returned values gather from the RAW
+input by position, so the public result is BIT-IDENTICAL to the XLA
+engine (pinned by tests/test_pallas_engines.py).
+
+VMEM per grid step: the (bm, bn) tile + its position plane + the (bm, 2kp)
+merge scratch — ~``_BM·_BN·8`` bytes ≈ 2 MB at the defaults, far under the
+~16 MB/core budget (the ceiling is registered in :data:`VMEM_CEILINGS` and
+audited via the ``kernels.select_k`` ``@hlo_program`` entry).
+
+Engine status: interpret mode is the continuously-verified contract
+(docs/pallas_kernels.md); the compiled-TPU route sits behind the single r5
+demotion gate in :mod:`raft_tpu.kernels.engine`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from raft_tpu.analysis.registry import hlo_program
+
+_BM = 64     # row block
+#: column block (power of two — the bitonic network width).  256 balances
+#: scan-step count against the sort network's depth: stage count grows
+#: log²(bn) and the INTERPRET lowering's compile time tracks it almost
+#: linearly (measured ~40% faster cold compiles than bn=512 on XLA:CPU
+#: at equal numerics), while the compiled-TPU grid just runs more cheap
+#: column steps
+_BN = 256
+#: largest k the blockwise engine accepts (kp = next-pow2(k) must fit the
+#: column block; the search paths' k/n_probes sit well under this)
+MAX_K = 128
+
+#: declared VMEM ceilings per kernel body (pallas-discipline contract):
+#: tile + positions + merge scratch + carry, f32 worst case
+VMEM_CEILINGS = {
+    "_select_kernel": _BM * _BN * 2 * 4 + _BM * 4 * MAX_K * 2 * 4,
+}
+
+
+def _next_pow2(v: int) -> int:
+    return 1 << max(0, int(v - 1).bit_length())
+
+
+def _better(av, ai, bv, bi, select_min: bool):
+    """Lexicographic ``(value, position)`` — the stable-top-k total order."""
+    b = (av < bv) if select_min else (av > bv)
+    return b | ((av == bv) & (ai < bi))
+
+
+def _compare_exchange(v, i, stride: int, size, select_min: bool):
+    """One bitonic compare-exchange stage at XOR-partner distance *stride*.
+
+    *size* selects region direction ((lane & size) == 0 → best-first);
+    ``None`` means all regions ascend (the merge network's stages)."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, v.shape, v.ndim - 1)
+    upper = (lane & stride) != 0
+    pv = jnp.where(upper, jnp.roll(v, stride, axis=-1),
+                   jnp.roll(v, -stride, axis=-1))
+    pi = jnp.where(upper, jnp.roll(i, stride, axis=-1),
+                   jnp.roll(i, -stride, axis=-1))
+    keep = _better(v, i, pv, pi, select_min) ^ upper
+    if size is not None:
+        keep = jnp.where((lane & size) == 0, keep, ~keep)
+    return jnp.where(keep, v, pv), jnp.where(keep, i, pi)
+
+
+def _bitonic_sort(v, i, select_min: bool):
+    """Full bitonic sort along lanes, best-first (statically unrolled:
+    log²(bn) vectorized stages over the whole tile)."""
+    n = v.shape[-1]
+    size = 2
+    while size <= n:
+        stride = size // 2
+        while stride >= 1:
+            v, i = _compare_exchange(v, i, stride, size, select_min)
+            stride //= 2
+        size *= 2
+    return v, i
+
+
+def _bitonic_merge(v, i, select_min: bool):
+    """Merge a bitonic (ascending-then-descending) lane sequence into
+    best-first order — the carry ⊕ reversed-tile-run step."""
+    stride = v.shape[-1] // 2
+    while stride >= 1:
+        v, i = _compare_exchange(v, i, stride, None, select_min)
+        stride //= 2
+    return v, i
+
+
+def _select_kernel(x_ref, val_ref, pos_ref, *, kp: int, bn: int,
+                   select_min: bool, worst):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        # sentinel carry: worst value + max position loses every
+        # lexicographic comparison against a real entry
+        val_ref[...] = jnp.full(val_ref.shape, worst, val_ref.dtype)
+        pos_ref[...] = jnp.full(pos_ref.shape, jnp.iinfo(jnp.int32).max,
+                                jnp.int32)
+
+    v = x_ref[...]                                        # (bm, bn)
+    pos = (jax.lax.broadcasted_iota(jnp.int32, v.shape, 1)
+           + j * bn)                                      # global positions
+    if jnp.issubdtype(v.dtype, jnp.inexact):
+        # NaN → worst value, ties by position (the XLA engine's preorder;
+        # raw values are re-gathered by position outside the kernel, so
+        # selected NaN slots still come back as NaN)
+        v = jnp.where(jnp.isnan(v), jnp.asarray(worst, v.dtype), v)
+    v, pos = _bitonic_sort(v, pos, select_min)
+    # carry is run a (earlier columns — lower positions win value ties);
+    # carry ++ reversed tile-run is bitonic, one merge network sorts it
+    mv = jnp.concatenate([val_ref[...], v[:, kp - 1::-1]], axis=1)
+    mp = jnp.concatenate([pos_ref[...], pos[:, kp - 1::-1]], axis=1)
+    mv, mp = _bitonic_merge(mv, mp, select_min)
+    val_ref[...] = mv[:, :kp]
+    pos_ref[...] = mp[:, :kp]
+
+
+def _worst_value(dtype, select_min: bool):
+    if jnp.issubdtype(dtype, jnp.inexact):
+        return jnp.inf if select_min else -jnp.inf
+    info = jnp.iinfo(dtype)
+    return info.max if select_min else info.min
+
+
+@functools.partial(jax.jit, static_argnames=("k", "select_min", "bm", "bn",
+                                             "interpret"))
+def _select_k_pallas(values, k: int, select_min: bool, bm: int = _BM,
+                     bn: int = _BN, interpret: bool = False):
+    """Best-first (sanitized values, positions) of the k best per row.
+
+    Rows are padded to ``bm`` multiples and columns to ``bn`` multiples
+    with the worst value; padded columns carry real (out-of-range)
+    positions ABOVE every in-range one, so they lose every tie against a
+    real entry and can never be selected while k ≤ n.
+    """
+    lead = values.shape[:-1]
+    n = values.shape[-1]
+    x = values.reshape((-1, n))
+    if (jnp.issubdtype(x.dtype, jnp.floating)
+            and jnp.dtype(x.dtype).itemsize < 4):
+        # run the comparator network in f32: the widening is exact and
+        # injective for bf16/f16, so ORDER AND TIES are unchanged and the
+        # returned positions are bit-identical — while the narrow-dtype
+        # interpret lowering compiles ~10× slower on XLA:CPU (unfused
+        # convert chains per compare-exchange stage).  Callers gather the
+        # raw values by position, so the public dtype is untouched.
+        x = x.astype(jnp.float32)
+    m = x.shape[0]
+    kp = _next_pow2(max(int(k), 8))
+    bn = max(min(bn, _next_pow2(n)), 2 * kp)
+    bm = min(bm, max(8, -(-m // 8) * 8))
+    worst = _worst_value(x.dtype, select_min)
+    mp = -(-m // bm) * bm
+    np_ = -(-n // bn) * bn
+    x = jnp.pad(x, ((0, mp - m), (0, np_ - n)), constant_values=worst)
+    vals, pos = pl.pallas_call(
+        functools.partial(_select_kernel, kp=kp, bn=bn,
+                          select_min=select_min, worst=worst),
+        grid=(mp // bm, np_ // bn),
+        in_specs=[pl.BlockSpec((bm, bn), lambda i, j: (i, j))],
+        out_specs=[
+            pl.BlockSpec((bm, kp), lambda i, j: (i, 0)),
+            pl.BlockSpec((bm, kp), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, kp), x.dtype),
+            jax.ShapeDtypeStruct((mp, kp), jnp.int32),
+        ],
+        interpret=interpret,
+    )(x)
+    k = int(k)
+    return (vals[:m, :k].reshape(lead + (k,)),
+            pos[:m, :k].reshape(lead + (k,)))
+
+
+def supports(k: int, n: int, dtype) -> bool:
+    """Static support matrix: the engine handles floating rows with
+    ``k ≤ MAX_K ≤ n``; everything else falls back to the XLA path (the
+    caller's guard — kept here so the policy is one predicate)."""
+    return (int(k) <= MAX_K and int(k) <= int(n)
+            and jnp.issubdtype(jnp.dtype(dtype), jnp.floating))
+
+
+def select_k_blockwise(values, k: int, select_min: bool = True,
+                       interpret: bool = None):
+    """Public entry: (values, positions) of the k best per row, sorted
+    best-first with ties at the lowest position — BIT-IDENTICAL to
+    ``matrix.select_k``'s XLA engine (values re-gathered from the raw
+    input by position).  Traceable; eager callers reach it through
+    ``matrix.select_k(engine="pallas")``'s AOT cache."""
+    values = jnp.asarray(values)
+    if interpret is None:
+        from raft_tpu.kernels.engine import interpret_requested
+
+        interpret = interpret_requested()
+    _, pos = _select_k_pallas(values, int(k), bool(select_min),
+                              interpret=bool(interpret))
+    return jnp.take_along_axis(values, pos, axis=-1), pos
+
+
+@hlo_program(
+    "kernels.select_k",
+    collectives=0, collective_bytes=0,
+    # interpret-mode lowering at the audit shape: XLA:CPU materializes a
+    # handful of whole-tile (bm, bn) value/position planes per live
+    # compare-exchange stage (measured ~10 MB at (64, 4096), bn=256); the
+    # compiled-TPU VMEM story is VMEM_CEILINGS — this ceiling bounds the
+    # shipped CPU/CI lowering against regressions that would materialize
+    # the grid-wide padded input per stage instead
+    transient_bytes=16 << 20,
+    notes="blockwise bitonic select_k (warpsort analogue) — the pallas "
+          "engine behind matrix.select_k and the IVF probe scans "
+          "(docs/pallas_kernels.md)")
+def _audit_select_k():
+    x = jax.ShapeDtypeStruct((64, 4096), jnp.float32)
+    # interpret=True: the audit env is CPU (ci/checks.sh forces it); the
+    # compiled Mosaic lowering is TPU-only and r5-gated
+    return dict(lowered=_select_k_pallas.lower(
+        x, k=64, select_min=True, bm=_BM, bn=_BN, interpret=True))

@@ -123,12 +123,23 @@ def _top_k_filtered(values, k: int, select_min: bool
     return jnp.take_along_axis(cand, ci, axis=-1), pos
 
 
-def _select_k_impl(values, k: int, select_min: bool):
+def _select_k_impl(values, k: int, select_min: bool, engine: str = "xla"):
+    if engine == "pallas":
+        from raft_tpu.kernels import select_k as pallas_select_k
+
+        # unsupported (k, n, dtype) combinations keep the XLA path — the
+        # engine knob is a preference, never a crash (the env-opted-in
+        # probe scans pass k/cap shapes the kernel may not cover)
+        if (values.size != 0
+                and pallas_select_k.supports(k, values.shape[-1],
+                                             values.dtype)):
+            return pallas_select_k.select_k_blockwise(values, k, select_min)
     return _top_k_filtered(values, k, select_min)
 
 
-def _select_k_payload_impl(values, indices, k: int, select_min: bool):
-    vals, idx = _select_k_impl(values, k, select_min)
+def _select_k_payload_impl(values, indices, k: int, select_min: bool,
+                           engine: str = "xla"):
+    vals, idx = _select_k_impl(values, k, select_min, engine)
     return vals, jnp.take_along_axis(indices, idx, axis=-1)
 
 
@@ -196,15 +207,21 @@ def _merge_sorted_runs_impl(a_vals, a_idx, b_vals, b_idx, k: int,
 # Eager calls dispatch AOT-cached executables (precompiled-libs role, see
 # raft_tpu.core.aot); traced calls inline into the caller's program; inputs
 # committed off the default device take the placement-specializing jit.
-_select_k_aot = aot(_select_k_impl, static_argnums=(1, 2))
-_select_k_payload_aot = aot(_select_k_payload_impl, static_argnums=(2, 3))
-_select_k_jit = jax.jit(_select_k_impl, static_argnums=(1, 2))
-_select_k_payload_jit = jax.jit(_select_k_payload_impl, static_argnums=(2, 3))
+# ``engine`` is a STATIC arg, so the XLA and pallas paths compile (and
+# AOT-cache) as distinct executables — flipping the env gate between
+# calls can never hit the other engine's program.
+_select_k_aot = aot(_select_k_impl, static_argnums=(1, 2, 3))
+_select_k_payload_aot = aot(_select_k_payload_impl,
+                            static_argnums=(2, 3, 4))
+_select_k_jit = jax.jit(_select_k_impl, static_argnums=(1, 2, 3))
+_select_k_payload_jit = jax.jit(_select_k_payload_impl,
+                                static_argnums=(2, 3, 4))
 _merge_aot = aot(_merge_sorted_runs_impl, static_argnums=(4, 5))
 _merge_jit = jax.jit(_merge_sorted_runs_impl, static_argnums=(4, 5))
 
 
-def select_k(values, k: int, select_min: bool = True, indices=None
+def select_k(values, k: int, select_min: bool = True, indices=None,
+             engine: Optional[str] = None
              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Select the k smallest (or largest) elements per row.
 
@@ -214,22 +231,34 @@ def select_k(values, k: int, select_min: bool = True, indices=None
     best-first (ascending for select_min) with ties at the lowest
     position first — a contract :func:`merge_sorted_runs` consumers rely
     on.
+
+    ``engine``: "xla" (``jax.lax.top_k`` + block-extremum filter — the
+    default) or "pallas" (the blockwise bitonic kernel,
+    :mod:`raft_tpu.kernels.select_k` — BIT-IDENTICAL output, the warpsort
+    analogue).  ``None`` resolves the env default through the one policy
+    home :func:`raft_tpu.kernels.resolve_engine`; unsupported (k, dtype)
+    combinations fall back to the XLA path.
     """
     values = jnp.asarray(values)
     k = int(k)
     select_min = bool(select_min)
+    if engine is None or engine == "pallas":
+        from raft_tpu.kernels.engine import resolve_engine
+
+        engine = resolve_engine("select_k", dtype=values.dtype,
+                                engine=engine)
     if is_tracer(values, indices):
         if indices is not None:
             return _select_k_payload_impl(values, jnp.asarray(indices), k,
-                                          select_min)
-        return _select_k_impl(values, k, select_min)
+                                          select_min, engine)
+        return _select_k_impl(values, k, select_min, engine)
     if indices is not None:
         indices = jnp.asarray(indices)
         fn = (_select_k_payload_aot if aot_dispatchable(values, indices)
               else _select_k_payload_jit)
-        return fn(values, indices, k, select_min)
+        return fn(values, indices, k, select_min, engine)
     fn = _select_k_aot if aot_dispatchable(values) else _select_k_jit
-    return fn(values, k, select_min)
+    return fn(values, k, select_min, engine)
 
 
 def merge_sorted_runs(a_vals, a_idx, b_vals, b_idx, k: Optional[int] = None,

@@ -375,7 +375,7 @@ def expand_probes(probe_ids, chunk_table, n_rows: int,
 
 def scan_probe_lists(probe_ids, score_tile: Callable, list_indices,
                      list_sizes, k: int, select_min: bool, dtype,
-                     xs: Optional[Tuple] = None
+                     xs: Optional[Tuple] = None, engine: str = "xla"
                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Running top-k over per-query probed lists — the shared inner loop of
     IVF-Flat, IVF-PQ and ball-cover search.
@@ -396,6 +396,13 @@ def scan_probe_lists(probe_ids, score_tile: Callable, list_indices,
     same way; ivf_pq's hoisted-ADC pipeline threads the quantized lookup
     table and per-probe base terms) without the callback closing over and
     recomputing it once per step.
+
+    *engine*: the per-tile top-k engine — "xla" (``lax.top_k``) or
+    "pallas" (the blockwise bitonic kernel, bit-identical; see
+    ``matrix.select_k``).  Callers thread a RESOLVED value (the env
+    default resolves outside their jit caches, via
+    ``raft_tpu.kernels.resolve_engine``); the sorted-run merge is
+    engine-agnostic because both engines emit identical sorted runs.
     """
     nq = probe_ids.shape[0]
     cap = list_indices.shape[1]
@@ -413,7 +420,8 @@ def scan_probe_lists(probe_ids, score_tile: Callable, list_indices,
         # partial top-k of this probe tile, then an O(k²) sorted-run merge
         # into the running top-k (the brute-force scan's primitive) —
         # instead of re-sorting (k + cap) concatenated candidates per step
-        tile_d, tile_i = select_k(d, kk, select_min=select_min, indices=ids)
+        tile_d, tile_i = select_k(d, kk, select_min=select_min, indices=ids,
+                                  engine=engine)
         return merge_sorted_runs(best_d, best_i, tile_d, tile_i, k=k,
                                  select_min=select_min), None
 

@@ -375,7 +375,7 @@ def _merge_one_allgather(comms: Comms, d, i, k: int, select_min: bool):
 
 
 def _ivf_flat_program(comms: Comms, metric_val: int, k: int, n_probes: int,
-                      probe_extra: int):
+                      probe_extra: int, engine: str = "xla"):
     sqrt = metric_val == int(DistanceType.L2SqrtExpanded)
     is_ip = metric_val == int(DistanceType.InnerProduct)
     # defer the L2Sqrt root PAST the merge: shards merge squared distances
@@ -386,7 +386,8 @@ def _ivf_flat_program(comms: Comms, metric_val: int, k: int, n_probes: int,
     def program(q, centers, data, idx, psz, ctab):
         local = (centers, data[0], idx[0], psz[0], ctab[0])
         d, i = ivf_flat._search_batch_impl(q, local, scan_metric, k,
-                                           n_probes, False, probe_extra)
+                                           n_probes, False, probe_extra,
+                                           engine)
         d, i = _merge_one_allgather(comms, d, i, k, select_min=not is_ip)
         if sqrt:
             d = jnp.sqrt(jnp.maximum(d, 0))
@@ -397,7 +398,8 @@ def _ivf_flat_program(comms: Comms, metric_val: int, k: int, n_probes: int,
 
 def _ivf_pq_program(comms: Comms, metric_val: int, k: int, n_probes: int,
                     per_cluster: bool, lut_dtype: str, int_dtype: str,
-                    pq_bits: int, hoisted: bool, probe_extra: int):
+                    pq_bits: int, hoisted: bool, probe_extra: int,
+                    engine: str = "xla"):
     sqrt = metric_val == int(DistanceType.L2SqrtExpanded)
     is_ip = metric_val == int(DistanceType.InnerProduct)
     scan_metric = (int(DistanceType.L2Expanded) if sqrt else metric_val)
@@ -408,7 +410,8 @@ def _ivf_pq_program(comms: Comms, metric_val: int, k: int, n_probes: int,
                   ctab[0], owner[0], list_adc, csum[0])
         d, i = ivf_pq._full_search_impl(q, leaves, scan_metric, k, n_probes,
                                         per_cluster, lut_dtype, int_dtype,
-                                        pq_bits, hoisted, probe_extra)
+                                        pq_bits, hoisted, probe_extra,
+                                        engine)
         d, i = _merge_one_allgather(comms, d, i, k, select_min=not is_ip)
         if sqrt:
             d = jnp.sqrt(jnp.maximum(d, 0))
@@ -474,11 +477,19 @@ class ShardedSearcher:
         if sharded.kind == "ivf_flat":
             p = params or ivf_flat.SearchParams()
             self.n_probes = int(min(p.n_probes, aux["n_lists"]))
+            # kernel engine resolved at searcher construction, OUTSIDE the
+            # program cache, and keyed into it (kernels.engine policy) —
+            # the sharded merge is engine-agnostic because both select_k
+            # engines emit identical sorted runs (multichip battery case
+            # select_k_sharded_matches_local pins this)
+            from raft_tpu.kernels.engine import resolve_engine
+
+            engine = resolve_engine("select_k", dtype=jnp.float32)
             key = ("ivf_flat", aux["metric"], self.k, self.n_probes,
-                   aux["probe_extra"])
+                   aux["probe_extra"], engine)
             builder = lambda: _ivf_flat_program(  # noqa: E731
                 sharded.comms, aux["metric"], self.k, self.n_probes,
-                aux["probe_extra"])
+                aux["probe_extra"], engine)
         elif sharded.kind == "ivf_pq":
             p = params or ivf_pq.SearchParams()
             expects(p.lut_dtype in ivf_pq._LUT_DTYPES,
@@ -488,9 +499,11 @@ class ShardedSearcher:
                        else bool(p.hoisted_lut))
             per_cluster = (aux["codebook_kind"]
                            == int(ivf_pq.CodebookKind.PER_CLUSTER))
+            engine = ivf_pq._resolve_scan_engine(aux["pq_dim"],
+                                                 aux["pq_bits"])
             statics = (aux["metric"], self.k, self.n_probes, per_cluster,
                        p.lut_dtype, p.internal_distance_dtype,
-                       aux["pq_bits"], hoisted, aux["probe_extra"])
+                       aux["pq_bits"], hoisted, aux["probe_extra"], engine)
             key = ("ivf_pq",) + statics
             builder = lambda: _ivf_pq_program(  # noqa: E731
                 sharded.comms, *statics)

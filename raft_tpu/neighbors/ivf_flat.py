@@ -334,7 +334,8 @@ def _owner_of(chunk_table, n_phys_rows: int):
 
 
 def _search_batch_impl(queries, index_leaves, metric_val: int, k: int,
-                       n_probes: int, sqrt: bool, probe_extra: int = -1):
+                       n_probes: int, sqrt: bool, probe_extra: int = -1,
+                       engine: str = "xla"):
     """ONE program for a query batch: coarse ranking → top-n_probes →
     probe-list scan → top-k (reference ivf_flat_search.cuh:1057 pipeline).
 
@@ -342,6 +343,12 @@ def _search_batch_impl(queries, index_leaves, metric_val: int, k: int,
     ``expand_probes`` (−1 derives it from the table shape).  Shard-local
     index blocks (``ann_mnmg``) must pass their true per-shard worst case
     — the local table shape undercounts it (see expand_probes).
+
+    ``engine`` (static, resolved by the caller via
+    ``raft_tpu.kernels.resolve_engine``): the select-k engine for the
+    coarse top-n_probes and the per-tile probe-scan top-k — "xla"
+    (``lax.top_k``) or "pallas" (blockwise bitonic kernel, BIT-IDENTICAL
+    output, so the whole search is bit-identical across engines).
 
     One `lax.scan` step per (probe rank, chunk): logical probes expand
     through the chunk table into physical rows, each step gathers one
@@ -363,7 +370,7 @@ def _search_batch_impl(queries, index_leaves, metric_val: int, k: int,
 
     # coarse ranking against centroids (reference :1120 linalg::gemm)
     cd = _coarse_distances(queries, centers, metric)
-    _, probe_sel = select_k(cd, n_probes, select_min=True)
+    _, probe_sel = select_k(cd, n_probes, select_min=True, engine=engine)
     probe_ids = probe_sel.astype(jnp.int32)
 
     # Half-precision datasets (bf16/f16 — TPU-native) keep half-width MXU
@@ -399,7 +406,7 @@ def _search_batch_impl(queries, index_leaves, metric_val: int, k: int,
                                 extra=None if probe_extra < 0 else probe_extra)
     best_d, best_i = scan_probe_lists(phys_probes, score_tile, list_indices,
                                       phys_sizes, k, select_min=not is_ip,
-                                      dtype=acc_t)
+                                      dtype=acc_t, engine=engine)
     if sqrt:
         best_d = jnp.sqrt(jnp.maximum(best_d, 0))
     return best_d, best_i
@@ -409,7 +416,7 @@ def _search_batch_impl(queries, index_leaves, metric_val: int, k: int,
 # ivf-flat kernel instantiations, SURVEY.md §2.14); jit kept for traced
 # callers and inputs off the default device — the ivf_pq._search_batch
 # pattern, now covering the WHOLE batch program (coarse + select + scan).
-_SEARCH_STATICS = (2, 3, 4, 5, 6)
+_SEARCH_STATICS = (2, 3, 4, 5, 6, 7)
 _search_batch = functools.partial(jax.jit, static_argnums=_SEARCH_STATICS)(
     _search_batch_impl)
 _search_batch_aot = aot(_search_batch_impl, static_argnums=_SEARCH_STATICS)
@@ -437,7 +444,7 @@ def _audit_search_batch():
     q = jax.ShapeDtypeStruct((64, 32), jnp.float32)
     return dict(fn=_search_batch_impl,
                 args=(q, leaves, int(DistanceType.L2SqrtExpanded), 8, 4,
-                      True, -1),
+                      True, -1, "xla"),
                 static_argnums=_SEARCH_STATICS)
 
 
@@ -465,6 +472,11 @@ def search(params: SearchParams, index: Index, queries, k: int,
     sqrt = index.metric == DistanceType.L2SqrtExpanded
     leaves = (index.centers, index.list_data, index.list_indices,
               index.phys_sizes, index.chunk_table)
+    # select-k engine: env default resolved HERE, outside the jit/aot
+    # caches, and threaded as a static (kernels.engine policy)
+    from raft_tpu.kernels.engine import resolve_engine
+
+    engine = resolve_engine("select_k", dtype=qf.dtype)
     out_d, out_i = [], []
     for q0 in range(0, qf.shape[0], batch_size_query):
         q1 = min(q0 + batch_size_query, qf.shape[0])
@@ -478,7 +490,7 @@ def search(params: SearchParams, index: Index, queries, k: int,
         batch_fn = (_search_batch_aot if aot_dispatchable(qb, leaves)
                     else _search_batch)
         d, i = batch_fn(qb, leaves, int(index.metric), int(k),
-                        int(n_probes), sqrt, -1)
+                        int(n_probes), sqrt, -1, engine)
         if n_valid != qb.shape[0]:
             d, i = d[:n_valid], i[:n_valid]
         out_d.append(d)

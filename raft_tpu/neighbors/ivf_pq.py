@@ -125,6 +125,24 @@ def hoisted_lut_enabled() -> bool:
     return os.environ.get("RAFT_TPU_HOISTED_LUT", "1") != "0"
 
 
+def _resolve_scan_engine(pq_dim: int, pq_bits: int,
+                         engine: Optional[str] = None) -> str:
+    """ONE resolution of the ivf_pq scan's kernel engine (kernels.engine
+    policy; consumed by :func:`search`, the serve backend and the sharded
+    searcher).  The single static knob enables BOTH Pallas kernels inside
+    the scan program — the LUT-in-VMEM scorer and the blockwise select_k —
+    so the env default is pallas when EITHER kind opts in; unsupported
+    LUT widths keep the XLA lookup (``_scan_hoisted`` guards per kernel)."""
+    from raft_tpu.kernels.engine import resolve_engine
+
+    if engine is not None:
+        return resolve_engine("pq_lut", engine=engine)
+    if (resolve_engine("pq_lut") == "pallas"
+            or resolve_engine("select_k") == "pallas"):
+        return "pallas"
+    return "xla"
+
+
 class CodebookKind(enum.IntEnum):
     """Reference ``codebook_gen`` (ivf_pq_types.hpp:31)."""
 
@@ -1058,7 +1076,7 @@ def _scan_hoisted(q, probe_ids, rot_q, rot_centers, centers, codebooks,
                   chunk_table, nq: int, pq_dim: int, kcb: int, ds: int,
                   k: int, is_ip: bool, per_cluster: bool,
                   lut_dtype_name: str, acc_dtype, pq_bits: int,
-                  probe_extra: int = -1):
+                  probe_extra: int = -1, engine: str = "xla"):
     """Hoisted-ADC probe scan: per-batch LUT stage + lookup-only scan body.
 
     Stage 2 of the pipeline (stage 1 is the build-time ``list_adc`` /
@@ -1095,7 +1113,15 @@ def _scan_hoisted(q, probe_ids, rot_q, rot_centers, centers, codebooks,
     ``take_along_axis`` on CPU / one one-hot MXU einsum on TPU — replacing
     the pq_dim sequential one-hot scan steps of the legacy path, plus the
     csum gather and the threaded base add.  Per-probe work drops from
-    O(pq_dim·2^bits·ds) einsum flops + epilogues to a pure table lookup."""
+    O(pq_dim·2^bits·ds) einsum flops + epilogues to a pure table lookup.
+
+    ``engine="pallas"`` routes the lookup through the LUT-in-VMEM Pallas
+    kernel (``raft_tpu.kernels.ivf_pq_lut``): the LUT block stays RESIDENT
+    in VMEM across a probe tile's candidate blocks and the packed codes
+    unpack + one-hot + dot tile-at-a-time in VMEM (int8/fp8 MXU dot paths
+    for the compressed LUT dtypes) — bounded-error vs this XLA lookup
+    (association order; docs/pallas_kernels.md §error bounds).  The same
+    knob selects the blockwise select_k inside the probe scan."""
     lut_trace_counters.inc("hoisted_lut_builds")
     q_sub = rot_q.reshape(nq, pq_dim, ds)
     # combined list+query LUT for compressed dtypes (quantization needs the
@@ -1139,10 +1165,21 @@ def _scan_hoisted(q, probe_ids, rot_q, rot_centers, centers, codebooks,
         lut_flat = lut_q[:, 0]                          # (nq, pq_dim·kcb)
         xs = (base_xs,)
     offsets = jnp.arange(pq_dim, dtype=jnp.int32) * kcb
+    use_pallas_lut = False
+    if engine == "pallas":
+        from raft_tpu.kernels import ivf_pq_lut as pallas_lut
+
+        use_pallas_lut = pallas_lut.supports(pq_dim, kcb)
 
     def _lookup(rows, lut_t):
         """out[q, c] = Σ_m lut_t[q, m·kcb + code[q, c, m]] — the allowlisted
         ADC lookup contraction; no LUT is built here."""
+        if use_pallas_lut:
+            # LUT-in-VMEM kernel: packed codes go in AS-PACKED — the
+            # unpacked (nq, cap, pq_dim) tensor and the one-hot exist only
+            # tile-at-a-time in VMEM (docs/pallas_kernels.md)
+            return pallas_lut.lut_score(list_codes[rows], lut_t,
+                                        pq_dim, pq_bits, kcb)
         codes = _unpack_codes(list_codes[rows], pq_dim, pq_bits)
         cap = codes.shape[1]
         if jax.default_backend() == "cpu":
@@ -1178,7 +1215,7 @@ def _scan_hoisted(q, probe_ids, rot_q, rot_centers, centers, codebooks,
 
     return scan_probe_lists(phys_probes, score_tile_hoisted, list_indices,
                             phys_sizes, k, select_min=not is_ip,
-                            dtype=jnp.float32, xs=xs)
+                            dtype=jnp.float32, xs=xs, engine=engine)
 
 
 def _quantize_lut(lut, base, lut_dtype_name: str):
@@ -1211,7 +1248,7 @@ def _quantize_lut(lut, base, lut_dtype_name: str):
 def _search_batch_impl(q, probe_ids, leaves, metric_val: int, k: int,
                        per_cluster: bool, lut_dtype_name: str,
                        int_dtype_name: str, pq_bits: int, hoisted: bool,
-                       probe_extra: int = -1):
+                       probe_extra: int = -1, engine: str = "xla"):
     """Score probed lists via per-query LUTs (reference similarity kernels
     ivf_pq_search.cuh:594-738) with a running top-k merge.
 
@@ -1220,7 +1257,11 @@ def _search_batch_impl(q, probe_ids, leaves, metric_val: int, k: int,
     einsum — quantizes it with a single per-query affine, and threads it
     through the probe scan as xs; the scan body is pure bit-unpack +
     flattened table lookup.  ``hoisted=False`` is the pre-PR per-tile
-    recompute, kept as the ``RAFT_TPU_HOISTED_LUT=0`` A/B baseline."""
+    recompute, kept as the ``RAFT_TPU_HOISTED_LUT=0`` A/B baseline.
+
+    ``engine`` (static, caller-resolved via ``kernels.resolve_engine``):
+    "pallas" selects the LUT-in-VMEM scoring kernel + the blockwise
+    select_k inside the hoisted scan (see ``_scan_hoisted``)."""
     (centers, rotation, codebooks, list_codes, list_indices,
      phys_sizes, chunk_table, owner, list_adc, list_csum) = leaves
     nq = q.shape[0]
@@ -1244,7 +1285,7 @@ def _search_batch_impl(q, probe_ids, leaves, metric_val: int, k: int,
             list_adc, list_csum, list_codes, list_indices, phys_sizes,
             chunk_table,
             nq, pq_dim, kcb, ds, k, is_ip, per_cluster, lut_dtype_name,
-            acc_dtype, pq_bits, probe_extra)
+            acc_dtype, pq_bits, probe_extra, engine)
         if metric_val == int(DistanceType.L2SqrtExpanded):
             best_d = jnp.sqrt(jnp.maximum(best_d, 0))
         return best_d, best_i
@@ -1344,7 +1385,7 @@ def _search_batch_impl(q, probe_ids, leaves, metric_val: int, k: int,
 # traced callers.  ``hoisted`` is a STATIC arg, so the two pipeline shapes
 # compile (and AOT-cache) as distinct executables — flipping
 # RAFT_TPU_HOISTED_LUT mid-process can never hit the other path's program.
-_SEARCH_STATICS = (3, 4, 5, 6, 7, 8, 9, 10)
+_SEARCH_STATICS = (3, 4, 5, 6, 7, 8, 9, 10, 11)
 _search_batch = functools.partial(jax.jit, static_argnums=_SEARCH_STATICS)(
     _search_batch_impl)
 _search_batch_aot = aot(_search_batch_impl, static_argnums=_SEARCH_STATICS)
@@ -1353,7 +1394,7 @@ _search_batch_aot = aot(_search_batch_impl, static_argnums=_SEARCH_STATICS)
 def _full_search_impl(queries, leaves, metric_val: int, k: int,
                       n_probes: int, per_cluster: bool, lut_dtype_name: str,
                       int_dtype_name: str, pq_bits: int, hoisted: bool,
-                      probe_extra: int = -1):
+                      probe_extra: int = -1, engine: str = "xla"):
     """Coarse ranking + top-n_probes + probe scoring as ONE program — the
     serving entry point (``serve.ServeEngine``): the whole query-batch →
     (d, i) computation is one AOT-cacheable executable whose signatures can
@@ -1366,13 +1407,14 @@ def _full_search_impl(queries, leaves, metric_val: int, k: int,
         coarse = -(queries @ centers.T)
     else:
         coarse = _l2_expanded(queries, centers, sqrt=False, precision=None)
-    _, probes = select_k(coarse, n_probes, select_min=True)
+    _, probes = select_k(coarse, n_probes, select_min=True, engine=engine)
     return _search_batch_impl(queries, probes.astype(jnp.int32), leaves,
                               metric_val, k, per_cluster, lut_dtype_name,
-                              int_dtype_name, pq_bits, hoisted, probe_extra)
+                              int_dtype_name, pq_bits, hoisted, probe_extra,
+                              engine)
 
 
-_FULL_SEARCH_STATICS = (2, 3, 4, 5, 6, 7, 8, 9, 10)
+_FULL_SEARCH_STATICS = (2, 3, 4, 5, 6, 7, 8, 9, 10, 11)
 _full_search = functools.partial(
     jax.jit, static_argnums=_FULL_SEARCH_STATICS)(_full_search_impl)
 _full_search_aot = aot(_full_search_impl,
@@ -1400,7 +1442,7 @@ def _audit_full_search():
     q = jax.ShapeDtypeStruct((64, 32), jnp.float32)
     return dict(fn=_full_search_impl,
                 args=(q, leaves, int(DistanceType.L2SqrtExpanded), 8, 4,
-                      False, "float32", "float32", 8, True, -1),
+                      False, "float32", "float32", 8, True, -1, "xla"),
                 static_argnums=_FULL_SEARCH_STATICS)
 
 
@@ -1491,6 +1533,10 @@ def search(params: SearchParams, index: Index, queries, k: int,
     # hoisted invariant statistic: coarse-center sq-norms once per search,
     # not once per query batch (distance.pairwise.metric_stats contract)
     center_sq = None if is_ip else _row_norms(index.centers)
+    # kernel engine: env default resolved HERE, outside the jit/aot caches,
+    # threaded as a static — "pallas" enables the LUT-in-VMEM scoring
+    # kernel AND the blockwise select_k in the probe scan
+    engine = _resolve_scan_engine(index.pq_dim, index.pq_bits)
     out_d, out_i = [], []
     # Batched dispatch over query blocks: each AOT/jit dispatch is ASYNC, so
     # successive batches overlap dispatch with execution — the TPU analogue
@@ -1518,7 +1564,8 @@ def search(params: SearchParams, index: Index, queries, k: int,
             # as before — coarse ranking tolerates it)
             coarse = _l2_expanded(qb, index.centers, sqrt=False,
                                   precision=None, yn=center_sq)
-        _, probes = select_k(coarse, n_probes, select_min=True)
+        _, probes = select_k(coarse, n_probes, select_min=True,
+                             engine=engine)
         batch_fn = (_search_batch_aot if aot_dispatchable(qb, probes, leaves)
                     else _search_batch)
         d, i = batch_fn(qb, probes.astype(jnp.int32), leaves,
@@ -1526,7 +1573,7 @@ def search(params: SearchParams, index: Index, queries, k: int,
                         index.codebook_kind == CodebookKind.PER_CLUSTER,
                         params.lut_dtype,
                         params.internal_distance_dtype,
-                        index.pq_bits, hoisted, -1)
+                        index.pq_bits, hoisted, -1, engine)
         if n_valid != qb.shape[0]:
             d, i = d[:n_valid], i[:n_valid]
         if pool:

@@ -138,6 +138,13 @@ class _IvfFlatBackend:
         self.dim = int(index.dim)
         self.leaves = (index.centers, index.list_data, index.list_indices,
                        index.phys_sizes, index.chunk_table)
+        # kernel engine resolved at backend construction (kernels.engine
+        # policy) and threaded as a static through _args, so warmup()
+        # pre-lowers the SELECTED engine's executable per (bucket, dtype)
+        # signature — the Pallas variant warms exactly like the XLA one
+        from raft_tpu.kernels.engine import resolve_engine
+
+        self.engine = resolve_engine("select_k", dtype=jnp.float32)
         self.fn = ivf_flat._search_batch_aot
 
     def ingest(self, q):
@@ -161,7 +168,7 @@ class _IvfFlatBackend:
 
     def _args(self, qb):
         return (qb, self.leaves, int(self.index.metric), self.k,
-                self.n_probes, self.sqrt, -1)
+                self.n_probes, self.sqrt, -1, self.engine)
 
     def warm(self, bucket: int, dtype) -> None:
         self.fn.compiled(*self._args(
@@ -197,6 +204,12 @@ class _IvfPqBackend:
                        index.list_codes, index.list_indices,
                        index.phys_sizes, index.chunk_table, index.owner,
                        index.list_adc, index.list_csum)
+        # kernel engine (LUT-in-VMEM scorer + blockwise select_k) resolved
+        # at backend construction and threaded as a static through _args —
+        # warmup() pre-lowers the selected engine's executable per
+        # (bucket, dtype) signature (kernels.engine policy)
+        self.engine = ivf_pq._resolve_scan_engine(index.pq_dim,
+                                                  index.pq_bits)
         self.fn = ivf_pq._full_search_aot
 
     def ingest(self, q):
@@ -235,7 +248,7 @@ class _IvfPqBackend:
                 self.n_probes,
                 self.index.codebook_kind == ivf_pq.CodebookKind.PER_CLUSTER,
                 self.params.lut_dtype, self.params.internal_distance_dtype,
-                self.index.pq_bits, self.hoisted, -1)
+                self.index.pq_bits, self.hoisted, -1, self.engine)
 
     def warm(self, bucket: int, dtype) -> None:
         self.fn.compiled(*self._args(

@@ -94,6 +94,11 @@ _FAST_TESTS = {
     "test_kmeans_mnmg.py::test_distributed_matches_single_device",
     "test_kmeans_mnmg.py::test_fori_loop_matches_device_loop",
     "test_pallas_kernels.py::test_pallas_is_enabled_requires_experimental_flag",
+    "test_pallas_engines.py::TestSelectKBlockwise::test_tie_stability_contract",
+    "test_pallas_engines.py::TestFusedL2nnPartials::"
+    "test_fused_em_step_pallas_engine_single_pass",
+    "test_pallas_engines.py::TestEngineResolution::"
+    "test_env_1_requires_tpu_and_experimental",
     "test_label.py::test_make_monotonic",
     "test_label.py::test_select_k",
     "test_linalg.py::TestDecompositions::test_svd",

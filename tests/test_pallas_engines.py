@@ -1,0 +1,336 @@
+"""Graduated Pallas kernel engines (ISSUE 13, docs/pallas_kernels.md).
+
+Interpret-mode oracles for the first-class kernel layer: the blockwise
+``select_k`` must be BIT-IDENTICAL to the XLA engine (values AND
+positions — the stability contract is pinned on crafted ties), the
+``fused_l2_nn`` partials hook must reproduce the fused-EM carry, the
+IVF-PQ LUT-in-VMEM scorer must match the hoisted-LUT scan within its
+documented bounded error, and the engine-resolved search paths must
+dispatch warm with ZERO compiles through the aot cache.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.kernels import select_k as pallas_select_k
+from raft_tpu.kernels.engine import resolve_engine
+from raft_tpu.matrix.select_k import select_k
+
+
+# ------------------------------------------------------------- select_k
+
+
+class TestSelectKBlockwise:
+    @staticmethod
+    def _adversarial(m, n, k, seed):
+        """Random rows SEEDED WITH the hard cases: exact-tie pairs across
+        column blocks, NaN entries, ±inf, and (row 1, when wide enough)
+        fewer real entries than k — every grid cell stresses the tie /
+        NaN preorder, not just bulk ordering."""
+        rng = np.random.default_rng(seed)
+        x = rng.normal(0, 1, (m, n)).astype(np.float32)
+        if n > 40 and m >= 4:
+            x[0, 5] = x[0, n - 7]            # exact tie across blocks
+            x[1, : n - 2] = np.nan           # fewer than k real entries
+            x[2, 9] = np.inf
+            x[3, 11] = -np.inf
+            x[m - 1, :3] = x[m - 1, 3]       # tie run inside one block
+        return x
+
+    # one CURATED grid covering the shape classes × dtypes × orientations
+    # (a full cross product re-compiles an interpret network per cell —
+    # tier-1 budget discipline, PR-3/PR-4 precedent); other tests in this
+    # class REUSE these signatures so their aot executables are shared
+    @pytest.mark.parametrize("m,n,k,select_min,dtype", [
+        (7, 300, 10, True, np.float32),    # nothing aligned
+        (33, 1000, 1, True, np.float32),   # k=1, ragged rows
+        (64, 4096, 64, True, np.float32),  # the filtered-path shape class
+        (16, 129, 100, False, np.float32), # k near n, select_max
+        (1, 17, 8, True, np.float32),      # single row, tiny n
+        (9, 700, 16, True, "bfloat16"),    # bf16 comparator
+        (5, 257, 8, False, "bfloat16"),    # bf16 select_max
+    ])
+    def test_bit_identical_to_xla_engine(self, dtype, select_min, m, n, k):
+        x = jnp.asarray(self._adversarial(
+            m, n, k, abs(hash((m, n, k, select_min))) % 2**31)
+        ).astype(dtype)
+        v_p, p_p = select_k(x, k, select_min=select_min, engine="pallas")
+        v_x, p_x = select_k(x, k, select_min=select_min, engine="xla")
+        np.testing.assert_array_equal(np.asarray(p_p), np.asarray(p_x))
+        np.testing.assert_array_equal(
+            np.asarray(v_p, np.float32), np.asarray(v_x, np.float32))
+
+    def test_tie_stability_contract(self):
+        """Duplicated values must resolve to the LOWEST positions first —
+        the stable-lax.top_k contract merge_sorted_runs consumers rely
+        on, reproduced by the kernel's lexicographic (value, position)
+        order.  (Reuses the (7, 300, 10) grid signature — no fresh
+        compile.)"""
+        x = np.ones((7, 300), np.float32)
+        x[:, 7] = 0.5
+        x[:, 280] = 0.5           # tie pair across column blocks
+        v_p, p_p = select_k(x, 10, engine="pallas")
+        v_x, p_x = select_k(x, 10, engine="xla")
+        np.testing.assert_array_equal(np.asarray(p_p), np.asarray(p_x))
+        np.testing.assert_array_equal(np.asarray(p_p)[0, :3], [7, 280, 0])
+        np.testing.assert_array_equal(np.asarray(v_p)[0, :2], [0.5, 0.5])
+
+    def test_payload_indices_gathered(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(0, 1, (7, 300)).astype(np.float32)
+        ids = rng.integers(0, 1 << 30, (7, 300)).astype(np.int32)
+        v_p, i_p = select_k(x, 10, indices=ids, engine="pallas")
+        v_x, i_x = select_k(x, 10, indices=ids, engine="xla")
+        np.testing.assert_array_equal(np.asarray(i_p), np.asarray(i_x))
+        np.testing.assert_array_equal(np.asarray(v_p), np.asarray(v_x))
+
+    def test_unsupported_k_falls_back_to_xla(self):
+        """k above the kernel cap silently keeps the XLA path — the
+        engine knob is a preference, never a crash."""
+        rng = np.random.default_rng(2)
+        x = rng.normal(0, 1, (4, 2048)).astype(np.float32)
+        k = pallas_select_k.MAX_K + 8
+        v_p, p_p = select_k(x, k, engine="pallas")
+        v_x, p_x = select_k(x, k, engine="xla")
+        np.testing.assert_array_equal(np.asarray(p_p), np.asarray(p_x))
+
+    def test_int_dtype_falls_back(self):
+        x = np.random.default_rng(3).integers(0, 1000, (5, 64)
+                                              ).astype(np.int32)
+        v_p, p_p = select_k(x, 4, engine="pallas")
+        v_x, p_x = select_k(x, 4, engine="xla")
+        np.testing.assert_array_equal(np.asarray(p_p), np.asarray(p_x))
+
+    def test_zero_compile_warm_dispatch(self):
+        """Eager pallas-engine select_k dispatches the aot cache: a warm
+        same-signature replay performs ZERO compiles.  (The (7, 300, 10)
+        signature is warmed by the tests above.)"""
+        from raft_tpu.core.aot import aot_compile_counters
+
+        rng = np.random.default_rng(4)
+        select_k(jnp.asarray(rng.normal(0, 1, (7, 300)).astype(np.float32)),
+                 10, engine="pallas")           # warm (likely cache-hit)
+        c0 = aot_compile_counters["compiles"]
+        out = select_k(jnp.asarray(
+            rng.normal(0, 1, (7, 300)).astype(np.float32)), 10,
+            engine="pallas")
+        jax.block_until_ready(out[0])
+        assert aot_compile_counters["compiles"] == c0
+
+
+# ------------------------------------------------------ engine resolution
+
+
+class TestEngineResolution:
+    def test_unknown_engine_rejected(self):
+        with pytest.raises(ValueError, match="unknown engine"):
+            resolve_engine("select_k", engine="cuda")
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="kernel kind"):
+            resolve_engine("warp_sort")
+
+    def test_l2nn_metric_family_enforced(self):
+        from raft_tpu.distance.distance_types import DistanceType
+
+        with pytest.raises(ValueError, match="L2 metric family"):
+            resolve_engine("l2nn", metric=DistanceType.CosineExpanded,
+                           engine="pallas")
+
+    def test_env_default_off(self, monkeypatch):
+        for var in ("RAFT_TPU_PALLAS_SELECT_K", "RAFT_TPU_PALLAS_PQ_LUT"):
+            monkeypatch.delenv(var, raising=False)
+        assert resolve_engine("select_k") == "xla"
+        assert resolve_engine("pq_lut") == "xla"
+
+    def test_env_force_enables_off_tpu(self, monkeypatch):
+        """``force`` opts the interpret path in on ANY backend — the
+        bench A/B + multichip-battery hook."""
+        monkeypatch.setenv("RAFT_TPU_PALLAS_SELECT_K", "force")
+        assert resolve_engine("select_k", dtype=jnp.float32) == "pallas"
+        # dtype the kernel does not cover falls back silently
+        assert resolve_engine("select_k", dtype=jnp.int32) == "xla"
+
+    def test_env_1_requires_tpu_and_experimental(self, monkeypatch):
+        """The r5 demotion gate in its new single home: '1' alone enables
+        nothing off-TPU, and on TPU still needs the experimental flag."""
+        monkeypatch.setenv("RAFT_TPU_PALLAS_SELECT_K", "1")
+        monkeypatch.delenv("RAFT_TPU_PALLAS_EXPERIMENTAL", raising=False)
+        assert resolve_engine("select_k", dtype=jnp.float32) == "xla"
+        monkeypatch.setenv("RAFT_TPU_PALLAS_EXPERIMENTAL", "1")
+        expected = "pallas" if jax.default_backend() == "tpu" else "xla"
+        assert resolve_engine("select_k", dtype=jnp.float32) == expected
+
+    def test_explicit_pallas_allowed_off_tpu(self):
+        # interpret mode needs no experimental acknowledgement
+        assert resolve_engine("select_k", engine="pallas") == "pallas"
+
+
+# --------------------------------------------- fused_l2_nn partials hook
+
+
+class TestFusedL2nnPartials:
+    @pytest.mark.parametrize("weighted", [False, True])
+    def test_partials_match_fused_em_carry(self, weighted):
+        """The kernel's in-VMEM one-hot accumulation reproduces the XLA
+        fused-EM scan's carry: labels EXACTLY, partials to accumulation
+        tolerance (association order differs)."""
+        from raft_tpu.cluster import fused_em_step
+        from raft_tpu.kernels.fused_l2nn import fused_l2_nn_partials
+
+        rng = np.random.default_rng(5)
+        c = (3.0 * rng.normal(0, 1, (32, 24))).astype(np.float32)
+        labels = rng.integers(0, 32, 513)
+        x = (c[labels] + 0.05 * rng.normal(0, 1, (513, 24))
+             ).astype(np.float32)
+        w = rng.random(513).astype(np.float32) if weighted else None
+        val, idx, sums, wsum, inertia = fused_l2_nn_partials(
+            x, c, w, interpret=True)
+        ref = fused_em_step(x, c, sample_weights=w, engine="xla",
+                            precision="highest", return_labels=True)
+        np.testing.assert_array_equal(np.asarray(idx),
+                                      np.asarray(ref.labels))
+        np.testing.assert_allclose(np.asarray(sums), np.asarray(ref.sums),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(wsum),
+                                   np.asarray(ref.weights), rtol=1e-6)
+        np.testing.assert_allclose(float(inertia), float(ref.inertia),
+                                   rtol=1e-4)
+
+    def test_fused_em_step_pallas_engine_single_pass(self):
+        """The public wiring: engine='pallas' routes fused_em_step through
+        the single-pass kernel (labels included) and agrees with the XLA
+        engine."""
+        from raft_tpu.cluster import fused_em_step
+
+        rng = np.random.default_rng(6)
+        c = (3.0 * rng.normal(0, 1, (16, 12))).astype(np.float32)
+        labels = rng.integers(0, 16, 300)
+        x = (c[labels] + 0.05 * rng.normal(0, 1, (300, 12))
+             ).astype(np.float32)
+        p = fused_em_step(x, c, engine="pallas", precision="highest",
+                          return_labels=True)
+        ref = fused_em_step(x, c, engine="xla", precision="highest",
+                            return_labels=True)
+        np.testing.assert_array_equal(np.asarray(p.labels),
+                                      np.asarray(ref.labels))
+        np.testing.assert_allclose(np.asarray(p.sums),
+                                   np.asarray(ref.sums),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(float(p.inertia), float(ref.inertia),
+                                   rtol=1e-4)
+
+
+# ------------------------------------------------ probe scans end to end
+
+
+class TestProbeScanEngines:
+    # tiny indexes: the contracts here are ENGINE wiring properties
+    # (identity, bounded error, zero-compile), not recall — small shapes
+    # keep the interpret-network compiles inside the tier-1 budget
+    def _data(self, seed=7, n=768, dim=16, nq=17):
+        rng = np.random.default_rng(seed)
+        return (rng.standard_normal((n, dim)).astype(np.float32),
+                rng.standard_normal((nq, dim)).astype(np.float32))
+
+    def test_ivf_flat_search_engine_identity(self, monkeypatch):
+        """select_k bit-identity makes the WHOLE ivf_flat search (coarse
+        select + probe-scan top-k + merge) bit-identical across
+        engines."""
+        from raft_tpu.neighbors import ivf_flat
+
+        x, q = self._data()
+        idx = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4), x)
+        sp = ivf_flat.SearchParams(n_probes=3)
+        d0, i0 = map(np.asarray, ivf_flat.search(sp, idx, q, 5))
+        monkeypatch.setenv("RAFT_TPU_PALLAS_SELECT_K", "force")
+        d1, i1 = map(np.asarray, ivf_flat.search(sp, idx, q, 5))
+        np.testing.assert_array_equal(i0, i1)
+        np.testing.assert_array_equal(d0, d1)
+
+    @pytest.mark.parametrize("lut_dtype,pq_bits", [
+        ("float32", 8), ("float8_e4m3", 5)])
+    def test_ivf_pq_vmem_kernel_matches_hoisted_scan(self, monkeypatch,
+                                                     lut_dtype, pq_bits):
+        """The LUT-in-VMEM kernel ≡ the hoisted-LUT scan top-k within the
+        documented bounded error (association order of the one-hot dot):
+        distances allclose, near-total id overlap."""
+        from raft_tpu.neighbors import ivf_pq
+
+        x, q = self._data(seed=8)
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=8, pq_dim=4, pq_bits=pq_bits,
+                               kmeans_n_iters=4), x)
+        sp = ivf_pq.SearchParams(n_probes=3, lut_dtype=lut_dtype)
+        d0, i0 = map(np.asarray, ivf_pq.search(sp, idx, q, 5))
+        monkeypatch.setenv("RAFT_TPU_PALLAS_PQ_LUT", "force")
+        d1, i1 = map(np.asarray, ivf_pq.search(sp, idx, q, 5))
+        np.testing.assert_allclose(d0, d1, rtol=1e-4, atol=1e-4)
+        overlap = np.mean([len(set(i0[r]) & set(i1[r])) / i0.shape[1]
+                           for r in range(i0.shape[0])])
+        assert overlap >= 0.95, overlap
+
+    def test_ivf_pq_warm_dispatch_zero_compile(self, monkeypatch):
+        """The pallas-engine search signature pins into the aot cache like
+        any other: a warm same-shape replay performs ZERO compiles."""
+        from raft_tpu.core.aot import aot_compile_counters
+        from raft_tpu.neighbors import ivf_pq
+
+        x, q = self._data(seed=9)
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=8, pq_dim=4, pq_bits=8,
+                               kmeans_n_iters=4), x)
+        sp = ivf_pq.SearchParams(n_probes=3)
+        monkeypatch.setenv("RAFT_TPU_PALLAS_PQ_LUT", "force")
+        out = ivf_pq.search(sp, idx, q, 5)      # cold: compiles
+        jax.block_until_ready(out[0])
+        c0 = aot_compile_counters["compiles"]
+        out = ivf_pq.search(sp, idx, q + 0.25, 5)
+        jax.block_until_ready(out[0])
+        assert aot_compile_counters["compiles"] == c0
+
+    def test_serve_engine_warms_pallas_variant(self, monkeypatch):
+        """ServeEngine resolves the kernel engine at backend construction
+        and warmup() pre-lowers the PALLAS variant per (bucket, dtype)
+        signature — steady-state coalesced serving stays zero-compile and
+        identical to the solo pallas path."""
+        from raft_tpu.core.aot import aot_compile_counters
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.serve import ServeEngine
+
+        monkeypatch.setenv("RAFT_TPU_PALLAS_SELECT_K", "force")
+        x, q = self._data(seed=10)
+        idx = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4), x)
+        sp = ivf_flat.SearchParams(n_probes=3)
+        eng = ServeEngine(idx, 5, sp, max_batch=16)
+        assert eng._backend.engine == "pallas"
+        eng.warmup(dtypes=(jnp.float32,))        # buckets 8, 16
+        eng.search([q[:3]])                      # plumbing warm call
+        c0 = aot_compile_counters["compiles"]
+        outs = eng.search([q[:5], q[5:9]])
+        assert aot_compile_counters["compiles"] == c0
+        for qq, (dd, ii) in zip((q[:5], q[5:9]), outs):
+            d_solo, i_solo = ivf_flat.search(sp, idx, qq, 5)
+            np.testing.assert_array_equal(ii, np.asarray(i_solo))
+            np.testing.assert_array_equal(dd, np.asarray(d_solo))
+
+
+# -------------------------------------------------- legacy gate delegates
+
+
+def test_legacy_gate_surfaces_delegate(monkeypatch):
+    """The historical per-module gates survive as thin delegates over the
+    one policy home — same answers, one env parser."""
+    from raft_tpu.distance import pallas_fused_l2nn, pallas_kernels
+    from raft_tpu.kernels.engine import env_enabled
+
+    monkeypatch.setenv("RAFT_TPU_PALLAS_NN", "force")
+    monkeypatch.setenv("RAFT_TPU_PALLAS", "force")
+    assert pallas_fused_l2nn.is_enabled() == env_enabled("l2nn") is True
+    assert pallas_kernels.is_enabled() is True
+    assert not pallas_kernels.is_enabled(k=pallas_kernels._MAX_K + 1)
