@@ -45,7 +45,9 @@ mods = [
     "raft_tpu.spectral", "raft_tpu.solver", "raft_tpu.comms",
     "raft_tpu.neighbors", "raft_tpu.neighbors.ivf_flat",
     "raft_tpu.neighbors.ivf_pq", "raft_tpu.neighbors.ball_cover",
-    "raft_tpu.serve", "raft_tpu.native",
+    "raft_tpu.serve", "raft_tpu.serve.admission",
+    "raft_tpu.serve.supervise", "raft_tpu.native",
+    "raft_tpu.testing", "raft_tpu.testing.faults",
     "raft_tpu.kernels", "raft_tpu.kernels.engine",
     "raft_tpu.kernels.select_k", "raft_tpu.kernels.fused_l2nn",
     "raft_tpu.kernels.ivf_pq_lut", "raft_tpu.kernels.pairwise",

@@ -33,7 +33,12 @@ PACKAGES = [
     ("neighbors", "Brute-force kNN, IVF-Flat, IVF-PQ, ball cover, "
                   "eps-neighborhood, haversine"),
     ("serve", "Batched query serving: request coalescing, executable "
-              "warmup/pinning, double-buffered dispatch"),
+              "warmup/pinning, double-buffered dispatch, deadline-aware "
+              "admission + load shedding, supervised dispatch "
+              "(watchdog/retry), atomic refresh"),
+    ("testing", "Deterministic fault-injection plane "
+                "(RAFT_TPU_FAULT_PLAN): seeded dispatch/comms/refresh "
+                "fault directives, off by default"),
     ("kernels", "First-class Pallas kernel layer: blockwise select_k, "
                 "tiled fused-L2-NN with M-step partials, IVF-PQ "
                 "LUT-in-VMEM scoring, pairwise accumulate; ONE "

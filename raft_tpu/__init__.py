@@ -64,6 +64,7 @@ _SUBMODULES = (
     "kernels",
     "telemetry",
     "analysis",
+    "testing",
 )
 
 

@@ -4,6 +4,7 @@ import; importing this package loads the full catalog."""
 from raft_tpu.analysis.rules import (  # noqa: F401
     collectives,
     dtype_drift,
+    error_discipline,
     host_transfer,
     pallas_discipline,
     probe_scan,
@@ -15,6 +16,7 @@ from raft_tpu.analysis.rules import (  # noqa: F401
     trace_purity,
 )
 
-__all__ = ["collectives", "dtype_drift", "host_transfer",
-           "pallas_discipline", "probe_scan", "reductions", "serve_path",
-           "static_args", "style", "telemetry_discipline", "trace_purity"]
+__all__ = ["collectives", "dtype_drift", "error_discipline",
+           "host_transfer", "pallas_discipline", "probe_scan",
+           "reductions", "serve_path", "static_args", "style",
+           "telemetry_discipline", "trace_purity"]

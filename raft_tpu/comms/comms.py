@@ -44,6 +44,7 @@ import numpy as np
 
 from raft_tpu.core.error import LogicError, expects
 from raft_tpu.comms.comms_types import ReduceOp, Request, Status
+from raft_tpu.testing import faults as _faults
 
 _REDUCERS = {
     ReduceOp.SUM: jax.lax.psum,
@@ -258,6 +259,10 @@ class Comms:
         """Bump the trace-time launch counter AND record the launch's
         per-rank payload bytes under ``f"{name}_bytes"`` (shapes are static
         at trace time, so the byte count is exact even for tracers)."""
+        # fault-injection site (host-side, TRACE time — collectives are
+        # staged, so the injectable failure is the trace that would stage
+        # one; stages NOTHING into the lowered program when silent)
+        _faults.check("comms", op=name, rank=self._host_rank)
         self.collective_calls.inc(name)
         itemsize = jnp.dtype(jnp.result_type(x)).itemsize
         self.collective_calls.inc(f"{name}_bytes", int(
@@ -540,6 +545,10 @@ class Comms:
     # exchange (tag 0x7E1E, reserved; docs/observability.md §fleet
     # aggregation).
     def isend(self, obj, dst: int, tag: int = 0) -> Request:
+        # host-plane fault site (runtime): a chosen rank's sends can be
+        # made to fail, the dead/slow-host scenario the partial-rollup
+        # degradation of telemetry.gather is tested against
+        _faults.check("comms", op="isend", rank=self._host_rank)
         if self._mailbox is not None:
             try:
                 self._mailbox.put(dst, tag, obj)
@@ -558,6 +567,8 @@ class Comms:
     def waitall(self, requests: Sequence[Request], timeout: float = 60.0):
         for r in requests:
             if r.kind == "recv" and not r.done:
+                # host-plane fault site (runtime; same contract as isend)
+                _faults.check("comms", op="waitall", rank=self._host_rank)
                 try:
                     if self._mailbox is not None:
                         r.payload = self._mailbox.get(r.peer, r.tag, timeout)

@@ -118,8 +118,13 @@ class CommsSession:
 
             try:
                 jax.distributed.shutdown()
-            except Exception:
-                pass
+            except Exception as e:
+                # best-effort teardown, but never SILENT (error-discipline):
+                # a failed control-plane shutdown is worth a line in the log
+                from raft_tpu.core.logger import log_warn
+
+                log_warn("jax.distributed.shutdown failed during session "
+                         "destroy: %s", e)
         self.comms = None
         self.initialized = False
 

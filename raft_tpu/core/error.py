@@ -41,6 +41,13 @@ class DeviceError(CudaError):
     """Preferred alias for device-side failures on TPU."""
 
 
+class CorruptionError(RaftError):
+    """A persisted artifact failed integrity verification (truncated or
+    bit-flipped archive, checksum mismatch) — raised by
+    :mod:`raft_tpu.neighbors.serialize` so corruption is a LOUD typed
+    error at load time, never garbage results downstream."""
+
+
 class InterruptedError_(RaftError):
     """Raised by :mod:`raft_tpu.core.interruptible` on cancellation.
 

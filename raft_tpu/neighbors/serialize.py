@@ -9,17 +9,29 @@ and each process loads the artifact.
 Format: a single ``.npz`` (numpy archive) holding every array leaf plus a
 JSON-encoded aux header (metric, codebook kind, pq_bits, versioning).
 Arrays come back as numpy; jax consumes them zero-copy on first use.
+
+Durability contract (docs/serving.md §failure model): every save writes
+to a temp file in the destination directory, fsyncs, and atomically
+renames into place — a crash mid-save can never leave a truncated
+archive under the real name for ``load`` to half-parse.  The header
+additionally carries a per-array CRC32 manifest verified at load; any
+corruption (bit flip, truncation, zip damage) raises a typed
+:class:`raft_tpu.core.error.CorruptionError` instead of returning
+garbage.  Pre-manifest archives still load (verification skipped).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import json
+import os
+import zipfile
+import zlib
 
 import jax.numpy as jnp
 import numpy as np
 
-from raft_tpu.core.error import LogicError, expects
+from raft_tpu.core.error import CorruptionError, LogicError, expects
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.neighbors import ivf_flat, ivf_pq
 
@@ -38,15 +50,27 @@ _VERSIONS = {"ivf_flat": 1, "ivf_pq": 2, "sharded": 1}
 _READABLE_VERSIONS = {"ivf_flat": (1,), "ivf_pq": (1, 2), "sharded": (1,)}
 
 
+def _checksums(arrays: dict) -> dict:
+    """Per-array CRC32 manifest (name → checksum over the raw bytes)."""
+    return {name: int(zlib.crc32(np.ascontiguousarray(a).tobytes())
+                      & 0xFFFFFFFF)
+            for name, a in arrays.items()}
+
+
+def _finish(kind: str, arrays: dict, aux: dict) -> dict:
+    """Attach the JSON header (versioning + aux + checksum manifest)."""
+    header = {"magic": _MAGIC, "version": _VERSIONS[kind], "kind": kind,
+              "aux": aux, "checksums": _checksums(arrays)}
+    arrays["__header__"] = np.frombuffer(
+        json.dumps(header).encode(), dtype=np.uint8)
+    return arrays
+
+
 def _pack(kind: str, index, aux: dict) -> dict:
     arrays = {f.name: np.asarray(getattr(index, f.name))
               for f in dataclasses.fields(index)
               if f.name not in aux}
-    header = {"magic": _MAGIC, "version": _VERSIONS[kind], "kind": kind,
-              "aux": aux}
-    arrays["__header__"] = np.frombuffer(
-        json.dumps(header).encode(), dtype=np.uint8)
-    return arrays
+    return _finish(kind, arrays, aux)
 
 
 def _normalize(path) -> str:
@@ -56,29 +80,66 @@ def _normalize(path) -> str:
     return path if path.endswith(".npz") else path + ".npz"
 
 
+def _atomic_savez(path, arrays: dict) -> None:
+    """Write the archive via temp file + fsync + atomic rename: readers
+    see either the previous complete archive or the new complete archive,
+    never a truncation (the rename is atomic within one filesystem; the
+    temp lives beside the destination for exactly that reason)."""
+    path = _normalize(path)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):  # failed save: never leave droppings
+            os.unlink(tmp)
+
+
 def _unpack(path, kind: str):
     path = _normalize(path)
-    with np.load(path) as z:
-        expects("__header__" in z.files,
-                f"{path}: not a raft-tpu index file (no header)")
-        header = json.loads(bytes(z["__header__"]).decode())
-        expects(header.get("magic") == _MAGIC,
-                f"{path}: not a raft-tpu index file")
-        if header["kind"] != kind:
-            raise LogicError(
-                f"{path} holds a {header['kind']} index, not {kind}")
-        expects(header.get("version") in _READABLE_VERSIONS[kind],
-                f"{path}: unsupported {kind} index version "
-                f"{header.get('version')}")
-        arrays = {k: z[k] for k in z.files if k != "__header__"}
+    try:
+        with np.load(path) as z:
+            expects("__header__" in z.files,
+                    f"{path}: not a raft-tpu index file (no header)")
+            header = json.loads(bytes(z["__header__"]).decode())
+            expects(header.get("magic") == _MAGIC,
+                    f"{path}: not a raft-tpu index file")
+            if header["kind"] != kind:
+                raise LogicError(
+                    f"{path} holds a {header['kind']} index, not {kind}")
+            expects(header.get("version") in _READABLE_VERSIONS[kind],
+                    f"{path}: unsupported {kind} index version "
+                    f"{header.get('version')}")
+            arrays = {k: z[k] for k in z.files if k != "__header__"}
+    except (zipfile.BadZipFile, zlib.error, EOFError, ValueError,
+            json.JSONDecodeError, UnicodeDecodeError, KeyError, OSError) as e:
+        # zip-level damage (numpy/zipfile verify entry CRCs on read) or a
+        # mangled header — surface ONE typed error, never a half-parse
+        raise CorruptionError(
+            f"{path}: corrupt or truncated index archive ({e})") from e
+    manifest = header.get("checksums")
+    if manifest is not None:  # pre-manifest archives: nothing to verify
+        stored = _checksums(arrays)
+        bad = sorted(name for name, crc in stored.items()
+                     if manifest.get(name) != crc)
+        missing = sorted(set(manifest) - set(stored))
+        if bad or missing:
+            raise CorruptionError(
+                f"{path}: checksum manifest mismatch "
+                f"(corrupt: {bad or '-'}, missing: {missing or '-'}) — "
+                "the archive is damaged; rebuild or restore it")
     return header["aux"], arrays
 
 
 def save_ivf_flat(path, index: ivf_flat.Index) -> None:
-    """Write an IVF-Flat index to *path* (``.npz``)."""
+    """Write an IVF-Flat index to *path* (``.npz``; atomic + checksummed
+    — module docstring)."""
     aux = {"metric": int(index.metric),
            "adaptive_centers": bool(index.adaptive_centers)}
-    np.savez(_normalize(path), **_pack("ivf_flat", index, aux))
+    _atomic_savez(path, _pack("ivf_flat", index, aux))
 
 
 def load_ivf_flat(path) -> ivf_flat.Index:
@@ -90,12 +151,13 @@ def load_ivf_flat(path) -> ivf_flat.Index:
 
 
 def save_ivf_pq(path, index: ivf_pq.Index) -> None:
-    """Write an IVF-PQ index to *path* (``.npz``)."""
+    """Write an IVF-PQ index to *path* (``.npz``; atomic + checksummed —
+    module docstring)."""
     aux = {"metric": int(index.metric),
            "codebook_kind": int(index.codebook_kind),
            "pq_bits": int(index.pq_bits),
            "dataset_dtype": index.dataset_dtype}
-    np.savez(_normalize(path), **_pack("ivf_pq", index, aux))
+    _atomic_savez(path, _pack("ivf_pq", index, aux))
 
 
 def save_sharded(path, sharded) -> None:
@@ -117,11 +179,7 @@ def save_sharded(path, sharded) -> None:
               for j, leaf in enumerate(sharded.replicated)}
     arrays.update({f"st{j}": np.asarray(leaf)
                    for j, leaf in enumerate(sharded.stacked)})
-    header = {"magic": _MAGIC, "version": _VERSIONS["sharded"],
-              "kind": "sharded", "aux": aux}
-    arrays["__header__"] = np.frombuffer(
-        json.dumps(header).encode(), dtype=np.uint8)
-    np.savez(_normalize(path), **arrays)
+    _atomic_savez(path, _finish("sharded", arrays, aux))
 
 
 def load_sharded(path, comms):

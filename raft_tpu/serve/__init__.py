@@ -7,8 +7,27 @@ Public surface:
   request coalescing into bucket-padded super-batches, executable
   warmup/pinning through the ``core.aot`` cache, double-buffered dispatch
   over the handle's stream pool, solo fallback for out-of-range requests.
+- The failure-handling layer (docs/serving.md §failure model):
+  :class:`ServeRequest` (deadline/timeout envelope),
+  :class:`AdmissionController` + :class:`RejectedError` (deadline-aware
+  admission, load shedding, typed rejection),
+  :class:`DispatchSupervisor` + :class:`WatchdogTimeout` /
+  :class:`DispatchError` (watchdog, bounded retry/backoff,
+  fail-fast classification).
 """
 
+from raft_tpu.serve.admission import (  # noqa: F401
+    AdmissionController,
+    RejectedError,
+    ServeRequest,
+)
 from raft_tpu.serve.engine import ServeEngine  # noqa: F401
+from raft_tpu.serve.supervise import (  # noqa: F401
+    DispatchError,
+    DispatchSupervisor,
+    WatchdogTimeout,
+)
 
-__all__ = ["ServeEngine"]
+__all__ = ["ServeEngine", "ServeRequest", "AdmissionController",
+           "RejectedError", "DispatchSupervisor", "DispatchError",
+           "WatchdogTimeout"]

@@ -29,6 +29,21 @@ closes that gap (docs/serving.md):
   (or the engine's ``max_batch``) is served solo through the backend's
   public entry point and counted in :attr:`ServeEngine.stats`, never
   crashed and never silently recompiled into the coalesced path.
+* **Failure handling** (docs/serving.md §failure model) — requests may
+  carry deadlines (:class:`raft_tpu.serve.admission.ServeRequest`);
+  a request whose remaining budget cannot cover its projected completion
+  is SHED at admission with a typed
+  :class:`~raft_tpu.serve.admission.RejectedError` in its result slot
+  instead of queued to die, super-batch collection runs under a
+  :class:`~raft_tpu.serve.supervise.DispatchSupervisor` (wall-clock
+  watchdog, bounded retry with backoff+jitter for transient failures,
+  fail-fast for logic bugs), and a poisoned request fails ALONE: ingest
+  errors land in that request's slot, and a failed multi-member
+  super-batch is split and re-dispatched member-by-member through the
+  warmed bucket ladder (still zero-compile).  ``refresh()`` is atomic
+  under injected crashes (the old backend keeps serving), ``close()`` is
+  bounded and idempotent, and ``/healthz`` reports a non-503
+  ``degraded`` flag while shedding.
 * **Telemetry** — the request lifecycle runs under nested
   ``raft_tpu.telemetry`` spans (``serve.request`` → ingest/coalesce/
   assemble/dispatch/deliver), per-request completion latency lands in a
@@ -64,6 +79,10 @@ from raft_tpu.core.error import expects
 from raft_tpu.core.handle import Handle
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.neighbors import ann_mnmg, brute_force, ivf_flat, ivf_pq
+from raft_tpu.serve.admission import (AdmissionController, RejectedError,
+                                      ServeRequest)
+from raft_tpu.serve.supervise import DispatchSupervisor
+from raft_tpu.testing import faults as _faults
 
 #: Bound on the per-call latency list AND the cumulative latency reservoir:
 #: the pre-telemetry ``last_latencies`` attribute kept one float per request
@@ -390,7 +409,10 @@ class ServeEngine:
     def __init__(self, index, k: int, params=None, *,
                  metric=DistanceType.L2SqrtExpanded, metric_arg: float = 2.0,
                  max_batch: int = 1024, batch_size_index: int = 16384,
-                 handle: Optional[Handle] = None):
+                 handle: Optional[Handle] = None,
+                 admission=None, watchdog_s: Optional[float] = None,
+                 max_retries: int = 2, retry_backoff_s: float = 0.05,
+                 retry_backoff_cap_s: float = 1.0, retry_seed: int = 0):
         expects(max_batch >= 8, "max_batch must be >= 8")
         self._backend = _make_backend(index, k, params, metric, metric_arg,
                                       batch_size_index)
@@ -418,6 +440,7 @@ class ServeEngine:
         # already hold self._lock, so ordering is always _lock → this
         self._warmed_mut = threading.Lock()
         self._refreshing = False  # /healthz: refresh in flight
+        self._closed = False      # close(): new requests reject typed
         self._recorder = None     # slow-request flight recorder (serve_http)
         self._http = None         # the live scrape server, if started
         #: Serving statistics — the same keys and read surface as the
@@ -431,8 +454,27 @@ class ServeEngine:
             "raft_tpu_serve_engine_stats", "ServeEngine serving statistics",
             labelnames=("engine", "key"), fixed=(self._engine_id,))
         for key in ("requests", "queries", "super_batches",
-                    "solo_fallbacks", "coalesced_requests", "refreshes"):
+                    "solo_fallbacks", "coalesced_requests", "refreshes",
+                    "admitted", "sheds", "expired", "retries",
+                    "watchdog_timeouts", "isolation_splits",
+                    "ingest_errors", "dispatch_errors"):
             self.stats[key] = 0
+        #: deadline-aware admission (docs/serving.md §failure model):
+        #: default controller unless the caller passes its own or opts
+        #: out with ``admission=False`` — with no deadlines and no queue
+        #: bound the default never sheds, so the layer is free until used
+        if admission is False:
+            self._admission: Optional[AdmissionController] = None
+        else:
+            self._admission = (admission if admission is not None
+                               else AdmissionController())
+            self._admission.bind(self._engine_id)
+        #: supervised collection: watchdog + bounded retry/backoff; the
+        #: supervisor mirrors its events into stats via _sup_event
+        self._supervisor = DispatchSupervisor(
+            watchdog_s=watchdog_s, max_retries=max_retries,
+            backoff_s=retry_backoff_s, backoff_cap_s=retry_backoff_cap_s,
+            seed=retry_seed, on_event=self._sup_event)
         #: Fixed-memory per-request completion-latency distribution
         #: (request j completes when its super-batch's results land on the
         #: host, measured from ``search()`` entry) + a bounded
@@ -452,6 +494,21 @@ class ServeEngine:
     @property
     def k(self) -> int:
         return self._backend.k
+
+    def _sup_event(self, kind: str) -> None:
+        # supervisor events → the engine's stats mirror
+        self.stats.inc({"retry": "retries",
+                        "watchdog_timeout": "watchdog_timeouts"}[kind])
+
+    def _backend_fn(self) -> Optional[str]:
+        """The backend program's telemetry label (``raft_tpu_device_seconds
+        {fn}`` / dispatch-latency rows) — the admission cost estimator's
+        key; None when unknown (estimator falls back to static)."""
+        be = self._backend
+        fn = getattr(be, "fn", None)
+        if fn is None:
+            fn = getattr(getattr(be, "searcher", None), "fn", None)
+        return getattr(fn, "__qualname__", None)
 
     # -- latency telemetry --------------------------------------------------
     @property
@@ -486,6 +543,7 @@ class ServeEngine:
         Explicit *buckets* narrow the range: requests that cannot fit the
         largest warmed bucket are served solo (counted, not compiled).
         Returns the number of (bucket, dtype) signatures ensured."""
+        expects(not self._closed, "warmup() on a closed engine")
         if buckets is None:
             buckets = []
             b = 8
@@ -529,6 +587,7 @@ class ServeEngine:
         unaffected.  ``max_batch`` re-derives from the requested bound and
         the NEW index's transient cap; warmed buckets above it are
         dropped (requests that needed them fall back to solo, counted)."""
+        expects(not self._closed, "refresh() on a closed engine")
         self._refreshing = True  # /healthz reports the swap in flight
         try:
             with telemetry.span("serve.refresh"):
@@ -537,6 +596,9 @@ class ServeEngine:
             self._refreshing = False
 
     def _refresh(self, index, params):
+        # fault-plane crash window 1: nothing built yet — a crash here
+        # must leave the old backend untouched trivially
+        _faults.check("refresh", stage="pre_warm")
         with self._lock:  # snapshot under the lock: warmup() mutates it
             c = dict(self._ctor)
             snapshot = {dt: set(bs) for dt, bs in self._warmed.items()}
@@ -553,6 +615,12 @@ class ServeEngine:
         for dt, buckets in warmed.items():
             for b in sorted(buckets):
                 backend.warm(b, jnp.dtype(dt))
+        # fault-plane crash window 2: BETWEEN re-lower and swap — the
+        # atomicity the battery proves: a crash raised here discards the
+        # fully-warmed replacement and the OLD backend keeps serving
+        # (tests/test_serve_faults.py injects it; nothing below this line
+        # but the locked swap may fail partially)
+        _faults.check("refresh", stage="pre_swap")
         with self._lock:
             # signatures warmed by a concurrent warmup() since the
             # snapshot must not be silently dropped — warm them under the
@@ -580,11 +648,23 @@ class ServeEngine:
         warmup() never iterates a set mid-add."""
         with self._warmed_mut:
             warmed = {dt: sorted(bs) for dt, bs in self._warmed.items()}
-        ready = any(warmed.values()) and not self._refreshing
-        return {"ready": bool(ready), "backend": self.backend, "k": self.k,
+        ready = (any(warmed.values()) and not self._refreshing
+                 and not self._closed)
+        body = {"ready": bool(ready), "backend": self.backend, "k": self.k,
                 "max_batch": self.max_batch, "warmed": warmed,
                 "refresh_in_flight": bool(self._refreshing),
+                "closed": bool(self._closed),
                 "stats": dict(self.stats)}
+        # overload is DEGRADED, not down: recent shedding/expiry flags the
+        # body (load balancers can read it) while the probe stays 200 —
+        # a shedding engine is still the best place to send traffic that
+        # fits its deadline budget (docs/serving.md §failure model)
+        adm = self._admission
+        body["degraded"] = (adm.degraded(telemetry.now())
+                            if adm is not None else False)
+        if adm is not None:
+            body["admission"] = adm.health(telemetry.now())
+        return body
 
     def serve_http(self, port: int = 0, host: str = "127.0.0.1", *,
                    slow_threshold_s: Optional[float] = None,
@@ -603,6 +683,7 @@ class ServeEngine:
         stops it."""
         from raft_tpu.telemetry import http as telemetry_http
 
+        expects(not self._closed, "serve_http() on a closed engine")
         with self._lock:
             if self._http is None:
                 self._recorder = telemetry_http.FlightRecorder(
@@ -615,11 +696,30 @@ class ServeEngine:
                     recorder=self._recorder).start()
             return self._http
 
-    def close(self) -> None:
-        """Stop the scrape server (if :meth:`serve_http` started one) and
-        drop the flight recorder.  The engine itself stays serveable."""
-        with self._lock:
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Bounded, idempotent shutdown (docs/serving.md §failure model):
+
+        * requests arriving AFTER close() reject immediately with a typed
+          ``RejectedError(reason="closed")`` — never a hang, never an
+          undefined half-closed dispatch;
+        * requests already in flight DRAIN: close() waits up to
+          *timeout_s* for the engine lock (an in-flight ``search()``
+          completes and delivers its results) and proceeds regardless
+          after the bound — shutdown latency is bounded either way;
+        * the scrape server (if :meth:`serve_http` started one) stops and
+          joins with its own bounded timeout, the flight recorder drops;
+        * double-close is a no-op (pinned by the fault battery).
+
+        ``/healthz`` reports ``ready: false`` (503) once closed."""
+        if self._closed:
+            return  # idempotent
+        self._closed = True  # reject new requests from this point on
+        acquired = self._lock.acquire(timeout=timeout_s)  # drain in-flight
+        try:
             http, self._http, self._recorder = self._http, None, None
+        finally:
+            if acquired:
+                self._lock.release()
         if http is not None:
             http.close()
 
@@ -662,10 +762,20 @@ class ServeEngine:
                ) -> List[Tuple[np.ndarray, np.ndarray]]:
         """Serve a batch of concurrent requests.
 
-        *requests*: sequence of (n_j, dim) query matrices (ragged n_j ≥ 0).
-        Returns one ``(distances (n_j, k), indices (n_j, k))`` numpy pair
-        per request, in request order — each bit-identical to what the
-        backend's public solo entry point returns for that request.
+        *requests*: sequence of (n_j, dim) query matrices (ragged n_j ≥ 0),
+        each optionally wrapped in a
+        :class:`~raft_tpu.serve.admission.ServeRequest` to carry a
+        deadline/timeout.  Returns one ``(distances (n_j, k), indices
+        (n_j, k))`` numpy pair per request, in request order — each
+        bit-identical to what the backend's public solo entry point
+        returns for that request.
+
+        Failure model (docs/serving.md §failure model): a request that is
+        shed (deadline/overload), fails ingest, or whose dispatch fails
+        after supervision receives ITS EXCEPTION in its result slot — a
+        typed ``RejectedError`` / the ingest/dispatch error — while every
+        other request in the call is served normally.  ``search()`` itself
+        raises only for engine-level misuse (closed engine).
 
         Pipeline: ingest → group by compute dtype → greedy in-order packing
         into ≤ max_batch super-batches → per batch: host-side numpy
@@ -682,6 +792,9 @@ class ServeEngine:
         :meth:`serve_http` running, a call slower than the flight
         recorder's threshold leaves its span tree in the bounded
         ``/debug/slow`` ring."""
+        if self._closed:
+            raise RejectedError("closed", "ServeEngine is closed — new "
+                                "requests reject; see close()")
         rec = self._recorder
         if rec is None or not telemetry.enabled():
             with self._lock:
@@ -696,25 +809,69 @@ class ServeEngine:
             if dur >= rec.threshold_s:
                 rec.record(col.events, dur_s=round(dur, 6),
                            requests=len(requests),
-                           queries=sum(int(np.shape(q)[0]) for q in requests))
+                           queries=sum(
+                               int(np.shape(q.q if isinstance(
+                                   q, ServeRequest) else q)[0])
+                               for q in requests))
             return out
 
     def _search_locked(self, requests):
         t_entry = telemetry.now()
         be = self._backend
+        sup = self._supervisor
+        adm = self._admission
+        raw = [r.q if isinstance(r, ServeRequest) else r for r in requests]
+        results: List[Any] = [None] * len(raw)
+        latencies = [0.0] * len(raw)
+        ingested: List[Any] = [None] * len(raw)
         with telemetry.span("serve.ingest"):
-            ingested = [be.ingest(q) for q in requests]
-        self.stats.inc("requests", len(ingested))
-        self.stats.inc("queries", sum(int(q.shape[0]) for q in ingested))
-        results: List[Optional[Tuple[np.ndarray, np.ndarray]]] = (
-            [None] * len(ingested))
-        latencies = [0.0] * len(ingested)
+            for j, q in enumerate(raw):
+                try:
+                    ingested[j] = be.ingest(q)
+                except Exception as e:
+                    # per-request isolation: a poisoned request (bad
+                    # dim/dtype, NaN-shaped ingest failure) fails ALONE —
+                    # its typed error lands in its slot, the call goes on
+                    results[j] = e
+                    self.stats.inc("ingest_errors")
+        self.stats.inc("requests", len(raw))
+        self.stats.inc("queries", sum(int(q.shape[0]) for q in ingested
+                                      if q is not None))
+
+        # deadline-aware admission in arrival order, BEFORE planning: a
+        # request whose remaining budget cannot cover its projected
+        # completion (batches queued ahead × the live per-batch cost
+        # estimate) is shed here with a typed error, not queued to die
+        deadlines: List[Optional[float]] = [None] * len(raw)
+        if adm is not None:
+            with telemetry.span("serve.admit"):
+                est = adm.batch_cost_s(self._backend_fn())
+                queued = 0
+                for j, r in enumerate(requests):
+                    if results[j] is not None or ingested[j] is None:
+                        continue
+                    n = int(ingested[j].shape[0])
+                    if n == 0:
+                        continue
+                    now = telemetry.now()
+                    if isinstance(r, ServeRequest):
+                        deadlines[j] = r.resolve_deadline(now)
+                    rej = adm.admit(n, deadlines[j], now, queued,
+                                    queued // self.max_batch, est)
+                    if rej is not None:
+                        results[j] = rej
+                        self.stats.inc("sheds")
+                    else:
+                        self.stats.inc("admitted")
+                        queued += n
 
         # group by compute dtype (the engine IS the (index, k, params) key;
         # dtype is the one per-request signature dimension left)
         with telemetry.span("serve.coalesce"):
             by_dtype: Dict[str, List[int]] = {}
             for j, q in enumerate(ingested):
+                if results[j] is not None or q is None:
+                    continue
                 if q.shape[0] == 0:
                     results[j] = (np.zeros((0, be.k), np.float32),
                                   np.full((0, be.k), -1, np.int32))
@@ -729,11 +886,14 @@ class ServeEngine:
                 batches, solo = self._plan(sizes, max_bucket)
                 plans.append((idxs, warmed, batches, solo))
 
-        inflight = []  # (kind, payload...) in dispatch order
+        inflight = []  # (kind, members, out, redo, warmed) dispatch order
         lane = 0
         for idxs, warmed, batches, solo in plans:
             for batch in batches:
                 members = [(idxs[jj], start, n) for jj, start, n in batch]
+                members = self._drop_expired(members, deadlines, results)
+                if not members:
+                    continue
                 total = members[-1][1] + members[-1][2]
                 bucket = self._bucket_for(total, warmed)
                 # host-side assembly: one contiguous padded block, ONE
@@ -743,45 +903,122 @@ class ServeEngine:
                 # per-shape concat/pad programs on device)
                 with telemetry.span("serve.assemble"):
                     block = np.zeros((bucket, be.dim),
-                                     ingested[idxs[0]].dtype)
+                                     ingested[members[0][0]].dtype)
                     for j, start, n in members:
                         block[start:start + n] = ingested[j]
                 with telemetry.span("serve.dispatch"):
                     out = be.dispatch(jnp.asarray(block))  # async
                     self._handle.get_next_usable_stream(lane).record(out)
                 lane += 1
-                inflight.append(("coalesced", members, out))
+                # the retry path re-dispatches the SAME block through the
+                # SAME warmed executable — zero-compile by construction
+                redo = (lambda blk=block: be.dispatch(jnp.asarray(blk)))
+                inflight.append(("coalesced", members, out, redo, warmed))
                 self.stats.inc("super_batches")
                 self.stats.inc("coalesced_requests", len(members))
             for jj in solo:
                 j = idxs[jj]
+                if not self._drop_expired([(j, 0, 0)], deadlines, results):
+                    continue
                 # the RAW request, not the ingested form: the public entry
                 # point applies its own ingest prologue, and re-ingesting
                 # (e.g. normalizing an already-normalized cosine query)
                 # would break the identical-to-solo contract at ulp level
                 with telemetry.span("serve.dispatch"):
-                    out = be.solo(requests[j])  # public: compiles allowed
+                    try:
+                        out = be.solo(raw[j])  # public: compiles allowed
+                    except Exception as e:
+                        # an eager solo failure fails alone, like ingest
+                        results[j] = e
+                        self.stats.inc("dispatch_errors")
+                        continue
                     self._handle.get_next_usable_stream(lane).record(out)
                 lane += 1
+                redo = (lambda jj_=j: be.solo(raw[jj_]))
                 inflight.append(("solo", [(j, 0, ingested[j].shape[0])],
-                                 out))
+                                 out, redo, None))
                 self.stats.inc("solo_fallbacks")
 
-        # collect: blocks per batch; later batches keep executing meanwhile
+        # collect: blocks per batch; later batches keep executing
+        # meanwhile.  Collection is SUPERVISED (watchdog + bounded retry);
+        # a super-batch that still fails is split and re-dispatched
+        # member-by-member so one poisoned request fails alone.
         with telemetry.span("serve.deliver"):
-            for _kind, members, out in inflight:
-                # exempt(hot-path-host-transfer): result delivery fetch
-                d, i = np.asarray(out[0]), np.asarray(out[1])
+            for kind, members, out, redo, warmed in inflight:
+                try:
+                    d, i = sup.collect(out, redo=redo, label=kind)
+                except Exception as e:
+                    self.stats.inc("dispatch_errors")
+                    if kind == "coalesced" and len(members) > 1:
+                        self.stats.inc("isolation_splits")
+                        self._isolate(members, ingested, warmed, results,
+                                      latencies, t_entry)
+                    else:
+                        done = telemetry.now() - t_entry
+                        for j, _start, _n in members:
+                            results[j] = e
+                            latencies[j] = done
+                    continue
                 done = telemetry.now() - t_entry
                 for j, start, n in members:
                     results[j] = (d[start:start + n], i[start:start + n])
                     latencies[j] = done
+        # feed the observed end-to-end per-batch service time back into
+        # the admission cost model (EWMA; see AdmissionController)
+        n_batches = sum(1 for kind, *_ in inflight if kind == "coalesced")
+        if adm is not None and n_batches:
+            adm.observe_batches(n_batches, telemetry.now() - t_entry)
         eng = (self._engine_id,)
-        for v in latencies:
-            self.latency_hist.observe(v, eng)
+        for j, v in enumerate(latencies):
+            if isinstance(results[j], tuple):  # served: record latency
+                self.latency_hist.observe(v, eng)
         # the legacy per-call read surface, BOUNDED (see last_latencies)
         self._last_latencies = latencies[:LATENCY_RESERVOIR]
         return results
+
+    def _drop_expired(self, members, deadlines, results):
+        """Dispatch-time deadline pass over one planned batch: admitted
+        requests whose deadline already passed are counted expired (and,
+        under shed-over-deadline, dropped — their slots get the typed
+        rejection and the survivors re-pack contiguously)."""
+        adm = self._admission
+        if adm is None:
+            return members
+        live, start = [], 0
+        for j, _start, n in members:
+            dl = deadlines[j]
+            now = telemetry.now()
+            if dl is not None and now > dl:
+                self.stats.inc("expired")
+                rej = adm.expire(dl, now)
+                if rej is not None:
+                    results[j] = rej
+                    continue
+            live.append((j, start, n))
+            start += n
+        return live
+
+    def _isolate(self, members, ingested, warmed, results, latencies,
+                 t_entry):
+        """Per-request isolation: re-dispatch each member of a failed
+        super-batch ALONE through the existing bucket ladder (the ladder
+        is warmed, so the re-dispatches are zero-compile — the fault
+        battery counter-asserts this).  Members that fail alone get their
+        error; the rest are served."""
+        be = self._backend
+        sup = self._supervisor
+        for j, _start, n in members:
+            bucket = self._bucket_for(n, warmed)
+            block = np.zeros((bucket, be.dim), ingested[j].dtype)
+            block[:n] = ingested[j]
+            redo = (lambda blk=block: be.dispatch(jnp.asarray(blk)))
+            try:
+                d, i = sup.collect(redo(), redo=redo, label="isolated")
+                results[j] = (d[:n], i[:n])
+            except Exception as e:
+                self.stats.inc("dispatch_errors")
+                results[j] = e
+            latencies[j] = telemetry.now() - t_entry
 
     def sync(self) -> None:
         """Wait for every recorded in-flight dispatch (delegates to the

@@ -127,30 +127,59 @@ def merge(snapshots: Sequence[Dict[str, dict]]) -> Dict[str, dict]:
 _GATHER_TAG = 0x7E1E
 
 
-def gather(comms, timeout: float = 60.0) -> Dict[str, object]:
+def gather(comms, timeout: float = 60.0, *,
+           strict: bool = False) -> Dict[str, object]:
     """Collect every host process's :func:`snapshot` over *comms*' host
     p2p plane and return the fleet view on EVERY host::
 
         {"world": n_host_processes,
          "hosts": {"0": snapshot, "1": snapshot, ...},   # rank-keyed
-         "rollup": merge(all host snapshots)}
+         "rollup": merge(all collected host snapshots),
+         "partial": False, "missing_ranks": []}
 
-    Must be called collectively by every host process of the communicator
-    (it is a symmetric all-to-all exchange of JSON-safe dicts; *timeout*
-    bounds each pending receive).  On a single-process communicator —
-    including one driving a whole multi-device mesh — this returns
-    immediately with the local snapshot as both the only host view and
-    the rollup."""
+    Should be called collectively by every host process of the
+    communicator (a symmetric all-to-all exchange of JSON-safe dicts;
+    *timeout* bounds each pending receive).  On a single-process
+    communicator — including one driving a whole multi-device mesh — this
+    returns immediately with the local snapshot as both the only host
+    view and the rollup.
+
+    **Degradation contract**: a dead or slow host must not turn the fleet
+    rollup into a timeout for every OTHER rank — an unreachable peer is
+    recorded in ``missing_ranks`` (and ``partial: true``), its row is
+    absent from ``hosts``, and the rollup merges whatever arrived.  A
+    failed telemetry exchange is deliberately NOT treated as a broken
+    data-plane clique: the communicator's aborted flag is restored to its
+    prior value (the observability plane must never poison the compute
+    plane).  ``strict=True`` restores the raise-on-first-failure
+    behavior for callers that prefer a loud error to a partial view."""
     local = snapshot()
     world = int(getattr(comms, "_host_world", 1) or 1)
     rank = int(getattr(comms, "_host_rank", 0) or 0)
     hosts: Dict[str, dict] = {str(rank): local}
+    missing: List[int] = []
     if world > 1:
         peers = [r for r in range(world) if r != rank]
-        reqs = [comms.isend(local, dst=r, tag=_GATHER_TAG) for r in peers]
-        reqs += [comms.irecv(src=r, tag=_GATHER_TAG) for r in peers]
-        payloads = comms.waitall(reqs, timeout=timeout)
-        for r, snap in zip(peers, payloads):
-            hosts[str(r)] = snap
+        prior_aborted = bool(getattr(comms, "_aborted", False))
+        for r in peers:
+            try:
+                comms.isend(local, dst=r, tag=_GATHER_TAG)
+            except Exception:
+                if strict:
+                    raise
+                # the peer will learn of us (or not) on its own recv; our
+                # collection below decides whether IT is reachable
+                comms._aborted = prior_aborted
+        for r in peers:
+            try:
+                hosts[str(r)] = comms.waitall(
+                    [comms.irecv(src=r, tag=_GATHER_TAG)],
+                    timeout=timeout)[0]
+            except Exception:
+                if strict:
+                    raise
+                missing.append(r)
+                comms._aborted = prior_aborted
     rollup = merge([hosts[k] for k in sorted(hosts, key=int)])
-    return {"world": world, "hosts": hosts, "rollup": rollup}
+    return {"world": world, "hosts": hosts, "rollup": rollup,
+            "partial": bool(missing), "missing_ranks": missing}

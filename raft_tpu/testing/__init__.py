@@ -1,0 +1,23 @@
+"""Test machinery that ships WITH the library — currently the
+deterministic fault-injection plane (:mod:`raft_tpu.testing.faults`).
+
+It lives inside ``raft_tpu`` (not under ``tests/``) because the serving
+engine, the communicator and the refresh path carry the injection hooks:
+the hooks must import the plane from library code, and operators may
+enable it in a staging process via ``RAFT_TPU_FAULT_PLAN`` without a
+checkout of the test tree.
+"""
+
+from raft_tpu.testing.faults import (  # noqa: F401
+    FaultPlan,
+    InjectedFault,
+    InjectedLogicFault,
+    active_plan,
+    check,
+    clear_plan,
+    install_plan,
+    plan,
+)
+
+__all__ = ["FaultPlan", "InjectedFault", "InjectedLogicFault",
+           "active_plan", "check", "clear_plan", "install_plan", "plan"]

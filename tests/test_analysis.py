@@ -256,6 +256,79 @@ class TestTraceImpurity:
 # telemetry-discipline
 
 
+class TestErrorDiscipline:
+    """The ISSUE-14 swallowed-error guard: bare ``except:`` and
+    ``except Exception: pass`` are forbidden in raft_tpu/serve/,
+    raft_tpu/comms/ and hot-path-registry modules (typed failure
+    contracts — docs/serving.md §failure model)."""
+
+    _BARE = ("def f(x):\n    try:\n        return x + 1\n"
+             "    except:{}\n        return None\n")
+    _SWALLOW = ("def f(x):\n    try:\n        return x + 1\n"
+                "    except Exception:{}\n        pass\n")
+
+    def test_bare_except_fires_in_serve(self):
+        f = findings("raft_tpu/serve/engine.py", self._BARE.format(""),
+                     "error-discipline")
+        assert f and "bare `except:`" in f[0].message
+
+    def test_swallowed_exception_fires_in_comms(self):
+        f = findings("raft_tpu/comms/comms.py", self._SWALLOW.format(""),
+                     "error-discipline")
+        assert f and "swallows" in f[0].message
+
+    def test_fires_in_hot_path_registry_module(self):
+        assert findings("raft_tpu/neighbors/ann_mnmg.py",
+                        self._SWALLOW.format(""), "error-discipline")
+
+    def test_base_exception_and_tuple_fire(self):
+        src = ("def f(x):\n    try:\n        return x\n"
+               "    except (ValueError, BaseException):\n        ...\n")
+        assert findings("raft_tpu/serve/mod.py", src, "error-discipline")
+
+    def test_return_none_swallow_fires(self):
+        src = ("def f(x):\n    try:\n        return x\n"
+               "    except Exception:\n        return None\n")
+        assert findings("raft_tpu/comms/mod.py", src, "error-discipline")
+
+    def test_handled_broad_catch_passes(self):
+        # logging / wrapping / recording IS handling, not swallowing
+        src = ("def f(x, log, results):\n    try:\n        return x\n"
+               "    except Exception as e:\n"
+               "        results.append(e)\n        return None\n")
+        assert not findings("raft_tpu/serve/mod.py", src,
+                            "error-discipline")
+
+    def test_typed_catch_passes(self):
+        src = ("def f(x):\n    try:\n        return x\n"
+               "    except (ValueError, KeyError):\n        pass\n")
+        assert not findings("raft_tpu/serve/mod.py", src,
+                            "error-discipline")
+
+    def test_out_of_scope_module_passes(self):
+        assert not findings("raft_tpu/stats/mod.py",
+                            self._SWALLOW.format(""), "error-discipline")
+
+    def test_marker_exempts(self):
+        f = findings(
+            "raft_tpu/serve/mod.py",
+            self._SWALLOW.format(
+                "  # exempt(error-discipline): third-party teardown"),
+            "error-discipline")
+        assert not f
+
+    def test_shipped_surfaces_clean(self):
+        from raft_tpu.analysis import hotpaths
+
+        for f in sorted((REPO / "raft_tpu").rglob("*.py")):
+            posix = f.as_posix()
+            if not ("raft_tpu/serve/" in posix or "raft_tpu/comms/" in posix
+                    or hotpaths.match(posix)):
+                continue
+            assert not [x for x in engine.check_source(posix, f.read_text())
+                        if x.rule == "error-discipline"], f
+
+
 class TestTelemetryDiscipline:
     _CLOCK = ("import time\n\n\ndef plan(reqs):\n"
               "    t0 = time.perf_counter(){}\n    return t0\n")

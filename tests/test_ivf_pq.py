@@ -309,6 +309,51 @@ def test_ivf_pq_serialize_roundtrip(tmp_path):
     assert idx3.size == idx.size + 50
 
 
+def test_serialize_atomic_write_and_corruption_detection(tmp_path):
+    """ISSUE 14 satellite (docs/serving.md §failure model): saves go via
+    temp file + atomic rename (no droppings, overwrite-in-place safe) and
+    the checksummed manifest turns ANY bit flip into a LOUD typed
+    CorruptionError at load — never garbage results."""
+    import os
+
+    from raft_tpu.core.error import CorruptionError
+    from raft_tpu.neighbors import ivf_flat, ivf_pq
+    from raft_tpu.neighbors.serialize import (load_ivf_flat, load_ivf_pq,
+                                              save_ivf_flat, save_ivf_pq)
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(0, 1, (600, 16)).astype(np.float32)
+    pq = ivf_pq.build(ivf_pq.IndexParams(n_lists=8, pq_dim=8, pq_bits=8,
+                                         seed=3), x)
+    flat = ivf_flat.build(ivf_flat.IndexParams(n_lists=8), x)
+    p_pq = tmp_path / "pq.npz"
+    p_flat = tmp_path / "flat.npz"
+    save_ivf_pq(p_pq, pq)
+    save_ivf_flat(p_flat, flat)
+    # overwrite in place (the crash-mid-save scenario's steady state):
+    # the rename is atomic, and no temp droppings survive
+    save_ivf_pq(p_pq, pq)
+    assert not [f for f in os.listdir(tmp_path) if ".tmp-" in f]
+    load_ivf_pq(p_pq)
+    load_ivf_flat(p_flat)
+
+    # flip one byte mid-archive → loud typed error, for BOTH kinds
+    for p, loader in ((p_pq, load_ivf_pq), (p_flat, load_ivf_flat)):
+        blob = bytearray(p.read_bytes())
+        blob[len(blob) // 2] ^= 0xFF
+        p.write_bytes(bytes(blob))
+        with pytest.raises(CorruptionError):
+            loader(p)
+
+    # truncation (crash mid-write without the atomic rename) is equally
+    # typed — a half-written archive can never half-parse
+    save_ivf_pq(p_pq, pq)
+    blob = p_pq.read_bytes()
+    p_pq.write_bytes(blob[:len(blob) // 2])
+    with pytest.raises(CorruptionError):
+        load_ivf_pq(p_pq)
+
+
 def test_ivf_pq_adc_matches_reconstruction_oracle():
     """ADC scoring must be EXACT given the quantization: with all lists
     probed, search distances equal ||q − (center + decoded code)||² and the

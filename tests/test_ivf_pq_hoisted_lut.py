@@ -248,6 +248,8 @@ def _downgrade_to_v1(path):
         arrays = {k: z[k] for k in z.files}
     header = json.loads(bytes(arrays.pop("__header__")).decode())
     header["version"] = 1
+    # a real pre-hoist writer also predates the checksum manifest
+    header.pop("checksums", None)
     for k in ("list_adc", "list_csum"):
         arrays.pop(k)
     arrays["__header__"] = np.frombuffer(

@@ -469,6 +469,8 @@ class TestShippedGoldens:
             g = json.loads(raw)
             assert g["backend"] == "cpu" and g["x64"] is False, p.name
 
+    @pytest.mark.slow  # re-lowers every fast-subset program (~24s cold);
+    # checks.sh --fingerprints --strict diffs the FULL registry every run
     def test_fast_subset_diffs_clean_at_head(self):
         # the single-device programs re-fingerprint and diff clean in-test
         # (the full 10-program pass incl. the 8-device sharded entries is

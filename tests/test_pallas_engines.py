@@ -44,13 +44,21 @@ class TestSelectKBlockwise:
     # (a full cross product re-compiles an interpret network per cell —
     # tier-1 budget discipline, PR-3/PR-4 precedent); other tests in this
     # class REUSE these signatures so their aot executables are shared
+    # tier-1 keeps three representatives (the shared-signature cell the
+    # rest of the class reuses, the tiny-shape cell, one bf16/max cell);
+    # the remaining cells are `slow` (each interpret-network compile is
+    # ~16-18s cold — ISSUE-14 budget rebalance, PR-3/PR-4 precedent)
     @pytest.mark.parametrize("m,n,k,select_min,dtype", [
         (7, 300, 10, True, np.float32),    # nothing aligned
-        (33, 1000, 1, True, np.float32),   # k=1, ragged rows
-        (64, 4096, 64, True, np.float32),  # the filtered-path shape class
-        (16, 129, 100, False, np.float32), # k near n, select_max
+        pytest.param(33, 1000, 1, True, np.float32,
+                     marks=pytest.mark.slow),   # k=1, ragged rows
+        pytest.param(64, 4096, 64, True, np.float32,
+                     marks=pytest.mark.slow),   # filtered-path shape class
+        pytest.param(16, 129, 100, False, np.float32,
+                     marks=pytest.mark.slow),   # k near n, select_max
         (1, 17, 8, True, np.float32),      # single row, tiny n
-        (9, 700, 16, True, "bfloat16"),    # bf16 comparator
+        pytest.param(9, 700, 16, True, "bfloat16",
+                     marks=pytest.mark.slow),   # bf16 comparator
         (5, 257, 8, False, "bfloat16"),    # bf16 select_max
     ])
     def test_bit_identical_to_xla_engine(self, dtype, select_min, m, n, k):
@@ -252,8 +260,12 @@ class TestProbeScanEngines:
         np.testing.assert_array_equal(i0, i1)
         np.testing.assert_array_equal(d0, d1)
 
+    # fp8-pq5 is the tier-1 representative (quantized LUT + sub-byte
+    # unpack, the distinctive kernel paths); the plain f32-pq8 cell is
+    # `slow` (ISSUE-14 budget rebalance)
     @pytest.mark.parametrize("lut_dtype,pq_bits", [
-        ("float32", 8), ("float8_e4m3", 5)])
+        pytest.param("float32", 8, marks=pytest.mark.slow),
+        ("float8_e4m3", 5)])
     def test_ivf_pq_vmem_kernel_matches_hoisted_scan(self, monkeypatch,
                                                      lut_dtype, pq_bits):
         """The LUT-in-VMEM kernel ≡ the hoisted-LUT scan top-k within the

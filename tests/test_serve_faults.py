@@ -1,0 +1,478 @@
+"""The fault-injection battery (ISSUE 14; docs/serving.md §failure
+model): deadline-aware admission + load shedding, supervised dispatch
+(watchdog / bounded retry / per-request isolation), refresh atomicity
+under injected crashes, bounded idempotent shutdown, and the trace-time
+guarantee that the fault plane adds NOTHING to lowered programs."""
+
+import contextlib
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_tpu.core.aot import aot_compile_counters
+from raft_tpu.core.error import LogicError
+from raft_tpu.neighbors import knn
+from raft_tpu.serve import (AdmissionController, RejectedError, ServeEngine,
+                            ServeRequest, WatchdogTimeout)
+from raft_tpu.serve.supervise import retryable
+from raft_tpu.testing import faults
+
+_N, _DIM, _K = 2000, 16, 5
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(0, 1, (_N, _DIM)).astype(np.float32), rng
+
+
+_X, _RNG = _data()
+_X2 = _data(7)[0]
+
+
+def _engine(max_batch=64, **kw):
+    eng = ServeEngine(_X, _K, max_batch=max_batch, **kw)
+    eng.warmup()
+    eng.search([_X[:2]])  # warm the dispatch plumbing too
+    return eng
+
+
+def _solo(x, q):
+    d, i = knn(x, q, _K)
+    return np.asarray(d), np.asarray(i)
+
+
+# ---------------------------------------------------------------------------
+# the plan grammar
+
+
+class TestFaultPlan:
+    def test_parse_fields(self):
+        p = faults.FaultPlan.parse(
+            "dispatch:n=3:raise; dispatch:n=5:stall=0.5;"
+            "comms:rank=1:op=isend:fail; refresh:stage=pre_swap:crash;"
+            "dispatch:p=0.25:seed=9:raise=logic")
+        d = p.directives
+        assert (d[0].site, d[0].n, d[0].action, d[0].kind) == (
+            "dispatch", 3, "raise", "transient")
+        assert (d[1].action, d[1].stall_s) == ("stall", 0.5)
+        assert (d[2].site, d[2].rank, d[2].op) == ("comms", 1, "isend")
+        assert (d[3].site, d[3].stage) == ("refresh", "pre_swap")
+        assert (d[4].p, d[4].seed, d[4].kind) == (0.25, 9, "logic")
+
+    @pytest.mark.parametrize("bad", [
+        "", "bogus:n=1:raise", "dispatch:n=1",        # no action
+        "dispatch:wat=1:raise", "dispatch:raise=wat",
+    ])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            faults.FaultPlan.parse(bad)
+
+    def test_nth_event_and_times(self):
+        p = faults.FaultPlan.parse("dispatch:n=2:times=2:raise")
+        p.check("dispatch")                       # event 1: silent
+        for _ in range(2):                        # events 2, 3: fire
+            with pytest.raises(faults.InjectedFault):
+                p.check("dispatch")
+        p.check("dispatch")                       # event 4: silent again
+
+    def test_attribute_filters_gate_counting(self):
+        p = faults.FaultPlan.parse("comms:rank=1:n=1:fail")
+        p.check("comms", rank=0, op="isend")      # filtered out, not counted
+        with pytest.raises(faults.InjectedFault):
+            p.check("comms", rank=1, op="isend")  # 1st MATCHING event
+
+    def test_seeded_probability_is_deterministic(self):
+        def seq():
+            p = faults.FaultPlan.parse("dispatch:p=0.4:seed=3:times=0:raise")
+            out = []
+            for _ in range(32):
+                try:
+                    p.check("dispatch")
+                    out.append(0)
+                except faults.InjectedFault:
+                    out.append(1)
+            return out
+
+        a, b = seq(), seq()
+        assert a == b and 0 < sum(a) < 32
+
+    def test_off_by_default_and_context_restores(self):
+        assert faults.active_plan() is None
+        with faults.plan("dispatch:n=1:raise") as p:
+            assert faults.active_plan() is p
+        assert faults.active_plan() is None
+
+    def test_retryable_classification(self):
+        assert retryable(faults.InjectedFault("x"))
+        assert retryable(WatchdogTimeout("x"))
+        assert retryable(RuntimeError("transient"))
+        assert not retryable(faults.InjectedLogicFault("x"))
+        assert not retryable(LogicError("shape bug"))
+        assert not retryable(TypeError("x"))
+        assert not retryable(ValueError("x"))
+
+
+# ---------------------------------------------------------------------------
+# supervised dispatch: retry, watchdog, isolation
+
+
+class TestSupervisedDispatch:
+    def test_transient_fault_retry_bit_identical_zero_compile(self):
+        """A transient dispatch failure is retried through the SAME warmed
+        executable: results bit-identical to solo, zero compiles during
+        the faulted replay (acceptance gate)."""
+        eng = _engine()
+        reqs = [_X[:3], _X[10:17], _X[40:41]]
+        c0 = aot_compile_counters["compiles"]
+        with faults.plan("dispatch:n=1:raise"):
+            outs = eng.search(reqs)
+        assert aot_compile_counters["compiles"] == c0, \
+            "retry path compiled (bucket ladder not reused)"
+        assert eng.stats["retries"] >= 1
+        for q, (d, i) in zip(reqs, outs):
+            d0, i0 = _solo(_X, q)
+            np.testing.assert_array_equal(i, i0)
+            np.testing.assert_array_equal(d, d0)
+
+    def test_watchdog_fires_and_engine_recovers(self):
+        """A hung dispatch trips the wall-clock watchdog instead of
+        blocking the engine forever; the retry re-dispatches fresh buffers
+        and the engine stays serviceable (acceptance gate)."""
+        eng = _engine(watchdog_s=0.25, max_retries=1)
+        t0 = time.monotonic()
+        with faults.plan("dispatch:n=1:stall=5"):
+            outs = eng.search([_X[:3]])
+        wall = time.monotonic() - t0
+        assert wall < 3.0, f"engine waited the stall out ({wall:.1f}s)"
+        assert eng.stats["watchdog_timeouts"] == 1
+        np.testing.assert_array_equal(outs[0][1], _solo(_X, _X[:3])[1])
+        # and the engine is fully serviceable afterwards
+        outs = eng.search([_X[5:9]])
+        np.testing.assert_array_equal(outs[0][1], _solo(_X, _X[5:9])[1])
+
+    def test_persistent_hang_fails_typed_then_recovers(self):
+        eng = _engine(watchdog_s=0.2, max_retries=0)
+        with faults.plan("dispatch:n=1:times=0:stall=5"):
+            outs = eng.search([_X[:3]])
+        assert isinstance(outs[0], WatchdogTimeout)
+        outs = eng.search([_X[:3]])  # plan gone: engine serves again
+        np.testing.assert_array_equal(outs[0][1], _solo(_X, _X[:3])[1])
+
+    def test_nonretryable_fails_fast_and_isolates(self):
+        """A non-retryable (logic) failure is NEVER retried; the failed
+        multi-member super-batch is split and re-dispatched member-by-
+        member through the warmed bucket ladder (zero-compile), so the
+        healthy members are served."""
+        eng = _engine()
+        r0 = eng.stats["retries"]
+        reqs = [_X[:3], _X[10:17]]
+        c0 = aot_compile_counters["compiles"]
+        with faults.plan("dispatch:n=1:raise=logic"):
+            outs = eng.search(reqs)
+        assert aot_compile_counters["compiles"] == c0, \
+            "isolation split compiled (ladder not warmed?)"
+        assert eng.stats["retries"] == r0, "a logic fault was retried"
+        assert eng.stats["isolation_splits"] == 1
+        for q, (d, i) in zip(reqs, outs):
+            np.testing.assert_array_equal(i, _solo(_X, q)[1])
+
+    def test_poisoned_request_fails_alone(self):
+        """Per-request isolation at ingest: one malformed request gets its
+        typed error in its slot; every other request is served."""
+        eng = _engine()
+        bad = np.zeros((3, _DIM + 2), np.float32)  # wrong dim
+        outs = eng.search([_X[:3], bad, _X[5:9]])
+        assert isinstance(outs[1], LogicError)
+        assert eng.stats["ingest_errors"] == 1
+        for j, q in ((0, _X[:3]), (2, _X[5:9])):
+            np.testing.assert_array_equal(outs[j][1], _solo(_X, q)[1])
+
+    def test_exhausted_retries_surface_typed_and_engine_recovers(self):
+        eng = _engine(max_retries=1)
+        with faults.plan("dispatch:times=0:raise"):
+            outs = eng.search([_X[:3], _X[5:9]])
+        assert all(isinstance(o, faults.InjectedFault) for o in outs)
+        assert eng.stats["dispatch_errors"] >= 1
+        outs = eng.search([_X[:3]])
+        np.testing.assert_array_equal(outs[0][1], _solo(_X, _X[:3])[1])
+
+
+# ---------------------------------------------------------------------------
+# admission: deadlines, shedding, bounded queue, expiry
+
+
+class TestAdmission:
+    def test_deadline_shed_at_admission_typed(self):
+        adm = AdmissionController(policy="shed-over-deadline",
+                                  static_batch_s=10.0, use_telemetry=False)
+        eng = ServeEngine(_X, _K, max_batch=16, admission=adm)
+        eng.warmup()
+        reqs = [ServeRequest(_X[:10], timeout_s=100.0),
+                ServeRequest(_X[:10], timeout_s=1.0)]
+        outs = eng.search(reqs)
+        np.testing.assert_array_equal(outs[0][1], _solo(_X, _X[:10])[1])
+        assert isinstance(outs[1], RejectedError)
+        assert outs[1].reason == "deadline"
+        assert eng.stats["sheds"] == 1 and eng.stats["admitted"] == 1
+        health = eng._health()
+        assert health["ready"] and health["degraded"]
+        assert health["admission"]["shed_total"] == 1
+
+    def test_overload_keeps_admitted_latency_bounded(self):
+        """The shed-under-overload property at unit scale: with a deadline
+        budget over an offered load the engine cannot clear in budget, the
+        excess is shed and every ADMITTED request completes within the
+        budget (+ slack) — the bench drives the full 2x-load version."""
+        adm = AdmissionController(policy="shed-over-deadline",
+                                  static_batch_s=0.004,
+                                  use_telemetry=False)
+        eng = ServeEngine(_X, _K, max_batch=16, admission=adm)
+        eng.warmup()
+        eng.search([_X[:2]])
+        budget = 0.02
+        reqs = [ServeRequest(_X[j * 10:j * 10 + 10], timeout_s=budget)
+                for j in range(12)]  # 12 batches projected ≫ budget
+        outs = eng.search(reqs)
+        served = [j for j, o in enumerate(outs) if isinstance(o, tuple)]
+        shed = [o for o in outs if isinstance(o, RejectedError)]
+        assert shed, "2x-over-budget load shed nothing"
+        assert served, "admission shed everything"
+        lats = [eng.last_latencies[j] for j in served]
+        assert max(lats) <= budget + 0.25, \
+            f"admitted p-max latency {max(lats):.3f}s not bounded"
+        for j in served:
+            np.testing.assert_array_equal(
+                outs[j][1], _solo(_X, _X[j * 10:j * 10 + 10])[1])
+
+    def test_bounded_queue_sheds_newest(self):
+        adm = AdmissionController(policy="shed-newest", max_queue=20,
+                                  use_telemetry=False)
+        eng = ServeEngine(_X, _K, max_batch=64, admission=adm)
+        eng.warmup()
+        outs = eng.search([_X[:15], _X[20:30], _X[40:43]])
+        assert isinstance(outs[0], tuple)
+        assert isinstance(outs[1], RejectedError)
+        assert outs[1].reason == "overload"
+        # 15 + 3 fits back under the bound: the queue drains per-request
+        assert isinstance(outs[2], tuple)
+        np.testing.assert_array_equal(outs[2][1], _solo(_X, _X[40:43])[1])
+
+    def test_admitted_but_expired_dropped_at_dispatch(self):
+        """shed-over-deadline's dispatch-time pass: an admitted request
+        whose deadline passed before its super-batch assembled is dropped
+        with reason='expired', not dispatched late."""
+        adm = AdmissionController(policy="shed-over-deadline",
+                                  static_batch_s=0.0, use_telemetry=False)
+        eng = ServeEngine(_X, _K, max_batch=16, admission=adm)
+        eng.warmup()
+        reqs = [ServeRequest(_X[:16], timeout_s=100.0),
+                ServeRequest(_X[20:24], timeout_s=0.0)]  # admits (est 0)
+        outs = eng.search(reqs)
+        np.testing.assert_array_equal(outs[0][1], _solo(_X, _X[:16])[1])
+        assert isinstance(outs[1], RejectedError)
+        assert outs[1].reason == "expired"
+        assert eng.stats["expired"] == 1
+
+    def test_shed_newest_serves_expired_late_but_counts(self):
+        adm = AdmissionController(policy="shed-newest",
+                                  static_batch_s=0.0, use_telemetry=False)
+        eng = ServeEngine(_X, _K, max_batch=16, admission=adm)
+        eng.warmup()
+        reqs = [ServeRequest(_X[:16], timeout_s=100.0),
+                ServeRequest(_X[20:24], timeout_s=0.0)]
+        outs = eng.search(reqs)
+        # admission is a promise under shed-newest: served late, counted
+        np.testing.assert_array_equal(outs[1][1],
+                                      _solo(_X, _X[20:24])[1])
+        assert eng.stats["expired"] == 1
+
+    def test_serve_request_without_deadline_is_plain(self):
+        eng = _engine()
+        outs = eng.search([ServeRequest(_X[:5]), _X[:5]])
+        np.testing.assert_array_equal(outs[0][1], outs[1][1])
+        np.testing.assert_array_equal(outs[0][0], outs[1][0])
+
+    def test_admission_counters_exported(self):
+        from raft_tpu import telemetry
+
+        snap = telemetry.snapshot()
+        assert "raft_tpu_serve_shed_total" in snap
+        assert "raft_tpu_serve_admitted_total" in snap
+        assert "raft_tpu_serve_expired_total" in snap
+
+
+# ---------------------------------------------------------------------------
+# refresh atomicity + concurrency under the fault plane
+
+
+class TestRefreshAtomicity:
+    def test_crashed_refresh_leaves_old_backend_serving(self):
+        """The acceptance gate: a crash injected BETWEEN re-lower and swap
+        leaves the OLD backend fully serving, bit-identically — proven
+        under injected crash, not by code reading."""
+        eng = _engine()
+        with faults.plan("refresh:stage=pre_swap:raise"):
+            with pytest.raises(faults.InjectedFault):
+                eng.refresh(_X2)
+        assert eng.stats["refreshes"] == 0
+        health = eng._health()
+        assert health["ready"] and not health["refresh_in_flight"]
+        outs = eng.search([_X[:6]])
+        np.testing.assert_array_equal(outs[0][1], _solo(_X, _X[:6])[1])
+        np.testing.assert_array_equal(outs[0][0], _solo(_X, _X[:6])[0])
+        # and a later clean refresh still lands the new index
+        eng.refresh(_X2)
+        outs = eng.search([_X[:6]])
+        np.testing.assert_array_equal(outs[0][1], _solo(_X2, _X[:6])[1])
+
+    def test_pre_warm_crash_equally_atomic(self):
+        eng = _engine()
+        with faults.plan("refresh:stage=pre_warm:raise"):
+            with pytest.raises(faults.InjectedFault):
+                eng.refresh(_X2)
+        outs = eng.search([_X[:4]])
+        np.testing.assert_array_equal(outs[0][1], _solo(_X, _X[:4])[1])
+
+    def test_concurrent_refresh_and_search_single_generation(self):
+        """Hammer search() across an injected SLOW swap: every response
+        comes bit-identical from exactly ONE backend generation (old or
+        new, never a mix), `_refreshing` gates /healthz, and post-swap
+        traffic is all new-generation."""
+        eng = _engine()
+        q = _X[:7]
+        d_old, i_old = _solo(_X, q)
+        d_new, i_new = _solo(_X2, q)
+        assert not np.array_equal(i_old, i_new), "degenerate test data"
+        saw_refreshing = []
+        errors = []
+
+        def do_refresh():
+            try:
+                with faults.plan("refresh:stage=pre_swap:stall=0.4"):
+                    eng.refresh(_X2)
+            except Exception as e:  # surfaced below
+                errors.append(e)
+
+        t = threading.Thread(target=do_refresh)
+        t.start()
+        generations = set()
+        while t.is_alive():
+            health = eng._health()
+            if health["refresh_in_flight"]:
+                saw_refreshing.append(health["ready"])
+            (d, i), = eng.search([q])
+            if np.array_equal(i, i_old) and np.array_equal(d, d_old):
+                generations.add("old")
+            elif np.array_equal(i, i_new) and np.array_equal(d, d_new):
+                generations.add("new")
+            else:
+                generations.add("MIXED")
+        t.join(30)
+        assert not errors, errors
+        assert "MIXED" not in generations, \
+            "a response matched neither backend generation bitwise"
+        assert saw_refreshing and not any(saw_refreshing), \
+            "/healthz stayed ready during the injected slow swap"
+        (d, i), = eng.search([q])  # post-swap: new generation only
+        np.testing.assert_array_equal(i, i_new)
+
+
+# ---------------------------------------------------------------------------
+# bounded, idempotent shutdown
+
+
+class TestClose:
+    def test_close_idempotent_and_rejects_typed(self):
+        eng = _engine()
+        eng.close()
+        eng.close()  # double-close is a no-op
+        with pytest.raises(RejectedError) as exc:
+            eng.search([_X[:2]])
+        assert exc.value.reason == "closed"
+        with pytest.raises(LogicError):
+            eng.warmup()
+        with pytest.raises(LogicError):
+            eng.refresh(_X2)
+        assert eng._health()["ready"] is False
+
+    def test_close_drains_in_flight_requests(self):
+        eng = _engine()
+        outs = {}
+
+        def slow_search():
+            with faults.plan("dispatch:n=1:stall=0.4"):
+                outs["v"] = eng.search([_X[:3]])
+
+        t = threading.Thread(target=slow_search)
+        t.start()
+        time.sleep(0.1)  # let the search take the engine lock
+        t0 = time.monotonic()
+        eng.close(timeout_s=5.0)
+        close_wall = time.monotonic() - t0
+        t.join(10)
+        # close returned only after the in-flight call drained, and the
+        # drained call's results are intact
+        np.testing.assert_array_equal(outs["v"][0][1],
+                                      _solo(_X, _X[:3])[1])
+        assert close_wall < 5.0
+        with pytest.raises(RejectedError):
+            eng.search([_X[:2]])
+
+    def test_close_stops_scrape_server(self):
+        import urllib.error
+        import urllib.request
+
+        eng = _engine()
+        srv = eng.serve_http(port=0)
+        url = f"{srv.url}/healthz"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            assert json.loads(r.read())["ready"] is True
+        eng.close()
+        with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+            urllib.request.urlopen(url, timeout=1)
+
+
+# ---------------------------------------------------------------------------
+# the trace-time guarantee: the plane adds NOTHING to lowered programs
+
+
+@contextlib.contextmanager
+def _x64_off():
+    prev = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", False)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_x64", prev)
+
+
+class TestTracePurity:
+    def test_installed_plan_leaves_fingerprints_byte_identical(self):
+        """Lower a registered serve program with a dispatch/refresh plan
+        INSTALLED and with the plane off: the structural fingerprints
+        serialize byte-identically, and both diff clean against the
+        committed golden (the full 13-golden pass is CI's job)."""
+        from raft_tpu.analysis import fingerprint, registry
+
+        entry = registry.get_program("brute_force.knn_scan")
+        with _x64_off():
+            fp_off = fingerprint.extract(entry)
+            with faults.plan("dispatch:n=1:raise;dispatch:n=2:stall=9;"
+                             "refresh:stage=pre_swap:raise"):
+                fp_on = fingerprint.extract(entry)
+        assert fingerprint.dumps(fp_off) == fingerprint.dumps(fp_on)
+        golden = json.loads(
+            fingerprint.golden_path(entry.name).read_text())
+        assert fingerprint.diff(golden, fp_off) == []
+
+    def test_hooks_are_free_when_off(self):
+        # the whole plane reduces to one attribute read per hook site
+        assert faults.active_plan() is None
+        faults.check("dispatch")
+        faults.check("comms", rank=0, op="isend")
+        faults.check("refresh", stage="pre_swap")

@@ -128,6 +128,8 @@ class TestDeviceAttribution:
         assert hist is None or hist.count(("_fleet_probe_zero",)) == 0
 
 
+@pytest.mark.slow  # compiles all 13 registered programs (~30s cold);
+# ci/checks.sh --hlo --strict verifies the full registry every run
 def test_all_registered_hot_paths_report_cost_gauges(enabled_telemetry):
     """ISSUE 10 acceptance: every @hlo_program-registered hot path (all
     nine at HEAD) reports flops AND bytes-accessed gauges — the audit
@@ -301,10 +303,62 @@ class TestGather:
             fleet = fleets[rank]
             assert fleet["world"] == 2
             assert set(fleet["hosts"]) == {"0", "1"}
+            assert fleet["partial"] is False
+            assert fleet["missing_ranks"] == []
             # both hosts run in ONE test process sharing one registry, so
             # the rollup counter is the marker counted once per host view
             assert fleet["rollup"]["t_fleet_gather_marker"]["values"][
                 ""] == 2 * marker.get()
+
+    def test_dead_host_degrades_to_partial_rollup(self, enabled_telemetry):
+        """ISSUE 14 satellite: a dead/slow host must NOT turn the fleet
+        rollup into a waitall timeout for every rank — gather degrades to
+        a partial rollup listing missing_ranks, the present hosts' rows
+        merge, and the communicator's data-plane clique is NOT poisoned
+        (a failed telemetry exchange is not a broken compute plane)."""
+        from jax.sharding import Mesh
+        from raft_tpu.comms.comms import Comms
+
+        mesh = Mesh(np.array(jax.devices()[:1]), ("world",))
+        # world CLAIMS three host ranks; rank 2 never shows up (dead host)
+        c0 = Comms(mesh, session_id="t-fleet-partial", host_rank=0,
+                   host_world=3)
+        c1 = Comms(mesh, session_id="t-fleet-partial", host_rank=1,
+                   host_world=3)
+        fleets, errs = {}, []
+
+        def run(rank, comms):
+            try:
+                fleets[rank] = telemetry.gather(comms, timeout=1.5)
+            except Exception as e:  # surfaced below
+                errs.append(e)
+
+        threads = [threading.Thread(target=run, args=(r, c))
+                   for r, c in ((0, c0), (1, c1))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errs, errs
+        for rank, comms in ((0, c0), (1, c1)):
+            fleet = fleets[rank]
+            assert fleet["partial"] is True
+            assert fleet["missing_ranks"] == [2]
+            assert set(fleet["hosts"]) == {"0", "1"}
+            assert "rollup" in fleet and fleet["world"] == 3
+            # the observability plane must not poison the compute plane
+            assert comms._aborted is False
+
+    def test_strict_gather_still_raises(self, enabled_telemetry):
+        from jax.sharding import Mesh
+        from raft_tpu.comms.comms import Comms
+        from raft_tpu.core.error import LogicError
+
+        mesh = Mesh(np.array(jax.devices()[:1]), ("world",))
+        c0 = Comms(mesh, session_id="t-fleet-strict", host_rank=0,
+                   host_world=2)
+        with pytest.raises(LogicError):
+            telemetry.gather(c0, timeout=0.2, strict=True)
 
 
 # ---------------------------------------------------------------------------
