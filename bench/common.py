@@ -376,6 +376,18 @@ def serve_request_stream(seed: int, n_requests: int, dim: int,
     return reqs
 
 
+#: Extra per-run fields a metric function stashes for the telemetry
+#: section of ITS bench row (ISSUE 15: cold-start seconds ride here so
+#: the trajectory finally sees them) — merged by telemetry_bench_section.
+_EXTRA_TELEMETRY: dict = {}
+
+
+def record_extra_telemetry(key, value):
+    """Stash one operational field into this run's bench ``telemetry``
+    section (the metric body runs before the section is built)."""
+    _EXTRA_TELEMETRY[str(key)] = value
+
+
 def telemetry_bench_section():
     """Operational-counter section persisted into every bench.py JSON row
     (ISSUE 10): a compact digest of the process telemetry snapshot —
@@ -417,4 +429,5 @@ def telemetry_bench_section():
         # per-fn histograms via the snapshot's convenience estimates)
         section["device_p50_s"] = {
             k.split("fn=", 1)[-1]: c["p50"] for k, c in dev.items()}
+    section.update(_EXTRA_TELEMETRY)
     return section

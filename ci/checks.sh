@@ -46,7 +46,8 @@ mods = [
     "raft_tpu.neighbors", "raft_tpu.neighbors.ivf_flat",
     "raft_tpu.neighbors.ivf_pq", "raft_tpu.neighbors.ball_cover",
     "raft_tpu.serve", "raft_tpu.serve.admission",
-    "raft_tpu.serve.supervise", "raft_tpu.native",
+    "raft_tpu.serve.supervise", "raft_tpu.serve.schedule",
+    "raft_tpu.core.aotstore", "raft_tpu.native",
     "raft_tpu.testing", "raft_tpu.testing.faults",
     "raft_tpu.kernels", "raft_tpu.kernels.engine",
     "raft_tpu.kernels.select_k", "raft_tpu.kernels.fused_l2nn",

@@ -35,7 +35,10 @@ PACKAGES = [
     ("serve", "Batched query serving: request coalescing, executable "
               "warmup/pinning, double-buffered dispatch, deadline-aware "
               "admission + load shedding, supervised dispatch "
-              "(watchdog/retry), atomic refresh"),
+              "(watchdog/retry), atomic refresh, telemetry-steered "
+              "continuous batching (quantum scheduler, streaming "
+              "submit()) and 2D shard x replica routing with fault "
+              "draining"),
     ("testing", "Deterministic fault-injection plane "
                 "(RAFT_TPU_FAULT_PLAN): seeded dispatch/comms/refresh "
                 "fault directives, off by default"),
@@ -127,6 +130,13 @@ _SUBMODULES = {
     # namespace, but http (the scrape server + flight recorder) is a lazy
     # submodule — rendered as its own section alongside the other two
     "telemetry": ["device", "aggregate", "http"],
+    # the executable store (ISSUE 15 cold start) is consumed via
+    # aotstore.install()/RAFT_TPU_AOT_STORE, not the package namespace
+    "core": ["aotstore"],
+    # the continuous-batching policy objects (chooser, quantum rule,
+    # replica router) live on the schedule submodule; the package
+    # re-exports only the config/router classes
+    "serve": ["schedule"],
 }
 
 

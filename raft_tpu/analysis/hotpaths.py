@@ -64,7 +64,12 @@ HOT_PATHS: Tuple[HotPath, ...] = (
     HotPath("raft_tpu/serve/",
             why="the serving dispatch loop double-buffers device work; an "
                 "unmarked fetch would serialize lanes (host-side request "
-                "assembly and result delivery are sanctioned, marked)"),
+                "assembly and result delivery are sanctioned, marked).  "
+                "Covers the continuous-batching scheduler (schedule.py) "
+                "too: the chooser/router run per dispatch, so they must "
+                "stay pure host arithmetic — no device work, no raw "
+                "clocks, no swallowed errors (host-transfer + telemetry- "
+                "+ error-discipline all apply module-wide)"),
     HotPath("raft_tpu/neighbors/brute_force.py",
             functions=("_knn_scan_impl", "_knn_scan_chunked"),
             why="the fused kNN scan program body"),

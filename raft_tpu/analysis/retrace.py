@@ -54,8 +54,10 @@ from raft_tpu.analysis import dataflow
 from raft_tpu.analysis.engine import REPO_ROOT, collect_files
 
 #: the serving layer whose closure is certified: backend adapters +
-#: engine live here, the sharded searcher they delegate to lives there
+#: engine live here, the sharded searcher they delegate to lives there,
+#: and the continuous-batching chooser (ISSUE 15) lives in schedule.py
 SERVE_MODULES = ("raft_tpu/serve/engine.py",
+                 "raft_tpu/serve/schedule.py",
                  "raft_tpu/neighbors/ann_mnmg.py")
 
 #: functions that map an unbounded value onto a finite signature ladder
@@ -151,6 +153,51 @@ def _delegation(call: ast.Call) -> Optional[Tuple[str, str]]:
     return None
 
 
+def _fanout_delegation(warm: ast.FunctionDef, disp: ast.FunctionDef
+                       ) -> Optional[str]:
+    """The REPLICA fan-out form (ISSUE 15): ``warm()`` loops one lane
+    collection and warms EVERY member (``for s in self.searchers:
+    s.warm(...)``) while ``dispatch()`` terminal-delegates to ONE member
+    of the SAME collection (``self.searchers[lane].dispatch(...)``).
+    Warming every lane is what makes lane re-routing zero-compile, so
+    this form is congruent BY CONSTRUCTION: the dispatchable signature
+    space per lane equals the warmed space per lane.  Returns the
+    collection skeleton when the pair matches, else None."""
+    loop = None
+    for node in reversed(warm.body):
+        if isinstance(node, ast.For):
+            loop = node
+            break
+    if loop is None or not isinstance(loop.target, ast.Name):
+        return None
+    coll = _normalize(loop.iter, frozenset())
+    body_call = None
+    for node in reversed(loop.body):
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            body_call = node.value
+            break
+    if body_call is None:
+        return None
+    bdel = _delegation(body_call)
+    if bdel is None or bdel[1] != "warm" \
+            or bdel[0] != loop.target.id:
+        return None
+    dc = _terminal_call(disp)
+    if dc is None:
+        return None
+    ddel = _delegation(dc)
+    if ddel is None or ddel[1] != "dispatch":
+        return None
+    # the dispatch base must be a SUBSCRIPT of the warmed collection
+    # (one lane of the set every lane of which warm() pre-lowered)
+    base = dc.func.value
+    if not isinstance(base, ast.Subscript):
+        return None
+    if _normalize(base.value, frozenset()) != coll:
+        return None
+    return coll
+
+
 def certify_warm_dispatch(files: Dict[str, ast.Module],
                           flows: Dict[str, dataflow.ValueFlow]
                           ) -> List[ObligationReport]:
@@ -172,6 +219,14 @@ def certify_warm_dispatch(files: Dict[str, ast.Module],
                     [f"class defines {'dispatch' if warm is None else 'warm'}"
                      f" but no {missing}() — its signatures can never be "
                      "pre-lowered (every dispatch is a potential compile)"]))
+                continue
+            fanout = _fanout_delegation(warm, disp)
+            if fanout is not None:
+                pairs += 1
+                reports.append(ObligationReport(
+                    name, "ok", [],
+                    f"fans warm() out across every lane of `{fanout}`; "
+                    "dispatch() hits one lane of the same set"))
                 continue
             wc, dc = _terminal_call(warm), _terminal_call(disp)
             if wc is None or dc is None:
@@ -394,6 +449,124 @@ def certify_bucket_closure(files: Dict[str, ast.Module]
 
 
 # ---------------------------------------------------------------------------
+# certificate 2b: the continuous-batching chooser stays inside the warmed
+# signature space (ISSUE 15, docs/serving.md §scheduler)
+
+
+def _function(tree: ast.Module, name: str) -> Optional[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node
+    return None
+
+
+def certify_scheduler_closure(files: Dict[str, ast.Module]
+                              ) -> List[ObligationReport]:
+    """The chooser-side obligations: ``choose_batches`` may pick buckets
+    ONLY through its ``bucket_for`` parameter (the engine's certified
+    ladder — never a locally computed size), the engine must feed it
+    ``self._bucket_for`` over the warmed set, and the streaming
+    ``submit()`` loop must route every dispatch through the same
+    ``search()`` pipeline gated by the quantum rule.  Together with the
+    bucket-closure certificate these prove: the scheduler only selects
+    warmed signatures."""
+    out: List[ObligationReport] = []
+
+    def obligation(name, ok, why_fail, detail=""):
+        out.append(ObligationReport(
+            f"serve.scheduler_closure.{name}", "ok" if ok else "fail",
+            [] if ok else [why_fail], detail))
+
+    sched = files.get("raft_tpu/serve/schedule.py")
+    if sched is None:
+        return [ObligationReport(
+            "serve.scheduler_closure", "fail",
+            ["raft_tpu/serve/schedule.py not found — the chooser moved; "
+             "update SERVE_MODULES and re-prove the closure"])]
+    chooser = _function(sched, "choose_batches")
+    if chooser is None:
+        obligation("chooser", False,
+                   "choose_batches not found in schedule.py — the "
+                   "chooser renamed; update the certificate")
+    else:
+        params = [a.arg for a in chooser.args.args]
+        has_param = "bucket_for" in params
+        obligation(
+            "chooser.ladder_param", has_param,
+            "choose_batches no longer takes the engine's bucket_for "
+            "ladder — bucket choice left the certified space")
+        # every binding of a name == "bucket" inside the chooser must be
+        # a call of the bucket_for parameter: the chooser NEVER computes
+        # a bucket itself (a raw total would mint unwarmed signatures)
+        bindings, via_param = 0, 0
+        for n in ast.walk(chooser):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Name) and t.id == "bucket":
+                        bindings += 1
+                        if isinstance(n.value, ast.Call) and isinstance(
+                                n.value.func, ast.Name) \
+                                and n.value.func.id == "bucket_for":
+                            via_param += 1
+        obligation(
+            "chooser.bucket_via_ladder",
+            bindings >= 1 and bindings == via_param,
+            f"{bindings - via_param} of {bindings} bucket bindings in "
+            "choose_batches do not come from the bucket_for ladder — "
+            "the chooser can emit a signature warmup() never pre-lowered",
+            f"{via_param} binding(s), all via bucket_for")
+
+    engine = files.get("raft_tpu/serve/engine.py")
+    if engine is None:
+        obligation("engine", False, "raft_tpu/serve/engine.py not found")
+        return out
+    # the engine's chooser call feeds the CERTIFIED ladder + warmed set
+    fed = False
+    for n in ast.walk(engine):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id == "choose_batches":
+            for arg in n.args:
+                if isinstance(arg, ast.Lambda):
+                    for inner in ast.walk(arg):
+                        if isinstance(inner, ast.Attribute) \
+                                and inner.attr == "_bucket_for":
+                            fed = True
+    obligation(
+        "engine.feeds_ladder", fed,
+        "the engine's choose_batches call does not pass self._bucket_for "
+        "— the chooser's buckets diverged from the certified ladder")
+    # the streaming loop gates on the quantum rule and dispatches only
+    # through search() (every search-path certificate carries over)
+    loop = None
+    serve_pending = None
+    for n in ast.walk(engine):
+        if isinstance(n, ast.FunctionDef) and n.name == "_sched_loop":
+            loop = n
+        if isinstance(n, ast.FunctionDef) and n.name == "_serve_pending":
+            serve_pending = n
+    gated = loop is not None and any(
+        isinstance(n, ast.Call) and (
+            (isinstance(n.func, ast.Name)
+             and n.func.id == "should_dispatch")
+            or (isinstance(n.func, ast.Attribute)
+                and n.func.attr == "should_dispatch"))
+        for n in ast.walk(loop))
+    obligation(
+        "stream.quantum_gated", gated,
+        "_sched_loop no longer consults should_dispatch — the streaming "
+        "path lost its quantum decision rule")
+    through_search = serve_pending is not None and any(
+        isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+        and n.func.attr == "search" for n in ast.walk(serve_pending))
+    obligation(
+        "stream.through_search", through_search,
+        "the submit() queue no longer drains through search() — the "
+        "streaming path escaped the certified dispatch pipeline")
+    return out
+
+
+# ---------------------------------------------------------------------------
 # certificate 3: static-arg value cardinality at aot() call sites
 
 
@@ -570,6 +743,7 @@ def run(names: Optional[Sequence[str]] = None, *, out=None,
     reports.extend(certify_warm_dispatch(serve_files, serve_flows))
     reports.extend(certify_backend_coverage(serve_files))
     reports.extend(certify_bucket_closure(serve_files))
+    reports.extend(certify_scheduler_closure(serve_files))
 
     # cardinality scan over the library (or the caller-supplied roots)
     card_findings: List[str] = []

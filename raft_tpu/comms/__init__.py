@@ -2,7 +2,12 @@
 raft/core/comms.hpp — SURVEY.md §2.13; session bootstrap — §2.16)."""
 
 from raft_tpu.comms.comms_types import ReduceOp, Request, Status  # noqa: F401
-from raft_tpu.comms.comms import Comms, as_comms, build_comms  # noqa: F401
+from raft_tpu.comms.comms import (  # noqa: F401
+    Comms,
+    ReplicaLayout,
+    as_comms,
+    build_comms,
+)
 from raft_tpu.comms.session import (  # noqa: F401
     CommsSession,
     get_comms_state,

@@ -31,6 +31,7 @@ Usage:
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import queue
 import threading
@@ -253,6 +254,67 @@ class Comms:
         sub._mailbox = self._mailbox
         sub._host_world = self._host_world
         return sub
+
+    def replica_split(self, n_replicas: int) -> "ReplicaLayout":
+        """Carve this communicator's devices into a 2D (shard × replica)
+        layout: *n_replicas* equal groups of contiguous ranks, each group a
+        full shard axis for one model copy (SURVEY §2.13 ``comm_split`` is
+        the grouping primitive; replica-parallel serving is what it
+        unlocks — docs/sharded_ann.md §replica groups).
+
+        Returns a :class:`ReplicaLayout` holding BOTH views of the same
+        carve:
+
+        * ``split`` — the grouped communicator over the FULL mesh
+          (``comm_split(colors=[rank // group_size])``): cross-shard
+          collectives within each replica group, one SPMD program over all
+          devices.  This is the view grouped collectives (and the
+          byte-accounting plane) see.
+        * ``groups[r]`` — a per-replica FULL-AXIS communicator over that
+          group's own sub-mesh: programs dispatched through it occupy ONLY
+          the group's devices, which is what lets R replicas serve R
+          batches concurrently instead of every batch occupying the whole
+          mesh.  Each group communicator carries its own
+          ``collective_calls`` registry rows (per-instance ``comm=`` label)
+          and its own MeshAot program caches, so per-group collective
+          accounting and executable signatures never alias across groups.
+
+        Requires a non-split single-process communicator whose world
+        divides evenly (replica groups must be congruent: each holds a
+        full index copy).
+        """
+        expects(self.groups is None,
+                "replica_split: already-split communicators cannot be "
+                "re-split (carve the world communicator)")
+        n_replicas = int(n_replicas)
+        world = self.mesh.shape[self.axis_name]
+        expects(n_replicas >= 1, "replica_split: n_replicas must be >= 1")
+        expects(world % n_replicas == 0,
+                f"replica_split: world {world} not divisible by "
+                f"n_replicas {n_replicas} (replica groups must be "
+                "congruent — each holds a full index copy)")
+        expects(not self.is_multiprocess,
+                "replica_split: per-group sub-meshes require a "
+                "single-process mesh (multi-controller replica groups "
+                "need per-process device slices)")
+        from jax.sharding import Mesh
+
+        gsz = world // n_replicas
+        split = self.comm_split([r // gsz for r in range(world)])
+        devices = list(self.mesh.devices.flat)
+        groups: List[Comms] = []
+        for r in range(n_replicas):
+            sub_mesh = Mesh(np.array(devices[r * gsz:(r + 1) * gsz]),
+                            (self.axis_name,))
+            g = Comms(sub_mesh, self.axis_name,
+                      session_id=f"{self.session_id}/replica{r}",
+                      host_rank=self._host_rank,
+                      host_world=1)
+            g._mailbox = self._mailbox  # share the parent's host plane
+            groups.append(g)
+        return ReplicaLayout(parent=self, split=split,
+                             groups=tuple(groups),
+                             n_replicas=n_replicas, group_size=gsz)
 
     # -- device collectives (used inside shard_map) --------------------------
     def _count_collective(self, name: str, x) -> None:
@@ -698,6 +760,29 @@ class Comms:
             jitted = jax.jit(mapped)
             self._run_cache[cache_key] = jitted
         return jitted(*args)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaLayout:
+    """The two coupled views of one 2D (shard × replica) device carve —
+    produced by :meth:`Comms.replica_split`, consumed by
+    ``neighbors.ann_mnmg.replicate`` and the serve engine's replica
+    router.
+
+    ``split`` is the ``comm_split`` grouped communicator over the full
+    mesh (cross-shard collectives within each replica group); ``groups``
+    are per-replica full-axis communicators over each group's own
+    sub-mesh (independent dispatch, per-group collective accounting,
+    per-group MeshAot caches)."""
+
+    parent: Comms
+    split: Comms
+    groups: Tuple[Comms, ...]
+    n_replicas: int
+    group_size: int
+
+    def __iter__(self):
+        return iter(self.groups)
 
 
 def as_comms(comms_or_handle) -> "Comms":

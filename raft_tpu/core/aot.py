@@ -20,6 +20,7 @@ of distinct executables for ragged workloads.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
 import zlib
@@ -49,6 +50,81 @@ from raft_tpu import telemetry
 #: contract instrument, not just telemetry.
 aot_compile_counters: telemetry.LegacyCounterView = telemetry.legacy_counter(
     "raft_tpu_aot_compiles", "AOT lower+compile cache misses by key")
+
+#: installed on-disk executable store (``core.aotstore.install`` /
+#: ``RAFT_TPU_AOT_STORE``): an in-process cache miss consults it BEFORE
+#: compiling — a hit deserializes+loads the persisted executable
+#: (counted under ``aot_compile_counters["store_hits"]``, NOT "compiles":
+#: no trace, no lower, no XLA compile happened) and a compile on miss is
+#: persisted for the next process's cold start (docs/serving.md
+#: §cold start).  None = off; every hook is one attribute read.
+_EXEC_STORE = None
+
+
+def set_executable_store(store):
+    """Install (or, with None, uninstall) the process-wide executable
+    store; returns the previous one.  Prefer the
+    :mod:`raft_tpu.core.aotstore` wrappers."""
+    global _EXEC_STORE
+    prev = _EXEC_STORE
+    _EXEC_STORE = store
+    return prev
+
+
+def get_executable_store():
+    return _EXEC_STORE
+
+
+@contextlib.contextmanager
+def _no_persistent_cache():
+    """Temporarily detach jax's on-disk compilation cache (see the
+    store-destined-compile note in :meth:`AotFunction._entry`).
+
+    Toggling ``jax_compilation_cache_dir`` alone is NOT enough: (a) the
+    cache module initializes its handle at most once and keeps serving
+    from it regardless of later config updates — reset it around the
+    toggle (and again after restoring the dir so normal compiles
+    re-attach); (b) jax's in-memory compilation cache can still hand
+    back an executable that originally came off the disk cache —
+    ``jax.clear_caches()`` flushes that layer.  In the real use (a
+    fleet-restart warmup) both layers are empty, so this costs nothing;
+    in-process it makes "restart simulation" tests/benches exact."""
+    prev = jax.config.jax_compilation_cache_dir
+    if prev is None:
+        yield
+        return
+    from jax._src import compilation_cache as _cc
+
+    jax.config.update("jax_compilation_cache_dir", None)
+    _cc.reset_cache()
+    jax.clear_caches()
+    try:
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+        _cc.reset_cache()
+
+
+_store_env_attempted = False
+
+
+def _ensure_env_store():
+    """Lazily honor ``RAFT_TPU_AOT_STORE=<dir>`` on the first cache miss
+    (the ``_ensure_persistent_cache`` pattern) — never clobbers a store
+    installed programmatically."""
+    global _store_env_attempted, _EXEC_STORE
+    if _store_env_attempted or _EXEC_STORE is not None:
+        return
+    _store_env_attempted = True
+    path = os.environ.get("RAFT_TPU_AOT_STORE")
+    if not path:
+        return
+    try:
+        from raft_tpu.core.aotstore import ExecutableStore
+
+        _EXEC_STORE = ExecutableStore(path)
+    except OSError:
+        pass  # unwritable dir: the store is an accelerator, not a dep
 
 
 def _machine_fingerprint() -> str:
@@ -307,12 +383,29 @@ class AotFunction:
         re-hashing the signature on the hot path."""
         entry = self._cache.get(sig)
         if entry is None:
+            _ensure_env_store()
+            sig_repr = repr(sig)
+            sig_label = f"{zlib.crc32(sig_repr.encode()) & 0xFFFFFFFF:08x}"
+            name = getattr(self._fn, '__qualname__', repr(self._fn))
+            store = _EXEC_STORE
+            if store is not None:
+                # cold-start restore: a persisted executable skips the
+                # whole trace→lower→compile pipeline.  Deliberately NOT
+                # counted as a compile — the zero-compile contract
+                # counter keeps meaning "XLA compiled something".
+                exe = store.load(self._name, sig_repr)
+                if exe is not None:
+                    aot_compile_counters.inc("store_hits")
+                    aot_compile_counters.inc(f"store_hits:{name}")
+                    entry = (exe, sig_label)
+                    self._cache[sig] = entry
+                    return entry
+                aot_compile_counters.inc("store_misses")
             # every lower+compile is observable: zero-retrace serving is
             # asserted by diffing this counter around steady-state traffic
             # (.inc is the atomic form — `c[k] += 1` races under threads)
             aot_compile_counters.inc("compiles")
-            aot_compile_counters.inc(
-                f"compiles:{getattr(self._fn, '__qualname__', repr(self._fn))}")
+            aot_compile_counters.inc(f"compiles:{name}")
             _ensure_persistent_cache()
             jitted = jax.jit(self._fn, static_argnums=self._static,
                              donate_argnums=self._donate)
@@ -320,8 +413,19 @@ class AotFunction:
                 a if i in self._static
                 else jax.tree_util.tree_map(self._leaf_struct, a)
                 for i, a in enumerate(args)]
-            exe = jitted.lower(*lower_args).compile()
-            sig_label = f"{zlib.crc32(repr(sig).encode()) & 0xFFFFFFFF:08x}"
+            if store is not None:
+                # a store-destined executable must compile FRESH: an
+                # executable jax's persistent compilation cache handed
+                # back serializes INCOMPLETELY on XLA:CPU (deserialize
+                # dies with "Symbols not found" — observed empirically),
+                # so bypass that cache for this one compile.  The store
+                # entry it produces replaces the persistent-cache role
+                # entirely for this signature (restores skip trace+
+                # lower+compile, not just the backend compile).
+                with _no_persistent_cache():
+                    exe = jitted.lower(*lower_args).compile()
+            else:
+                exe = jitted.lower(*lower_args).compile()
             entry = (exe, sig_label)
             self._cache[sig] = entry
             # device-cost attribution, static half: harvest this
@@ -329,6 +433,8 @@ class AotFunction:
             # raft_tpu_program_* gauges (once per compile miss — never on
             # the dispatch path; docs/observability.md §device attribution)
             telemetry.record_program_costs(self._name, sig_label, exe)
+            if store is not None:
+                store.save(self._name, sig_repr, exe)
         return entry
 
     def compiled(self, *args):
@@ -412,6 +518,22 @@ class MeshAotFunction(AotFunction):
     def _leaf_sharding(leaf):
         return getattr(leaf, "sharding", None)
 
+    @staticmethod
+    def _sharding_token(s):
+        """The sharding plus its concrete DEVICE ASSIGNMENT.  The sharding
+        object alone is correct for the in-process cache (hashable, mesh
+        identity included) but its repr does NOT name the devices — two
+        replica groups' congruent sub-meshes repr identically, which
+        would alias their entries in the on-disk executable store (keyed
+        by the signature's repr).  The device tuple disambiguates both."""
+        if s is None:
+            return None
+        try:
+            devs = tuple(sorted(str(d) for d in s.device_set))
+        except Exception:  # unusual sharding types: object identity only
+            devs = ()
+        return (s, devs)
+
     def _signature(self, args):
         sig = []
         for i, a in enumerate(args):
@@ -421,7 +543,7 @@ class MeshAotFunction(AotFunction):
                 leaves, treedef = jax.tree_util.tree_flatten(a)
                 entry = tuple(
                     (self._leaf_spec(leaf)[0], str(self._leaf_spec(leaf)[1]),
-                     self._leaf_sharding(leaf))
+                     self._sharding_token(self._leaf_sharding(leaf)))
                     for leaf in leaves)
                 sig.append((treedef, entry))
         return tuple(sig)

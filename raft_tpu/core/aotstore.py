@@ -1,0 +1,157 @@
+"""On-disk AOT EXECUTABLE store: fleet restarts warm from disk instead of
+re-lowering every (bucket, dtype, world) signature (docs/serving.md
+§cold start).
+
+JAX's persistent compilation cache (``core.aot.enable_persistent_cache``)
+already skips the XLA *backend compile* on a warm disk — but a restarted
+serving process still pays tracing + lowering + cache lookup per
+signature, which dominates cold-start wall time for the wide (bucket ×
+dtype × world) signature ladders ``ServeEngine.warmup()`` pins.  This
+store persists the COMPILED EXECUTABLE itself
+(``jax.experimental.serialize_executable`` — the ``jax.export``-era
+serialization surface), keyed by the full AOT signature, so a restart's
+``warmup()``/``refresh()`` deserializes and loads in place of the whole
+trace→lower→compile pipeline.
+
+Wiring: :func:`install` (or ``RAFT_TPU_AOT_STORE=<dir>``) registers the
+store with :mod:`raft_tpu.core.aot`; every :class:`~raft_tpu.core.aot.
+AotFunction`/``MeshAotFunction`` cache miss then consults it before
+compiling, and persists what it compiled.  Counters:
+``aot_compile_counters["store_hits"]`` (restores that skipped a compile
+— a hit does NOT bump ``"compiles"``, preserving the zero-compile
+contract counter's meaning) and ``["store_misses"]``.
+
+Safety: entries are scoped by jax version, backend, and the SAME
+machine fingerprint the persistent cache uses (XLA:CPU executables
+encode the compile host's instruction-set features — loading foreign
+ones can SIGILL; see ``core.aot._machine_fingerprint``).  Any load
+failure (schema drift, corrupt file, incompatible jax) degrades to a
+normal compile — the store is an accelerator, never a correctness
+dependency.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import tempfile
+from typing import Any, Optional
+
+from raft_tpu.core.logger import log_warn
+
+#: store format version — bump on any layout/schema change; mismatched
+#: entries are treated as misses
+SCHEMA = 1
+
+
+def _entry_scope() -> str:
+    """The compatibility scope every entry is keyed under: jax version +
+    backend + machine fingerprint (the no-cross-host-AOT guarantee)."""
+    import jax
+
+    from raft_tpu.core.aot import _machine_fingerprint
+
+    return f"{SCHEMA}|{jax.__version__}|{jax.default_backend()}|" \
+           f"{_machine_fingerprint()}"
+
+
+class ExecutableStore:
+    """Directory-backed executable store (one file per signature).
+
+    ``load``/``save`` take the AOT cache's (function qualname, signature
+    repr) pair; file names are a SHA-256 digest of (scope, qualname,
+    signature), so any ingredient drifting — jax upgrade, different
+    backend, different host, changed statics — misses cleanly instead of
+    loading a stale executable."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        os.makedirs(self.path, exist_ok=True)
+        self._warned = False
+
+    def _file(self, name: str, sig_repr: str) -> str:
+        digest = hashlib.sha256(
+            f"{_entry_scope()}|{name}|{sig_repr}".encode()).hexdigest()
+        return os.path.join(self.path, f"{digest[:32]}.jaxexe")
+
+    def load(self, name: str, sig_repr: str) -> Optional[Any]:
+        """The deserialized, loaded executable for this signature, or
+        None (miss/incompatible/corrupt — all degrade to a compile)."""
+        path = self._file(name, sig_repr)
+        try:
+            with open(path, "rb") as f:
+                payload = pickle.load(f)
+        except FileNotFoundError:
+            return None
+        except Exception as e:  # corrupt/stale entry: recompile, warn once
+            self._warn(f"unreadable entry for {name} ({e!r})")
+            return None
+        try:
+            from jax.experimental import serialize_executable
+
+            blob, in_tree, out_tree = payload
+            return serialize_executable.deserialize_and_load(
+                blob, in_tree, out_tree)
+        except Exception as e:
+            self._warn(f"deserialize failed for {name} ({e!r})")
+            return None
+
+    def save(self, name: str, sig_repr: str, exe: Any) -> bool:
+        """Persist one compiled executable (atomic write).  False when
+        this executable/backend cannot serialize — not an error.
+
+        Every entry is VERIFIED loadable before it lands: serialize →
+        immediate deserialize_and_load.  XLA:CPU executables that came
+        out of jax's persistent compilation cache serialize incompletely
+        (their deserialize dies with "Symbols not found"); the AOT layer
+        compiles store-destined executables fresh to avoid that, and
+        this check guarantees no broken entry can ever reach a restart's
+        warmup path regardless."""
+        try:
+            from jax.experimental import serialize_executable
+
+            payload = serialize_executable.serialize(exe)
+            serialize_executable.deserialize_and_load(*payload)
+        except Exception as e:
+            self._warn(f"serialize unsupported for {name} ({e!r})")
+            return False
+        path = self._file(name, sig_repr)
+        try:
+            fd, tmp = tempfile.mkstemp(dir=self.path, suffix=".tmp")
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(payload, f)
+            os.replace(tmp, path)  # atomic: no torn entries
+            return True
+        except OSError as e:
+            self._warn(f"write failed for {name} ({e!r})")
+            return False
+
+    def _warn(self, msg: str) -> None:
+        if not self._warned:
+            self._warned = True
+            log_warn("aotstore: %s — falling back to compile "
+                     "(further store warnings suppressed)", msg)
+
+
+def install(path_or_store) -> Optional[ExecutableStore]:
+    """Install an executable store process-wide (path or prebuilt store);
+    returns the PREVIOUS one so callers can restore it.  ``None``
+    uninstalls."""
+    store = (path_or_store if path_or_store is None
+             or isinstance(path_or_store, ExecutableStore)
+             else ExecutableStore(path_or_store))
+    return _aot_module().set_executable_store(store)
+
+
+def installed() -> Optional[ExecutableStore]:
+    return _aot_module().get_executable_store()
+
+
+def _aot_module():
+    # NB the package re-exports the aot() FUNCTION under the submodule's
+    # name, so both `from raft_tpu.core import aot` and `import
+    # raft_tpu.core.aot as m` bind the function — resolve the module
+    import importlib
+
+    return importlib.import_module("raft_tpu.core.aot")

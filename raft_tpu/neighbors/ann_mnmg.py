@@ -63,7 +63,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.analysis.registry import hlo_program
-from raft_tpu.comms.comms import Comms, as_comms, shard_map_compat
+from raft_tpu.comms.comms import (Comms, ReplicaLayout, as_comms,
+                                  shard_map_compat)
 from raft_tpu.core.aot import MeshAotFunction, _bucket_dim
 from raft_tpu.core.error import expects
 from raft_tpu.core.logger import traced
@@ -339,6 +340,93 @@ def shard_brute_force(dataset, comms, metric=DistanceType.L2SqrtExpanded,
            "n_rows": int(n),
            "tile": int(min(batch_size_index, rows_per))}
     return ShardedIndex("brute_force", comms, (), (xs,), aux)
+
+
+# ---------------------------------------------------------------------------
+# replica groups: the 2D (shard × replica) layout
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaSet:
+    """R full :class:`ShardedIndex` copies laid out on a 2D (shard ×
+    replica) carve of one communicator's devices
+    (docs/sharded_ann.md §replica groups).
+
+    Each replica group holds a COMPLETE copy of the index — the model
+    tables replicated within the group, the packed list blocks
+    round-robin-sharded across the group's own devices — built with the
+    group's full-axis sub-mesh communicator from
+    :meth:`raft_tpu.comms.comms.Comms.replica_split`.  A query batch
+    dispatches to exactly ONE group (occupying only that group's
+    devices), so R groups serve R batches concurrently and throughput
+    scales past a single model copy; the one-allgather-per-batch
+    discipline holds per group and is byte/count-accounted on each
+    group communicator's own ``collective_calls`` rows.
+
+    Route through ``serve.ServeEngine`` (its replica backend picks the
+    least-loaded live group per super-batch and drains faulted groups),
+    or search a single group directly via ``replicas[r].search(...)``.
+    """
+
+    kind: str
+    layout: ReplicaLayout
+    replicas: Tuple[ShardedIndex, ...]
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self.replicas)
+
+    @property
+    def dim(self) -> int:
+        return self.replicas[0].dim
+
+    @property
+    def metric(self) -> DistanceType:
+        return self.replicas[0].metric
+
+    @property
+    def aux(self) -> Dict[str, Any]:
+        return self.replicas[0].aux
+
+
+@traced("raft_tpu.neighbors.ann_mnmg.replicate")
+def replicate(index, comms_or_layout, n_replicas: int = None, *,
+              metric=DistanceType.L2SqrtExpanded, metric_arg: float = 2.0,
+              batch_size_index: int = 16384) -> ReplicaSet:
+    """Build a :class:`ReplicaSet`: carve *comms_or_layout* into replica
+    groups (:meth:`Comms.replica_split`, unless a pre-built
+    :class:`ReplicaLayout` is passed) and shard one full copy of *index*
+    into each group.
+
+    *index* selects the kind exactly like ``ServeEngine``/``shard()``: an
+    ``ivf_flat.Index``, an ``ivf_pq.Index``, or a dense (n, dim) matrix
+    (brute force; ``metric``/``metric_arg``/``batch_size_index`` apply).
+    Every replica runs the SAME partition arithmetic over congruent
+    groups, so per-group search results are identical across replicas —
+    routing is free to pick any live group (the serve engine's router
+    asserts nothing about WHICH group served a batch)."""
+    if isinstance(comms_or_layout, ReplicaLayout):
+        expects(n_replicas is None
+                or int(n_replicas) == comms_or_layout.n_replicas,
+                "replicate: n_replicas disagrees with the provided layout")
+        layout = comms_or_layout
+    else:
+        expects(n_replicas is not None,
+                "replicate: pass n_replicas (or a prebuilt ReplicaLayout)")
+        layout = as_comms(comms_or_layout).replica_split(int(n_replicas))
+    if isinstance(index, ivf_flat.Index):
+        kind = "ivf_flat"
+        replicas = tuple(shard_ivf_flat(index, g) for g in layout.groups)
+    elif isinstance(index, ivf_pq.Index):
+        kind = "ivf_pq"
+        replicas = tuple(shard_ivf_pq(index, g) for g in layout.groups)
+    else:
+        kind = "brute_force"
+        replicas = tuple(
+            shard_brute_force(index, g, metric, metric_arg,
+                              batch_size_index)
+            for g in layout.groups)
+    return ReplicaSet(kind, layout, replicas)
 
 
 # ---------------------------------------------------------------------------
@@ -684,3 +772,31 @@ def _audit_sharded_brute_force():
           "backends in sharded form (docs/sharded_ann.md)")
 def _audit_sharded_ivf_pq():
     return _audit_sharded("ivf_pq")
+
+
+#: ONE allgather per batch PER REPLICA GROUP: a group's program spans only
+#: its own sub-mesh, so the payload stacks over the GROUP world (8/2 = 4
+#: shards) — the ×R total collective budget of a replica-routed fleet is
+#: R groups × this per-group bound (docs/sharded_ann.md §replica groups)
+_REPLICA_GROUP_AUDIT_BYTES = (8 // 2) * 64 * 2 * 8 * 4
+
+
+@hlo_program(
+    "ann_mnmg.ivf_flat_replica_group",
+    collectives=1, collective_bytes=_REPLICA_GROUP_AUDIT_BYTES,
+    requires_devices=8, fast=False,
+    notes="one replica group's batch search on the 2D (shard × replica) "
+          "carve (R=2 over the 8-device mesh): the SAME one-shard_map-"
+          "program discipline as the full-mesh entries, lowered on the "
+          "group's own 4-device sub-mesh — exactly ONE allgather of the "
+          "group-world-stacked merge payload, so the fleet-total budget "
+          "is R × this bound (docs/sharded_ann.md §replica groups)")
+def _audit_replica_group():
+    rng = np.random.default_rng(0)
+    layout = Comms().replica_split(2)
+    x = rng.standard_normal((1024, 16)).astype(np.float32)
+    rep = replicate(ivf_flat.build(ivf_flat.IndexParams(n_lists=8), x),
+                    layout)
+    s = ShardedSearcher(rep.replicas[0], 8)
+    return dict(compiled=s.fn.compiled(
+        s._q_spec(64, jnp.float32), *s._tail))

@@ -22,6 +22,11 @@ from raft_tpu.serve.admission import (  # noqa: F401
     ServeRequest,
 )
 from raft_tpu.serve.engine import ServeEngine  # noqa: F401
+from raft_tpu.serve.schedule import (  # noqa: F401
+    CostModel,
+    ReplicaRouter,
+    SchedulerConfig,
+)
 from raft_tpu.serve.supervise import (  # noqa: F401
     DispatchError,
     DispatchSupervisor,
@@ -30,4 +35,5 @@ from raft_tpu.serve.supervise import (  # noqa: F401
 
 __all__ = ["ServeEngine", "ServeRequest", "AdmissionController",
            "RejectedError", "DispatchSupervisor", "DispatchError",
-           "WatchdogTimeout"]
+           "WatchdogTimeout", "SchedulerConfig", "CostModel",
+           "ReplicaRouter"]

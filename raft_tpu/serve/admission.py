@@ -114,22 +114,13 @@ def _batch_cost_from_telemetry(fn: Optional[str]) -> Optional[float]:
     disp = telemetry.REGISTRY.get("raft_tpu_aot_dispatch_seconds")
     if disp is not None:
         # (fn, sig)-labeled: merge every signature row of this fn on the
-        # shared bucket geometry (the aggregate.merge property)
-        from raft_tpu.telemetry.registry import quantile_from_counts
+        # shared bucket geometry (the aggregate.merge property) — ONE
+        # implementation, shared with the scheduler's cost model
+        from raft_tpu.telemetry.registry import merged_quantile
 
-        counts = None
-        total, lo, hi = 0, float("inf"), float("-inf")
-        for labels, cell in disp.items():
-            if not labels or labels[0] != fn or cell.count == 0:
-                continue
-            if counts is None:
-                counts = [0] * len(cell.counts)
-            for i, n in enumerate(cell.counts):
-                counts[i] += n
-            total += cell.count
-            lo, hi = min(lo, cell.min), max(hi, cell.max)
-        if counts is not None and total:
-            return float(quantile_from_counts(counts, total, lo, hi, 0.5))
+        est = merged_quantile(disp, 0.5, (fn,))
+        if est is not None:
+            return float(est)
     return None
 
 

@@ -67,6 +67,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+from concurrent import futures
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -81,7 +82,10 @@ from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.neighbors import ann_mnmg, brute_force, ivf_flat, ivf_pq
 from raft_tpu.serve.admission import (AdmissionController, RejectedError,
                                       ServeRequest)
-from raft_tpu.serve.supervise import DispatchSupervisor
+from raft_tpu.serve.schedule import (CostModel, ReplicaRouter,
+                                     SchedulerConfig, choose_batches,
+                                     should_dispatch)
+from raft_tpu.serve.supervise import DispatchSupervisor, retryable
 from raft_tpu.testing import faults as _faults
 
 #: Bound on the per-call latency list AND the cumulative latency reservoir:
@@ -308,50 +312,14 @@ class _ShardedBackend:
         ``ann_mnmg._ingest`` (itself mirroring each kind's solo prologue):
         exact host-side widenings stay numpy; only cosine's inexact row
         normalize round-trips the device (the _IvfFlatBackend contract)."""
-        # exempt(hot-path-host-transfer): request ingest of host numpy
-        q = np.asarray(q)
-        expects(q.ndim == 2 and q.shape[1] == self.dim,
-                "query must be (n, dim) with the index's dim")
-        kind = self.sharded.kind
-        if kind == "brute_force":
-            return q
-        if kind == "ivf_pq":
-            # dataset-dtype consistency BEFORE the widening (the
-            # _IvfPqBackend/ann_mnmg._ingest contract — widening first
-            # would silently admit traffic the solo fallback rejects)
-            if q.dtype in (np.int8, np.uint8):
-                q_dtype = str(q.dtype)
-            else:
-                expects(jnp.issubdtype(q.dtype, jnp.floating),
-                        f"ivf_pq: unsupported query dtype {q.dtype}")
-                q_dtype = "float32"
-            expects(q_dtype in (self.sharded.aux["dataset_dtype"],
-                                "float32"),
-                    f"query dtype {q_dtype} != index dataset dtype "
-                    f"{self.sharded.aux['dataset_dtype']}")
-            return q.astype(np.float32)
-        if q.dtype in (np.int8, np.uint8):
-            q = q.astype(np.float32)  # exact widening: matches device cast
-        if self.sharded.metric == DistanceType.CosineExpanded:
-            # exempt(hot-path-host-transfer): cosine solo-numerics bounce
-            return np.asarray(ivf_flat._normalize_rows(jnp.asarray(q)))
-        return q
+        return _sharded_ingest(self.sharded, q, self.dim)
 
     def batch_cap(self) -> Optional[int]:
         """Per-SHARD transient bound: the hoisted compressed-LUT configs
         materialize their combined tables on every shard, so the clamp
         sizes by the shard-local physical block (the ONE formula,
         ``ivf_pq.hoisted_batch_cap_dims``)."""
-        if self.sharded.kind != "ivf_pq" or not getattr(
-                self.searcher, "hoisted", False):
-            return None
-        aux = self.sharded.aux
-        return ivf_pq.hoisted_batch_cap_dims(
-            self.sharded.metric,
-            aux["codebook_kind"] == int(ivf_pq.CodebookKind.PER_CLUSTER),
-            aux["cap_n_phys"], aux["cap_max_chunks"], aux["n_lists"],
-            aux["pq_dim"], aux["pq_bits"], self.searcher.n_probes,
-            self.searcher.lut_dtype, self.searcher.hoisted)
+        return _sharded_batch_cap(self.sharded, self.searcher)
 
     def warm(self, bucket: int, dtype) -> None:
         self.searcher.warm(bucket, dtype)
@@ -363,7 +331,110 @@ class _ShardedBackend:
         return ann_mnmg.search(self.sharded, q, self.k, self.params)
 
 
+def _sharded_ingest(container, q, dim: int):
+    """The sharded kinds' HOST-side ingest (shared by the sharded and
+    replica backends — *container* is a ``ShardedIndex`` or a
+    ``ReplicaSet``, both expose ``kind``/``aux``/``metric``): exact
+    widenings stay numpy; only cosine's inexact row normalize
+    round-trips the device (the _IvfFlatBackend contract)."""
+    # exempt(hot-path-host-transfer): request ingest of host numpy
+    q = np.asarray(q)
+    expects(q.ndim == 2 and q.shape[1] == dim,
+            "query must be (n, dim) with the index's dim")
+    kind = container.kind
+    if kind == "brute_force":
+        return q
+    if kind == "ivf_pq":
+        # dataset-dtype consistency BEFORE the widening (the
+        # _IvfPqBackend/ann_mnmg._ingest contract — widening first
+        # would silently admit traffic the solo fallback rejects)
+        if q.dtype in (np.int8, np.uint8):
+            q_dtype = str(q.dtype)
+        else:
+            expects(jnp.issubdtype(q.dtype, jnp.floating),
+                    f"ivf_pq: unsupported query dtype {q.dtype}")
+            q_dtype = "float32"
+        expects(q_dtype in (container.aux["dataset_dtype"], "float32"),
+                f"query dtype {q_dtype} != index dataset dtype "
+                f"{container.aux['dataset_dtype']}")
+        return q.astype(np.float32)
+    if q.dtype in (np.int8, np.uint8):
+        q = q.astype(np.float32)  # exact widening: matches device cast
+    if container.metric == DistanceType.CosineExpanded:
+        # exempt(hot-path-host-transfer): cosine solo-numerics bounce
+        return np.asarray(ivf_flat._normalize_rows(jnp.asarray(q)))
+    return q
+
+
+def _sharded_batch_cap(container, searcher) -> Optional[int]:
+    """The per-shard ivf_pq transient clamp (the ONE formula,
+    ``ivf_pq.hoisted_batch_cap_dims``) — shared by the sharded and
+    replica backends."""
+    if container.kind != "ivf_pq" or not getattr(searcher, "hoisted",
+                                                 False):
+        return None
+    aux = container.aux
+    return ivf_pq.hoisted_batch_cap_dims(
+        container.metric,
+        aux["codebook_kind"] == int(ivf_pq.CodebookKind.PER_CLUSTER),
+        aux["cap_n_phys"], aux["cap_max_chunks"], aux["n_lists"],
+        aux["pq_dim"], aux["pq_bits"], searcher.n_probes,
+        searcher.lut_dtype, searcher.hoisted)
+
+
+class _ReplicaBackend:
+    """Adapter: ``ann_mnmg.ReplicaSet`` → R per-group ``ShardedSearcher``s
+    on the 2D (shard × replica) carve (docs/sharded_ann.md §replica
+    groups).  ``warm()`` fans the (bucket, dtype) signature out across
+    EVERY replica lane's MeshAot cache (the caches are per-group-
+    communicator, so signatures never alias across lanes and any lane
+    can serve any warmed batch — that is what makes fault re-routing
+    zero-compile); ``dispatch(qb, lane)`` runs one pre-bucketed batch on
+    ONE lane's sub-mesh, occupying only that group's devices.  The
+    engine's :class:`~raft_tpu.serve.schedule.ReplicaRouter` owns lane
+    choice, draining and re-routing."""
+
+    def __init__(self, rep, k: int, params):
+        expects(k >= 1, "k must be >= 1")
+        # brute-force replica sets carry their metric themselves — the
+        # _ShardedBackend contract
+        expects(rep.kind != "brute_force" or params is None,
+                "replicated brute-force serving takes no SearchParams "
+                "(metric/metric_arg ride the ReplicaSet)")
+        self.rep = rep
+        self.params = params
+        self.name = f"replica_{rep.kind}"
+        self.k = int(k)
+        self.dim = int(rep.dim)
+        self.searchers = tuple(s.searcher(int(k), params)
+                               for s in rep.replicas)
+        self.n_replicas = len(self.searchers)
+
+    def ingest(self, q):
+        return _sharded_ingest(self.rep, q, self.dim)
+
+    def batch_cap(self) -> Optional[int]:
+        return _sharded_batch_cap(self.rep, self.searchers[0])
+
+    def warm(self, bucket: int, dtype) -> None:
+        for s in self.searchers:
+            s.warm(bucket, dtype)
+
+    def dispatch(self, qb, lane: int = 0):
+        # the PR-14 fault plane's `comms` site, per replica lane: a plan
+        # like `comms:op=replica_dispatch:rank=1:raise` deterministically
+        # faults lane 1 — the provable degrade path the battery drives
+        _faults.check("comms", op="replica_dispatch", rank=int(lane))
+        return self.searchers[lane].dispatch(qb)
+
+    def solo(self, q, lane: int = 0):
+        return ann_mnmg.search(self.rep.replicas[lane], q, self.k,
+                               self.params)
+
+
 def _make_backend(index, k, params, metric, metric_arg, batch_size_index):
+    if isinstance(index, ann_mnmg.ReplicaSet):
+        return _ReplicaBackend(index, k, params)
     if isinstance(index, ann_mnmg.ShardedIndex):
         return _ShardedBackend(index, k, params)
     if isinstance(index, ivf_flat.Index):
@@ -412,7 +483,8 @@ class ServeEngine:
                  handle: Optional[Handle] = None,
                  admission=None, watchdog_s: Optional[float] = None,
                  max_retries: int = 2, retry_backoff_s: float = 0.05,
-                 retry_backoff_cap_s: float = 1.0, retry_seed: int = 0):
+                 retry_backoff_cap_s: float = 1.0, retry_seed: int = 0,
+                 scheduler=None):
         expects(max_batch >= 8, "max_batch must be >= 8")
         self._backend = _make_backend(index, k, params, metric, metric_arg,
                                       batch_size_index)
@@ -457,8 +529,41 @@ class ServeEngine:
                     "solo_fallbacks", "coalesced_requests", "refreshes",
                     "admitted", "sheds", "expired", "retries",
                     "watchdog_timeouts", "isolation_splits",
-                    "ingest_errors", "dispatch_errors"):
+                    "ingest_errors", "dispatch_errors",
+                    "sched_dispatches", "sched_waits",
+                    "replica_faults", "replica_reroutes"):
             self.stats[key] = 0
+        #: continuous-batching scheduler (docs/serving.md §scheduler) —
+        #: ON by default: the telemetry-steered chooser replaces the
+        #: drain-all coalescer (cold it reproduces the drain-all packing
+        #: exactly, so default behavior only changes once measured
+        #: per-bucket costs say a different packing is cheaper);
+        #: ``scheduler=False`` pins the legacy drain-all planner (the
+        #: bench A/B baseline), a SchedulerConfig tunes quantum/model
+        if scheduler is False:
+            self._sched_cfg: Optional[SchedulerConfig] = None
+        else:
+            self._sched_cfg = (scheduler if isinstance(
+                scheduler, SchedulerConfig) else SchedulerConfig())
+        #: the scheduler/router cost model: per-(dtype, bucket) EWMA fed
+        #: after every collected super-batch, registry-seeded
+        self._cost = CostModel(
+            fn=self._backend_fn(),
+            static_batch_s=(self._sched_cfg.static_batch_s
+                            if self._sched_cfg is not None else 0.05),
+            use_telemetry=(self._sched_cfg.use_telemetry
+                           if self._sched_cfg is not None else True))
+        #: replica-lane router (2D shard × replica backends only):
+        #: least-estimated-completion-time pick + fault draining
+        self._router: Optional[ReplicaRouter] = None
+        if getattr(self._backend, "n_replicas", 0) > 1:
+            self._router = ReplicaRouter(self._backend.n_replicas,
+                                         self._engine_id)
+        #: streaming continuous batching (submit()): pending envelope
+        #: queue + the quantum-paced scheduler thread, started lazily
+        self._pending: List[Any] = []
+        self._pending_cv = threading.Condition()
+        self._sched_thread: Optional[threading.Thread] = None
         #: deadline-aware admission (docs/serving.md §failure model):
         #: default controller unless the caller passes its own or opts
         #: out with ``admission=False`` — with no deadlines and no queue
@@ -508,6 +613,10 @@ class ServeEngine:
         fn = getattr(be, "fn", None)
         if fn is None:
             fn = getattr(getattr(be, "searcher", None), "fn", None)
+        if fn is None:
+            searchers = getattr(be, "searchers", None)
+            if searchers:  # replica lanes share one program identity
+                fn = getattr(searchers[0], "fn", None)
         return getattr(fn, "__qualname__", None)
 
     # -- latency telemetry --------------------------------------------------
@@ -636,6 +745,16 @@ class ServeEngine:
             self._ctor = dict(c, params=params)
             self.max_batch = max_batch
             self._warmed = warmed
+            # the scheduler's cost seed re-points at the new backend
+            # program, and a replica backend gets a FRESH router (the new
+            # ReplicaSet's lanes are new replicas — drained state does
+            # not carry over a swap)
+            self._cost.bind_fn(self._backend_fn())
+            if getattr(backend, "n_replicas", 0) > 1:
+                self._router = ReplicaRouter(backend.n_replicas,
+                                             self._engine_id)
+            else:
+                self._router = None
             self.stats.inc("refreshes")
 
     # -- live scrape surface ------------------------------------------------
@@ -664,6 +783,19 @@ class ServeEngine:
                             if adm is not None else False)
         if adm is not None:
             body["admission"] = adm.health(telemetry.now())
+        # replica routing: a drained (faulted) lane marks the body
+        # DEGRADED — the engine still serves on survivors (200, not 503),
+        # and a balancer can see which lanes died
+        router = self._router
+        if router is not None:
+            rh = router.health()
+            body["replicas"] = rh
+            if rh["degraded"]:
+                body["degraded"] = True
+        if self._sched_cfg is not None:
+            body["scheduler"] = {
+                "quantum_s": self._sched_cfg.quantum_s,
+                "pending": len(self._pending)}
         return body
 
     def serve_http(self, port: int = 0, host: str = "127.0.0.1", *,
@@ -714,6 +846,19 @@ class ServeEngine:
         if self._closed:
             return  # idempotent
         self._closed = True  # reject new requests from this point on
+        # stop the submit() scheduler thread and reject its queue typed
+        # (never leave a Future dangling)
+        with self._pending_cv:
+            pending, self._pending = list(self._pending), []
+            self._pending_cv.notify_all()
+        for _r, f, _t in pending:
+            if not f.done():
+                f.set_exception(RejectedError(
+                    "closed", "engine closed with the request still "
+                    "queued in the scheduler"))
+        t = self._sched_thread
+        if t is not None:
+            t.join(timeout=min(1.0, timeout_s))
         acquired = self._lock.acquire(timeout=timeout_s)  # drain in-flight
         try:
             http, self._http, self._recorder = self._http, None, None
@@ -815,6 +960,106 @@ class ServeEngine:
                                for q in requests))
             return out
 
+    # -- streaming continuous batching (submit/flush) -----------------------
+    def submit(self, request) -> "futures.Future":
+        """Enqueue ONE request for continuous batching; returns a
+        ``concurrent.futures.Future`` resolving to the same ``(distances,
+        indices)`` pair ``search()`` would produce for it (or raising its
+        typed rejection/ingest error).
+
+        The quantum-paced scheduler thread coalesces submissions across
+        callers: a pending partial batch dispatches when it fills the
+        largest warmed bucket, when its oldest member has waited one
+        quantum, or when an admitted deadline would be jeopardized by
+        waiting longer — otherwise it waits one quantum to fill a larger
+        bucket (:func:`raft_tpu.serve.schedule.should_dispatch`; the
+        decision counters land in ``stats["sched_dispatches"]`` /
+        ``stats["sched_waits"]``).  Dispatch itself runs through the
+        exact ``search()`` pipeline (admission, chooser, supervision,
+        replica routing), so every contract — bit-identity, zero-compile,
+        per-request isolation — carries over unchanged."""
+        expects(self._sched_cfg is not None,
+                "submit() requires the continuous-batching scheduler "
+                "(engine constructed with scheduler=False)")
+        if self._closed:
+            raise RejectedError("closed", "ServeEngine is closed — new "
+                                "requests reject; see close()")
+        fut: futures.Future = futures.Future()
+        with self._pending_cv:
+            self._pending.append((request, fut, telemetry.now()))
+            if self._sched_thread is None \
+                    or not self._sched_thread.is_alive():
+                self._sched_thread = threading.Thread(
+                    target=self._sched_loop, daemon=True,
+                    name=f"raft-tpu-serve-sched-{self._engine_id}")
+                self._sched_thread.start()
+            self._pending_cv.notify_all()
+        return fut
+
+    def flush(self) -> None:
+        """Force-dispatch everything pending in the submit() queue NOW
+        (in the caller's thread), without waiting out the quantum."""
+        with self._pending_cv:
+            batch, self._pending = list(self._pending), []
+        if batch:
+            self._serve_pending(batch)
+
+    def _serve_pending(self, batch) -> None:
+        try:
+            outs = self.search([r for r, _f, _t in batch])
+        except Exception as e:  # engine-level misuse (e.g. closed)
+            for _r, f, _t in batch:
+                if not f.done():
+                    f.set_exception(e)
+            return
+        for (_r, f, _t), out in zip(batch, outs):
+            if f.done():
+                continue
+            if isinstance(out, BaseException):
+                f.set_exception(out)
+            else:
+                f.set_result(out)
+
+    def _sched_loop(self) -> None:
+        """The quantum-paced scheduler thread behind :meth:`submit`."""
+        cfg = self._sched_cfg
+        while True:
+            with self._pending_cv:
+                if not self._pending:
+                    if self._closed:
+                        return
+                    self._pending_cv.wait(timeout=cfg.quantum_s)
+                    if not self._pending:
+                        if self._closed:
+                            return
+                        continue
+                now = telemetry.now()
+                rows = 0
+                dls: List[float] = []
+                for r, _f, _t in self._pending:
+                    q = r.q if isinstance(r, ServeRequest) else r
+                    rows += int(np.shape(q)[0])
+                    if isinstance(r, ServeRequest):
+                        dl = r.resolve_deadline(now)
+                        if dl is not None:
+                            dls.append(dl)
+                oldest = now - self._pending[0][2]
+                with self._warmed_mut:
+                    largest = max((max(bs) for bs in self._warmed.values()
+                                   if bs), default=self.max_batch)
+                est = self._cost.batch_cost_s("float32", largest)
+                if self._closed or should_dispatch(
+                        rows, largest, oldest, cfg.quantum_s, dls, now,
+                        est):
+                    batch, self._pending = list(self._pending), []
+                    self.stats.inc("sched_dispatches")
+                else:
+                    # wait one quantum to fill a larger bucket
+                    self.stats.inc("sched_waits")
+                    self._pending_cv.wait(timeout=cfg.quantum_s)
+                    continue
+            self._serve_pending(batch)
+
     def _search_locked(self, requests):
         t_entry = telemetry.now()
         be = self._backend
@@ -883,12 +1128,25 @@ class ServeEngine:
                 max_bucket = (min(max(warmed), self.max_batch) if warmed
                               else self.max_batch)
                 sizes = [int(ingested[j].shape[0]) for j in idxs]
-                batches, solo = self._plan(sizes, max_bucket)
-                plans.append((idxs, warmed, batches, solo))
+                if self._sched_cfg is not None:
+                    # the continuous-batching chooser: telemetry-steered
+                    # cut points, deadlines breaking ties; buckets come
+                    # ONLY from the certified _bucket_for ladder, so the
+                    # chooser stays inside the warmed signature space
+                    # (retrace obligation serve.scheduler_closure)
+                    dls = [deadlines[j] for j in idxs]
+                    batches, solo = choose_batches(
+                        sizes, dls,
+                        lambda total, w=warmed: self._bucket_for(total, w),
+                        max_bucket, self._cost, dt, telemetry.now())
+                else:
+                    batches, solo = self._plan(sizes, max_bucket)
+                plans.append((dt, idxs, warmed, batches, solo))
 
-        inflight = []  # (kind, members, out, redo, warmed) dispatch order
+        # (kind, members, out, redo, warmed, dt, bucket, block, lane_r, t0)
+        inflight = []
         lane = 0
-        for idxs, warmed, batches, solo in plans:
+        for dt, idxs, warmed, batches, solo in plans:
             for batch in batches:
                 members = [(idxs[jj], start, n) for jj, start, n in batch]
                 members = self._drop_expired(members, deadlines, results)
@@ -906,14 +1164,37 @@ class ServeEngine:
                                      ingested[members[0][0]].dtype)
                     for j, start, n in members:
                         block[start:start + n] = ingested[j]
+                est = self._cost.batch_cost_s(dt, bucket)
+                t0 = telemetry.now()
                 with telemetry.span("serve.dispatch"):
-                    out = be.dispatch(jnp.asarray(block))  # async
+                    if self._router is None:
+                        out = be.dispatch(jnp.asarray(block))  # async
+                        lane_r = None
+                    else:
+                        # replica routing: least-estimated-completion
+                        # lane; a dispatch-time lane fault drains the
+                        # lane and re-routes (zero failed requests while
+                        # any lane lives)
+                        try:
+                            out, lane_r = self._dispatch_routed(block, est)
+                        except Exception as e:
+                            done = telemetry.now() - t_entry
+                            self.stats.inc("dispatch_errors")
+                            for j, _s, _n in members:
+                                results[j] = e
+                                latencies[j] = done
+                            continue
                     self._handle.get_next_usable_stream(lane).record(out)
                 lane += 1
                 # the retry path re-dispatches the SAME block through the
                 # SAME warmed executable — zero-compile by construction
-                redo = (lambda blk=block: be.dispatch(jnp.asarray(blk)))
-                inflight.append(("coalesced", members, out, redo, warmed))
+                if lane_r is None:
+                    redo = (lambda blk=block: be.dispatch(jnp.asarray(blk)))
+                else:
+                    redo = (lambda blk=block, ln=lane_r:
+                            be.dispatch(jnp.asarray(blk), ln))
+                inflight.append(("coalesced", members, out, redo, warmed,
+                                 dt, bucket, block, lane_r, t0))
                 self.stats.inc("super_batches")
                 self.stats.inc("coalesced_requests", len(members))
             for jj in solo:
@@ -936,30 +1217,46 @@ class ServeEngine:
                 lane += 1
                 redo = (lambda jj_=j: be.solo(raw[jj_]))
                 inflight.append(("solo", [(j, 0, ingested[j].shape[0])],
-                                 out, redo, None))
+                                 out, redo, None, dt, None, None, None,
+                                 telemetry.now()))
                 self.stats.inc("solo_fallbacks")
 
         # collect: blocks per batch; later batches keep executing
         # meanwhile.  Collection is SUPERVISED (watchdog + bounded retry);
-        # a super-batch that still fails is split and re-dispatched
+        # a replica-lane failure drains the lane and re-routes the SAME
+        # block through a surviving lane's warmed executable; a
+        # super-batch that still fails is split and re-dispatched
         # member-by-member so one poisoned request fails alone.
         with telemetry.span("serve.deliver"):
-            for kind, members, out, redo, warmed in inflight:
+            for (kind, members, out, redo, warmed, dt, bucket, block,
+                 lane_r, t0) in inflight:
                 try:
                     d, i = sup.collect(out, redo=redo, label=kind)
                 except Exception as e:
-                    self.stats.inc("dispatch_errors")
-                    if kind == "coalesced" and len(members) > 1:
-                        self.stats.inc("isolation_splits")
-                        self._isolate(members, ingested, warmed, results,
-                                      latencies, t_entry)
-                    else:
-                        done = telemetry.now() - t_entry
-                        for j, _start, _n in members:
-                            results[j] = e
-                            latencies[j] = done
-                    continue
+                    collected = None
+                    if lane_r is not None:
+                        collected = self._reroute(block, lane_r, e)
+                    if collected is None:
+                        self.stats.inc("dispatch_errors")
+                        if kind == "coalesced" and len(members) > 1:
+                            self.stats.inc("isolation_splits")
+                            self._isolate(members, ingested, warmed,
+                                          results, latencies, t_entry)
+                        else:
+                            done = telemetry.now() - t_entry
+                            for j, _start, _n in members:
+                                results[j] = e
+                                latencies[j] = done
+                        continue
+                    d, i = collected
                 done = telemetry.now() - t_entry
+                now = telemetry.now()
+                if kind == "coalesced" and bucket is not None:
+                    # per-(dtype, bucket) service time → the scheduler's
+                    # cost model (EWMA), the signal the chooser steers by
+                    self._cost.observe(dt, bucket, now - t0)
+                if lane_r is not None:
+                    self._router.note_done(lane_r, now)
                 for j, start, n in members:
                     results[j] = (d[start:start + n], i[start:start + n])
                     latencies[j] = done
@@ -998,6 +1295,63 @@ class ServeEngine:
             start += n
         return live
 
+    def _dispatch_routed(self, block, est_s):
+        """Replica-lane dispatch with dispatch-time fault draining: pick
+        the least-loaded live lane, dispatch; a retryable lane failure
+        (the comms fault site, a transient runtime error) DRAINS that
+        lane and the same block re-routes to the next live lane — zero
+        failed requests while any lane survives.  Raises only when every
+        lane is drained or the failure is a logic bug (fail fast)."""
+        be = self._backend
+        tried: List[int] = []
+        last: Optional[Exception] = None
+        while True:
+            lane = self._router.pick(telemetry.now(), est_s, exclude=tried)
+            if lane is None:
+                raise last if last is not None else RejectedError(
+                    "overload", "no live replica lane to dispatch to")
+            try:
+                out = be.dispatch(jnp.asarray(block), lane)
+                if tried:  # a drained lane's traffic landed elsewhere
+                    self.stats.inc("replica_reroutes")
+                return out, lane
+            except Exception as e:
+                if not retryable(e):
+                    raise
+                self._router.fault(lane)
+                self.stats.inc("replica_faults")
+                tried.append(lane)
+                last = e
+
+    def _reroute(self, block, lane, exc):
+        """Collect-time replica failure: drain *lane* and re-dispatch the
+        SAME assembled block through a surviving lane's warmed executable
+        (zero-compile — every lane warmed every signature).  Returns the
+        collected (d, i) or None when no lane can serve it (the caller
+        falls back to isolation/per-request errors)."""
+        if not retryable(exc):
+            return None
+        be = self._backend
+        self._router.fault(lane)
+        self.stats.inc("replica_faults")
+        tried = [lane]
+        while True:
+            alt = self._router.pick(telemetry.now(), 0.0, exclude=tried)
+            if alt is None:
+                return None
+            try:
+                out = be.dispatch(jnp.asarray(block), alt)
+                redo = (lambda blk=block, ln=alt:
+                        be.dispatch(jnp.asarray(blk), ln))
+                d, i = self._supervisor.collect(out, redo=redo,
+                                                label="rerouted")
+                self.stats.inc("replica_reroutes")
+                return d, i
+            except Exception:
+                self._router.fault(alt)
+                self.stats.inc("replica_faults")
+                tried.append(alt)
+
     def _isolate(self, members, ingested, warmed, results, latencies,
                  t_entry):
         """Per-request isolation: re-dispatch each member of a failed
@@ -1011,7 +1365,13 @@ class ServeEngine:
             bucket = self._bucket_for(n, warmed)
             block = np.zeros((bucket, be.dim), ingested[j].dtype)
             block[:n] = ingested[j]
-            redo = (lambda blk=block: be.dispatch(jnp.asarray(blk)))
+            if self._router is not None:
+                lanes = self._router.alive_lanes() or [0]
+                ln0 = lanes[0]
+                redo = (lambda blk=block, ln=ln0:
+                        be.dispatch(jnp.asarray(blk), ln))
+            else:
+                redo = (lambda blk=block: be.dispatch(jnp.asarray(blk)))
             try:
                 d, i = sup.collect(redo(), redo=redo, label="isolated")
                 results[j] = (d[:n], i[:n])

@@ -315,6 +315,34 @@ class Histogram(Metric):
             return list(self._cells.items())
 
 
+def merged_quantile(hist: "Histogram", q: float,
+                    prefix: Tuple[str, ...]) -> Optional[float]:
+    """Quantile estimate over the UNION of every cell whose label tuple
+    starts with *prefix* — folded bucket-wise on the shared fixed log
+    geometry (the :mod:`raft_tpu.telemetry.aggregate` merge property),
+    then interpolated by the ONE :func:`quantile_from_counts` rule.
+
+    This is how a per-(fn, sig) histogram (e.g.
+    ``raft_tpu_aot_dispatch_seconds``) answers a per-fn question: merge
+    all of *fn*'s signature rows rather than privileging one.  Both the
+    serve admission cost model and the continuous-batching scheduler
+    seed their estimates through here.  None when nothing matched."""
+    counts: Optional[List[int]] = None
+    total, lo, hi = 0, float("inf"), float("-inf")
+    for labels, cell in hist.items():
+        if labels[:len(prefix)] != tuple(prefix) or cell.count == 0:
+            continue
+        if counts is None:
+            counts = [0] * len(cell.counts)
+        for i, n in enumerate(cell.counts):
+            counts[i] += n
+        total += cell.count
+        lo, hi = min(lo, cell.min), max(hi, cell.max)
+    if counts is None or not total:
+        return None
+    return quantile_from_counts(counts, total, lo, hi, q)
+
+
 # ---------------------------------------------------------------------------
 # the registry
 

@@ -90,6 +90,14 @@ _FAST_TESTS = {
     "test_every_registered_program_has_a_committed_golden",
     "test_serve.py::test_zero_compiles_after_warmup",
     "test_serve.py::test_out_of_bucket_range_request_served_solo",
+    "test_serve_schedule.py::TestChooser::"
+    "test_flat_cost_reproduces_drain_all",
+    "test_serve_schedule.py::TestEngineScheduler::"
+    "test_scheduler_on_off_bit_identical_zero_compile",
+    "test_serve_replica.py::TestReplicaServe::"
+    "test_routed_identical_zero_compile_per_group_allgather",
+    "test_serve_replica.py::TestReplicaServe::"
+    "test_degrade_reroutes_zero_failures_healthz",
     "test_ivf_pq.py::test_ivf_pq_recall_pq_bits",
     "test_kmeans_mnmg.py::test_distributed_matches_single_device",
     "test_kmeans_mnmg.py::test_fori_loop_matches_device_loop",

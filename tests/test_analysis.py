@@ -778,11 +778,11 @@ HloModule m, input_output_alias={ {0}: (1, {}, may-alias) }
 class TestShippedRegistry:
     def test_catalog(self):
         entries = {e.name: e for e in registry.iter_programs()}
-        # the ISSUE-13 floor: >= 13 hot-path programs declared — all three
-        # serve backends in sharded one-allgather form (ISSUE 12) PLUS the
-        # three graduated Pallas kernels (select_k / fused_l2_nn / the
-        # IVF-PQ LUT-in-VMEM scorer)
-        assert len(entries) >= 13, sorted(entries)
+        # the ISSUE-15 floor: >= 14 hot-path programs declared — all three
+        # serve backends in sharded one-allgather form (ISSUE 12), the
+        # three graduated Pallas kernels (ISSUE 13), and the replica-group
+        # program on the 2D shard × replica carve (ISSUE 15)
+        assert len(entries) >= 14, sorted(entries)
         for expected in ("brute_force.knn_scan", "ivf_flat.search_batch",
                          "ivf_pq.full_search", "ivf_pq.encode_tile",
                          "ivf_pq.csum_tile", "cluster.fused_em_step",
@@ -790,12 +790,15 @@ class TestShippedRegistry:
                          "ann_mnmg.ivf_flat_sharded",
                          "ann_mnmg.ivf_pq_sharded",
                          "ann_mnmg.brute_force_sharded",
+                         "ann_mnmg.ivf_flat_replica_group",
                          "kernels.select_k", "kernels.fused_l2_nn",
                          "kernels.ivf_pq_lut"):
             assert expected in entries, expected
         # every single-device entry pins a zero-collective budget; the
-        # sharded entries pin exactly one launch of the SAME packed
-        # (nq, 2k) merge payload
+        # sharded entries pin exactly one launch of the packed (nq, 2k)
+        # merge payload — stacked over the FULL world for the full-mesh
+        # programs, over the GROUP world for the replica-group program
+        # (the fleet total is R × the group payload)
         sharded_bytes = set()
         for e in entries.values():
             if e.requires_devices == 1:
@@ -803,7 +806,8 @@ class TestShippedRegistry:
             else:
                 assert e.collectives == 1, e.name
                 sharded_bytes.add(e.collective_bytes)
-        assert sharded_bytes == {8 * 64 * 2 * 8 * 4}
+        assert sharded_bytes == {8 * 64 * 2 * 8 * 4,
+                                 (8 // 2) * 64 * 2 * 8 * 4}
 
     def test_ivf_pq_sharded_audit_one_allgather(self, devices):
         # satellite: the previously-missing third sharded backend entry

@@ -1,0 +1,292 @@
+"""Replica routing battery (ISSUE 15, docs/sharded_ann.md §replica
+groups): the 2D (shard × replica) carve via ``Comms.replica_split``,
+replica-group ``ShardedIndex`` construction, routed serving with the
+degrade path, per-group collective byte accounting, MeshAot cache-key
+isolation across groups, fleet-telemetry rollup of per-replica rows, and
+the AOT executable store over mesh programs."""
+
+import numpy as np
+import pytest
+
+from raft_tpu import telemetry
+from raft_tpu.comms import build_comms
+from raft_tpu.core.aot import aot_compile_counters
+from raft_tpu.core.error import RaftError
+from raft_tpu.neighbors import ann_mnmg, brute_force, ivf_flat
+from raft_tpu.serve import ServeEngine
+from raft_tpu.testing import faults
+
+_DIM = 16
+_K = 4
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(0)
+    return rng.random((2048, _DIM), dtype=np.float32)
+
+
+@pytest.fixture(scope="module")
+def fl_index(corpus):
+    return ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4), corpus)
+
+
+@pytest.fixture(scope="module")
+def replica_set(fl_index):
+    return ann_mnmg.replicate(fl_index, build_comms(), 2)
+
+
+def _reqs(seed=1, sizes=(3, 7, 2, 9, 1, 5)):
+    rng = np.random.default_rng(seed)
+    return [rng.random((n, _DIM), dtype=np.float32) for n in sizes]
+
+
+_SP = ivf_flat.SearchParams(n_probes=3)
+
+
+class TestReplicaSplit:
+    def test_layout_carves_contiguous_groups(self):
+        comms = build_comms()
+        lay = comms.replica_split(2)
+        assert lay.n_replicas == 2 and lay.group_size == 4
+        assert lay.split.groups == [[0, 1, 2, 3], [4, 5, 6, 7]]
+        for r, g in enumerate(lay.groups):
+            devs = list(g.mesh.devices.flat)
+            assert len(devs) == 4
+            assert g.groups is None  # full-axis within its sub-mesh
+        # the two views are one carve: split's group r == group r's devices
+        all_devs = list(comms.mesh.devices.flat)
+        for r, g in enumerate(lay.groups):
+            assert list(g.mesh.devices.flat) \
+                == all_devs[r * 4:(r + 1) * 4]
+
+    def test_invalid_splits_raise(self):
+        comms = build_comms()
+        with pytest.raises(RaftError):
+            comms.replica_split(3)  # 8 % 3 != 0
+        lay = comms.replica_split(2)
+        with pytest.raises(RaftError):
+            lay.split.replica_split(2)  # no re-splitting a split comm
+
+    def test_per_group_collective_isolation(self):
+        comms = build_comms()
+        lay = comms.replica_split(2)
+        g0, g1 = lay.groups
+        before1 = dict(g1.collective_calls)
+        g0.run(lambda x: g0.allreduce(x), np.ones((4, 2), np.float32))
+        assert g0.collective_calls["allreduce"] == 1
+        assert g0.collective_calls["allreduce_bytes"] == 8
+        # group 1's registry rows did not move: per-instance comm labels
+        assert dict(g1.collective_calls) == before1
+
+
+class TestReplicate:
+    def test_each_group_matches_local_search(self, fl_index, replica_set,
+                                             corpus):
+        q = _reqs()[3]
+        d_l, i_l = ivf_flat.search(_SP, fl_index, q, _K)
+        for r in range(replica_set.n_replicas):
+            d, i = ann_mnmg.search(replica_set.replicas[r], q, _K, _SP)
+            assert np.array_equal(np.asarray(i), np.asarray(i_l))
+            assert np.array_equal(np.asarray(d), np.asarray(d_l))
+
+    def test_layout_reuse_and_arg_validation(self, fl_index):
+        comms = build_comms()
+        lay = comms.replica_split(2)
+        rep = ann_mnmg.replicate(fl_index, lay)
+        assert rep.n_replicas == 2
+        with pytest.raises(RaftError):
+            ann_mnmg.replicate(fl_index, lay, 4)  # disagrees with layout
+        with pytest.raises(RaftError):
+            ann_mnmg.replicate(fl_index, comms)  # n_replicas required
+
+    def test_no_meshaot_cache_aliasing_across_groups(self, replica_set):
+        # a split comm must round-trip through the MeshAot cache keys:
+        # each group's searcher binds its OWN program (cached on its own
+        # communicator), so warming one group cannot silently satisfy —
+        # or poison — the other group's signatures
+        s0 = replica_set.replicas[0].searcher(_K, _SP)
+        s1 = replica_set.replicas[1].searcher(_K, _SP)
+        assert s0.fn is not s1.fn
+        # same statics on the SAME group → the same cached program
+        s0b = replica_set.replicas[0].searcher(_K, _SP)
+        assert s0.fn is s0b.fn
+        import jax.numpy as jnp
+
+        s0.warm(8, jnp.float32)
+        c0 = aot_compile_counters["compiles"]
+        s0.warm(8, jnp.float32)  # cache hit within the group
+        assert aot_compile_counters["compiles"] == c0
+        s1.warm(8, jnp.float32)  # the OTHER group must lower its own
+        assert aot_compile_counters["compiles"] > c0
+
+
+class TestReplicaServe:
+    def test_routed_identical_zero_compile_per_group_allgather(
+            self, fl_index, replica_set):
+        eng = ServeEngine(replica_set, _K, _SP, max_batch=16)
+        eng.warmup()
+        reqs = _reqs(seed=2)
+        eng.search(reqs[:1])  # plumbing warm call
+        g_counts = [dict(g.collective_calls)
+                    for g in replica_set.layout.groups]
+        # warm time staged every launch: exactly one allgather per traced
+        # (bucket) program per group, group-world payload
+        for counts in g_counts:
+            assert counts.get("allgather", 0) >= 1
+            assert counts.get("allgather_bytes", 0) > 0
+        c0 = aot_compile_counters["compiles"]
+        outs = eng.search(reqs)
+        assert aot_compile_counters["compiles"] == c0
+        for q, (d, i) in zip(reqs, outs):
+            d_l, i_l = ivf_flat.search(_SP, fl_index, q, _K)
+            assert np.array_equal(i, np.asarray(i_l))
+            assert np.array_equal(d, np.asarray(d_l))
+        # steady-state serving traced nothing new: the per-group
+        # trace-time counters are EXACTLY what warmup left
+        assert [dict(g.collective_calls)
+                for g in replica_set.layout.groups] == g_counts
+        # the router actually spread batches across both lanes
+        disp = telemetry.REGISTRY.get("raft_tpu_serve_replica_dispatch_total")
+        lanes_used = {labels[1] for labels, v in disp.items()
+                      if labels[0] == eng._engine_id and v > 0}
+        assert lanes_used == {"0", "1"}
+        eng.close()
+
+    def test_degrade_reroutes_zero_failures_healthz(self, fl_index,
+                                                    replica_set):
+        eng = ServeEngine(replica_set, _K, _SP, max_batch=16)
+        eng.warmup()
+        reqs = _reqs(seed=3)
+        eng.search(reqs[:1])
+        c0 = aot_compile_counters["compiles"]
+        # lane 0 (the router's first pick) faults on EVERY dispatch:
+        # traffic must drain to lane 1 with zero failed requests
+        with faults.plan("comms:op=replica_dispatch:rank=0:raise"):
+            outs = eng.search(reqs)
+        assert aot_compile_counters["compiles"] == c0  # reroute warmed
+        assert all(isinstance(o, tuple) for o in outs)
+        for q, (d, i) in zip(reqs, outs):
+            _, i_l = ivf_flat.search(_SP, fl_index, q, _K)
+            assert np.array_equal(i, np.asarray(i_l))
+        assert eng.stats["replica_faults"] >= 1
+        assert eng.stats["replica_reroutes"] >= 1
+        body = eng._health()
+        assert body["degraded"] is True
+        assert body["replicas"] == {"total": 2, "live": 1,
+                                    "degraded": [0]}
+        # the drain is sticky after the plan clears (a faulted replica
+        # stays out until an operator restores or refreshes)
+        outs2 = eng.search(reqs[:2])
+        assert all(isinstance(o, tuple) for o in outs2)
+        assert eng._health()["replicas"]["degraded"] == [0]
+        eng._router.restore(0)
+        assert eng._health()["replicas"]["degraded"] == []
+        eng.close()
+
+    def test_injected_logic_fault_fails_fast(self, replica_set):
+        # a LOGIC fault (shape/dtype-bug family) must NOT drain-and-
+        # reroute — that would mask a deterministic bug as lane loss
+        eng = ServeEngine(replica_set, _K, _SP, max_batch=16)
+        eng.warmup()
+        eng.search(_reqs(seed=4)[:1])
+        with faults.plan("comms:op=replica_dispatch:rank=0:raise=logic"):
+            outs = eng.search(_reqs(seed=4)[:1])
+        assert isinstance(outs[0], Exception)
+        assert eng._health()["replicas"]["degraded"] == []
+        eng.close()
+
+    def test_brute_force_replicas(self, corpus):
+        rep = ann_mnmg.replicate(corpus, build_comms(), 2)
+        assert rep.kind == "brute_force"
+        eng = ServeEngine(rep, _K, max_batch=16)
+        eng.warmup()
+        reqs = _reqs(seed=5, sizes=(3, 6, 2))
+        outs = eng.search(reqs)
+        for q, (d, i) in zip(reqs, outs):
+            _, i_l = brute_force.knn(corpus, q, _K)
+            assert np.array_equal(i, np.asarray(i_l))
+        # oversize → solo through one replica group, still identical
+        big = _reqs(seed=6, sizes=(25,))[0]
+        (d, i), = eng.search([big])
+        _, i_l = brute_force.knn(corpus, big, _K)
+        assert np.array_equal(i, np.asarray(i_l))
+        assert eng.stats["solo_fallbacks"] == 1
+        eng.close()
+
+
+class TestFleetRollup:
+    def test_gather_rolls_up_per_replica_rows_without_collisions(
+            self, replica_set):
+        # every group communicator's byte/count rows ride the snapshot
+        # under its own comm= ordinal — the parent-comms gather rollup
+        # must carry each group's view exactly (no label collisions
+        # folding two groups into one row)
+        for g in replica_set.layout.groups:
+            assert dict(g.collective_calls), "fixture groups have traffic"
+        fleet = telemetry.gather(replica_set.layout.parent)
+        roll = fleet["rollup"].get(
+            "raft_tpu_comms_collective_calls", {}).get("values", {})
+        prefixes = set()
+        for g in replica_set.layout.groups:
+            prefix = ",".join(
+                f"comm={v}" for v in g.collective_calls.fixed_labels)
+            prefixes.add(prefix)
+            for key, val in dict(g.collective_calls).items():
+                assert roll.get(f"{prefix},key={key}") == val, (prefix,
+                                                                key)
+        assert len(prefixes) == len(replica_set.layout.groups)
+
+    def test_merge_sums_counter_rows_additively(self):
+        from raft_tpu.telemetry import aggregate
+
+        snap = telemetry.snapshot()
+        name = "raft_tpu_comms_collective_calls"
+        if name not in snap:
+            pytest.skip("no comms rows in this process")
+        merged = aggregate.merge([snap, snap])
+        for key, val in snap[name]["values"].items():
+            assert merged[name]["values"][key] == 2 * val
+
+
+class TestExecutableStoreMeshPrograms:
+    def test_store_round_trips_replica_group_executable(self, tmp_path,
+                                                        replica_set):
+        # the cold-start satellite must cover the (bucket, dtype, world)
+        # MESH signatures too: serialize one group's warmed shard_map
+        # executable, clear the in-process cache, and restore with zero
+        # XLA compiles — results bit-identical
+        import jax.numpy as jnp
+
+        from raft_tpu.core import aotstore
+
+        searchers = [r.searcher(_K, _SP) for r in replica_set.replicas]
+        q = _reqs(seed=7, sizes=(8,))[0]
+        prev = aotstore.install(str(tmp_path))
+        try:
+            for s in searchers:
+                s.fn._cache.clear()  # force store-visible misses
+                s.warm(8, jnp.float32)
+            # one entry PER GROUP: congruent sub-meshes repr identically,
+            # so the store key must carry the device assignment — a
+            # collision here loads group 0's executable onto group 1's
+            # devices (the aliasing bug the verify drive caught)
+            import os as _os
+
+            assert len(_os.listdir(str(tmp_path))) == len(searchers)
+            base = [s.dispatch(q) for s in searchers]
+            for s in searchers:
+                s.fn._cache.clear()  # simulate the process restart
+            h0 = aot_compile_counters["store_hits"]
+            c0 = aot_compile_counters["compiles"]
+            for s in searchers:
+                s.warm(8, jnp.float32)
+            assert aot_compile_counters["compiles"] == c0
+            assert aot_compile_counters["store_hits"] == h0 + len(searchers)
+            for s, (d0, i0) in zip(searchers, base):
+                d1, i1 = s.dispatch(q)
+                assert np.array_equal(np.asarray(i0), np.asarray(i1))
+                assert np.array_equal(np.asarray(d0), np.asarray(d1))
+        finally:
+            aotstore.install(prev)

@@ -1,0 +1,341 @@
+"""Continuous-batching scheduler battery (ISSUE 15, docs/serving.md
+§scheduler): the telemetry-steered chooser, the streaming quantum rule,
+the replica router, and the cost model — plus the merged-quantile
+telemetry helper they seed from."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import telemetry
+from raft_tpu.core.aot import _bucket_dim, aot_compile_counters
+from raft_tpu.neighbors.brute_force import knn
+from raft_tpu.serve import (RejectedError, SchedulerConfig, ServeEngine,
+                            ServeRequest)
+from raft_tpu.serve import schedule
+
+_DIM = 16
+_K = 4
+
+
+def _data(n=512, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.random((n, _DIM), dtype=np.float32)
+
+
+def _bucket_for(total, max_batch=1024, warmed=frozenset()):
+    b = min(_bucket_dim(total), max_batch)
+    if warmed and b not in warmed:
+        bigger = [w for w in warmed if w >= total]
+        if bigger:
+            b = min(bigger)
+    return b
+
+
+def _drain_all(sizes, max_bucket):
+    """The legacy drain-all packing (ServeEngine._plan), as the oracle."""
+    batches, solo, cur, cur_n = [], [], [], 0
+    for j, n in enumerate(sizes):
+        if n > max_bucket:
+            solo.append(j)
+            continue
+        if cur_n + n > max_bucket:
+            batches.append(cur)
+            cur, cur_n = [], 0
+        cur.append((j, cur_n, n))
+        cur_n += n
+    if cur:
+        batches.append(cur)
+    return batches, solo
+
+
+class TestChooser:
+    def test_flat_cost_reproduces_drain_all(self):
+        # cold (flat) cost model: minimizing total cost minimizes the
+        # batch count, which IS the drain-all packing — the shipped
+        # default changes nothing until telemetry says otherwise
+        rng = np.random.default_rng(1)
+        cm = schedule.CostModel(use_telemetry=False)
+        for _ in range(100):
+            sizes = [int(s) for s in rng.choice(
+                [1, 2, 5, 8, 16, 40, 130, 700, 1100],
+                size=rng.integers(0, 30))]
+            dls = [None] * len(sizes)
+            b1, s1 = schedule.choose_batches(
+                sizes, dls, _bucket_for, 1024, cm, "float32", 0.0)
+            b2, s2 = _drain_all(sizes, 1024)
+            assert s1 == s2
+            assert len(b1) == len(b2), (sizes, b1, b2)
+            assert [m[0] for b in b1 for m in b] \
+                == [m[0] for b in b2 for m in b]
+            # members stay in arrival order with contiguous row offsets
+            for batch in b1:
+                start = 0
+                for _j, st, n in batch:
+                    assert st == start
+                    start += n
+
+    def test_measured_costs_can_split_batches(self):
+        # a measured cost surface where padding 512+16 rows to one 1024
+        # bucket costs far more than dispatching 512 + 8 separately:
+        # the chooser must split — and every bucket it uses must come
+        # from the ladder callable (never a raw total)
+        cm = schedule.CostModel()
+        cm.observe("float32", 8, 0.001)
+        cm.observe("float32", 1024, 0.100)
+        seen = []
+
+        def ladder(total):
+            b = _bucket_for(total)
+            seen.append(b)
+            return b
+
+        batches, solo = schedule.choose_batches(
+            [512, 16], [None, None], ladder, 1024, cm, "float32", 0.0)
+        assert solo == []
+        assert [[m[0] for m in b] for b in batches] == [[0], [1]]
+        assert all(b == _bucket_dim(b) for b in seen)
+
+    def test_deadline_pressure_breaks_ties(self):
+        # cost(16) == cost(8) + cost(8) exactly — a packing tie; the
+        # first request's tight deadline must pull it into its own
+        # earlier-completing batch
+        cm = schedule.CostModel()
+        cm.observe("float32", 8, 0.05)
+        cm.observe("float32", 16, 0.10)
+        batches, _ = schedule.choose_batches(
+            [8, 8], [0.06, None], _bucket_for, 1024, cm, "float32", 0.0)
+        assert [[m[0] for m in b] for b in batches] == [[0], [1]]
+        # without the deadline the tie resolves to either packing but
+        # never to a THIRD, costlier plan
+        batches2, _ = schedule.choose_batches(
+            [8, 8], [None, None], _bucket_for, 1024, cm, "float32", 0.0)
+        assert len(batches2) in (1, 2)
+
+    def test_oversize_requests_go_solo(self):
+        cm = schedule.CostModel(use_telemetry=False)
+        batches, solo = schedule.choose_batches(
+            [4, 2000, 3], [None] * 3, _bucket_for, 1024, cm,
+            "float32", 0.0)
+        assert solo == [1]
+        assert [m[0] for b in batches for m in b] == [0, 2]
+
+
+class TestCostModel:
+    def test_precedence_static_then_observed(self):
+        cm = schedule.CostModel(static_batch_s=0.25, use_telemetry=False)
+        assert cm.batch_cost_s("float32", 64) == 0.25
+        cm2 = schedule.CostModel(static_batch_s=0.25)
+        assert cm2.batch_cost_s("float32", 64) == 0.25  # cold, no fn
+        cm2.observe("float32", 64, 0.01)
+        assert cm2.batch_cost_s("float32", 64) == pytest.approx(0.01)
+        # EWMA folds subsequent observations
+        cm2.observe("float32", 64, 0.02)
+        assert 0.01 < cm2.batch_cost_s("float32", 64) < 0.02
+
+    def test_bucket_interpolation(self):
+        cm = schedule.CostModel()
+        cm.observe("float32", 8, 0.010)
+        cm.observe("float32", 128, 0.070)
+        # fixed+per-row decomposition: 8→0.01, 128→0.07 ⇒ per-row 5e-4,
+        # fixed 6e-3 ⇒ 64 → 0.038
+        assert cm.batch_cost_s("float32", 64) == pytest.approx(0.038,
+                                                               rel=1e-6)
+        # dtypes do not bleed into each other
+        assert cm.batch_cost_s("bfloat16", 64) == cm.static_batch_s
+
+    def test_registry_seed_via_merged_quantile(self):
+        # the (fn, sig)-labeled dispatch histogram seeds a per-fn cost:
+        # rows merge bucket-wise (telemetry.registry.merged_quantile)
+        hist = telemetry.histogram(
+            "raft_tpu_aot_dispatch_seconds",
+            "host-side dispatch latency", labelnames=("fn", "sig"))
+        fn = "test_sched_seed_fn"
+        for v in (0.02, 0.02, 0.02):
+            hist.observe(v, (fn, "aaaa"))
+        for v in (0.02, 0.02):
+            hist.observe(v, (fn, "bbbb"))
+        cm = schedule.CostModel(fn=fn)
+        est = cm.batch_cost_s("float32", 32)
+        assert est == pytest.approx(0.02, rel=0.5)  # one bucket ratio
+
+    def test_merged_quantile_prefix_isolation(self):
+        from raft_tpu.telemetry.registry import merged_quantile
+
+        hist = telemetry.histogram(
+            "test_merged_quantile_hist", "x", labelnames=("fn", "sig"))
+        hist.observe(0.001, ("a", "s1"))
+        hist.observe(0.001, ("a", "s2"))
+        hist.observe(10.0, ("b", "s1"))
+        got = merged_quantile(hist, 0.5, ("a",))
+        assert got is not None and got < 0.01  # b's rows must not bleed
+        assert merged_quantile(hist, 0.5, ("c",)) is None
+
+
+class TestShouldDispatch:
+    def test_rules(self):
+        q = 0.010
+        # empty queue never dispatches
+        assert not schedule.should_dispatch(0, 64, 1.0, q, [], 0.0, 0.01)
+        # fills the largest warmed bucket → now
+        assert schedule.should_dispatch(64, 64, 0.0, q, [], 0.0, 0.01)
+        # fresh partial batch → wait one quantum
+        assert not schedule.should_dispatch(8, 64, 0.001, q, [], 0.0,
+                                            0.01)
+        # oldest member waited a full quantum → now
+        assert schedule.should_dispatch(8, 64, 0.02, q, [], 0.0, 0.01)
+        # a deadline that one more quantum would jeopardize → now
+        assert schedule.should_dispatch(8, 64, 0.0, q, [0.015], 0.0, 0.01)
+        # a comfortable deadline → still wait
+        assert not schedule.should_dispatch(8, 64, 0.0, q, [10.0], 0.0,
+                                            0.01)
+
+
+class TestReplicaRouter:
+    def test_least_loaded_spread_and_drain(self):
+        r = schedule.ReplicaRouter(2, "test-router")
+        # equal horizons: consecutive picks alternate lanes
+        l0 = r.pick(0.0, 1.0)
+        l1 = r.pick(0.0, 1.0)
+        assert {l0, l1} == {0, 1}
+        # the busier lane loses the next pick
+        r.note_done(l0, 0.0)
+        assert r.pick(0.0, 0.1) == l0
+        # fault drains: all traffic lands on the survivor
+        r.fault(0)
+        assert r.alive_lanes() == [1]
+        assert all(r.pick(0.0, 0.1) == 1 for _ in range(4))
+        assert r.health() == {"total": 2, "live": 1, "degraded": [0]}
+        # exclusion on top of draining → nothing left
+        assert r.pick(0.0, 0.1, exclude=[1]) is None
+        r.restore(0)
+        assert r.health()["live"] == 2
+
+
+class TestEngineScheduler:
+    def test_scheduler_on_off_bit_identical_zero_compile(self):
+        x = _data()
+        rng = np.random.default_rng(3)
+        reqs = [rng.random((n, _DIM), dtype=np.float32)
+                for n in (3, 9, 1, 14, 6, 2)]
+        eng_on = ServeEngine(x, _K, max_batch=32)
+        eng_off = ServeEngine(x, _K, max_batch=32, scheduler=False)
+        for e in (eng_on, eng_off):
+            e.warmup()
+            e.search(reqs[:1])
+        c0 = aot_compile_counters["compiles"]
+        outs_on = eng_on.search(reqs)
+        outs_off = eng_off.search(reqs)
+        assert aot_compile_counters["compiles"] == c0
+        for q, (d1, i1), (d2, i2) in zip(reqs, outs_on, outs_off):
+            d_l, i_l = knn(x, q, _K)
+            assert np.array_equal(i1, np.asarray(i_l))
+            assert np.array_equal(i2, np.asarray(i_l))
+            assert np.array_equal(d1, d2)
+
+    def test_chooser_uses_only_warmed_buckets_after_observations(self):
+        # drive per-bucket EWMAs to a pathological surface, then serve a
+        # stream: whatever packing the chooser picks, the zero-compile
+        # counter proves every bucket was pre-lowered
+        x = _data()
+        eng = ServeEngine(x, _K, max_batch=64)
+        eng.warmup()
+        eng._cost.observe("float32", 8, 0.0001)
+        eng._cost.observe("float32", 64, 1.0)
+        rng = np.random.default_rng(4)
+        reqs = [rng.random((n, _DIM), dtype=np.float32)
+                for n in (30, 5, 3, 20, 8)]
+        eng.search([reqs[0]])
+        c0 = aot_compile_counters["compiles"]
+        outs = eng.search(reqs)
+        assert aot_compile_counters["compiles"] == c0
+        for q, (d, i) in zip(reqs, outs):
+            _, i_l = knn(x, q, _K)
+            assert np.array_equal(i, np.asarray(i_l))
+        # the skewed surface makes big buckets expensive → more, smaller
+        # batches than drain-all's single fill
+        assert eng.stats["super_batches"] >= 3
+
+    def test_submit_streaming_coalesces_and_matches(self):
+        x = _data()
+        eng = ServeEngine(x, _K, max_batch=32,
+                          scheduler=SchedulerConfig(quantum_s=0.02))
+        eng.warmup()
+        eng.search([_data(2, seed=9)])  # plumbing warm
+        rng = np.random.default_rng(5)
+        reqs = [rng.random((n, _DIM), dtype=np.float32)
+                for n in (2, 3, 4, 1, 5)]
+        sb0 = eng.stats["super_batches"]
+        c0 = aot_compile_counters["compiles"]
+        futs = [eng.submit(q) for q in reqs]
+        outs = [f.result(timeout=30) for f in futs]
+        assert aot_compile_counters["compiles"] == c0
+        for q, (d, i) in zip(reqs, outs):
+            _, i_l = knn(x, q, _K)
+            assert np.array_equal(i, np.asarray(i_l))
+        # the quantum coalesced concurrent submissions: fewer batches
+        # than requests (15 rows fit one 16-bucket)
+        assert eng.stats["super_batches"] - sb0 < len(reqs)
+        assert eng.stats["sched_dispatches"] >= 1
+        eng.close()
+
+    def test_submit_deadline_rides_through_admission(self):
+        x = _data()
+        eng = ServeEngine(x, _K, max_batch=32,
+                          scheduler=SchedulerConfig(quantum_s=0.01))
+        eng.warmup()
+        fut = eng.submit(ServeRequest(_data(3, seed=11),
+                                      deadline_s=telemetry.now() - 1.0))
+        eng.flush()
+        with pytest.raises(RejectedError):
+            fut.result(timeout=30)
+        eng.close()
+
+    def test_submit_after_close_rejects_and_pending_resolve(self):
+        x = _data()
+        eng = ServeEngine(x, _K, max_batch=32,
+                          scheduler=SchedulerConfig(quantum_s=30.0))
+        eng.warmup()
+        fut = eng.submit(_data(2, seed=12))  # parked behind a huge quantum
+        eng.close()
+        with pytest.raises(RejectedError):
+            fut.result(timeout=30)
+        with pytest.raises(RejectedError):
+            eng.submit(_data(2, seed=12))
+
+    def test_submit_requires_scheduler(self):
+        eng = ServeEngine(_data(), _K, max_batch=32, scheduler=False)
+        with pytest.raises(Exception):
+            eng.submit(_data(2, seed=13))
+        eng.close()
+
+    def test_concurrent_submitters_one_batch(self):
+        # several threads submit within one quantum: the scheduler thread
+        # must coalesce them and every future must resolve correctly
+        x = _data()
+        eng = ServeEngine(x, _K, max_batch=64,
+                          scheduler=SchedulerConfig(quantum_s=0.05))
+        eng.warmup()
+        eng.search([_data(2, seed=14)])
+        rng = np.random.default_rng(6)
+        reqs = [rng.random((3, _DIM), dtype=np.float32) for _ in range(8)]
+        futs = [None] * len(reqs)
+
+        def worker(j):
+            futs[j] = eng.submit(reqs[j])
+
+        threads = [threading.Thread(target=worker, args=(j,))
+                   for j in range(len(reqs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        t0 = time.monotonic()
+        outs = [f.result(timeout=30) for f in futs]
+        assert time.monotonic() - t0 < 25
+        for q, (d, i) in zip(reqs, outs):
+            _, i_l = knn(x, q, _K)
+            assert np.array_equal(i, np.asarray(i_l))
+        eng.close()

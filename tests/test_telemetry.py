@@ -378,13 +378,16 @@ class TestLegacySurfaces:
         x, q = _data()
         eng = ServeEngine(x, _K, max_batch=32)
         # the pre-telemetry key set still reads zero at construction; the
-        # failure-model keys (ISSUE 14) extend the same dict surface
+        # failure-model keys (ISSUE 14) and the scheduler/replica keys
+        # (ISSUE 15) extend the same dict surface
         assert dict(eng.stats) == {
             "requests": 0, "queries": 0, "super_batches": 0,
             "solo_fallbacks": 0, "coalesced_requests": 0, "refreshes": 0,
             "admitted": 0, "sheds": 0, "expired": 0, "retries": 0,
             "watchdog_timeouts": 0, "isolation_splits": 0,
-            "ingest_errors": 0, "dispatch_errors": 0}
+            "ingest_errors": 0, "dispatch_errors": 0,
+            "sched_dispatches": 0, "sched_waits": 0,
+            "replica_faults": 0, "replica_reroutes": 0}
         eng.warmup()
         eng.search([q[:2], q[2:5]])
         assert eng.stats["requests"] == 2
