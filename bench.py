@@ -16,7 +16,8 @@ value / estimate, where ≥0.8 meets the north-star target.
 
 Select a metric with
 BENCH_METRIC=pairwise|kmeans|kmeans_mnmg|ivf_pq|ivf_pq_search|ivf_build|
-lanczos|knn_bruteforce|serve|ann_sharded.
+lanczos|knn_bruteforce|serve|ann_sharded|serve_replica|select_k|
+tiered_serve.
 
 Robust bring-up (the round-1 failure was an unguarded TPU backend init):
 the measurement runs in a *child* process under a watchdog.  The parent
@@ -840,6 +841,223 @@ def bench_serve_replica():
     }
 
 
+def bench_tiered_serve():
+    """Host/device tiering + exact re-rank gates (ISSUE 18;
+    docs/index_tiering.md).  Two independently-asserted parts, both on
+    the dispatch path the tiered ``ServeEngine`` backend delegates to.
+
+    **Tiering gate** — 100k×64 f32 IVF-PQ (n_lists=128, pq_dim=16,
+    pq_bits=8), hot fraction 25% by measured hotness, cold remainder cut
+    into 2 host tiles so the corpus is ≥4× the device-resident byte
+    budget (hot set + 2 staging tiles; the budget is asserted from
+    ``memory_analysis`` of the COMPILED cold-scan executable, not
+    estimated).  Gates before any number records:
+
+    * f32 top-k (ids AND distances) bit-identical to the fully-resident
+      family search — tiering must be a pure residency change;
+    * zero compiles during both warmed timed replays;
+    * cold-scan transient ≤ 1.25× the fully-resident program's transient
+      (both are dominated by the corpus-independent per-batch LUT — the
+      cold phase must not materialize corpus-shaped staging on device);
+    * **tiered qps ≥ 0.5× fully-resident qps** on the best PAIRED replay
+      (the PR-14 drift rationale) — async double-buffered prefetch must
+      hide most of the host→device staging cost.
+
+    **Refine gate** — the PR-3 triage configuration (3000×32,
+    n_lists=32, pq_dim=8: the shape whose ADC recall ceiling ~0.53 at
+    k=5/probes=8 is pinned by tests/test_ivf_pq.py's oracle test).
+    ``refine_ratio=4`` re-scores the top-4k ADC candidates against the
+    original host-tier vectors in one exact program:
+
+    * unrefined recall@10 stays ≤0.75 (the quantization ceiling is real);
+    * refined recall@10 ≥ 0.85 at n_probes=16;
+    * **refined qps cost ≤30% vs unrefined** on the best paired replay —
+      affordable because the k·ratio candidate scan rides the stacked
+      wide-k select path (``_common.scan_probe_lists``) instead of the
+      per-step merge whose cost is quadratic in k.
+
+    Per-tier bytes moved (staged prefetch, refine gathers) come from the
+    ``tiering.tier_counters`` deltas of one counted replay and ride the
+    row + extra telemetry.
+    """
+    import jax
+
+    from bench.common import record_extra_telemetry
+    from raft_tpu import telemetry
+    from raft_tpu.core.aot import _bucket_dim, aot_compile_counters
+    from raft_tpu.neighbors import ivf_pq, knn, tiering
+
+    n, dim, nq, k = 100_000, 64, 256, 10
+    n_probes, hot_fraction, n_tiles = 64, 0.25, 2
+    rng = np.random.default_rng(0)
+    x = rng.normal(0.0, 1.0, (n, dim)).astype(np.float32)
+    q = rng.normal(0.0, 1.0, (nq, dim)).astype(np.float32)
+    index = ivf_pq.build(ivf_pq.IndexParams(n_lists=128, pq_dim=16,
+                                            pq_bits=8, seed=1), x)
+    sp = ivf_pq.SearchParams(n_probes=n_probes)
+    n_phys = index.phys_sizes.shape[0] - 1
+    tiered = tiering.tier(index, hot_fraction=hot_fraction, dataset=x)
+    # recut the cold remainder into exactly n_tiles minimal-padding tiles
+    tiered = tiering.retier(tiered, tile_phys=max(
+        8, -(-(n_phys - tiered.hot_rows) // n_tiles)))
+    assert len(tiered.cold_tiles) == n_tiles, len(tiered.cold_tiles)
+
+    # residency budget from the COMPILED programs' memory analysis: the
+    # corpus must not fit in hot set + both staging lanes, and the cold
+    # scan's transient must stay in the fully-resident program's regime
+    # (no corpus-shaped staging).  memory_analysis may be unimplemented
+    # on some backends (the tiled-build precedent above).
+    s = tiered.searcher(k, sp)
+    bucket = _bucket_dim(nq)
+    qspec = jax.ShapeDtypeStruct((bucket, dim), np.float32)
+    pspec = jax.ShapeDtypeStruct((bucket, s.n_probes), np.int32)
+    blk = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                for a in tiered.cold_tiles[0])
+    budget = tiered.device_bytes() + 2 * tiered.tile_bytes()
+    corpus_over_budget = x.nbytes / budget
+    assert corpus_over_budget >= 4.0, (
+        f"corpus {x.nbytes}B only {corpus_over_budget:.2f}x the device "
+        f"budget {budget}B — the tiering gate needs >=4x")
+    transient_parity = None
+    try:
+        cold_exe = tiering._cold_scan_aot.compiled(
+            *s._cold_args(qspec, pspec, blk))
+        # fully-resident comparison program at the same bucket; statics
+        # mirror ivf_pq.search defaults for these params
+        full_exe = ivf_pq._full_search_aot.compiled(
+            qspec,
+            tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                  for a in (index.centers, index.rotation, index.codebooks,
+                            index.list_codes, index.list_indices,
+                            index.phys_sizes, index.chunk_table, index.owner,
+                            index.list_adc, index.list_csum)),
+            int(index.metric), k, n_probes, False, "float32", "float32",
+            index.pq_bits, True, -1, s.engine)
+        cold_temp = int(cold_exe.memory_analysis().temp_size_in_bytes)
+        full_temp = int(full_exe.memory_analysis().temp_size_in_bytes)
+        transient_parity = cold_temp / max(full_temp, 1)
+        assert transient_parity <= 1.25, (
+            f"cold-scan transient {cold_temp}B vs fully-resident "
+            f"{full_temp}B — staging leaked a corpus-shaped buffer")
+    except AttributeError:
+        cold_temp = full_temp = -1  # backend without memory_analysis
+
+    qd = jax.device_put(q)
+    d_full, i_full = ivf_pq.search(sp, index, qd, k)        # warm both
+    d_t, i_t = tiering.search(tiered, qd, k, params=sp)
+    assert np.array_equal(np.asarray(d_full), np.asarray(d_t)) and \
+        np.array_equal(np.asarray(i_full), np.asarray(i_t)), \
+        "tiered top-k != fully-resident top-k (residency changed results)"
+
+    # per-tier traffic: counter deltas of ONE counted (untimed) replay
+    prev_tel = telemetry.set_enabled(True)
+    try:
+        c_before = {key: tiering.tier_counters.get(key, 0)
+                    for key in ("prefetch_bytes", "cold_tiles",
+                                "hot_dispatches")}
+        tiering.search(tiered, qd, k, params=sp)
+        moved = {key: int(tiering.tier_counters.get(key, 0) - c_before[key])
+                 for key in c_before}
+    finally:
+        telemetry.set_enabled(prev_tel)
+
+    c0 = aot_compile_counters["compiles"]
+    best = {"full": float("inf"), "tiered": float("inf")}
+    pair_ratio = 0.0
+    for _ in range(3):  # paired replays: drift hits both sides alike
+        t0 = time.perf_counter()
+        out = ivf_pq.search(sp, index, qd, k)
+        jax.block_until_ready(out[0])
+        t_full = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = tiering.search(tiered, qd, k, params=sp)
+        jax.block_until_ready(out[0])
+        t_tier = time.perf_counter() - t0
+        best["full"] = min(best["full"], t_full)
+        best["tiered"] = min(best["tiered"], t_tier)
+        pair_ratio = max(pair_ratio, t_full / t_tier)
+    assert aot_compile_counters["compiles"] == c0, \
+        "warmed tiered replay compiled"
+    qps_full = nq / best["full"]
+    qps_tier = nq / best["tiered"]
+    assert pair_ratio >= 0.5, (
+        f"tiered serving {pair_ratio:.2f}x of fully-resident qps < 0.5x "
+        f"gate ({qps_tier:.0f} vs {qps_full:.0f} qps)")
+
+    # ---- refine gate on the PR-3 triage configuration ----
+    x2 = rng.normal(0.0, 1.0, (3000, 32)).astype(np.float32)
+    q2 = x2[:nq] + 0.01 * rng.normal(0.0, 1.0, (nq, 32)).astype(np.float32)
+    idx2 = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=8, pq_bits=8,
+                                           seed=1), x2)
+    t2 = tiering.tier(idx2, hot_fraction=0.5, dataset=x2)
+    ti = np.asarray(knn(x2, q2, k)[1])
+
+    def recall(i):
+        i = np.asarray(i)
+        return sum(len(set(row.tolist()) & set(truth.tolist()))
+                   for row, truth in zip(i, ti)) / ti.size
+
+    sp_plain = ivf_pq.SearchParams(n_probes=16)
+    sp_ref = ivf_pq.SearchParams(n_probes=16, refine_ratio=4)
+    q2d = jax.device_put(q2)
+    rec_plain = recall(tiering.search(t2, q2d, k, params=sp_plain)[1])
+    prev_tel = telemetry.set_enabled(True)
+    try:
+        g0 = tiering.tier_counters.get("refine_gather_bytes", 0)
+        rec_ref = recall(tiering.search(t2, q2d, k, params=sp_ref)[1])
+        moved["refine_gather_bytes"] = int(
+            tiering.tier_counters.get("refine_gather_bytes", 0) - g0)
+    finally:
+        telemetry.set_enabled(prev_tel)
+    assert rec_plain <= 0.75, (
+        f"unrefined triage recall {rec_plain:.3f} — the quantization "
+        "ceiling moved; the refine gate no longer demonstrates a lift")
+    assert rec_ref >= 0.85, (
+        f"refined recall {rec_ref:.3f} < 0.85 gate (unrefined "
+        f"{rec_plain:.3f})")
+    cost_ratio = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = tiering.search(t2, q2d, k, params=sp_plain)
+        jax.block_until_ready(out[0])
+        t_plain = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = tiering.search(t2, q2d, k, params=sp_ref)
+        jax.block_until_ready(out[0])
+        t_ref = time.perf_counter() - t0
+        cost_ratio = max(cost_ratio, t_plain / t_ref)
+    refine_cost_pct = (1.0 / cost_ratio - 1.0) * 100.0
+    assert cost_ratio >= 1.0 / 1.3, (
+        f"refine_ratio=4 costs {refine_cost_pct:.0f}% qps > 30% gate")
+
+    for key, value in moved.items():
+        record_extra_telemetry(f"tier_{key}", value)
+    return {
+        "metric": f"tiered_serve_ivf_pq_{n // 1000}kx{dim}_"
+                  f"probes{n_probes}_hot{int(hot_fraction * 100)}",
+        "value": round(qps_tier, 1),
+        "unit": "qps",
+        # the gate ratio: tiered over fully-resident at 4x+ corpus/budget
+        "vs_baseline": round(pair_ratio, 3),
+        "full_qps": round(qps_full, 1),
+        "tiered_qps": round(qps_tier, 1),
+        "qps_ratio": round(pair_ratio, 3),
+        "corpus_over_budget": round(corpus_over_budget, 2),
+        "device_bytes": int(tiered.device_bytes()),
+        "tile_bytes": int(tiered.tile_bytes()),
+        "cold_transient_parity": (round(transient_parity, 3)
+                                  if transient_parity is not None else None),
+        "prefetch_bytes_per_replay": moved["prefetch_bytes"],
+        "cold_tiles_per_replay": moved["cold_tiles"],
+        "refine_gather_bytes": moved["refine_gather_bytes"],
+        "refine_recall": round(rec_ref, 3),
+        "unrefined_recall": round(rec_plain, 3),
+        "refine_cost_pct": round(refine_cost_pct, 1),
+        "bit_identical": True,
+        "zero_compile_replay": True,
+    }
+
+
 def bench_ivf_build():
     """Tiled vs monolithic IVF-PQ index construction A/B (ISSUE 7;
     docs/index_build.md): rows/s ingesting 100k×64 f32 into a pre-trained
@@ -1178,7 +1396,8 @@ _METRICS = {"pairwise": bench_pairwise, "kmeans": bench_kmeans,
             "lanczos": bench_lanczos, "knn_bruteforce": bench_knn_bruteforce,
             "serve": bench_serve, "ann_sharded": bench_ann_sharded,
             "serve_replica": bench_serve_replica,
-            "select_k": bench_select_k}
+            "select_k": bench_select_k,
+            "tiered_serve": bench_tiered_serve}
 
 #: Per-metric child-environment overrides.  The replica-scaling metric is
 #: a VIRTUAL-DEVICE contract gate (the 2D shard x replica carve needs a

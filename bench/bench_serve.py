@@ -1,8 +1,10 @@
 """Serving-engine microbenches (raft_tpu/serve; docs/serving.md).
 
 ``engine_coalesced`` vs ``naive_loop`` replay the SAME mixed-size request
-stream (bench/common.serve_request_stream — the protocol shared with
-bench.py's ``serve`` headline A/B) against one brute-force index:
+stream (bench/common.serve_request_stream — the seeded HEAVY_TAIL_PLAN
+traffic plan, the protocol shared with bench.py's ``serve`` headline A/B;
+its replay is bit-identical to the pre-plan hardcoded mix, so this
+bench's history is continuous) against one brute-force index:
 coalesced = warmed ServeEngine packing the stream into bucket-padded
 super-batches with double-buffered dispatch; naive = the per-request
 ``knn`` loop every caller writes first.  ``engine_ivf_flat`` covers the

@@ -15,6 +15,7 @@ minimum-of-repetitions policy).  All calls are blocked on with
 from __future__ import annotations
 
 import json
+import math
 import sys
 import time
 from typing import Callable, Dict, List, Tuple
@@ -351,29 +352,120 @@ def main_for(module_name: str):
     run_registered(iters=iters, select=select)
 
 
+# -- trace-driven load generator (seeded declarative traffic plans) --------
+#
+# The serve benches used to hardcode ONE synthetic mix; real serving traffic
+# has shapes (day curves, bursts) that stress coalescing and the tiered
+# cold path differently.  A traffic PLAN is a declarative, seeded recipe in
+# the ``raft_tpu.testing.faults`` plan grammar — directives separated by
+# ``;``, fields by ``:``, the first field naming the directive::
+#
+#     band:p=0.85:lo=1:hi=17        # size band: with prob p, size ~ U[lo,hi)
+#     diurnal:period=64:floor=0.25  # day curve: scale sizes by a sinusoid
+#     burst:at=100:len=16:lo=129:hi=701   # requests at..at+len-1 go bulk
+#
+# Bands are matched in directive order by cumulative probability (the last
+# band catches the remainder).  Every request consumes exactly one
+# ``random()`` + one ``integers()`` + one payload draw from the seeded
+# generator regardless of modifiers, so two plans sharing a prefix replay
+# identical traffic up to the first size-modified request (a size change
+# alters how many payload values are consumed, so streams legitimately
+# diverge from there on) — and the default heavy-tail plan replays the
+# pre-DSL hardcoded stream bit for bit.
+# ``diurnal`` is index-deterministic (no extra RNG draws): request j's size
+# scales by floor + (1-floor)·(1+sin(2πj/period))/2.
+
+#: the serving mix every existing gate was tuned on: 85% interactive
+#: (1-16 queries), 10% medium (17-128), 5% bulk (129-700) — the "millions
+#: of users" shape where most requests are small and concurrent, which is
+#: exactly what coalescing amortizes
+HEAVY_TAIL_PLAN = ("band:p=0.85:lo=1:hi=17;band:p=0.10:lo=17:hi=129;"
+                   "band:p=0.05:lo=129:hi=701")
+
+#: exemplar day-curve plan: the heavy-tail mix under a sinusoidal load
+#: envelope (trough at 25% of drawn size)
+DIURNAL_PLAN = HEAVY_TAIL_PLAN + ";diurnal:period=64:floor=0.25"
+
+#: exemplar burst plan: heavy-tail steady state with one 16-request bulk
+#: squall at request 100 (the coalescer/cold-tier stress shape)
+BURST_PLAN = HEAVY_TAIL_PLAN + ";burst:at=100:len=16:lo=129:hi=701"
+
+
+def parse_traffic_plan(spec: str):
+    """Parse a plan string → (bands, modifiers); raises ``ValueError`` on
+    an unknown directive or a malformed field (fail loudly at bench setup,
+    not mid-stream)."""
+    bands, mods = [], []
+    for raw in str(spec).split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        fields = [f.strip() for f in raw.split(":")]
+        kind, kv = fields[0], {}
+        for f in fields[1:]:
+            if "=" not in f:
+                raise ValueError(f"traffic plan field {f!r} is not k=v "
+                                 f"(directive {raw!r})")
+            key, val = f.split("=", 1)
+            kv[key.strip()] = float(val)
+        if kind == "band":
+            bands.append((kv.get("p", 1.0), int(kv["lo"]), int(kv["hi"])))
+        elif kind in ("diurnal", "burst"):
+            mods.append((kind, kv))
+        else:
+            raise ValueError(f"unknown traffic directive {kind!r} "
+                             f"(want band/diurnal/burst)")
+    if not bands:
+        raise ValueError("traffic plan needs at least one band directive")
+    return bands, mods
+
+
+def traffic_requests(spec: str, seed: int, n_requests: int, dim: int,
+                     dtype="float32"):
+    """Materialize *n_requests* query batches from the seeded plan —
+    a list of (size_j, dim) arrays of *dtype* (values ~ U[0,1), the
+    serve-bench payload contract)."""
+    import numpy as np
+
+    bands, mods = parse_traffic_plan(spec)
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for j in range(n_requests):
+        u = rng.random()
+        lo, hi = bands[-1][1], bands[-1][2]   # last band catches the tail
+        cum = 0.0
+        for p, b_lo, b_hi in bands:
+            cum += p
+            if u < cum:
+                lo, hi = b_lo, b_hi
+                break
+        scale = 1.0
+        for kind, kv in mods:
+            if kind == "burst":
+                at, ln = int(kv["at"]), int(kv["len"])
+                if at <= j < at + ln:
+                    lo, hi = int(kv["lo"]), int(kv["hi"])
+            else:  # diurnal: index-deterministic size envelope
+                floor = float(kv.get("floor", 0.25))
+                period = max(1.0, float(kv.get("period", 64)))
+                scale *= (floor + (1.0 - floor)
+                          * 0.5 * (1.0 + math.sin(2 * math.pi * j / period)))
+        s = int(rng.integers(lo, hi))
+        s = max(1, int(round(s * scale)))
+        reqs.append(rng.random((s, dim)).astype(dtype))
+    return reqs
+
+
 def serve_request_stream(seed: int, n_requests: int, dim: int,
                          dtype="float32"):
     """The serve bench's mixed-size request stream — ONE protocol shared by
     bench.py's ``serve`` headline metric and bench/bench_serve.py (the same
-    sharing rule as ``ivf_pq_bench_data``): request sizes are drawn from a
-    heavy-tailed serving mix, 85% interactive (1-16 queries), 10% medium
-    (17-128), 5% bulk (129-700) — the "millions of users" shape where most
-    requests are small and concurrent, which is exactly what coalescing
-    amortizes.  Returns a list of (size_j, dim) float arrays."""
-    import numpy as np
-
-    rng = np.random.default_rng(seed)
-    reqs = []
-    for _ in range(n_requests):
-        u = rng.random()
-        if u < 0.85:
-            s = int(rng.integers(1, 17))
-        elif u < 0.95:
-            s = int(rng.integers(17, 129))
-        else:
-            s = int(rng.integers(129, 701))
-        reqs.append(rng.random((s, dim)).astype(dtype))
-    return reqs
+    sharing rule as ``ivf_pq_bench_data``), now a named traffic plan:
+    the seeded :data:`HEAVY_TAIL_PLAN`, whose replay is bit-identical to
+    the pre-DSL hardcoded mix (tests/test_bench_common.py pins it), so
+    every existing serve gate sees unchanged traffic.  Returns a list of
+    (size_j, dim) float arrays."""
+    return traffic_requests(HEAVY_TAIL_PLAN, seed, n_requests, dim, dtype)
 
 
 #: Extra per-run fields a metric function stashes for the telemetry

@@ -41,6 +41,14 @@ class HotPath:
     pattern: str
     functions: Tuple[str, ...] = ()
     why: str = ""
+    #: Tiered-residency paths (``neighbors.tiering``): the cold-tier fetch
+    #: is a DESIGNED host↔device transfer.  ``staging=True`` widens the
+    #: host-transfer rule's surface set to the staging calls
+    #: (``device_put``/``Stream.stage``) and accepts the
+    #: ``tier-staging(hot-path-host-transfer): why`` marker at the one
+    #: sanctioned staging call site — everywhere else (and in every
+    #: non-staging hot path) that marker sanctions nothing.
+    staging: bool = False
 
     def matches(self, posix: str) -> bool:
         return self.pattern in posix
@@ -74,8 +82,19 @@ HOT_PATHS: Tuple[HotPath, ...] = (
             functions=("_knn_scan_impl", "_knn_scan_chunked"),
             why="the fused kNN scan program body"),
     HotPath("raft_tpu/neighbors/ivf_flat.py",
-            functions=("_search_batch_impl",),
-            why="the one-program ivf_flat batch search"),
+            functions=("_search_batch_impl", "_probe_search_impl"),
+            why="the one-program ivf_flat batch search (and its explicit-"
+                "probe scoring stage, which the tiered phases dispatch)"),
+    HotPath("raft_tpu/neighbors/tiering.py",
+            functions=("dispatch", "ingest", "warm", "_stage",
+                       "_run_cold", "_refine"),
+            staging=True,
+            why="the tiered two-phase dispatch path: per-row data crosses "
+                "the host/device boundary ONLY at the single staging call "
+                "site (cold-tile prefetch / refine-vector gather, "
+                "tier-staging-marked); any other fetch in these bodies "
+                "reintroduces the round-trip the tier split exists to "
+                "bound"),
     HotPath("raft_tpu/neighbors/ivf_pq.py",
             functions=("_search_batch_impl", "_full_search_impl",
                        "_scan_hoisted", "_encode_tile_impl",

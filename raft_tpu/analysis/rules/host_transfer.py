@@ -33,6 +33,18 @@ _HOST_TRANSFER_PATHS = frozenset({
     "jax.block_until_ready",
 })
 
+#: Staging surfaces, tracked ONLY inside ``staging=True`` registry entries
+#: (the tiered residency layer): host→device staging is part of that
+#: path's designed transfer budget, so it must flow through the single
+#: ``tier-staging(hot-path-host-transfer)``-marked call site — an unmarked
+#: ``device_put``/``Stream.stage`` there is an unbudgeted transfer.
+_STAGING_CALLS = ("device_put", "stage")
+
+#: the sanctioned-transfer marker for staging hot paths; spelled distinctly
+#: from the unified ``exempt(...)`` form so the one designed transfer reads
+#: as a budget declaration, not a waiver
+_STAGING_MARKER = "tier-staging(hot-path-host-transfer)"
+
 
 def _transfer_name(node, flow=None):
     """The banned-surface name this node uses, or None."""
@@ -96,16 +108,28 @@ def check_host_transfers(tree, lines, posix="raft_tpu/neighbors/ann_mnmg.py",
     module_wide = any(not hp.functions for hp in hits)
     spans = [] if module_wide else _function_spans(
         tree, {f for hp in hits for f in hp.functions})
+    # staging entries widen the surface set and accept the tier-staging
+    # marker; in a NON-staging hot path the marker sanctions nothing (the
+    # quarantine trio pins both directions)
+    staging = any(getattr(hp, "staging", False) for hp in hits)
 
     def in_scope(lineno):
         return module_wide or any(a <= lineno <= b for a, b in spans)
 
+    def staging_marked(lineno):
+        return staging and any(
+            _STAGING_MARKER in ln
+            for ln in lines[max(0, lineno - 2):lineno])
+
     found = {}
     for node in ast.walk(tree):
         name = _transfer_name(node, flow)
+        if (name is None and staging and isinstance(node, ast.Call)
+                and call_name(node) in _STAGING_CALLS):
+            name = call_name(node)
         if name is None or not in_scope(node.lineno):
             continue
-        if exempt(node.lineno):
+        if exempt(node.lineno) or staging_marked(node.lineno):
             continue
         found.setdefault((node.lineno, name.split(".")[-1]), name)
     where = "this declared hot path" if not module_wide else posix

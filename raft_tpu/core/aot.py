@@ -251,6 +251,16 @@ def _leaf_on_default(leaf, default) -> bool:
         return False
 
 
+def dispatch_device():
+    """The device AOT executables lower for and key on — the configured
+    ``jax.default_device`` or the first local device (the same lookup
+    :meth:`AotFunction._signature` performs).  Host-staged inputs (the
+    tiered cold-tier tiles, ``neighbors.tiering``; the serve engine's
+    coalesced blocks) must land on THIS device or the warmed executable's
+    signature would miss and the call would fall to the jit path."""
+    return jax.config.jax_default_device or jax.devices()[0]
+
+
 def aot_dispatchable(*values) -> bool:
     """True when an eager call may dispatch an AOT executable: no tracers
     (opaque to tracing) and every committed jax array on the default device
@@ -356,7 +366,7 @@ class AotFunction:
         ``jax.default_backend()``) must miss the cache rather than dispatch
         an executable built for another device.
         """
-        default = jax.config.jax_default_device or jax.devices()[0]
+        default = dispatch_device()
         sig = [("device", str(default))]
         for i, a in enumerate(args):
             if i in self._static:

@@ -82,6 +82,26 @@ class Stream:
                     if hasattr(leaf, "is_ready"):
                         self._inflight.append(leaf)
 
+    def stage(self, tree: Any, device: Any = None) -> Any:
+        """Copy a (pytree of) host array(s) to *device* on this lane and
+        record the transfer — the pinned-host → device staging primitive
+        the tiered cold-tier prefetch rides (``neighbors.tiering``).
+
+        ``jax.device_put`` enqueues the copy asynchronously, so a caller
+        can stage tile i+1 while tile i's compute is still in flight (the
+        reference stream pool's launch-ahead overlap); the recorded strong
+        refs keep the staged buffers alive until this lane observes them
+        done.  The default target is :func:`raft_tpu.core.aot.
+        dispatch_device` — staged inputs MUST land where the AOT
+        executables were lowered or the warmed signature would miss."""
+        import jax
+
+        from raft_tpu.core.aot import dispatch_device
+
+        staged = jax.device_put(tree, device or dispatch_device())
+        self.record(staged)
+        return staged
+
     def synchronize(self) -> None:
         """Interruptibly wait for all recorded work (reference
         ``handle.sync_stream`` → ``interruptible::synchronize``).

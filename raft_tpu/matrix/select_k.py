@@ -44,6 +44,11 @@ _FILTER_MIN_N = 4096
 #: k above this falls back to the single top-k (the candidate set and the
 #: block-extrema row would approach the input width)
 _FILTER_MAX_K = 128
+#: merge width at which the O(k²) rank-arithmetic merge loses to one
+#: stable top-k over the 2k-wide concatenation (the k×k comparison masks
+#: grow quadratically; the concat select is near-linear in k) — wide-k
+#: merges come from refine-ratio candidate runs, not the k ≤ 16 defaults
+_MERGE_CONCAT_MIN_K = 24
 
 
 def _worst_value(dtype, select_min: bool):
@@ -172,6 +177,18 @@ def _merge_sorted_runs_impl(a_vals, a_idx, b_vals, b_idx, k: int,
         b_key = jnp.where(jnp.isnan(b_vals), worst, b_vals)
     else:
         a_key, b_key = a_vals, b_vals
+    if k >= _MERGE_CONCAT_MIN_K and ka + kb >= k:
+        # wide-k branch: the rank path's k×k masks are quadratic in k, so
+        # past _MERGE_CONCAT_MIN_K one stable top-k over the concatenated
+        # runs wins.  Run a precedes run b in the concat, so the stable
+        # tie-break (lowest position) reproduces run-a-wins-ties; output
+        # values/ids gather from the RAW runs, so NaN entries survive.
+        cat_key = jnp.concatenate([a_key, b_key], axis=-1)
+        _, pos = jax.lax.top_k(-cat_key if select_min else cat_key, k)
+        cat_v = jnp.concatenate([a_vals, b_vals], axis=-1)
+        cat_i = jnp.concatenate([a_idx, b_idx], axis=-1)
+        return (jnp.take_along_axis(cat_v, pos, axis=-1),
+                jnp.take_along_axis(cat_i, pos, axis=-1))
     av = a_key[..., :, None]                                    # (…, ka, 1)
     bv = b_key[..., None, :]                                    # (…, 1, kb)
     if select_min:

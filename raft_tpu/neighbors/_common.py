@@ -77,6 +77,22 @@ def chunk_layout(counts: np.ndarray, chunk_cap: Optional[int] = None,
                        owner=owner, phys_sizes=phys_sizes)
 
 
+def remap_chunk_table(chunk_table: np.ndarray, row_map: np.ndarray,
+                      dummy: int) -> np.ndarray:
+    """Map a logical→physical chunk table through a physical-row
+    renumbering (numpy, host-side — the residency-split arithmetic of
+    ``neighbors.tiering``): entry ``r`` becomes ``row_map[r]``, and rows
+    the renumbering drops (``row_map[r] < 0``) fall to *dummy*, the
+    target block's reserved empty row.  Probing a dropped list then
+    gathers only masked dummy slots — sentinel scores, zero candidates —
+    which is exactly how the hot-phase scan skips cold-resident lists."""
+    # exempt(hot-path-host-transfer): (n_lists, max_chunks) table arithmetic
+    ct = np.asarray(chunk_table)
+    # exempt(hot-path-host-transfer): (n_phys,) renumber vector, host-side
+    out = np.asarray(row_map).astype(np.int64)[ct]
+    return np.where(out < 0, np.int64(dummy), out).astype(np.int32)
+
+
 @dataclasses.dataclass(frozen=True)
 class ExtendLayout:
     """Host-side table update for an incremental extend (see
@@ -345,6 +361,16 @@ def expand_probes(probe_ids, chunk_table, n_rows: int,
     worst case explicitly (the same static value on every shard: SPMD
     needs one program).
 
+    The budget is additionally capped at ``n_rows - 1``: a query's probed
+    lists can reference each REAL physical row at most once (probes are
+    distinct lists and a (list, chunk) pair owns one row), so columns past
+    the block's real row count could only ever score the masked dummy.
+    The cap never binds for a fully-resident index (there
+    ``n_probes + extra <= n_lists + (n_phys - n_lists) = n_rows - 1``) —
+    it is what makes a SMALL physical block (a tiered staging tile or a
+    compacted hot set, ``neighbors.tiering``) scan in O(block) steps
+    instead of O(n_probes + block).
+
     With ``return_ord=True`` also returns the PROBE ORDINAL (nq, budget)
     int32 of each physical slot — which of the query's n_probes coarse
     probes the slot's chunk belongs to (continuation chunks of one list
@@ -365,12 +391,18 @@ def expand_probes(probe_ids, chunk_table, n_rows: int,
     # chunk-major flattening: flat position j holds probe ordinal j % n_probes
     ord_flat = jnp.broadcast_to(
         jnp.arange(flat.shape[1], dtype=jnp.int32) % n_probes, flat.shape)
-    budget = min(flat.shape[1], n_probes + extra)
+    budget = max(1, min(flat.shape[1], n_probes + extra, n_rows - 1))
     if budget != flat.shape[1]:
         order = jnp.argsort(flat == dummy, axis=1, stable=True)[:, :budget]
         flat = jnp.take_along_axis(flat, order, axis=1)
         ord_flat = jnp.take_along_axis(ord_flat, order, axis=1)
     return (flat, ord_flat) if return_ord else flat
+
+
+# Width at which scan_probe_lists abandons the running merge for the
+# stacked one-shot select (see its docstring).  Family defaults (k ≤ 16)
+# stay on the proven small-k path; refine candidate scans (k·ratio) cross it.
+_SCAN_STACK_MIN_K = 24
 
 
 def scan_probe_lists(probe_ids, score_tile: Callable, list_indices,
@@ -403,20 +435,50 @@ def scan_probe_lists(probe_ids, score_tile: Callable, list_indices,
     default resolves outside their jit caches, via
     ``raft_tpu.kernels.resolve_engine``); the sorted-run merge is
     engine-agnostic because both engines emit identical sorted runs.
+
+    Wide k (``k >= _SCAN_STACK_MIN_K``) switches the loop body from the
+    running per-step (select_k + O(k²) sorted-run merge) to STACKING the
+    masked tile scores as scan ys and running ONE wide select over all
+    ``steps·cap`` candidates at the end.  Both per-step primitives scale
+    with k (the merge quadratically), so a k·ratio candidate scan
+    (``SearchParams.refine_ratio``) would cost ~4× the k it refines; the
+    stacked select is k-insensitive and lands in ``select_k``'s
+    block-extremum filter regime.  Output is BIT-IDENTICAL: the stacked
+    candidate order is step-major (step·cap + slot), exactly the order
+    the running merge ranks ties in (earlier step wins, then lower slot),
+    and both paths gather ids from the same masked views.  The trade is
+    an O(nq · steps · cap) transient instead of O(nq · (k + cap)) — the
+    caller's probe budget bounds it (tiered cold scans: O(nq · tile)).
     """
     nq = probe_ids.shape[0]
     cap = list_indices.shape[1]
     sentinel = jnp.asarray(jnp.inf if select_min else -jnp.inf, dtype)
     kk = min(k, cap)
+    n_steps = probe_ids.shape[1]
 
-    def step(carry, inp):
-        probe_col, extras = inp[0], inp[1:]
-        best_d, best_i = carry
+    def tile_scores(probe_col, extras):
         d = score_tile(probe_col, *extras).astype(dtype)
         ids = list_indices[probe_col]
         sizes = list_sizes[probe_col]
         live = jnp.arange(cap)[None, :] < sizes[:, None]
-        d = jnp.where(live, d, sentinel)
+        return jnp.where(live, d, sentinel), ids
+
+    if k >= _SCAN_STACK_MIN_K and n_steps * cap >= k:
+        def stack_step(carry, inp):
+            d, ids = tile_scores(inp[0], inp[1:])
+            return carry, (d, ids)
+
+        _, (ds, ids) = jax.lax.scan(
+            stack_step, 0,
+            (jnp.swapaxes(probe_ids, 0, 1),) + tuple(xs or ()))
+        ds = jnp.swapaxes(ds, 0, 1).reshape(nq, n_steps * cap)
+        ids = jnp.swapaxes(ids, 0, 1).reshape(nq, n_steps * cap)
+        return select_k(ds, k, select_min=select_min, indices=ids,
+                        engine=engine)
+
+    def step(carry, inp):
+        best_d, best_i = carry
+        d, ids = tile_scores(inp[0], inp[1:])
         # partial top-k of this probe tile, then an O(k²) sorted-run merge
         # into the running top-k (the brute-force scan's primitive) —
         # instead of re-sorting (k + cap) concatenated candidates per step

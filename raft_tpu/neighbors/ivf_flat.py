@@ -76,6 +76,12 @@ class SearchParams:
     """Reference ``ivf_flat::search_params`` (ivf_flat_types.hpp:118)."""
 
     n_probes: int = 20
+    # Exact re-rank ratio for TIERED serving (neighbors.tiering): search
+    # with k·ratio candidates, then re-score the survivors against the
+    # original host-tier vectors with exact distance.  None/1 disables.
+    # Honored by the tiered backend only — the fully-resident flat scan
+    # already scores exact distances, so there is nothing to refine.
+    refine_ratio: Optional[int] = None
 
 
 @jax.tree_util.register_pytree_node_class
@@ -365,13 +371,32 @@ def _search_batch_impl(queries, index_leaves, metric_val: int, k: int,
     """
     (centers, list_data, list_indices, phys_sizes, chunk_table) = index_leaves
     metric = DistanceType(metric_val)
-    is_ip = metric_val == int(DistanceType.InnerProduct)
-    is_cos = metric_val == int(DistanceType.CosineExpanded)
 
     # coarse ranking against centroids (reference :1120 linalg::gemm)
     cd = _coarse_distances(queries, centers, metric)
     _, probe_sel = select_k(cd, n_probes, select_min=True, engine=engine)
     probe_ids = probe_sel.astype(jnp.int32)
+    return _probe_search_impl(queries, probe_ids, index_leaves[1:],
+                              metric_val, k, sqrt, probe_extra, engine)
+
+
+def _probe_search_impl(queries, probe_ids, scan_leaves, metric_val: int,
+                       k: int, sqrt: bool, probe_extra: int = -1,
+                       engine: str = "xla"):
+    """The probe-scoring stage of :func:`_search_batch_impl` with the probe
+    set supplied EXPLICITLY: ``scan_leaves`` is the index leaves minus the
+    centroids — (list_data, list_indices, phys_sizes, chunk_table).
+
+    Split out so the tiered residency layer (``neighbors.tiering``) can run
+    the IDENTICAL scoring program over a doctored physical block (the
+    device-resident hot rows, or one staged cold tile) while computing the
+    probe selection once per batch: per-candidate distances here are a pure
+    function of (queries, gathered rows), so any residency split that
+    preserves row content scores bit-identically to the fully-resident
+    scan."""
+    (list_data, list_indices, phys_sizes, chunk_table) = scan_leaves
+    is_ip = metric_val == int(DistanceType.InnerProduct)
+    is_cos = metric_val == int(DistanceType.CosineExpanded)
 
     # Half-precision datasets (bf16/f16 — TPU-native) keep half-width MXU
     # inputs but accumulate scores in f32 (same contract as
@@ -420,6 +445,15 @@ _SEARCH_STATICS = (2, 3, 4, 5, 6, 7)
 _search_batch = functools.partial(jax.jit, static_argnums=_SEARCH_STATICS)(
     _search_batch_impl)
 _search_batch_aot = aot(_search_batch_impl, static_argnums=_SEARCH_STATICS)
+
+# Explicit-probe scoring stage (probe_ids is arg 1, so statics shift by
+# one vs _SEARCH_STATICS minus the n_probes slot) — the tiered hot/cold
+# phase programs dispatch this cache (neighbors.tiering).
+_PROBE_SEARCH_STATICS = (3, 4, 5, 6, 7)
+_probe_search = functools.partial(
+    jax.jit, static_argnums=_PROBE_SEARCH_STATICS)(_probe_search_impl)
+_probe_search_aot = aot(_probe_search_impl,
+                        static_argnums=_PROBE_SEARCH_STATICS)
 
 
 @hlo_program(

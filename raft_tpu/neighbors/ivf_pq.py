@@ -199,6 +199,13 @@ class SearchParams:
     # None → RAFT_TPU_HOISTED_LUT env gate (default on).  False forces the
     # pre-PR in-scan LUT recompute (the A/B baseline).
     hoisted_lut: Optional[bool] = None
+    # Exact re-rank ratio for TIERED serving (neighbors.tiering, the
+    # reference refine() recipe): the ADC scan returns k·ratio candidates,
+    # whose ORIGINAL vectors are gathered from the host tier and re-scored
+    # with exact distance — the recall safety net for compressed list
+    # storage (PR-3 triage: ADC ceiling 0.62 at this shape).  None/1
+    # disables; honored by the tiered backend.
+    refine_ratio: Optional[int] = None
 
 
 @jax.tree_util.register_pytree_node_class

@@ -43,11 +43,16 @@ _MAGIC = "raft-tpu-index"
 # list-side ADC tables ``list_adc``/``list_csum``; v1 archives still load —
 # the tables are recomputed from centers/rotation/codebooks + stored codes,
 # which is exact (pure functions of the trained model).
-_VERSIONS = {"ivf_flat": 1, "ivf_pq": 2, "sharded": 1}
+# tiered v1: the underlying family leaves (to_index reassembly) + the
+# residency policy (hot_lists mask, tile_phys) + the optional host refine
+# store — the residency SPLIT itself is recomputed at load (pure function
+# of mask + chunk table), never stored.
+_VERSIONS = {"ivf_flat": 1, "ivf_pq": 2, "sharded": 1, "tiered": 1}
 # Readable versions are per kind too: accepting another kind's version at
 # the gate would defer the failure to an obscure Index(**arrays) TypeError
 # instead of the clean unsupported-version error this check exists to give.
-_READABLE_VERSIONS = {"ivf_flat": (1,), "ivf_pq": (1, 2), "sharded": (1,)}
+_READABLE_VERSIONS = {"ivf_flat": (1,), "ivf_pq": (1, 2), "sharded": (1,),
+                      "tiered": (1,)}
 
 
 def _checksums(arrays: dict) -> dict:
@@ -207,6 +212,60 @@ def load_sharded(path, comms):
         for j in range(n_st))
     return ann_mnmg.ShardedIndex(aux["kind"], comms, replicated, stacked,
                                  dict(aux["aux"]))
+
+
+def save_tiered(path, tiered) -> None:
+    """Write a :class:`raft_tpu.neighbors.tiering.TieredIndex` to *path*
+    (``.npz``; atomic + checksummed — module docstring): the reassembled
+    family leaves plus the residency POLICY (hot-list mask, tile size) and
+    the host refine store.  The split blocks themselves are not stored —
+    load recuts them from the mask, bit-identically (the split is a pure
+    row permutation of the packed leaves)."""
+    from raft_tpu.neighbors import tiering
+
+    index = tiering.to_index(tiered)
+    if tiered.kind == "ivf_flat":
+        fam = {"metric": int(index.metric),
+               "adaptive_centers": bool(index.adaptive_centers)}
+    else:
+        fam = {"metric": int(index.metric),
+               "codebook_kind": int(index.codebook_kind),
+               "pq_bits": int(index.pq_bits),
+               "dataset_dtype": index.dataset_dtype}
+    aux = {"kind": tiered.kind, "tile_phys": int(tiered.tile_phys),
+           "family": fam}
+    arrays = {f.name: np.asarray(getattr(index, f.name))
+              for f in dataclasses.fields(index) if f.name not in fam}
+    arrays["tiered_hot_lists"] = np.asarray(tiered.hot_lists)
+    if tiered.refine_store is not None:
+        arrays["tiered_refine_store"] = np.asarray(tiered.refine_store)
+    _atomic_savez(path, _finish("tiered", arrays, aux))
+
+
+def load_tiered(path):
+    """Load a tiered index: rebuild the family Index from the archived
+    leaves, then re-tier under the ARCHIVED residency mask — the loaded
+    split (hot block, cold tiles, probe budgets) is bit-identical to the
+    saved one."""
+    from raft_tpu.neighbors import tiering
+
+    aux, a = _unpack(path, "tiered")
+    mask = a.pop("tiered_hot_lists").astype(bool)
+    store = a.pop("tiered_refine_store", None)
+    fam = aux["family"]
+    arrays = {k: jnp.asarray(v) for k, v in a.items()}
+    if aux["kind"] == "ivf_flat":
+        index = ivf_flat.Index(
+            **arrays, metric=DistanceType(fam["metric"]),
+            adaptive_centers=fam["adaptive_centers"])
+    else:
+        index = ivf_pq.Index(
+            **arrays, metric=DistanceType(fam["metric"]),
+            codebook_kind=ivf_pq.CodebookKind(fam["codebook_kind"]),
+            pq_bits=fam["pq_bits"],
+            dataset_dtype=fam.get("dataset_dtype", "float32"))
+    return tiering.tier(index, hot_lists=mask,
+                        tile_phys=int(aux["tile_phys"]), dataset=store)
 
 
 def load_ivf_pq(path) -> ivf_pq.Index:

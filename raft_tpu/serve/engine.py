@@ -79,7 +79,8 @@ from raft_tpu.core.aot import _bucket_dim
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import Handle
 from raft_tpu.distance.distance_types import DistanceType
-from raft_tpu.neighbors import ann_mnmg, brute_force, ivf_flat, ivf_pq
+from raft_tpu.neighbors import (ann_mnmg, brute_force, ivf_flat, ivf_pq,
+                                tiering)
 from raft_tpu.serve.admission import (AdmissionController, RejectedError,
                                       ServeRequest)
 from raft_tpu.serve.schedule import (CostModel, ReplicaRouter,
@@ -432,11 +433,46 @@ class _ReplicaBackend:
                                self.params)
 
 
+class _TieredBackend:
+    """Adapter: ``tiering.TieredIndex`` → the two-phase tiered searcher
+    (hot-set scan + staged cold-tile sweep + optional exact re-rank,
+    ``neighbors.tiering``).  Pure delegation, the ``_ShardedBackend``
+    precedent: the searcher owns the warmed hot/cold/refine/merge
+    signatures, the double-buffer staging lanes and the device-resident
+    hotness counters ``refresh(tiering.retier(...))`` re-tiers from."""
+
+    def __init__(self, tiered, k: int, params):
+        expects(k >= 1, "k must be >= 1")
+        self.tiered = tiered
+        self.params = params
+        self.name = f"tiered_{tiered.kind}"
+        self.searcher = tiered.searcher(int(k), params)
+        self.k = int(k)
+        self.dim = int(tiered.dim)
+
+    def ingest(self, q):
+        return self.searcher.ingest(q)
+
+    def batch_cap(self) -> Optional[int]:
+        return self.searcher.batch_cap()
+
+    def warm(self, bucket: int, dtype) -> None:
+        self.searcher.warm(bucket, dtype)
+
+    def dispatch(self, qb):
+        return self.searcher.dispatch(qb)
+
+    def solo(self, q):
+        return tiering.search(self.tiered, q, self.k, params=self.params)
+
+
 def _make_backend(index, k, params, metric, metric_arg, batch_size_index):
     if isinstance(index, ann_mnmg.ReplicaSet):
         return _ReplicaBackend(index, k, params)
     if isinstance(index, ann_mnmg.ShardedIndex):
         return _ShardedBackend(index, k, params)
+    if isinstance(index, tiering.TieredIndex):
+        return _TieredBackend(index, k, params)
     if isinstance(index, ivf_flat.Index):
         return _IvfFlatBackend(index, k, params)
     if isinstance(index, ivf_pq.Index):
@@ -464,7 +500,14 @@ class ServeEngine:
       multi-device backend: super-batches dispatch as ONE shard_map
       program across every device of the index's communicator (*params*
       is the underlying kind's SearchParams; brute-force sharded indexes
-      carry their metric themselves).
+      carry their metric themselves),
+    * :class:`raft_tpu.neighbors.tiering.TieredIndex` → the two-phase
+      host/device tiered backend (hot-set scan + double-buffered cold-tile
+      staging + optional ``refine_ratio`` exact re-rank, still
+      zero-compile warm; *params* is the underlying kind's SearchParams).
+      Re-tier off the request path via
+      ``engine.refresh(tiering.retier(tiered, hotness))`` with the
+      backend's ``searcher.hotness()`` counters.
 
     ``max_batch`` bounds one coalesced super-batch (and is the largest
     bucket :meth:`warmup` pins by default).  ``handle`` supplies the stream
@@ -796,6 +839,12 @@ class ServeEngine:
             body["scheduler"] = {
                 "quantum_s": self._sched_cfg.quantum_s,
                 "pending": len(self._pending)}
+        # tiered residency: hot/cold split + staging-tile footprint, so a
+        # scrape can see what re-tiering (refresh + tiering.retier) did
+        stats_fn = getattr(self._backend, "searcher", None)
+        stats_fn = getattr(stats_fn, "tier_stats", None)
+        if stats_fn is not None:
+            body["tiering"] = stats_fn()
         return body
 
     def serve_http(self, port: int = 0, host: str = "127.0.0.1", *,

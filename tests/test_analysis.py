@@ -564,6 +564,34 @@ class TestHostTransferRegistry:
         assert not findings("raft_tpu/cluster/kmeans.py", src,
                             "hot-path-host-transfer")
 
+    # -- the tier-staging quarantine trio (ISSUE 18): staging=True entries
+    # track device_put/stage as BUDGETED transfers that must carry the
+    # tier-staging marker; the marker is not a general waiver
+
+    def test_unmarked_staging_transfer_fires(self):
+        src = ("import jax\n\n\ndef _stage(tile):\n"
+               "    return jax.device_put(tile)\n")
+        f = findings("raft_tpu/neighbors/tiering.py", src,
+                     "hot-path-host-transfer")
+        assert f and "device_put" in f[0].message
+
+    def test_tier_staging_marker_sanctions_in_staging_scope(self):
+        src = ("import jax\n\n\ndef _stage(tile):\n"
+               "    # tier-staging(hot-path-host-transfer): O(tile) lane\n"
+               "    return jax.device_put(tile)\n")
+        assert not findings("raft_tpu/neighbors/tiering.py", src,
+                            "hot-path-host-transfer")
+
+    def test_tier_staging_marker_sanctions_nothing_elsewhere(self):
+        # in a NON-staging hot path (serve/engine.py) the marker is inert:
+        # banned fetches still fire — staging budgets don't leak out of
+        # the residency layer
+        src = ("import numpy as np\n\n\ndef dispatch(x):\n"
+               "    # tier-staging(hot-path-host-transfer): not a budget\n"
+               "    return np.asarray(x)\n")
+        assert findings("raft_tpu/serve/engine.py", src,
+                        "hot-path-host-transfer")
+
 
 # ---------------------------------------------------------------------------
 # the engine over the shipped tree
@@ -778,11 +806,12 @@ HloModule m, input_output_alias={ {0}: (1, {}, may-alias) }
 class TestShippedRegistry:
     def test_catalog(self):
         entries = {e.name: e for e in registry.iter_programs()}
-        # the ISSUE-15 floor: >= 14 hot-path programs declared — all three
+        # the ISSUE-18 floor: >= 16 hot-path programs declared — all three
         # serve backends in sharded one-allgather form (ISSUE 12), the
-        # three graduated Pallas kernels (ISSUE 13), and the replica-group
-        # program on the 2D shard × replica carve (ISSUE 15)
-        assert len(entries) >= 14, sorted(entries)
+        # three graduated Pallas kernels (ISSUE 13), the replica-group
+        # program on the 2D shard × replica carve (ISSUE 15), and the
+        # tiered cold-scan + exact-refine pair (ISSUE 18)
+        assert len(entries) >= 16, sorted(entries)
         for expected in ("brute_force.knn_scan", "ivf_flat.search_batch",
                          "ivf_pq.full_search", "ivf_pq.encode_tile",
                          "ivf_pq.csum_tile", "cluster.fused_em_step",
@@ -792,7 +821,8 @@ class TestShippedRegistry:
                          "ann_mnmg.brute_force_sharded",
                          "ann_mnmg.ivf_flat_replica_group",
                          "kernels.select_k", "kernels.fused_l2_nn",
-                         "kernels.ivf_pq_lut"):
+                         "kernels.ivf_pq_lut",
+                         "tiering.cold_scan", "tiering.refine"):
             assert expected in entries, expected
         # every single-device entry pins a zero-collective budget; the
         # sharded entries pin exactly one launch of the packed (nq, 2k)

@@ -1,0 +1,93 @@
+"""Trace-driven load-generator DSL (ISSUE 18; bench/common.py): the named
+HEAVY_TAIL_PLAN must replay the pre-DSL hardcoded request stream bit for
+bit (every serve gate in bench.py was tuned on that traffic), plan
+parsing must fail loudly, and the RNG-draw discipline (one random + one
+integers + one payload draw per request, modifiers draw nothing) must
+keep shared-prefix plans replay-compatible."""
+
+import math
+
+import numpy as np
+import pytest
+
+from bench.common import (
+    BURST_PLAN,
+    DIURNAL_PLAN,
+    HEAVY_TAIL_PLAN,
+    parse_traffic_plan,
+    serve_request_stream,
+    traffic_requests,
+)
+
+
+def _pre_dsl_stream(seed, n_requests, dim, dtype="float32"):
+    """The hardcoded generator serve_request_stream shipped before the
+    plan DSL — the replay-compatibility oracle, verbatim."""
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for _ in range(n_requests):
+        u = rng.random()
+        if u < 0.85:
+            s = int(rng.integers(1, 17))
+        elif u < 0.95:
+            s = int(rng.integers(17, 129))
+        else:
+            s = int(rng.integers(129, 701))
+        reqs.append(rng.random((s, dim)).astype(dtype))
+    return reqs
+
+
+class TestReplayCompatibility:
+    @pytest.mark.parametrize("seed", [0, 1, 3])
+    def test_heavy_tail_plan_is_bit_identical_to_pre_dsl(self, seed):
+        new = serve_request_stream(seed=seed, n_requests=120, dim=16)
+        old = _pre_dsl_stream(seed=seed, n_requests=120, dim=16)
+        assert len(new) == len(old)
+        for a, b in zip(new, old):
+            np.testing.assert_array_equal(a, b)
+
+    def test_shared_prefix_plans_replay_identically(self):
+        # modifiers consume no EXTRA RNG draws, so BURST replays the plain
+        # plan's traffic bit for bit up to the squall at request 100 (a
+        # size change alters how many payload values the stream consumes,
+        # so requests past the first modified one legitimately diverge)
+        base = traffic_requests(HEAVY_TAIL_PLAN, 5, 120, 8)
+        burst = traffic_requests(BURST_PLAN, 5, 120, 8)
+        for a, b in zip(base[:100], burst[:100]):
+            np.testing.assert_array_equal(a, b)
+        assert any(r.shape[0] != s.shape[0]
+                   for r, s in zip(base[100:116], burst[100:116]))
+
+    def test_diurnal_envelope_is_index_deterministic(self):
+        # a fixed-size band isolates the envelope: request j's size is
+        # pure arithmetic on j, no extra draws
+        day = traffic_requests(
+            "band:p=1.0:lo=100:hi=101;diurnal:period=64:floor=0.25",
+            2, 64, 4)
+        for j, b in enumerate(day):
+            scale = 0.25 + 0.75 * 0.5 * (1.0 + math.sin(2 * math.pi
+                                                        * j / 64.0))
+            assert b.shape[0] == max(1, int(round(100 * scale)))
+
+
+class TestPlanParsing:
+    def test_named_plans_parse(self):
+        for plan in (HEAVY_TAIL_PLAN, DIURNAL_PLAN, BURST_PLAN):
+            bands, mods = parse_traffic_plan(plan)
+            assert bands
+
+    def test_unknown_directive_fails_loudly(self):
+        with pytest.raises(ValueError, match="directive"):
+            parse_traffic_plan("band:p=1.0:lo=1:hi=2;lunar:phase=3")
+
+    def test_malformed_field_fails_loudly(self):
+        with pytest.raises(ValueError, match="k=v"):
+            parse_traffic_plan("band:p=1.0:lo")
+
+    def test_band_required(self):
+        with pytest.raises(ValueError, match="band"):
+            parse_traffic_plan("diurnal:period=64:floor=0.25")
+
+    def test_burst_overrides_band_sizes(self):
+        reqs = traffic_requests(BURST_PLAN, 9, 120, 4)
+        assert all(r.shape[0] >= 129 for r in reqs[100:116])

@@ -88,6 +88,41 @@ class TestMergeSortedRuns:
                                  b2, np.array([[5, 6, 7]], np.int32), k=6)
         np.testing.assert_array_equal(np.asarray(i), [[0, 5, 1, 2, 6, 7]])
 
+    @pytest.mark.parametrize("select_min", [True, False])
+    def test_wide_k_concat_branch_matches_rank_path(self, select_min,
+                                                    monkeypatch):
+        """Past _MERGE_CONCAT_MIN_K the merge switches from the O(k²)
+        rank arithmetic to one stable top-k over the concatenation
+        (ISSUE 18: refine-ratio candidate runs are merged at k·ratio).
+        Both paths must agree with the host oracle — including NaN tails
+        surviving as NaN values."""
+        import importlib
+
+        # raft_tpu.matrix re-exports the select_k FUNCTION over the module
+        sk_mod = importlib.import_module("raft_tpu.matrix.select_k")
+
+        k = 40
+        rng = np.random.default_rng(7)
+        a = np.sort(rng.random((5, k)).astype(np.float32), axis=1)
+        b = np.sort(rng.random((5, k)).astype(np.float32), axis=1)
+        if not select_min:
+            a, b = -a, -b
+        a[0, -2:] = np.nan                      # NaN tail stays a valid run
+        ai = rng.integers(0, 10_000, (5, k)).astype(np.int32)
+        bi = rng.integers(0, 10_000, (5, k)).astype(np.int32)
+        assert k >= sk_mod._MERGE_CONCAT_MIN_K  # the branch actually runs
+        wv, wi = merge_sorted_runs(a, ai, b, bi, k=k, select_min=select_min)
+        monkeypatch.setattr(sk_mod, "_MERGE_CONCAT_MIN_K", 10**9)
+        sk_mod._merge_aot._cache.clear()        # force a rank-path retrace
+        rv, ri = merge_sorted_runs(a, ai, b, bi, k=k, select_min=select_min)
+        sk_mod._merge_aot._cache.clear()        # don't leak the patched
+        np.testing.assert_array_equal(np.asarray(wi), np.asarray(ri))
+        np.testing.assert_array_equal(np.asarray(wv), np.asarray(rv))
+        ov, oi = _merge_oracle(np.where(np.isnan(a), np.inf if select_min
+                                        else -np.inf, a), ai, b, bi, k,
+                               select_min)
+        np.testing.assert_array_equal(np.asarray(wi), oi)
+
     def test_matches_select_k_over_concat(self):
         """merge(sorted runs) ≡ select_k(concat) on tie-free data — the
         exact substitution the scan makes."""
