@@ -17,7 +17,7 @@ value / estimate, where ≥0.8 meets the north-star target.
 Select a metric with
 BENCH_METRIC=pairwise|kmeans|kmeans_mnmg|ivf_pq|ivf_pq_search|ivf_build|
 lanczos|knn_bruteforce|serve|ann_sharded|serve_replica|select_k|
-tiered_serve.
+tiered_serve|serve_autotune.
 
 Robust bring-up (the round-1 failure was an unguarded TPU backend init):
 the measurement runs in a *child* process under a watchdog.  The parent
@@ -1058,6 +1058,208 @@ def bench_tiered_serve():
     }
 
 
+def bench_serve_autotune():
+    """Online autotuner gate (ISSUE 19; docs/serving.md §autotuning):
+    hand-set default vs tuner-promoted config on the diurnal+burst
+    traffic plan, paired best-of per PR 14.
+
+    Scenario: 30k×16 f32 IVF-Flat (n_lists=32), k=10, served at
+    max_batch=1024 with the full warmed ladder.  The hand-set default is
+    an accuracy-first ``n_probes=24`` (75% of the lists, recall@10 ≈
+    1.0 — the "conservative operator" config).  The tuner's candidate
+    space is the warmed bucket-cap ladder plus three ``SearchParams``
+    variants (n_probes 8/12/16 — measured recall@10 ≈ 0.92/0.98/0.995
+    on this corpus, so the 0.95 floor rejects 8 and the tuner buys its
+    win from 12 or 16), explored by successive halving over shadow
+    traffic (live shadow-ring samples topped up from the SAME traffic-
+    plan DSL) with an exact brute-force recall reference.  Gates, all
+    asserted before any number records:
+
+    * **zero compiles during explore AND after promotion** — counter-
+      asserted from after ``warm_candidates()`` (the one sanctioned
+      lowering stage) through explore, the refresh-swap promotion, and
+      every timed replay;
+    * **zero failed/shed live requests during shadow evaluation** — live
+      traffic is interleaved between shadow evaluations (every measure
+      call is followed by a live ``search()``) and each request must
+      return a result tuple with the engine's shed/expired counters
+      unmoved;
+    * the winner is a params variant promoted ATOMICALLY through
+      ``refresh`` (the cap candidates are coverage- or win-rejected),
+      with the decision trail exported through
+      ``raft_tpu_autotune_decisions_total``;
+    * **tuned beats the hand-set default by >= 10% qps at no-worse p99
+      (10% slack)** on the best paired replay — each pair replays the
+      same plan through default-then-tuned via zero-compile refresh
+      swaps, so ambient drift hits both sides alike;
+    * **recall floor held**: the promoted config's live results spot-
+      check >= 0.95 recall@10 against exact brute force.
+    """
+    import itertools
+
+    from bench.common import (DIURNAL_PLAN, record_extra_telemetry,
+                              traffic_requests)
+    from raft_tpu import telemetry
+    from raft_tpu.core.aot import aot_compile_counters
+    from raft_tpu.neighbors import brute_force, ivf_flat
+    from raft_tpu.serve import AutoTuner, ServeEngine, TunerConfig
+    from raft_tpu.serve.autotune import exact_reference
+
+    n, dim, k = 30_000, 16, 10
+    rng = np.random.default_rng(0)
+    # U[0,1) corpus matching the traffic-plan payload contract (queries
+    # are U[0,1) — bench/common.traffic_requests), so the recall oracle
+    # measures in-distribution behavior
+    x = rng.random((n, dim)).astype(np.float32)
+    index = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=8), x)
+    sp_default = ivf_flat.SearchParams(n_probes=24)
+    variants = [ivf_flat.SearchParams(n_probes=p) for p in (8, 12, 16)]
+
+    # the scored traffic: the shared diurnal plan with the burst window
+    # stacked on top (bench/common's plan DSL; bit-identical per seed)
+    plan = DIURNAL_PLAN + ";burst:at=100:len=16:lo=129:hi=701"
+    reqs = traffic_requests(plan, seed=3, n_requests=160, dim=dim)
+    live_chunks = [traffic_requests(plan, seed=50 + i, n_requests=4,
+                                    dim=dim) for i in range(8)]
+
+    eng = ServeEngine(index, k, sp_default, max_batch=1024)
+    eng.warmup()
+    eng.search(reqs[:8])  # plumbing warm + shadow-ring feed
+    reference = exact_reference(x, k)
+    # pre-lower the recall oracle's query-row buckets: the oracle is
+    # bench instrumentation (brute_force.knn, power-of-two bucketed),
+    # not the tuner — its compiles must not pollute the zero-compile
+    # window the gate asserts over
+    b = 1
+    while b <= 1024:
+        reference(np.zeros((b, dim), np.float32))
+        b *= 2
+    tuner = AutoTuner(
+        eng, TunerConfig(seed=0, pairs=3, shadow_requests=12,
+                         recall_floor=0.95, recall_probes=4),
+        param_variants=variants, shadow_plan=plan,
+        reference=reference)
+    tuner.warm_candidates()  # the ONE sanctioned lowering stage
+
+    # interleave REAL live traffic between shadow evaluations: every
+    # measure call is followed by a live search() through the engine —
+    # shadow evaluation must not fail, shed, or expire a single one
+    live_iter = itertools.cycle(live_chunks)
+    live_outs = []
+    inner_measure = tuner._measure
+
+    def measure_and_serve(cand, shadow_reqs):
+        score = inner_measure(cand, shadow_reqs)
+        live_outs.extend(eng.search(next(live_iter)))
+        return score
+
+    tuner._measure = measure_and_serve
+    shed0 = eng.stats["sheds"] + eng.stats["expired"]
+    err0 = eng.stats["dispatch_errors"] + eng.stats["ingest_errors"]
+    c0 = aot_compile_counters["compiles"]
+    report = tuner.run()
+    assert report["winner"] is not None, \
+        f"tuner promoted nothing: {report['decisions']}"
+    winner = next(c for c in tuner.candidates()
+                  if c.name == report["winner"])
+    assert winner.params is not None, (
+        f"winner {report['winner']} is not a params variant — the "
+        "coverage rule should have rejected the cap candidates")
+    assert live_outs and all(isinstance(o, tuple) for o in live_outs), \
+        "shadow evaluation failed live requests"
+    assert eng.stats["sheds"] + eng.stats["expired"] == shed0, \
+        "shadow evaluation shed live requests"
+    assert eng.stats["dispatch_errors"] + eng.stats["ingest_errors"] \
+        == err0, "shadow evaluation errored live requests"
+
+    # paired best-of replays: default-then-tuned per pair, flipped via
+    # the zero-compile refresh swap (every signature stays warm)
+    sp_tuned = winner.params
+
+    def timed_replay():
+        t0 = time.perf_counter()
+        outs = eng.search(reqs)
+        wall = time.perf_counter() - t0
+        lats = eng.last_latencies[-len(reqs):]
+        return (len(reqs) / wall, float(np.percentile(lats, 99)), outs)
+
+    best = {"default": 0.0, "tuned": 0.0}
+    p99 = {"default": float("inf"), "tuned": float("inf")}
+    pair_ratio = 0.0
+    outs_default = outs_tuned = None
+    for _ in range(3):
+        qd = qt = None
+        for name, sp in (("default", sp_default), ("tuned", sp_tuned)):
+            eng.refresh(index, params=sp)
+            q, p, outs = timed_replay()
+            best[name] = max(best[name], q)
+            p99[name] = min(p99[name], p)
+            if name == "default":
+                qd, outs_default = q, outs
+            else:
+                qt, outs_tuned = q, outs
+        pair_ratio = max(pair_ratio, qt / qd)
+    assert aot_compile_counters["compiles"] == c0, (
+        "explore/promote/replay compiled "
+        f"(+{aot_compile_counters['compiles'] - c0}) — the tuner left "
+        "the warmed signature space")
+    assert pair_ratio >= 1.10, (
+        f"tuned n_probes={sp_tuned.n_probes} qps {best['tuned']:.0f} "
+        f"< 110% of default n_probes={sp_default.n_probes} "
+        f"{best['default']:.0f} (best pair ratio {pair_ratio:.3f})")
+    assert p99["tuned"] <= p99["default"] * 1.10, (
+        f"tuned p99 {p99['tuned'] * 1e3:.1f} ms regressed past 10% "
+        f"slack over default {p99['default'] * 1e3:.1f} ms")
+
+    # recall floor held live: the tuned replay's results spot-checked
+    # against exact brute force over the original vectors
+    hit = tot = 0
+    for q, (_, ids) in list(zip(reqs, outs_tuned))[:8]:
+        _, exact_ids = brute_force.knn(x, q, k)
+        exact_ids = np.asarray(exact_ids)
+        ids = np.asarray(ids)
+        for row in range(ids.shape[0]):
+            hit += len(set(ids[row].tolist())
+                       & set(exact_ids[row].tolist()))
+            tot += k
+    live_recall = hit / max(tot, 1)
+    assert live_recall >= 0.95, (
+        f"promoted config recall {live_recall:.3f} broke the 0.95 floor")
+
+    dec = telemetry.REGISTRY.get("raft_tpu_autotune_decisions_total")
+    n_promote = sum(v for labels, v in dec.items()
+                    if labels == (eng._engine_id, "promote"))
+    assert n_promote == 1, "promotion not exported through telemetry"
+    record_extra_telemetry("autotune_winner", report["winner"])
+    record_extra_telemetry("autotune_evaluations", len(tuner.schedule))
+    record_extra_telemetry("autotune_live_recall", round(live_recall, 4))
+    eng.close()
+
+    return {
+        "metric": f"serve_autotune_ivf_flat_{n // 1000}kx{dim}_"
+                  f"req{len(reqs)}_k{k}",
+        "value": round(best["tuned"], 1),
+        "unit": "qps",
+        # the gate ratio: tuned over hand-set default, best paired replay
+        "vs_baseline": round(pair_ratio, 3),
+        "default_qps": round(best["default"], 1),
+        "tuned_qps": round(best["tuned"], 1),
+        "qps_ratio": round(pair_ratio, 3),
+        "default_p99_ms": round(p99["default"] * 1e3, 2),
+        "tuned_p99_ms": round(p99["tuned"] * 1e3, 2),
+        "default_n_probes": sp_default.n_probes,
+        "tuned_n_probes": sp_tuned.n_probes,
+        "winner": report["winner"],
+        "decisions": len(report["decisions"]),
+        "shadow_evaluations": len(tuner.schedule),
+        "live_during_explore": len(live_outs),
+        "live_recall": round(live_recall, 4),
+        "zero_compile_explore_promote": True,
+        "zero_live_failures": True,
+    }
+
+
 def bench_ivf_build():
     """Tiled vs monolithic IVF-PQ index construction A/B (ISSUE 7;
     docs/index_build.md): rows/s ingesting 100k×64 f32 into a pre-trained
@@ -1397,7 +1599,8 @@ _METRICS = {"pairwise": bench_pairwise, "kmeans": bench_kmeans,
             "serve": bench_serve, "ann_sharded": bench_ann_sharded,
             "serve_replica": bench_serve_replica,
             "select_k": bench_select_k,
-            "tiered_serve": bench_tiered_serve}
+            "tiered_serve": bench_tiered_serve,
+            "serve_autotune": bench_serve_autotune}
 
 #: Per-metric child-environment overrides.  The replica-scaling metric is
 #: a VIRTUAL-DEVICE contract gate (the 2D shard x replica carve needs a

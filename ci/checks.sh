@@ -48,6 +48,7 @@ mods = [
     "raft_tpu.neighbors.tiering",
     "raft_tpu.serve", "raft_tpu.serve.admission",
     "raft_tpu.serve.supervise", "raft_tpu.serve.schedule",
+    "raft_tpu.serve.autotune",
     "raft_tpu.core.aotstore", "raft_tpu.native",
     "raft_tpu.testing", "raft_tpu.testing.faults",
     "raft_tpu.kernels", "raft_tpu.kernels.engine",

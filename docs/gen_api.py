@@ -37,8 +37,9 @@ PACKAGES = [
               "admission + load shedding, supervised dispatch "
               "(watchdog/retry), atomic refresh, telemetry-steered "
               "continuous batching (quantum scheduler, streaming "
-              "submit()) and 2D shard x replica routing with fault "
-              "draining"),
+              "submit()), 2D shard x replica routing with fault "
+              "draining, and the online shadow-canary autotuner "
+              "(zero-compile knob search + atomic promotion)"),
     ("testing", "Deterministic fault-injection plane "
                 "(RAFT_TPU_FAULT_PLAN): seeded dispatch/comms/refresh "
                 "fault directives, off by default"),
@@ -136,7 +137,7 @@ _SUBMODULES = {
     # the continuous-batching policy objects (chooser, quantum rule,
     # replica router) live on the schedule submodule; the package
     # re-exports only the config/router classes
-    "serve": ["schedule"],
+    "serve": ["schedule", "autotune"],
 }
 
 

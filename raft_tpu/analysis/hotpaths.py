@@ -77,7 +77,11 @@ HOT_PATHS: Tuple[HotPath, ...] = (
                 "too: the chooser/router run per dispatch, so they must "
                 "stay pure host arithmetic — no device work, no raw "
                 "clocks, no swallowed errors (host-transfer + telemetry- "
-                "+ error-discipline all apply module-wide)"),
+                "+ error-discipline all apply module-wide).  The online "
+                "autotuner (autotune.py) is covered too: its shadow "
+                "replays dispatch real device work off-path, so its "
+                "result fetches carry the same exempt markers and its "
+                "explore loop must never reach a compile"),
     HotPath("raft_tpu/neighbors/brute_force.py",
             functions=("_knn_scan_impl", "_knn_scan_chunked"),
             why="the fused kNN scan program body"),

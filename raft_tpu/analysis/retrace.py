@@ -58,6 +58,7 @@ from raft_tpu.analysis.engine import REPO_ROOT, collect_files
 #: and the continuous-batching chooser (ISSUE 15) lives in schedule.py
 SERVE_MODULES = ("raft_tpu/serve/engine.py",
                  "raft_tpu/serve/schedule.py",
+                 "raft_tpu/serve/autotune.py",
                  "raft_tpu/neighbors/ann_mnmg.py")
 
 #: functions that map an unbounded value onto a finite signature ladder
@@ -567,6 +568,149 @@ def certify_scheduler_closure(files: Dict[str, ast.Module]
 
 
 # ---------------------------------------------------------------------------
+# certificate 2c: the online autotuner explores and promotes ONLY inside
+# the warmed signature space (ISSUE 19, docs/serving.md §autotuning)
+
+#: tuner stages that run AFTER warm_candidates(): none of them may lower
+#: or compile — exploration is zero-compile by construction
+_TUNER_HOT_FNS = ("explore", "_halve", "_measure_real", "_replay",
+                  "_recall_probe", "_live_ids")
+_TUNER_COMPILE_NAMES = frozenset(
+    {"warm", "warmup", "warm_candidates", "jit", "lower", "compile",
+     "aot", "mesh_aot", "_make_backend"})
+
+
+def certify_tuner_closure(files: Dict[str, ast.Module]
+                          ) -> List[ObligationReport]:
+    """The autotuner-side obligations: the candidate space derives from
+    the engine's warmed-signature ladder, every shadow-replay bucket is
+    bound through the certified ``_bucket_for`` ladder, no post-warm
+    tuner stage can reach a compile, promotion goes through the existing
+    ``refresh``/``apply_tuning`` swaps (never a raw backend assignment),
+    and ``apply_tuning`` validates a promoted cap against the warmed
+    registry.  Together with the bucket/scheduler closures these prove:
+    the tuner only selects pre-warmed (bucket, dtype, params)
+    signatures — zero-compile exploration AND promotion."""
+    out: List[ObligationReport] = []
+
+    def obligation(name, ok, why_fail, detail=""):
+        out.append(ObligationReport(
+            f"serve.tuner_closure.{name}", "ok" if ok else "fail",
+            [] if ok else [why_fail], detail))
+
+    tuner = files.get("raft_tpu/serve/autotune.py")
+    if tuner is None:
+        return [ObligationReport(
+            "serve.tuner_closure", "fail",
+            ["raft_tpu/serve/autotune.py not found — the tuner moved; "
+             "update SERVE_MODULES and re-prove the closure"])]
+
+    # candidates() derives the space FROM the warmed-signature ladder
+    cands = _function(tuner, "candidates")
+    from_warmed = cands is not None and any(
+        isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+        and n.func.attr == "warmed_signatures" for n in ast.walk(cands))
+    obligation(
+        "candidates_from_warmed", from_warmed,
+        "AutoTuner.candidates() no longer reads warmed_signatures() — "
+        "the candidate space left the certified warmed ladder")
+
+    # every shadow-replay bucket binding goes through the certified
+    # _bucket_for ladder (the chooser-side rule, applied to the tuner's
+    # off-path replay and recall-probe dispatches)
+    bindings, via_ladder = 0, 0
+    for fname in ("_replay", "_live_ids"):
+        fn = _function(tuner, fname)
+        if fn is None:
+            continue
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Name) and t.id == "bucket":
+                        bindings += 1
+                        if isinstance(n.value, ast.Call) and isinstance(
+                                n.value.func, ast.Attribute) \
+                                and n.value.func.attr == "_bucket_for":
+                            via_ladder += 1
+    obligation(
+        "shadow_bucket_via_ladder",
+        bindings >= 1 and bindings == via_ladder,
+        f"{bindings - via_ladder} of {bindings} bucket bindings in the "
+        "tuner's shadow replay do not come from the engine's _bucket_for "
+        "ladder — a shadow dispatch can mint an unwarmed signature",
+        f"{via_ladder} binding(s), all via _bucket_for")
+
+    # no post-warm tuner stage may reach a compile: warm/lower/compile
+    # calls are sanctioned ONLY in warm_candidates() (off the replay path)
+    offenders: List[str] = []
+    for fname in _TUNER_HOT_FNS:
+        fn = _function(tuner, fname)
+        if fn is None:
+            offenders.append(f"{fname}() not found — stage renamed; "
+                             "update the certificate")
+            continue
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            callee = (n.func.attr if isinstance(n.func, ast.Attribute)
+                      else n.func.id if isinstance(n.func, ast.Name)
+                      else None)
+            if callee in _TUNER_COMPILE_NAMES:
+                offenders.append(
+                    f"{fname}() calls `{callee}` at line {n.lineno}")
+    obligation(
+        "explore_no_compile", not offenders,
+        "a post-warm tuner stage can reach a compile — exploration is "
+        "no longer zero-compile by construction: "
+        + "; ".join(offenders),
+        f"{len(_TUNER_HOT_FNS)} stage(s) clean")
+
+    # promotion swaps ONLY through the certified engine surface:
+    # refresh() for params, apply_tuning() for host knobs — and neither
+    # promote nor rollback may assign a backend directly
+    promote = _function(tuner, "promote")
+    rollback = _function(tuner, "maybe_rollback")
+    via_refresh = promote is not None and all(
+        any(isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+            and n.func.attr == attr for n in ast.walk(promote))
+        for attr in ("refresh", "apply_tuning"))
+    obligation(
+        "promote_via_refresh", via_refresh,
+        "AutoTuner.promote() no longer swaps through "
+        "ServeEngine.refresh + apply_tuning — promotion escaped the "
+        "certified atomic-swap surface")
+    raw_swap = []
+    for fn in (promote, rollback):
+        if fn is None:
+            continue
+        for n in ast.walk(fn):
+            if isinstance(n, (ast.Assign, ast.AugAssign)):
+                targets = (n.targets if isinstance(n, ast.Assign)
+                           else [n.target])
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and t.attr == "_backend":
+                        raw_swap.append(f"{fn.name}() line {t.lineno}")
+    obligation(
+        "no_raw_backend_swap", rollback is not None and not raw_swap,
+        "promotion/rollback assigns _backend directly (bypassing the "
+        "refresh swap's warm-before-swap protocol): "
+        + ("; ".join(raw_swap) or "maybe_rollback() not found"))
+
+    engine = files.get("raft_tpu/serve/engine.py")
+    apply_fn = None if engine is None else _function(engine, "apply_tuning")
+    validates = apply_fn is not None and any(
+        isinstance(n, ast.Attribute) and n.attr == "_warmed"
+        for n in ast.walk(apply_fn))
+    obligation(
+        "engine_caps_in_ladder", validates,
+        "ServeEngine.apply_tuning no longer validates max_batch against "
+        "the warmed registry — a promoted cap could leave the certified "
+        "ladder")
+    return out
+
+
+# ---------------------------------------------------------------------------
 # certificate 3: static-arg value cardinality at aot() call sites
 
 
@@ -744,6 +888,7 @@ def run(names: Optional[Sequence[str]] = None, *, out=None,
     reports.extend(certify_backend_coverage(serve_files))
     reports.extend(certify_bucket_closure(serve_files))
     reports.extend(certify_scheduler_closure(serve_files))
+    reports.extend(certify_tuner_closure(serve_files))
 
     # cardinality scan over the library (or the caller-supplied roots)
     card_findings: List[str] = []

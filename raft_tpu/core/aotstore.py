@@ -33,10 +33,11 @@ dependency.
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import pickle
 import tempfile
-from typing import Any, Optional
+from typing import Any, Dict, Optional, Tuple
 
 from raft_tpu.core.logger import log_warn
 
@@ -126,6 +127,60 @@ class ExecutableStore:
         except OSError as e:
             self._warn(f"write failed for {name} ({e!r})")
             return False
+
+    # -- per-signature cost rows (serve cold-start seeding) ---------------
+    def _cost_file(self, fn: str) -> str:
+        digest = hashlib.sha256(
+            f"{_entry_scope()}|costs|{fn}".encode()).hexdigest()
+        return os.path.join(self.path, f"{digest[:32]}.costs.json")
+
+    def save_costs(self, fn: str,
+                   rows: Dict[Tuple[str, int], float]) -> bool:
+        """Persist one backend program's observed per-(dtype, bucket)
+        service-time rows next to its executables (atomic write, merged
+        over any existing manifest).  ``ServeEngine.close()`` writes
+        these; the next process's engine construction seeds its scheduler
+        cost model from them — real costs on the very first decision
+        after a store-warm restart, not the static fallback."""
+        merged = {f"{dt}|{int(b)}": float(v)
+                  for (dt, b), v in rows.items() if float(v) > 0.0}
+        if not merged:
+            return False
+        prior = self.load_costs(fn)
+        for (dt, b), v in prior.items():
+            merged.setdefault(f"{dt}|{int(b)}", v)
+        path = self._cost_file(fn)
+        try:
+            fd, tmp = tempfile.mkstemp(dir=self.path, suffix=".tmp")
+            with os.fdopen(fd, "w") as f:
+                json.dump({"schema": SCHEMA, "fn": fn, "rows": merged}, f)
+            os.replace(tmp, path)  # atomic: no torn manifests
+            return True
+        except OSError as e:
+            self._warn(f"cost-manifest write failed for {fn} ({e!r})")
+            return False
+
+    def load_costs(self, fn: str) -> Dict[Tuple[str, int], float]:
+        """The persisted per-(dtype, bucket) cost rows for one backend
+        program — empty on miss/corruption (costs are an accelerator,
+        never a correctness dependency, like the executables)."""
+        try:
+            with open(self._cost_file(fn)) as f:
+                payload = json.load(f)
+        except FileNotFoundError:
+            return {}
+        except (OSError, ValueError) as e:
+            self._warn(f"unreadable cost manifest for {fn} ({e!r})")
+            return {}
+        out: Dict[Tuple[str, int], float] = {}
+        try:
+            for key, v in payload["rows"].items():
+                dt, _, b = key.rpartition("|")
+                out[(dt, int(b))] = float(v)
+        except (KeyError, TypeError, ValueError) as e:
+            self._warn(f"malformed cost manifest for {fn} ({e!r})")
+            return {}
+        return out
 
     def _warn(self, msg: str) -> None:
         if not self._warned:

@@ -14,12 +14,21 @@ Public surface:
   :class:`DispatchSupervisor` + :class:`WatchdogTimeout` /
   :class:`DispatchError` (watchdog, bounded retry/backoff,
   fail-fast classification).
+- :class:`AutoTuner` + :class:`TunerConfig` / :class:`Candidate`
+  (docs/serving.md §autotuning): online shadow-canary knob search over
+  the certified warmed-signature ladder with atomic zero-compile
+  promotion through ``refresh`` and a guarded rollback window.
 """
 
 from raft_tpu.serve.admission import (  # noqa: F401
     AdmissionController,
     RejectedError,
     ServeRequest,
+)
+from raft_tpu.serve.autotune import (  # noqa: F401
+    AutoTuner,
+    Candidate,
+    TunerConfig,
 )
 from raft_tpu.serve.engine import ServeEngine  # noqa: F401
 from raft_tpu.serve.schedule import (  # noqa: F401
@@ -36,4 +45,4 @@ from raft_tpu.serve.supervise import (  # noqa: F401
 __all__ = ["ServeEngine", "ServeRequest", "AdmissionController",
            "RejectedError", "DispatchSupervisor", "DispatchError",
            "WatchdogTimeout", "SchedulerConfig", "CostModel",
-           "ReplicaRouter"]
+           "ReplicaRouter", "AutoTuner", "TunerConfig", "Candidate"]

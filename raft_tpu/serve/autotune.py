@@ -1,0 +1,664 @@
+"""Online serving autotuner (docs/serving.md §autotuning): shadow-canary
+knob search with atomic zero-compile promotion.
+
+Every serving knob the PR arc accumulated — the bucket-ladder cap,
+``n_probes``/``refine_ratio`` (and kernel-engine choice) inside the
+backend's ``SearchParams``, the scheduler quantum — was hand-set, while
+the runtime already measures everything needed to set them: per-bucket
+cost EWMAs, per-request completion latencies, per-program device seconds.
+This module closes the loop, under three hard constraints that make an
+ONLINE tuner safe on a serving process:
+
+* **Zero-compile exploration by construction.**  The candidate space is
+  derived from the engine's certified warmed-signature ladder
+  (:meth:`ServeEngine.warmed_signatures`): bucket-cap candidates are
+  SUBSETS of the warmed set, and backend-params candidates (``n_probes``,
+  ``refine_ratio``, engine choice) are pre-lowered once by
+  :meth:`AutoTuner.warm_candidates` — off the request path, through the
+  same shared ``aot()`` caches ``warmup()`` pins — before any shadow
+  traffic flows.  After that, explore and promotion dispatch only warmed
+  executables (the retrace certifier pins this statically:
+  ``serve.tuner_closure.*`` obligations; the bench counter-asserts it at
+  runtime).
+* **Shadow evaluation off the serving path.**  Candidates replay shadow
+  traffic — sampled live requests from the engine's bounded shadow ring
+  plus (optionally) the bench traffic-plan DSL — against an off-path
+  warmed lane: a param candidate's own pre-warmed backend, or (replica
+  engines) a :meth:`~raft_tpu.serve.schedule.ReplicaRouter.drain`-ed
+  replica lane.  Live requests are never queued behind, shed for, or
+  failed by an evaluation.  Scores are measured qps / p99 under a
+  recall-probe floor (exact re-rank spot checks: pass ``reference=`` an
+  exact oracle, e.g. a boosted-``refine_ratio`` tiered searcher or
+  :func:`exact_reference`).
+* **Atomic promotion, guarded rollback.**  A winner is selected by
+  successive halving and promoted ONLY on a statistically paired win
+  (min-over-pairs objective ratio, the PR 14 paired best-of protocol):
+  backend params swap atomically through the existing
+  ``ServeEngine.refresh`` (all signatures already warm → the swap's
+  re-lower is pure cache hits), host knobs through
+  ``ServeEngine.apply_tuning``.  For ``rollback_window_s`` after a
+  promotion, a live p99 regression beyond ``rollback_p99_rel`` × the
+  pre-promotion p99 reverts the whole decision.
+
+Every decision (candidate, scores, promote/reject/rollback) exports
+through ``raft_tpu_autotune_*`` registry counters/gauges (visible in
+``/varz`` like every registry metric) and in the engine's ``/healthz``
+body (``autotune`` sub-object).
+
+Determinism: the candidate schedule and the shadow-traffic sampling
+derive from one seed (``TunerConfig.seed``), exactly like
+``testing/faults.py`` — same seed + same measurement stream ⇒ identical
+candidate schedule and identical promote/reject decisions (tier-1 pins
+this with an injected ``measure=``).
+
+The serve hot-path rules apply module-wide (no ``jax.jit``/``jax.lax``,
+``telemetry.now()`` for clocks, typed errors, marked host fetches).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu import telemetry
+from raft_tpu.core.error import expects
+from raft_tpu.serve.schedule import choose_batches
+
+#: decision labels exported via raft_tpu_autotune_decisions_total
+DECISIONS = ("promote", "reject", "rollback")
+
+
+@dataclasses.dataclass(frozen=True)
+class TunerConfig:
+    """The autotuner's knobs (all decisions derive from ``seed``)."""
+
+    #: candidate-schedule + shadow-sampling seed (testing/faults.py
+    #: precedent: one seed, bit-identical schedule on replay)
+    seed: int = 0
+    #: shadow requests per evaluation in round 0 (grows ×eta per round)
+    shadow_requests: int = 24
+    #: successive-halving factor: keep len//eta candidates per round and
+    #: multiply the shadow budget by eta
+    eta: int = 2
+    #: paired candidate/baseline replays per evaluation (the PR 14 paired
+    #: best-of protocol: each pair replays the SAME request set through
+    #: both configs back-to-back, so ambient drift hits both sides)
+    pairs: int = 3
+    #: paired win margin: the candidate must beat the baseline objective
+    #: by this relative margin in EVERY pair to promote
+    min_win_rel: float = 0.10
+    #: "equal p99 / equal qps" tolerance for the win rule's held axis
+    slack_rel: float = 0.10
+    #: recall-probe floor: a candidate whose probe recall drops below this
+    #: is rejected regardless of speed
+    recall_floor: float = 0.95
+    #: requests spot-checked against the recall reference per evaluation
+    recall_probes: int = 4
+    #: bound on the derived candidate set (seeded subsample above it)
+    max_candidates: int = 16
+    #: live-p99 guard window after a promotion
+    rollback_window_s: float = 30.0
+    #: rollback when live p99 exceeds this multiple of the pre-promotion
+    #: p99 inside the window
+    rollback_p99_rel: float = 1.5
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One point of the bounded knob space.
+
+    ``params`` is a backend ``SearchParams`` variant (``n_probes``,
+    ``refine_ratio``, kernel-engine choice — promoted via ``refresh``);
+    ``max_batch`` caps the planner's bucket ladder at a WARMED bucket;
+    ``quantum_s`` retunes the streaming scheduler.  ``None`` fields keep
+    the serving value."""
+
+    name: str
+    params: Any = None
+    max_batch: Optional[int] = None
+    quantum_s: Optional[float] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Score:
+    """One shadow evaluation's measurements.  ``served`` is the fraction
+    of the request set the candidate could serve inside its warmed
+    ladder — the coverage rule rejects any candidate that serves less
+    than the baseline (qps over a shrunken request set is not a win)."""
+
+    qps: float
+    p99_s: float
+    recall: float
+    served: float = 1.0
+
+
+#: the no-change candidate every pair measures against
+BASELINE = Candidate("baseline")
+
+
+def exact_reference(dataset: np.ndarray, k: int
+                    ) -> Callable[[np.ndarray], np.ndarray]:
+    """An exact brute-force recall oracle over *dataset* — the
+    spot-check equivalent of a full-refine re-rank (tiered engines can
+    instead pass a boosted-``refine_ratio`` searcher's ids)."""
+    def _ref(q: np.ndarray) -> np.ndarray:
+        from raft_tpu.neighbors import brute_force
+
+        _d, i = brute_force.knn(dataset, q, k)
+        # exempt(hot-path-host-transfer): recall-oracle result fetch
+        return np.array(i)
+    return _ref
+
+
+class AutoTuner:
+    """Online shadow-canary tuner for one :class:`ServeEngine`.
+
+    Lifecycle: :meth:`warm_candidates` (pre-lowers param-variant
+    backends; the ONLY stage allowed to compile) → :meth:`explore`
+    (successive halving over shadow replays) → :meth:`promote` on a
+    paired win → :meth:`maybe_rollback` while the guard window is open.
+    :meth:`run` chains the first three.  Constructing the tuner attaches
+    it to the engine's ``/healthz`` body (``autotune`` sub-object)."""
+
+    def __init__(self, engine, config: Optional[TunerConfig] = None, *,
+                 param_variants: Sequence[Any] = (),
+                 extra_candidates: Sequence[Candidate] = (),
+                 shadow_plan: Optional[Any] = None,
+                 shadow_lane: Optional[int] = None,
+                 reference: Optional[Callable[[np.ndarray],
+                                              np.ndarray]] = None,
+                 measure: Optional[Callable[[Candidate, List[np.ndarray]],
+                                            Score]] = None):
+        self.engine = engine
+        self.cfg = config or TunerConfig()
+        expects(self.cfg.eta >= 2, "TunerConfig.eta must be >= 2")
+        expects(self.cfg.pairs >= 1, "TunerConfig.pairs must be >= 1")
+        self._variants = tuple(param_variants)
+        self._extra = tuple(extra_candidates)
+        #: bench traffic-plan DSL spec (str → resolved through
+        #: bench.common.traffic_requests, lazily) or a callable
+        #: ``(seed, n, dim, dtype) -> [arrays]`` supplying synthetic fill
+        self._plan = shadow_plan
+        #: replica engines: the drained lane shadow replays dispatch to
+        self._shadow_lane = shadow_lane
+        self._reference = reference
+        self._measure = measure or self._measure_real
+        #: name -> pre-warmed off-path backend (param variants only)
+        self._shadow: Dict[str, Any] = {}
+        #: the evaluation order actually executed: (round, candidate)
+        self.schedule: List[Tuple[int, str]] = []
+        #: every decision taken: (candidate, decision, why)
+        self.decisions: List[Tuple[str, str, str]] = []
+        self._winner_scores: Optional[Tuple[List[Score], List[Score]]] = None
+        self._promoted: Optional[Candidate] = None
+        self._previous: Optional[Dict[str, Any]] = None
+        self._promoted_at = 0.0
+        self._pre_p99: Optional[float] = None
+        self._label = (getattr(engine, "_engine_id", "?"),)
+        self._evals = telemetry.counter(
+            "raft_tpu_autotune_evals_total",
+            "shadow evaluations executed per candidate",
+            labelnames=("engine", "candidate"))
+        self._decisions_c = telemetry.counter(
+            "raft_tpu_autotune_decisions_total",
+            "tuner decisions by kind (promote/reject/rollback)",
+            labelnames=("engine", "decision"))
+        self._rounds = telemetry.counter(
+            "raft_tpu_autotune_rounds_total",
+            "successive-halving rounds executed",
+            labelnames=("engine",))
+        self._skipped = telemetry.counter(
+            "raft_tpu_autotune_shadow_skipped_total",
+            "shadow requests skipped (rows above the warmed ladder cap)",
+            labelnames=("engine",))
+        self._exploring = telemetry.gauge(
+            "raft_tpu_autotune_exploring",
+            "1 while a tune cycle's explore phase is running",
+            labelnames=("engine",))
+        self._qps_g = telemetry.gauge(
+            "raft_tpu_autotune_qps",
+            "best-pair shadow qps per candidate",
+            labelnames=("engine", "candidate"))
+        self._p99_g = telemetry.gauge(
+            "raft_tpu_autotune_p99_seconds",
+            "best-pair shadow p99 per candidate",
+            labelnames=("engine", "candidate"))
+        self._recall_g = telemetry.gauge(
+            "raft_tpu_autotune_recall",
+            "worst-pair probe recall per candidate",
+            labelnames=("engine", "candidate"))
+        engine.attach_tuner(self)
+
+    # -- candidate space ----------------------------------------------------
+    def candidates(self) -> List[Candidate]:
+        """Derive the bounded candidate space from the engine's certified
+        warmed-signature ladder.
+
+        Bucket-cap candidates (one per warmed bucket ≠ the serving cap)
+        are subsets of the warmed set — trivially zero-compile; operator-
+        supplied ``param_variants`` become backend candidates that
+        :meth:`warm_candidates` must pre-lower; ``extra_candidates`` pass
+        through (e.g. quantum retunes).  The set is deterministic for a
+        given engine state + seed: enumeration order is fixed and the
+        over-bound subsample uses the config seed."""
+        eng = self.engine
+        sigs = eng.warmed_signatures()
+        buckets = sorted({b for bs in sigs.values() for b in bs})
+        expects(buckets, "candidates() before warmup(): the ladder is "
+                         "empty, there is nothing certified to explore")
+        out: List[Candidate] = [BASELINE]
+        for b in buckets:
+            if b != eng.max_batch:
+                out.append(Candidate(f"cap{b}", max_batch=b))
+        for i, p in enumerate(self._variants):
+            out.append(Candidate(f"params{i}", params=p))
+        out.extend(self._extra)
+        if len(out) > self.cfg.max_candidates:
+            rng = np.random.default_rng(self.cfg.seed)
+            tail = out[1:]
+            keep = rng.choice(len(tail), size=self.cfg.max_candidates - 1,
+                              replace=False)
+            out = [out[0]] + [tail[i] for i in sorted(keep)]
+        return out
+
+    # -- zero-compile pre-warm ----------------------------------------------
+    def warm_candidates(self) -> int:
+        """Pre-lower every params-variant candidate across the engine's
+        warmed (bucket, dtype) ladder — the ONE tuner stage where
+        compiles are sanctioned (exactly like ``warmup()``/``refresh()``,
+        off the request path).  The shadow backends share the library's
+        ``aot()`` caches, so a later promotion's ``refresh`` re-lower is
+        pure cache hits.  Returns the number of signatures ensured."""
+        from raft_tpu.serve.engine import _make_backend
+
+        eng = self.engine
+        sigs = eng.warmed_signatures()
+        c = dict(eng._ctor)
+        n = 0
+        for cand in self.candidates():
+            if cand.params is None or cand.name in self._shadow:
+                continue
+            be = _make_backend(eng.index, c["k"], cand.params, c["metric"],
+                               c["metric_arg"], c["batch_size_index"])
+            for dt, bs in sigs.items():
+                for b in bs:
+                    be.warm(b, jnp.dtype(dt))
+                    n += 1
+            self._shadow[cand.name] = be
+        return n
+
+    # -- shadow traffic -----------------------------------------------------
+    def shadow_traffic(self, n: int, seed: int) -> List[np.ndarray]:
+        """*n* shadow request arrays: a seeded sample of the engine's live
+        shadow ring, topped up from the traffic-plan DSL (``shadow_plan``)
+        when the ring cannot fill the budget.  Deterministic per seed for
+        a fixed ring state + plan."""
+        rng = np.random.default_rng(seed)
+        live = self.engine.shadow_samples()
+        reqs: List[np.ndarray] = []
+        if live:
+            take = min(n, len(live))
+            idx = rng.choice(len(live), size=take, replace=(len(live) < n))
+            reqs = [live[i] for i in idx]
+        fill = n - len(reqs)
+        if fill > 0 and self._plan is not None:
+            be = self.engine._backend
+            if callable(self._plan):
+                reqs.extend(self._plan(seed, fill, be.dim, "float32"))
+            else:
+                from bench.common import traffic_requests
+
+                reqs.extend(traffic_requests(str(self._plan), seed, fill,
+                                             be.dim, "float32"))
+        return reqs
+
+    # -- measurement --------------------------------------------------------
+    @staticmethod
+    def objective(s: Score) -> float:
+        """The scalar ranking objective within a halving round: qps per
+        unit p99 (the promote decision itself uses :meth:`paired_win`,
+        which holds one axis and requires a win on the other)."""
+        return s.qps / max(s.p99_s, 1e-9)
+
+    def paired_win(self, cand: Sequence[Score],
+                   base: Sequence[Score]) -> bool:
+        """The statistically paired promotion rule: in EVERY pair the
+        candidate must win qps by ``min_win_rel`` at no-worse p99 (within
+        ``slack_rel``), or win p99 by ``min_win_rel`` at no-worse qps —
+        min-over-pairs, so one lucky replay cannot promote."""
+        cfg = self.cfg
+        for cs, bs in zip(cand, base):
+            qps_win = (cs.qps >= (1.0 + cfg.min_win_rel) * bs.qps
+                       and cs.p99_s <= bs.p99_s * (1.0 + cfg.slack_rel))
+            p99_win = (cs.p99_s * (1.0 + cfg.min_win_rel) <= bs.p99_s
+                       and cs.qps >= bs.qps * (1.0 - cfg.slack_rel))
+            if not (qps_win or p99_win):
+                return False
+        return True
+
+    def _measure_real(self, cand: Candidate,
+                      requests: List[np.ndarray]) -> Score:
+        """Replay *requests* against the candidate's off-path lane and
+        measure (qps, p99, probe recall).  Param candidates replay
+        through their pre-warmed shadow backend; knob candidates through
+        the live backend's warmed executables (on the drained
+        ``shadow_lane`` for replica engines) — never through the engine
+        lock, admission, or router, so live traffic is untouched."""
+        expects(requests, "no shadow traffic: serve some requests first "
+                          "or pass shadow_plan=")
+        eng = self.engine
+        be = self._shadow.get(cand.name)
+        lane = None
+        if be is None:
+            be = eng._backend
+            lane = self._shadow_lane
+        cap = cand.max_batch if cand.max_batch is not None \
+            else eng.max_batch
+        qps, p99, results, served = self._replay(be, requests, cap, lane)
+        recall = self._recall_probe(requests, results, served)
+        return Score(qps=qps, p99_s=p99, recall=recall,
+                     served=len(served) / len(requests))
+
+    def _replay(self, be, requests: List[np.ndarray], cap: int,
+                lane: Optional[int]):
+        """Coalesce + dispatch *requests* exactly like the engine's plan
+        stage — buckets bound ONLY through the certified ``_bucket_for``
+        ladder over the warmed set (capped at the candidate's ladder cap),
+        so every dispatch hits a pre-lowered executable."""
+        eng = self.engine
+        sigs = eng.warmed_signatures()
+        ingested = [be.ingest(q) for q in requests]
+        results: List[Optional[Tuple[np.ndarray, np.ndarray]]] = \
+            [None] * len(requests)
+        lat = [0.0] * len(requests)
+        by_dtype: Dict[str, List[int]] = {}
+        skipped = 0
+        for j, q in enumerate(ingested):
+            dt = str(q.dtype)
+            warmed = {b for b in sigs.get(dt, ()) if b <= cap}
+            if not warmed or q.shape[0] > max(warmed) \
+                    or q.shape[0] == 0:
+                skipped += 1  # stays zero-compile: never solo off-path
+                continue
+            by_dtype.setdefault(dt, []).append(j)
+        if skipped:
+            self._skipped.inc(skipped, self._label)
+        t_start = telemetry.now()
+        n_served = 0
+        for dt, idxs in by_dtype.items():
+            warmed = {b for b in sigs.get(dt, ()) if b <= cap}
+            max_bucket = max(warmed)
+            sizes = [int(ingested[j].shape[0]) for j in idxs]
+            batches, _solo = choose_batches(
+                sizes, [None] * len(sizes),
+                lambda total, w=warmed: eng._bucket_for(total, w),
+                max_bucket, eng._cost, dt, telemetry.now())
+            for batch in batches:
+                members = [(idxs[jj], start, n) for jj, start, n in batch]
+                total = members[-1][1] + members[-1][2]
+                bucket = eng._bucket_for(total, warmed)
+                block = np.zeros((bucket, be.dim),
+                                 ingested[members[0][0]].dtype)
+                for j, start, n in members:
+                    block[start:start + n] = ingested[j]
+                if lane is None:
+                    out = be.dispatch(jnp.asarray(block))
+                else:
+                    out = be.dispatch(jnp.asarray(block), lane)
+                d, i = out
+                # exempt(hot-path-host-transfer): shadow result delivery
+                d = np.asarray(d)
+                # exempt(hot-path-host-transfer): shadow result delivery
+                i = np.asarray(i)
+                done = telemetry.now() - t_start
+                for j, start, n in members:
+                    results[j] = (d[start:start + n], i[start:start + n])
+                    lat[j] = done
+                    n_served += 1
+        wall = max(telemetry.now() - t_start, 1e-9)
+        served = [j for j in range(len(requests))
+                  if results[j] is not None]
+        expects(served, "shadow replay served nothing: every request "
+                        "exceeded the warmed ladder cap")
+        p99 = float(np.percentile([lat[j] for j in served], 99.0))
+        return n_served / wall, p99, results, served
+
+    def _recall_probe(self, requests, results, served) -> float:
+        """Spot-check the first ``recall_probes`` served requests against
+        the reference oracle (exact re-rank when ``reference=`` is an
+        exact oracle; the live config's own results otherwise)."""
+        probes = served[:self.cfg.recall_probes]
+        if not probes:
+            return 1.0
+        hit = tot = 0
+        for j in probes:
+            ids = results[j][1]
+            if self._reference is not None:
+                ref_ids = self._reference(requests[j])
+            else:
+                ref_ids = self._live_ids(requests[j])
+            # exempt(hot-path-host-transfer): recall-probe comparison
+            ref_ids = np.asarray(ref_ids)
+            for row in range(ids.shape[0]):
+                hit += len(set(ids[row].tolist())
+                           & set(ref_ids[row].tolist()))
+                tot += ids.shape[1]
+        return hit / max(tot, 1)
+
+    def _live_ids(self, q: np.ndarray) -> np.ndarray:
+        """The serving config's own ids for one request — the default
+        recall reference (a candidate may not lose more than the floor of
+        what the live config returns), via the live backend's warmed
+        ladder (zero-compile, off-path)."""
+        eng = self.engine
+        be = eng._backend
+        qi = be.ingest(q)
+        dt = str(qi.dtype)
+        warmed = set(eng.warmed_signatures().get(dt, ()))
+        bucket = eng._bucket_for(int(qi.shape[0]), warmed)
+        block = np.zeros((bucket, be.dim), qi.dtype)
+        block[:qi.shape[0]] = qi
+        if self._shadow_lane is None:
+            out = be.dispatch(jnp.asarray(block))
+        else:
+            out = be.dispatch(jnp.asarray(block), self._shadow_lane)
+        # exempt(hot-path-host-transfer): recall-probe result fetch
+        ids = np.asarray(out[1])
+        return ids[:qi.shape[0]]
+
+    # -- explore (successive halving) ---------------------------------------
+    def explore(self) -> Optional[Candidate]:
+        """Successive halving over the candidate set: evaluate every
+        survivor on the round's shadow budget (paired against the
+        baseline on the SAME request sets), drop candidates below the
+        recall floor or the baseline's served coverage (the coverage
+        rule), keep the top ``1/eta`` by min-over-pairs objective
+        ratio, grow the budget ×eta, repeat to one winner.  Returns the
+        winner iff it passes :meth:`paired_win` (else None; every
+        non-winner's rejection is recorded + counted).  Zero-compile:
+        requires :meth:`warm_candidates` for params variants."""
+        eng = self.engine
+        cands = [c for c in self.candidates() if c.name != BASELINE.name]
+        for c in cands:
+            expects(c.params is None or c.name in self._shadow,
+                    "explore() before warm_candidates(): candidate "
+                    f"{c.name} has no pre-warmed shadow backend")
+        if not cands:
+            return None
+        router = eng._router
+        drained = (self._shadow_lane is not None and router is not None
+                   and self._shadow_lane not in router.degraded_lanes())
+        if drained:
+            router.drain(self._shadow_lane)
+        self._exploring.set(1, self._label)
+        try:
+            return self._halve(cands)
+        finally:
+            self._exploring.set(0, self._label)
+            if drained:
+                router.restore(self._shadow_lane)
+
+    def _halve(self, survivors: List[Candidate]) -> Optional[Candidate]:
+        cfg = self.cfg
+        budget = cfg.shadow_requests
+        rnd = 0
+        while survivors:
+            self._rounds.inc(1, self._label)
+            scored = []
+            for ci, cand in enumerate(survivors):
+                pc: List[Score] = []
+                pb: List[Score] = []
+                for p in range(cfg.pairs):
+                    seed = (cfg.seed * 1000003 + rnd * 8191
+                            + ci * 131 + p)
+                    reqs = self.shadow_traffic(budget, seed)
+                    pb.append(self._measure(BASELINE, reqs))
+                    pc.append(self._measure(cand, reqs))
+                self.schedule.append((rnd, cand.name))
+                self._evals.inc(1, (self._label[0], cand.name))
+                best = max(pc, key=self.objective)
+                self._qps_g.set(best.qps, (self._label[0], cand.name))
+                self._p99_g.set(best.p99_s, (self._label[0], cand.name))
+                worst_recall = min(s.recall for s in pc)
+                self._recall_g.set(worst_recall,
+                                   (self._label[0], cand.name))
+                ratio = min(self.objective(c)
+                            / max(self.objective(b), 1e-12)
+                            for c, b in zip(pc, pb))
+                # the coverage rule: a candidate must serve at least the
+                # baseline's fraction of every pair's request set — qps
+                # measured over a shrunken (skip-heavy) set is not a win
+                covers = all(c.served >= b.served - 1e-9
+                             for c, b in zip(pc, pb))
+                recall_ok = worst_recall >= cfg.recall_floor
+                why = ("recall floor" if not recall_ok
+                       else "coverage" if not covers else "")
+                scored.append((cand, pc, pb, recall_ok and covers,
+                               ratio, why))
+            for cand, _pc, _pb, ok, _r, why in scored:
+                if not ok:
+                    self._decide("reject", cand.name, why)
+            viable = [t for t in scored if t[3]]
+            if not viable:
+                return None
+            viable.sort(key=lambda t: (-t[4], t[0].name))
+            if len(viable) == 1:
+                return self._final(viable[0])
+            keep = max(1, len(viable) // cfg.eta)
+            for cand, *_ in viable[keep:]:
+                self._decide("reject", cand.name, "halved")
+            survivors = [t[0] for t in viable[:keep]]
+            if len(survivors) == 1:
+                return self._final(viable[0])
+            budget *= cfg.eta
+            rnd += 1
+        return None
+
+    def _final(self, entry) -> Optional[Candidate]:
+        cand, pc, pb, _ok, _ratio, _why = entry
+        if not self.paired_win(pc, pb):
+            self._decide("reject", cand.name, "no paired win")
+            return None
+        self._winner_scores = (pc, pb)
+        return cand
+
+    # -- promotion / rollback ------------------------------------------------
+    def promote(self, cand: Candidate) -> Dict[str, Any]:
+        """Atomically apply *cand*: backend params through the existing
+        ``ServeEngine.refresh`` swap (every signature pre-warmed by
+        :meth:`warm_candidates` → the re-lower is pure ``aot()`` cache
+        hits, zero compiles), host knobs through
+        ``ServeEngine.apply_tuning``.  Records the rollback token + live
+        p99 baseline and opens the guard window.  The admission
+        controller's observed-cost EWMA resets so its estimates
+        re-converge under the new config.  Returns the previous config
+        (the rollback token)."""
+        eng = self.engine
+        pre_p99 = eng.latency_quantiles((0.99,))[0]
+        prev_params = eng._ctor["params"]
+        if cand.params is not None:
+            eng.refresh(eng.index, params=cand.params)
+        prev = eng.apply_tuning(quantum_s=cand.quantum_s,
+                                max_batch=cand.max_batch)
+        adm = eng._admission
+        if adm is not None:
+            adm.reset_observed()
+        self._promoted = cand
+        self._previous = dict(prev, params=prev_params)
+        self._promoted_at = telemetry.now()
+        self._pre_p99 = pre_p99
+        self._decide("promote", cand.name, "paired win")
+        return dict(self._previous)
+
+    def maybe_rollback(self, live_p99_s: Optional[float] = None) -> bool:
+        """The guarded rollback window: within ``rollback_window_s`` of a
+        promotion, a live p99 above ``rollback_p99_rel`` × the
+        pre-promotion p99 reverts the promotion (params back through
+        ``refresh`` — still zero-compile, the old signatures stayed warm
+        — knobs back through ``apply_tuning``).  *live_p99_s* defaults to
+        the p99 of the engine's most recent ``search()`` call.  Returns
+        True iff a rollback happened; once the window closes the
+        promotion is accepted and the guard disarms."""
+        cfg = self.cfg
+        eng = self.engine
+        if self._promoted is None:
+            return False
+        now = telemetry.now()
+        if now - self._promoted_at > cfg.rollback_window_s:
+            self._promoted = None  # window closed: promotion accepted
+            return False
+        if live_p99_s is None:
+            lats = eng.last_latencies
+            if not lats:
+                return False
+            live_p99_s = float(np.percentile(lats, 99.0))
+        pre = self._pre_p99
+        if pre is None or pre <= 0.0:
+            return False
+        if live_p99_s <= cfg.rollback_p99_rel * pre:
+            return False
+        prev = self._previous or {}
+        name = self._promoted.name
+        if self._promoted.params is not None:
+            eng.refresh(eng.index, params=prev.get("params"))
+        eng.apply_tuning(quantum_s=prev.get("quantum_s"),
+                         max_batch=prev.get("max_batch"))
+        adm = eng._admission
+        if adm is not None:
+            adm.reset_observed()
+        self._promoted = None
+        self._decide("rollback", name,
+                     f"live p99 {live_p99_s:.4f}s > "
+                     f"{cfg.rollback_p99_rel}x pre-promotion {pre:.4f}s")
+        return True
+
+    def run(self) -> Dict[str, Any]:
+        """One full tune cycle: warm → explore → promote on a paired win.
+        Returns a report (winner, schedule, decisions) — the bench/ops
+        entry point."""
+        self.warm_candidates()
+        winner = self.explore()
+        if winner is not None:
+            self.promote(winner)
+        return {"winner": winner.name if winner is not None else None,
+                "schedule": list(self.schedule),
+                "decisions": list(self.decisions)}
+
+    # -- reporting ----------------------------------------------------------
+    def _decide(self, decision: str, candidate: str, why: str = "") -> None:
+        self.decisions.append((candidate, decision, why))
+        self._decisions_c.inc(1, (self._label[0], decision))
+
+    def health(self) -> Dict[str, Any]:
+        """The engine ``/healthz`` ``autotune`` sub-object (JSON-safe)."""
+        return {
+            "seed": self.cfg.seed,
+            "evaluations": len(self.schedule),
+            "decisions": [list(d) for d in self.decisions[-8:]],
+            "promoted": (self._promoted.name
+                         if self._promoted is not None else None),
+            "rollback_window_open": self._promoted is not None,
+        }

@@ -65,6 +65,7 @@ guarantee silently erodes.
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import threading
 from concurrent import futures
@@ -96,6 +97,11 @@ from raft_tpu.testing import faults as _faults
 #: replacement keeps at most this many samples while the full distribution
 #: lives in the fixed-memory latency histogram.
 LATENCY_RESERVOIR = 4096
+
+#: bounded live-request shadow ring size (serve.autotune shadow traffic):
+#: enough for a representative mix, small enough that retaining the
+#: ingested arrays costs at most a few MB
+_SHADOW_RING = 64
 
 #: per-instance ordinal labeling each engine's metrics in the registry
 _ENGINE_IDS = itertools.count()
@@ -531,6 +537,10 @@ class ServeEngine:
         expects(max_batch >= 8, "max_batch must be >= 8")
         self._backend = _make_backend(index, k, params, metric, metric_arg,
                                       batch_size_index)
+        # the served container itself (refresh() re-points it): the
+        # autotuner's promotion path re-refreshes the SAME index under
+        # candidate params, so the engine must be able to hand it back
+        self._index = index
         # refresh() rebuilds a backend of the (possibly) same kind with the
         # same serving knobs — keep them (and the UNCLAMPED batch bound:
         # the transient cap depends on the index and is re-derived then)
@@ -558,6 +568,15 @@ class ServeEngine:
         self._closed = False      # close(): new requests reject typed
         self._recorder = None     # slow-request flight recorder (serve_http)
         self._http = None         # the live scrape server, if started
+        #: bounded live-traffic shadow ring (docs/serving.md §autotuning):
+        #: the autotuner's shadow replays sample REAL recent requests from
+        #: here — round-robin overwrite, at most _SHADOW_RING ingested
+        #: request arrays retained (fixed memory, one list store per
+        #: request on the hot path)
+        self._shadow_ring: List[Optional[np.ndarray]] = \
+            [None] * _SHADOW_RING
+        self._shadow_pos = 0
+        self._tuner = None        # attached AutoTuner (/healthz autotune)
         #: Serving statistics — the same keys and read surface as the
         #: pre-telemetry plain dict, now a Counter-shaped view over the
         #: registry (``raft_tpu_serve_engine_stats{engine,key}``): reads
@@ -596,6 +615,12 @@ class ServeEngine:
                             if self._sched_cfg is not None else 0.05),
             use_telemetry=(self._sched_cfg.use_telemetry
                            if self._sched_cfg is not None else True))
+        # cold-start cost seeding (docs/serving.md §cold start): when an
+        # AOT executable store is installed, the previous process's
+        # persisted per-(dtype, bucket) cost rows (written by close())
+        # seed the model, so the FIRST scheduler decisions after a 0.15s
+        # store-warm restart use real costs, not the static fallback
+        self._seed_cost_from_store()
         #: replica-lane router (2D shard × replica backends only):
         #: least-estimated-completion-time pick + fault draining
         self._router: Optional[ReplicaRouter] = None
@@ -642,6 +667,12 @@ class ServeEngine:
     @property
     def k(self) -> int:
         return self._backend.k
+
+    @property
+    def index(self):
+        """The served container (as last constructed/refreshed) — what the
+        autotuner re-refreshes under candidate params."""
+        return self._index
 
     def _sup_event(self, kind: str) -> None:
         # supervisor events → the engine's stats mirror
@@ -720,6 +751,85 @@ class ServeEngine:
     def warmed_buckets(self, dtype) -> List[int]:
         return sorted(self._warmed.get(str(jnp.dtype(dtype)), ()))
 
+    def warmed_signatures(self) -> Dict[str, List[int]]:
+        """The certified warmed-signature ladder as a plain mapping
+        (dtype string → sorted buckets) — the autotuner's candidate-space
+        source: every knob it explores is drawn from this set, which is
+        what makes exploration zero-compile by construction."""
+        with self._warmed_mut:
+            return {dt: sorted(bs) for dt, bs in self._warmed.items()}
+
+    # -- autotuning hooks (docs/serving.md §autotuning) ---------------------
+    def shadow_samples(self) -> List[np.ndarray]:
+        """A snapshot of the live-traffic shadow ring: up to _SHADOW_RING
+        recently ingested request arrays, the autotuner's sampled-live
+        shadow traffic source."""
+        return [q for q in list(self._shadow_ring) if q is not None]
+
+    def attach_tuner(self, tuner) -> None:
+        """Attach (or detach with None) an AutoTuner: its state shows in
+        the ``/healthz`` body as the ``autotune`` sub-object."""
+        self._tuner = tuner
+
+    def apply_tuning(self, *, quantum_s: Optional[float] = None,
+                     max_batch: Optional[int] = None) -> Dict[str, Any]:
+        """Atomically apply host-side tuner knobs; returns the PREVIOUS
+        values (the tuner's rollback token).  ``max_batch`` must be a
+        warmed bucket (or the construction-time cap): the planner's
+        ladder cap stays inside the certified warmed signature space, so
+        a promoted cap can never make dispatch compile."""
+        expects(not self._closed, "apply_tuning() on a closed engine")
+        with self._lock:
+            prev: Dict[str, Any] = {
+                "quantum_s": (self._sched_cfg.quantum_s
+                              if self._sched_cfg is not None else None),
+                "max_batch": self.max_batch}
+            if quantum_s is not None:
+                expects(self._sched_cfg is not None,
+                        "quantum tuning needs the scheduler enabled")
+                expects(quantum_s > 0.0, "quantum_s must be positive")
+                self._sched_cfg = dataclasses.replace(
+                    self._sched_cfg, quantum_s=float(quantum_s))
+            if max_batch is not None:
+                b = int(max_batch)
+                with self._warmed_mut:
+                    warmed_any = {x for bs in self._warmed.values()
+                                  for x in bs}
+                cap = getattr(self._backend, "batch_cap", lambda: None)()
+                base = (self._requested_max_batch if cap is None else
+                        max(8, min(self._requested_max_batch, cap)))
+                expects(b in warmed_any or b == base,
+                        f"max_batch={b} is neither a warmed bucket nor "
+                        "the construction cap — tuning must stay inside "
+                        "the certified ladder")
+                self.max_batch = b
+            return prev
+
+    def _seed_cost_from_store(self) -> None:
+        """Seed the scheduler cost model from the AOT store's persisted
+        per-signature cost rows (written by close()); a no-op without an
+        installed store or persisted rows for this backend program."""
+        from raft_tpu.core import aotstore
+
+        store = aotstore.installed()
+        fn = self._backend_fn()
+        if store is None or not fn:
+            return
+        self._cost.seed_rows(store.load_costs(fn))
+
+    def _persist_cost_rows(self) -> None:
+        """Persist the cost model's observed rows next to the executables
+        (close()-time): the next process's construction seeds from them."""
+        from raft_tpu.core import aotstore
+
+        store = aotstore.installed()
+        fn = self._backend_fn()
+        if store is None or not fn:
+            return
+        rows = self._cost.rows()
+        if rows:
+            store.save_costs(fn, rows)
+
     # -- index refresh ------------------------------------------------------
     def refresh(self, index, params=None) -> None:
         """Swap the served index for *index* without cold-serving a single
@@ -785,6 +895,7 @@ class ServeEngine:
                     backend.warm(b, jnp.dtype(dt))
                 warmed.setdefault(dt, set()).update(late)
             self._backend = backend
+            self._index = index
             self._ctor = dict(c, params=params)
             self.max_batch = max_batch
             self._warmed = warmed
@@ -793,6 +904,7 @@ class ServeEngine:
             # ReplicaSet's lanes are new replicas — drained state does
             # not carry over a swap)
             self._cost.bind_fn(self._backend_fn())
+            self._seed_cost_from_store()
             if getattr(backend, "n_replicas", 0) > 1:
                 self._router = ReplicaRouter(backend.n_replicas,
                                              self._engine_id)
@@ -839,6 +951,11 @@ class ServeEngine:
             body["scheduler"] = {
                 "quantum_s": self._sched_cfg.quantum_s,
                 "pending": len(self._pending)}
+        # autotuner visibility: candidate decisions, promotion state and
+        # the rollback guard window (docs/serving.md §autotuning)
+        tuner = self._tuner
+        if tuner is not None:
+            body["autotune"] = tuner.health()
         # tiered residency: hot/cold split + staging-tile footprint, so a
         # scrape can see what re-tiering (refresh + tiering.retier) did
         stats_fn = getattr(self._backend, "searcher", None)
@@ -895,6 +1012,10 @@ class ServeEngine:
         if self._closed:
             return  # idempotent
         self._closed = True  # reject new requests from this point on
+        # persist the observed per-(dtype, bucket) cost rows next to the
+        # store's executables, so the next process's cold restore starts
+        # its scheduler on real costs (see _seed_cost_from_store)
+        self._persist_cost_rows()
         # stop the submit() scheduler thread and reject its queue typed
         # (never leave a Future dangling)
         with self._pending_cv:
@@ -1131,6 +1252,13 @@ class ServeEngine:
         self.stats.inc("requests", len(raw))
         self.stats.inc("queries", sum(int(q.shape[0]) for q in ingested
                                       if q is not None))
+        # feed the bounded shadow ring (autotune shadow traffic source):
+        # round-robin overwrite of fixed slots — one list store per
+        # request, no allocation, no growth
+        for q in ingested:
+            if q is not None and q.shape[0]:
+                self._shadow_ring[self._shadow_pos % _SHADOW_RING] = q
+                self._shadow_pos += 1
 
         # deadline-aware admission in arrival order, BEFORE planning: a
         # request whose remaining budget cannot cover its projected
@@ -1305,7 +1433,9 @@ class ServeEngine:
                     # cost model (EWMA), the signal the chooser steers by
                     self._cost.observe(dt, bucket, now - t0)
                 if lane_r is not None:
-                    self._router.note_done(lane_r, now)
+                    # per-lane observed service time → the router's cost
+                    # EWMA: a SLOW (not failed) lane sheds load gradually
+                    self._router.note_done(lane_r, now, now - t0)
                 for j, start, n in members:
                     results[j] = (d[start:start + n], i[start:start + n])
                     latencies[j] = done

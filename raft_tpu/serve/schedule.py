@@ -103,6 +103,24 @@ class CostModel:
         """Re-point the registry seed at a new backend program (refresh)."""
         self._fn = fn
 
+    def rows(self) -> Dict[Tuple[str, int], float]:
+        """The observed per-(dtype, bucket) EWMA rows — what the engine
+        persists into the AOT executable store at close() so a cold
+        restore's first scheduler decisions use real costs."""
+        return dict(self._ewma)
+
+    def seed_rows(self, rows: Dict[Tuple[str, int], float]) -> int:
+        """Seed ABSENT per-(dtype, bucket) rows from a persisted snapshot
+        (the AOT store's cost manifest); live observations already made
+        take precedence.  Returns the number of rows seeded."""
+        n = 0
+        for (dt, b), v in rows.items():
+            key = (str(dt), int(b))
+            if key not in self._ewma and float(v) > 0.0:
+                self._ewma[key] = float(v)
+                n += 1
+        return n
+
     def observe(self, dtype: str, bucket: int, wall_s: float) -> None:
         """One collected super-batch's end-to-end wall time."""
         if wall_s <= 0.0:
@@ -304,10 +322,22 @@ class ReplicaRouter:
     on one (the in-call analogue of least-outstanding-requests LB).  A
     lane marked :meth:`fault`-ed is DRAINED: it stops receiving traffic,
     ``/healthz`` lists it degraded, and :meth:`pick` routes only over
-    survivors — zero failed requests as long as one lane lives.  Counters
-    export per-lane dispatch/fault totals
-    (``raft_tpu_serve_replica_{dispatch,faults}_total{engine,replica}``)
-    and a live-lane gauge (``raft_tpu_serve_replicas_live{engine}``)."""
+    survivors — zero failed requests as long as one lane lives.
+    :meth:`drain` marks a lane degraded WITHOUT counting a fault (the
+    operator/autotuner canary action).
+
+    Between those extremes, each lane also keeps an observed service-time
+    EWMA (fed by the engine's collect via :meth:`note_done`/
+    :meth:`observe`): a SLOW-but-alive lane (a stalled host, a noisy
+    neighbor) books its batches at ``est_s × slowness`` — its relative
+    EWMA against the fastest live lane — so it sheds load GRADUALLY as it
+    degrades and wins it back as it recovers, instead of flapping between
+    the binary live/drained states.  Counters export per-lane
+    dispatch/fault totals
+    (``raft_tpu_serve_replica_{dispatch,faults}_total{engine,replica}``),
+    the per-lane cost EWMA
+    (``raft_tpu_serve_replica_cost_seconds{engine,replica}``) and a
+    live-lane gauge (``raft_tpu_serve_replicas_live{engine}``)."""
 
     def __init__(self, n_lanes: int, engine_label: str = "?"):
         expects(n_lanes >= 1, "ReplicaRouter needs at least one lane")
@@ -315,6 +345,12 @@ class ReplicaRouter:
         self._engine = str(engine_label)
         self._busy_until = [0.0] * self.n_lanes
         self._degraded = [False] * self.n_lanes
+        #: per-lane observed service-time EWMA (None until first observed)
+        self._cost_ewma: List[Optional[float]] = [None] * self.n_lanes
+        self._cost_g = telemetry.gauge(
+            "raft_tpu_serve_replica_cost_seconds",
+            "per-lane observed super-batch service-time EWMA",
+            labelnames=("engine", "replica"))
         self._dispatches = telemetry.counter(
             "raft_tpu_serve_replica_dispatch_total",
             "super-batches routed to each replica lane",
@@ -340,7 +376,7 @@ class ReplicaRouter:
         for i in self.alive_lanes():
             if i in exclude:
                 continue
-            done = max(self._busy_until[i], now) + est_s
+            done = max(self._busy_until[i], now) + est_s * self.slowness(i)
             if best_lane is None or done < best_done:
                 best_lane, best_done = i, done
         if best_lane is not None:
@@ -348,11 +384,49 @@ class ReplicaRouter:
             self._dispatches.inc(1, (self._engine, str(best_lane)))
         return best_lane
 
-    def note_done(self, lane: int, now: float) -> None:
+    def slowness(self, lane: int) -> float:
+        """The lane's observed cost relative to the FASTEST live lane
+        (≥ 1.0; 1.0 while unobserved) — the gradual-shedding weight
+        :meth:`pick` books batches at."""
+        mine = self._cost_ewma[lane]
+        if mine is None:
+            return 1.0
+        floor = min((self._cost_ewma[i] for i in self.alive_lanes()
+                     if self._cost_ewma[i] is not None),
+                    default=None)
+        if floor is None or floor <= 0.0:
+            return 1.0
+        return max(1.0, mine / floor)
+
+    def observe(self, lane: int, wall_s: float) -> None:
+        """One collected batch's observed service time on *lane* → the
+        lane's cost EWMA (the gradual-shedding signal)."""
+        if wall_s <= 0.0:
+            return
+        prev = self._cost_ewma[lane]
+        self._cost_ewma[lane] = (
+            wall_s if prev is None
+            else EWMA_KEEP * prev + (1 - EWMA_KEEP) * wall_s)
+        self._cost_g.set(self._cost_ewma[lane],
+                         (self._engine, str(lane)))
+
+    def note_done(self, lane: int, now: float,
+                  wall_s: Optional[float] = None) -> None:
         """A lane's batch collected: clamp its horizon to the present so
-        stale over-estimates do not starve it."""
+        stale over-estimates do not starve it; *wall_s* (when the caller
+        measured it) feeds the lane's cost EWMA."""
         if self._busy_until[lane] > now:
             self._busy_until[lane] = now
+        if wall_s is not None:
+            self.observe(lane, wall_s)
+
+    def drain(self, lane: int) -> None:
+        """Administratively drain *lane* (no fault counted): the
+        autotuner's shadow-canary lane, an operator's maintenance drain.
+        :meth:`restore` un-drains."""
+        if not self._degraded[lane]:
+            self._degraded[lane] = True
+            self._live.set(len(self.alive_lanes()), (self._engine,))
 
     def fault(self, lane: int) -> None:
         """Drain *lane*: no further traffic routes to it; visible as

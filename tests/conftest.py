@@ -98,6 +98,10 @@ _FAST_TESTS = {
     "test_routed_identical_zero_compile_per_group_allgather",
     "test_serve_replica.py::TestReplicaServe::"
     "test_degrade_reroutes_zero_failures_healthz",
+    "test_serve_autotune.py::TestDeterminism::"
+    "test_same_seed_same_schedule_and_decisions",
+    "test_serve_autotune.py::TestZeroCompile::"
+    "test_explore_and_promote_are_zero_compile",
     "test_ivf_pq.py::test_ivf_pq_recall_pq_bits",
     "test_kmeans_mnmg.py::test_distributed_matches_single_device",
     "test_kmeans_mnmg.py::test_fori_loop_matches_device_loop",

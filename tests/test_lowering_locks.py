@@ -724,6 +724,9 @@ class TestStaleExemptions:
                "    return x  # exempt(no-such-rule): typo\n")
         assert not engine.scan_stale_source("raft_tpu/x/mod.py", src)
 
+    # `slow` since ISSUE-19: the identical shipped-tree scan runs as a
+    # warning pass in every ci/checks.sh invocation (budget rebalance)
+    @pytest.mark.slow
     def test_shipped_tree_has_no_stale_markers(self):
         n = engine.scan_stale_exemptions(out=io.StringIO())
         assert n == 0

@@ -44,10 +44,12 @@ class TestSelectKBlockwise:
     # (a full cross product re-compiles an interpret network per cell —
     # tier-1 budget discipline, PR-3/PR-4 precedent); other tests in this
     # class REUSE these signatures so their aot executables are shared
-    # tier-1 keeps three representatives (the shared-signature cell the
-    # rest of the class reuses, the tiny-shape cell, one bf16/max cell);
-    # the remaining cells are `slow` (each interpret-network compile is
-    # ~16-18s cold — ISSUE-14 budget rebalance, PR-3/PR-4 precedent)
+    # tier-1 keeps two representatives (the shared-signature cell the
+    # rest of the class reuses, the tiny-shape cell); the remaining
+    # cells are `slow` (each interpret-network compile is ~16-22s cold —
+    # ISSUE-14/ISSUE-19 budget rebalances, PR-3/PR-4 precedent; the
+    # bf16 cells mostly exercise the documented sub-f32→f32 comparator
+    # upcast, so f32 cells carry the network-correctness load)
     @pytest.mark.parametrize("m,n,k,select_min,dtype", [
         (7, 300, 10, True, np.float32),    # nothing aligned
         pytest.param(33, 1000, 1, True, np.float32,
@@ -59,7 +61,8 @@ class TestSelectKBlockwise:
         (1, 17, 8, True, np.float32),      # single row, tiny n
         pytest.param(9, 700, 16, True, "bfloat16",
                      marks=pytest.mark.slow),   # bf16 comparator
-        (5, 257, 8, False, "bfloat16"),    # bf16 select_max
+        pytest.param(5, 257, 8, False, "bfloat16",
+                     marks=pytest.mark.slow),   # bf16 select_max
     ])
     def test_bit_identical_to_xla_engine(self, dtype, select_min, m, n, k):
         x = jnp.asarray(self._adversarial(
@@ -86,6 +89,10 @@ class TestSelectKBlockwise:
         np.testing.assert_array_equal(np.asarray(p_p)[0, :3], [7, 280, 0])
         np.testing.assert_array_equal(np.asarray(v_p)[0, :2], [0.5, 0.5])
 
+    # fresh (payload) signature → its own ~18s interpret compile; the
+    # payload-gather path is exercised tier-1 through the IVF probe
+    # scans (ISSUE-19 budget rebalance)
+    @pytest.mark.slow
     def test_payload_indices_gathered(self):
         rng = np.random.default_rng(1)
         x = rng.normal(0, 1, (7, 300)).astype(np.float32)
@@ -244,6 +251,10 @@ class TestProbeScanEngines:
         return (rng.standard_normal((n, dim)).astype(np.float32),
                 rng.standard_normal((nq, dim)).astype(np.float32))
 
+    # `slow` since ISSUE-19: the same engine-threaded search identity is
+    # re-proven by the multichip battery's select_k_sharded_matches_local
+    # case, and the pq-side identity test below stays tier-1
+    @pytest.mark.slow
     def test_ivf_flat_search_engine_identity(self, monkeypatch):
         """select_k bit-identity makes the WHOLE ivf_flat search (coarse
         select + probe-scan top-k + merge) bit-identical across
@@ -305,6 +316,11 @@ class TestProbeScanEngines:
         jax.block_until_ready(out[0])
         assert aot_compile_counters["compiles"] == c0
 
+    # `slow` since ISSUE-19 (~31s, the single heaviest tier-1 test):
+    # warm-then-zero-compile with the pallas engine stays tier-1 via
+    # test_ivf_pq_warm_dispatch_zero_compile above, and engine-resolved
+    # serve warming is pinned by the xla-engine serve batteries
+    @pytest.mark.slow
     def test_serve_engine_warms_pallas_variant(self, monkeypatch):
         """ServeEngine resolves the kernel engine at backend construction
         and warmup() pre-lowers the PALLAS variant per (bucket, dtype)

@@ -1,0 +1,438 @@
+"""Online autotuner battery (ISSUE 19, docs/serving.md §autotuning):
+candidate space bounded by the warmed ladder, seeded determinism of the
+candidate schedule + promote/reject decisions, zero-compile explore →
+promote counter asserts, params promotion through the atomic refresh
+swap, guarded rollback, per-lane cost-EWMA gradual shedding under an
+injected stalled lane, and AOT-store cost-row cold-start seeding."""
+
+import numpy as np
+import pytest
+
+from raft_tpu import telemetry
+from raft_tpu.comms import build_comms
+from raft_tpu.core import aotstore
+from raft_tpu.core.aot import aot_compile_counters
+from raft_tpu.core.error import RaftError
+from raft_tpu.neighbors import ann_mnmg, ivf_flat, knn
+from raft_tpu.serve import AutoTuner, Candidate, ServeEngine, TunerConfig
+from raft_tpu.serve.autotune import BASELINE, Score, exact_reference
+from raft_tpu.serve.schedule import CostModel, ReplicaRouter
+from raft_tpu.testing import faults
+
+_DIM = 16
+_K = 4
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(7)
+    return rng.normal(0, 1, (1024, _DIM)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def fl_index(corpus):
+    return ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4), corpus)
+
+
+def _reqs(seed=1, sizes=(3, 7, 2, 6, 1, 5)):
+    rng = np.random.default_rng(seed)
+    return [rng.normal(0, 1, (n, _DIM)).astype(np.float32)
+            for n in sizes]
+
+
+def _bf_engine(corpus, max_batch=32):
+    eng = ServeEngine(corpus, _K, max_batch=max_batch)
+    eng.warmup()
+    return eng
+
+
+class TestCandidateSpace:
+    def test_candidates_derive_from_warmed_ladder(self, corpus):
+        eng = _bf_engine(corpus)
+        try:
+            tuner = AutoTuner(eng, TunerConfig(seed=3))
+            names = [c.name for c in tuner.candidates()]
+            # baseline + one cap per warmed bucket below the serving cap
+            assert names == ["baseline", "cap8", "cap16"]
+            # every cap candidate IS a warmed bucket (zero-compile by
+            # construction: the space is a subset of the ladder)
+            warmed = {b for bs in eng.warmed_signatures().values()
+                      for b in bs}
+            for c in tuner.candidates():
+                if c.max_batch is not None:
+                    assert c.max_batch in warmed
+        finally:
+            eng.close()
+
+    def test_candidates_before_warmup_raise(self, corpus):
+        eng = ServeEngine(corpus, _K, max_batch=32)
+        try:
+            with pytest.raises(RaftError):
+                AutoTuner(eng).candidates()
+        finally:
+            eng.close()
+
+    def test_overbound_subsample_is_seeded(self, corpus):
+        eng = _bf_engine(corpus, max_batch=64)
+        try:
+            extra = tuple(Candidate(f"q{i}", quantum_s=0.001 * (i + 1))
+                          for i in range(8))
+            cfg = TunerConfig(seed=11, max_candidates=4)
+            a = [c.name for c in
+                 AutoTuner(eng, cfg, extra_candidates=extra).candidates()]
+            b = [c.name for c in
+                 AutoTuner(eng, cfg, extra_candidates=extra).candidates()]
+            assert a == b and len(a) == 4 and a[0] == "baseline"
+        finally:
+            eng.close()
+
+
+def _fake_measure(log, winner="cap16"):
+    """A deterministic injected measurement stream: *winner* beats the
+    baseline on qps at equal p99 in every pair; everything else loses.
+    Logs (candidate, stream fingerprint) so replays can be compared."""
+    def measure(cand, requests):
+        fp = tuple(round(float(q[0, 0]), 5) for q in requests)
+        log.append((cand.name, len(requests), fp))
+        if cand.name == winner:
+            return Score(qps=150.0, p99_s=0.010, recall=1.0)
+        if cand.name == BASELINE.name:
+            return Score(qps=100.0, p99_s=0.010, recall=1.0)
+        return Score(qps=90.0, p99_s=0.012, recall=1.0)
+    return measure
+
+
+class TestDeterminism:
+    def _run_once(self, corpus, seed=5):
+        eng = _bf_engine(corpus)
+        try:
+            eng.search(_reqs(seed=2))  # populate the shadow ring
+            log = []
+            tuner = AutoTuner(eng, TunerConfig(seed=seed, pairs=2,
+                                               shadow_requests=6),
+                              measure=_fake_measure(log))
+            report = tuner.run()
+            return report, log, eng.max_batch
+        finally:
+            eng.close()
+
+    def test_same_seed_same_schedule_and_decisions(self, corpus):
+        """Same seed + same measurement stream ⇒ bit-identical candidate
+        schedule, shadow-traffic stream, and promote/reject decisions
+        (the testing/faults.py determinism contract)."""
+        r1, log1, mb1 = self._run_once(corpus)
+        r2, log2, mb2 = self._run_once(corpus)
+        assert r1 == r2
+        assert log1 == log2  # identical shadow sampling per seed
+        assert mb1 == mb2 == 16  # cap16 promoted both times
+        assert r1["winner"] == "cap16"
+        assert ("cap16", "promote", "paired win") in [
+            tuple(d) for d in r1["decisions"]]
+
+    def test_different_seed_different_stream(self, corpus):
+        _, log1, _ = self._run_once(corpus, seed=5)
+        _, log2, _ = self._run_once(corpus, seed=6)
+        assert [t[:2] for t in log1] == [t[:2] for t in log2]
+        assert log1 != log2  # sampling follows the seed
+
+    def test_coverage_rule_rejects_skip_heavy_candidates(self, corpus):
+        """A candidate that scores a higher qps by SERVING FEWER of the
+        pair's requests (skipping above its cap) must be coverage-
+        rejected, not promoted — qps over a shrunken set is not a win."""
+        eng = _bf_engine(corpus)
+        try:
+            eng.search(_reqs(seed=2))
+
+            def measure(cand, requests):
+                if cand.name == "cap8":  # fast BECAUSE it skips half
+                    return Score(qps=500.0, p99_s=0.001, recall=1.0,
+                                 served=0.5)
+                return Score(qps=100.0, p99_s=0.010, recall=1.0)
+
+            tuner = AutoTuner(eng, TunerConfig(seed=0, pairs=2,
+                                               shadow_requests=6),
+                              measure=measure)
+            report = tuner.run()
+            assert report["winner"] != "cap8"
+            assert ("cap8", "reject", "coverage") in [
+                tuple(d) for d in report["decisions"]]
+            assert eng.max_batch == 32
+        finally:
+            eng.close()
+
+    def test_losing_candidates_are_rejected_not_promoted(self, corpus):
+        eng = _bf_engine(corpus)
+        try:
+            eng.search(_reqs(seed=2))
+            log = []
+            tuner = AutoTuner(eng, TunerConfig(seed=1, pairs=2,
+                                               shadow_requests=6),
+                              measure=_fake_measure(log, winner="nobody"))
+            report = tuner.run()
+            assert report["winner"] is None
+            assert all(d[1] == "reject" for d in report["decisions"])
+            assert eng.max_batch == 32  # nothing applied
+        finally:
+            eng.close()
+
+
+class TestZeroCompile:
+    def test_explore_and_promote_are_zero_compile(self, corpus):
+        """The acceptance gate's counter assert: a full real-measure
+        explore over the warmed-cap candidates, then a forced promotion
+        and post-promotion serving, with ZERO aot compiles end to end."""
+        eng = _bf_engine(corpus)
+        try:
+            eng.search(_reqs(seed=3))
+            tuner = AutoTuner(eng, TunerConfig(seed=0, pairs=1,
+                                               shadow_requests=8))
+            c0 = aot_compile_counters["compiles"]
+            tuner.warm_candidates()  # no params variants: nothing to lower
+            tuner.explore()
+            tuner.promote(Candidate("cap16", max_batch=16))
+            outs = eng.search(_reqs(seed=4))
+            assert aot_compile_counters["compiles"] == c0, \
+                dict(aot_compile_counters)
+            assert eng.max_batch == 16
+            for q, (d, i) in zip(_reqs(seed=4), outs):
+                _, i0 = knn(corpus, q, _K)
+                np.testing.assert_array_equal(i, np.asarray(i0))
+        finally:
+            eng.close()
+
+    def test_params_promotion_via_refresh_zero_compile(self, fl_index):
+        """A backend-params candidate: warm_candidates pre-lowers its
+        shadow backend (compiles sanctioned there), after which explore,
+        the refresh-swap promotion, AND post-promotion serving are all
+        pure cache hits — and the engine serves the NEW params."""
+        sp0 = ivf_flat.SearchParams(n_probes=2)
+        sp1 = ivf_flat.SearchParams(n_probes=6)
+        eng = ServeEngine(fl_index, _K, sp0, max_batch=16)
+        eng.warmup()
+        try:
+            eng.search(_reqs(seed=5))
+            tuner = AutoTuner(eng, TunerConfig(seed=0, pairs=1,
+                                               shadow_requests=6),
+                              param_variants=[sp1])
+            assert tuner.warm_candidates() > 0  # lowered the variant
+            c0 = aot_compile_counters["compiles"]
+            score = tuner._measure_real(Candidate("params0", params=sp1),
+                                        _reqs(seed=6))
+            assert score.qps > 0 and 0.0 <= score.recall <= 1.0
+            tuner.promote(Candidate("params0", params=sp1))
+            outs = eng.search(_reqs(seed=7))
+            assert aot_compile_counters["compiles"] == c0, \
+                dict(aot_compile_counters)
+            for q, (d, i) in zip(_reqs(seed=7), outs):
+                _, i1 = ivf_flat.search(sp1, fl_index, q, _K)
+                np.testing.assert_array_equal(i, np.asarray(i1))
+        finally:
+            eng.close()
+
+    def test_recall_probe_against_exact_reference(self, corpus):
+        eng = _bf_engine(corpus)
+        try:
+            eng.search(_reqs(seed=8))
+            tuner = AutoTuner(eng, TunerConfig(seed=0, pairs=1,
+                                               shadow_requests=6),
+                              reference=exact_reference(corpus, _K))
+            # brute force IS exact: the probe must certify perfect recall
+            score = tuner._measure_real(Candidate("cap16", max_batch=16),
+                                        _reqs(seed=9))
+            assert score.recall == 1.0
+        finally:
+            eng.close()
+
+
+class TestRollback:
+    def test_live_p99_regression_rolls_back(self, corpus):
+        eng = _bf_engine(corpus)
+        try:
+            eng.search(_reqs(seed=3))
+            tuner = AutoTuner(eng, TunerConfig(seed=0))
+            tuner.promote(Candidate("cap16", max_batch=16))
+            assert eng.max_batch == 16
+            pre = tuner._pre_p99
+            assert pre is not None and pre > 0.0
+            # inside the window, a p99 blowup reverts the whole decision
+            assert tuner.maybe_rollback(live_p99_s=100.0 * pre) is True
+            assert eng.max_batch == 32
+            assert tuner.decisions[-1][1] == "rollback"
+            # the guard disarmed: a second regression report is a no-op
+            assert tuner.maybe_rollback(live_p99_s=100.0 * pre) is False
+        finally:
+            eng.close()
+
+    def test_healthy_p99_keeps_promotion(self, corpus):
+        eng = _bf_engine(corpus)
+        try:
+            eng.search(_reqs(seed=3))
+            tuner = AutoTuner(eng, TunerConfig(seed=0))
+            tuner.promote(Candidate("cap16", max_batch=16))
+            assert tuner.maybe_rollback(
+                live_p99_s=tuner._pre_p99) is False
+            assert eng.max_batch == 16
+            # window expiry accepts the promotion and disarms the guard
+            tuner._promoted_at -= (tuner.cfg.rollback_window_s + 1.0)
+            assert tuner.maybe_rollback(live_p99_s=1e9) is False
+            assert tuner._promoted is None
+        finally:
+            eng.close()
+
+    def test_apply_tuning_rejects_unwarmed_cap(self, corpus):
+        eng = _bf_engine(corpus)
+        try:
+            with pytest.raises(RaftError):
+                eng.apply_tuning(max_batch=24)  # not a warmed bucket
+            assert eng.max_batch == 32
+        finally:
+            eng.close()
+
+
+class TestHealthAndVarz:
+    def test_decisions_visible_in_healthz_and_registry(self, corpus):
+        eng = _bf_engine(corpus)
+        try:
+            eng.search(_reqs(seed=2))
+            log = []
+            tuner = AutoTuner(eng, TunerConfig(seed=5, pairs=2,
+                                               shadow_requests=6),
+                              measure=_fake_measure(log))
+            tuner.run()
+            body = eng._health()
+            assert body["autotune"]["promoted"] == "cap16"
+            assert body["autotune"]["rollback_window_open"] is True
+            assert body["autotune"]["evaluations"] == len(tuner.schedule)
+            text = telemetry.prometheus_text()
+            assert "raft_tpu_autotune_decisions_total" in text
+            assert "raft_tpu_autotune_qps" in text
+            dec = telemetry.REGISTRY.get("raft_tpu_autotune_decisions_total")
+            promoted = sum(v for labels, v in dec.items()
+                           if labels == (eng._engine_id, "promote"))
+            assert promoted == 1
+        finally:
+            eng.close()
+
+
+class TestLaneCostShedding:
+    def test_router_ewma_sheds_gradually(self):
+        r = ReplicaRouter(2, "t-ewma")
+        # unobserved lanes are equal-cost: round-robin-ish booking
+        assert r.slowness(0) == r.slowness(1) == 1.0
+        for _ in range(4):
+            r.observe(0, 0.001)
+            r.observe(1, 0.010)
+        assert r.slowness(0) == 1.0
+        assert r.slowness(1) > 5.0
+        # pick books the slow lane's completion at slowness x est: the
+        # fast lane absorbs several batches before the slow one is next
+        picks = [r.pick(0.0, 0.001) for _ in range(10)]
+        assert picks.count(0) > picks.count(1)
+        assert picks.count(1) >= 1  # gradual shedding, not a drain
+        assert r.degraded_lanes() == []
+
+    def test_drain_is_not_a_fault(self):
+        r = ReplicaRouter(2, "t-drain")
+        r.drain(1)
+        assert r.degraded_lanes() == [1]
+        assert r.pick(0.0, 0.001) == 0
+        faults_c = telemetry.REGISTRY.get(
+            "raft_tpu_serve_replica_faults_total")
+        assert all(labels[0] != "t-drain"
+                   for labels, v in faults_c.items() if v > 0)
+        r.restore(1)
+        assert r.degraded_lanes() == []
+
+    def test_stalled_lane_sheds_load_but_stays_live(self, fl_index):
+        """The PR 14 fault plane injects a persistent stall on lane 1:
+        its cost EWMA inflates, the router books it at its observed
+        slowness, and load gradually shifts to lane 0 — WITHOUT draining
+        lane 1 (a slow lane is capacity, not a fault) and with every
+        request correctly served."""
+        replica_set = ann_mnmg.replicate(fl_index, build_comms(), 2)
+        sp = ivf_flat.SearchParams(n_probes=3)
+        eng = ServeEngine(replica_set, _K, sp, max_batch=8)
+        eng.warmup()
+        try:
+            eng.search(_reqs(seed=1, sizes=(2,)))  # plumbing warm call
+            disp = telemetry.REGISTRY.get(
+                "raft_tpu_serve_replica_dispatch_total")
+
+            def lane_counts():
+                return {labels[1]: v for labels, v in disp.items()
+                        if labels[0] == eng._engine_id}
+
+            base = lane_counts()
+            with faults.plan(
+                    "comms:op=replica_dispatch:rank=1:stall=0.03:times=0"):
+                for s in range(6):
+                    reqs = _reqs(seed=10 + s, sizes=(5, 6, 7, 5, 6, 7))
+                    outs = eng.search(reqs)
+                    for q, (d, i) in zip(reqs, outs):
+                        _, i0 = ivf_flat.search(sp, fl_index, q, _K)
+                        np.testing.assert_array_equal(i, np.asarray(i0))
+            counts = lane_counts()
+            to0 = counts.get("0", 0) - base.get("0", 0)
+            to1 = counts.get("1", 0) - base.get("1", 0)
+            assert to0 > to1  # the stalled lane shed load...
+            assert to1 >= 1   # ...gradually — it still serves
+            assert eng._health()["replicas"]["degraded"] == []
+            cost = telemetry.REGISTRY.get(
+                "raft_tpu_serve_replica_cost_seconds")
+            lanes = {labels[1]: v for labels, v in cost.items()
+                     if labels[0] == eng._engine_id}
+            assert lanes["1"] > lanes["0"]  # the EWMA saw the stall
+        finally:
+            eng.close()
+
+
+class TestCostColdStart:
+    def test_seed_rows_fills_absent_only(self):
+        cm = CostModel(use_telemetry=False, static_batch_s=0.5)
+        cm.observe("float32", 8, 0.001)
+        n = cm.seed_rows({("float32", 8): 0.9, ("float32", 16): 0.002,
+                          ("bfloat16", 8): -1.0})
+        assert n == 1  # live row kept, negative row dropped
+        rows = cm.rows()
+        assert rows[("float32", 8)] == pytest.approx(0.001)
+        assert rows[("float32", 16)] == pytest.approx(0.002)
+
+    def test_engine_seeds_cost_model_from_store(self, corpus, tmp_path):
+        """The cold-start fix: close() persists the observed per-(dtype,
+        bucket) cost rows into the installed AOT store; a NEW engine over
+        the same serving key seeds its scheduler cost model from them at
+        construction — real costs on the first decision, not the static
+        fallback."""
+        prev = aotstore.install(str(tmp_path))
+        try:
+            eng = _bf_engine(corpus)
+            eng.search(_reqs(seed=2))
+            fn = eng._backend_fn()
+            observed = eng._cost.rows()
+            assert observed  # serving produced real rows
+            eng.close()
+            store = aotstore.installed()
+            persisted = store.load_costs(fn)
+            assert persisted
+            for key, v in observed.items():
+                assert persisted[key] == pytest.approx(v)
+
+            eng2 = ServeEngine(corpus, _K, max_batch=32)
+            try:
+                seeded = eng2._cost.rows()
+                for key, v in persisted.items():
+                    assert seeded[key] == pytest.approx(v)
+            finally:
+                eng2.close()
+        finally:
+            aotstore.install(prev)
+
+    def test_no_store_is_a_clean_noop(self, corpus):
+        prev = aotstore.install(None)
+        try:
+            eng = _bf_engine(corpus)
+            assert eng._cost.rows() == {}
+            eng.close()
+        finally:
+            aotstore.install(prev)
