@@ -574,7 +574,7 @@ def certify_scheduler_closure(files: Dict[str, ast.Module]
 #: tuner stages that run AFTER warm_candidates(): none of them may lower
 #: or compile — exploration is zero-compile by construction
 _TUNER_HOT_FNS = ("explore", "_halve", "_measure_real", "_replay",
-                  "_recall_probe", "_live_ids")
+                  "_dispatch", "_recall_probe", "_live_ids")
 _TUNER_COMPILE_NAMES = frozenset(
     {"warm", "warmup", "warm_candidates", "jit", "lower", "compile",
      "aot", "mesh_aot", "_make_backend"})

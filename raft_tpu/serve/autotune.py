@@ -25,8 +25,11 @@ ONLINE tuner safe on a serving process:
   plus (optionally) the bench traffic-plan DSL — against an off-path
   warmed lane: a param candidate's own pre-warmed backend, or (replica
   engines) a :meth:`~raft_tpu.serve.schedule.ReplicaRouter.drain`-ed
-  replica lane.  Live requests are never queued behind, shed for, or
-  failed by an evaluation.  Scores are measured qps / p99 under a
+  replica lane.  Live requests are never shed for or failed by an
+  evaluation; replays through the live backend serialize each
+  super-batch dispatch under the engine lock (the :class:`ServeEngine`
+  thread-safety contract), so a live call can at most wait behind one
+  in-flight shadow dispatch.  Scores are measured qps / p99 under a
   recall-probe floor (exact re-rank spot checks: pass ``reference=`` an
   exact oracle, e.g. a boosted-``refine_ratio`` tiered searcher or
   :func:`exact_reference`).
@@ -38,7 +41,12 @@ ONLINE tuner safe on a serving process:
   re-lower is pure cache hits), host knobs through
   ``ServeEngine.apply_tuning``.  For ``rollback_window_s`` after a
   promotion, a live p99 regression beyond ``rollback_p99_rel`` × the
-  pre-promotion p99 reverts the whole decision.
+  pre-promotion p99 reverts the whole decision.  The guard needs a live
+  pre-promotion p99 baseline to arm; promoting without one (no traffic
+  yet, telemetry disabled) still applies the winner but counts
+  ``raft_tpu_autotune_guard_disarmed_total`` and reports
+  ``rollback_window_open=false`` rather than advertising a guard it
+  cannot enforce.
 
 Every decision (candidate, scores, promote/reject/rollback) exports
 through ``raft_tpu_autotune_*`` registry counters/gauges (visible in
@@ -197,6 +205,9 @@ class AutoTuner:
         self._previous: Optional[Dict[str, Any]] = None
         self._promoted_at = 0.0
         self._pre_p99: Optional[float] = None
+        #: True iff the open rollback window has a live pre-promotion
+        #: p99 baseline to compare against (see :meth:`promote`)
+        self._guard_armed = False
         self._label = (getattr(engine, "_engine_id", "?"),)
         self._evals = telemetry.counter(
             "raft_tpu_autotune_evals_total",
@@ -213,6 +224,11 @@ class AutoTuner:
         self._skipped = telemetry.counter(
             "raft_tpu_autotune_shadow_skipped_total",
             "shadow requests skipped (rows above the warmed ladder cap)",
+            labelnames=("engine",))
+        self._guard_disarmed = telemetry.counter(
+            "raft_tpu_autotune_guard_disarmed_total",
+            "promotions with no live pre-promotion p99 baseline: the "
+            "rollback guard could not arm",
             labelnames=("engine",))
         self._exploring = telemetry.gauge(
             "raft_tpu_autotune_exploring",
@@ -300,8 +316,12 @@ class AutoTuner:
         live = self.engine.shadow_samples()
         reqs: List[np.ndarray] = []
         if live:
+            # take <= len(live) always, so sample WITHOUT replacement: a
+            # short ring contributes each live request exactly once (the
+            # plan tops up the remainder) instead of duplicating some
+            # and dropping others
             take = min(n, len(live))
-            idx = rng.choice(len(live), size=take, replace=(len(live) < n))
+            idx = rng.choice(len(live), size=take, replace=False)
             reqs = [live[i] for i in idx]
         fill = n - len(reqs)
         if fill > 0 and self._plan is not None:
@@ -339,14 +359,36 @@ class AutoTuner:
                 return False
         return True
 
+    def _dispatch(self, be, block, lane: Optional[int]):
+        """One shadow super-batch dispatch.  A params candidate's
+        pre-warmed shadow backend owns its own searcher state and
+        dispatches directly; anything routed through the LIVE backend
+        serializes under the engine lock — the :class:`ServeEngine`
+        thread-safety contract: planning/dispatch share the handle's
+        stream pool, and a concurrent ``refresh()`` swaps ``_backend``
+        under that lock — so an off-thread ``explore()`` can never
+        interleave its dispatches with a live ``search()``'s.  A live
+        call at most waits behind ONE in-flight shadow super-batch; it
+        is never shed or failed."""
+        eng = self.engine
+        if be is not eng._backend:
+            return be.dispatch(block)
+        with eng._lock:
+            if lane is None:
+                return be.dispatch(block)
+            return be.dispatch(block, lane)
+
     def _measure_real(self, cand: Candidate,
                       requests: List[np.ndarray]) -> Score:
         """Replay *requests* against the candidate's off-path lane and
         measure (qps, p99, probe recall).  Param candidates replay
         through their pre-warmed shadow backend; knob candidates through
         the live backend's warmed executables (on the drained
-        ``shadow_lane`` for replica engines) — never through the engine
-        lock, admission, or router, so live traffic is untouched."""
+        ``shadow_lane`` for replica engines), each dispatch serialized
+        under the engine lock (:meth:`_dispatch`) — never through
+        admission or the router, so live requests are never shed or
+        failed by an evaluation (they can at most wait behind one
+        in-flight shadow super-batch)."""
         expects(requests, "no shadow traffic: serve some requests first "
                           "or pass shadow_plan=")
         eng = self.engine
@@ -404,11 +446,7 @@ class AutoTuner:
                                  ingested[members[0][0]].dtype)
                 for j, start, n in members:
                     block[start:start + n] = ingested[j]
-                if lane is None:
-                    out = be.dispatch(jnp.asarray(block))
-                else:
-                    out = be.dispatch(jnp.asarray(block), lane)
-                d, i = out
+                d, i = self._dispatch(be, jnp.asarray(block), lane)
                 # exempt(hot-path-host-transfer): shadow result delivery
                 d = np.asarray(d)
                 # exempt(hot-path-host-transfer): shadow result delivery
@@ -461,10 +499,7 @@ class AutoTuner:
         bucket = eng._bucket_for(int(qi.shape[0]), warmed)
         block = np.zeros((bucket, be.dim), qi.dtype)
         block[:qi.shape[0]] = qi
-        if self._shadow_lane is None:
-            out = be.dispatch(jnp.asarray(block))
-        else:
-            out = be.dispatch(jnp.asarray(block), self._shadow_lane)
+        out = self._dispatch(be, jnp.asarray(block), self._shadow_lane)
         # exempt(hot-path-host-transfer): recall-probe result fetch
         ids = np.asarray(out[1])
         return ids[:qi.shape[0]]
@@ -572,17 +607,30 @@ class AutoTuner:
         :meth:`warm_candidates` → the re-lower is pure ``aot()`` cache
         hits, zero compiles), host knobs through
         ``ServeEngine.apply_tuning``.  Records the rollback token + live
-        p99 baseline and opens the guard window.  The admission
+        p99 baseline and opens the guard window; with NO baseline (no
+        live traffic yet, or telemetry disabled) the promotion still
+        applies but the guard cannot arm — counted in
+        ``raft_tpu_autotune_guard_disarmed_total`` and reported as
+        ``rollback_window_open=false`` in ``/healthz``.  The admission
         controller's observed-cost EWMA resets so its estimates
         re-converge under the new config.  Returns the previous config
         (the rollback token)."""
         eng = self.engine
         pre_p99 = eng.latency_quantiles((0.99,))[0]
         prev_params = eng._ctor["params"]
+        pre_cap = eng.max_batch
         if cand.params is not None:
             eng.refresh(eng.index, params=cand.params)
-        prev = eng.apply_tuning(quantum_s=cand.quantum_s,
-                                max_batch=cand.max_batch)
+        # refresh() re-derives max_batch from the construction bound: a
+        # cap promoted by an EARLIER tune cycle must survive a params
+        # promotion, so re-assert the pre-refresh cap whenever this
+        # candidate leaves the ladder cap alone (a no-op when nothing
+        # was refreshed)
+        prev = eng.apply_tuning(
+            quantum_s=cand.quantum_s,
+            max_batch=(cand.max_batch if cand.max_batch is not None
+                       else pre_cap))
+        prev["max_batch"] = pre_cap  # the true pre-promotion cap
         adm = eng._admission
         if adm is not None:
             adm.reset_observed()
@@ -590,6 +638,9 @@ class AutoTuner:
         self._previous = dict(prev, params=prev_params)
         self._promoted_at = telemetry.now()
         self._pre_p99 = pre_p99
+        self._guard_armed = pre_p99 is not None and pre_p99 > 0.0
+        if not self._guard_armed:
+            self._guard_disarmed.inc(1, self._label)
         self._decide("promote", cand.name, "paired win")
         return dict(self._previous)
 
@@ -601,10 +652,16 @@ class AutoTuner:
         — knobs back through ``apply_tuning``).  *live_p99_s* defaults to
         the p99 of the engine's most recent ``search()`` call.  Returns
         True iff a rollback happened; once the window closes the
-        promotion is accepted and the guard disarms."""
+        promotion is accepted and the guard disarms.  A promotion whose
+        guard never armed (no pre-promotion baseline) is accepted
+        immediately — :meth:`promote` already counted and reported the
+        disarm."""
         cfg = self.cfg
         eng = self.engine
         if self._promoted is None:
+            return False
+        if not self._guard_armed:
+            self._promoted = None  # unguarded promotion: accepted as-is
             return False
         now = telemetry.now()
         if now - self._promoted_at > cfg.rollback_window_s:
@@ -616,13 +673,14 @@ class AutoTuner:
                 return False
             live_p99_s = float(np.percentile(lats, 99.0))
         pre = self._pre_p99
-        if pre is None or pre <= 0.0:
-            return False
         if live_p99_s <= cfg.rollback_p99_rel * pre:
             return False
         prev = self._previous or {}
         name = self._promoted.name
         if self._promoted.params is not None:
+            # the token's params apply VERBATIM (KEEP_PARAMS semantics):
+            # a None here restores a params=None construction instead of
+            # silently keeping the regressing candidate's params
             eng.refresh(eng.index, params=prev.get("params"))
         eng.apply_tuning(quantum_s=prev.get("quantum_s"),
                          max_batch=prev.get("max_batch"))
@@ -660,5 +718,9 @@ class AutoTuner:
             "decisions": [list(d) for d in self.decisions[-8:]],
             "promoted": (self._promoted.name
                          if self._promoted is not None else None),
-            "rollback_window_open": self._promoted is not None,
+            # open means ARMED: an unguarded promotion (no pre-promotion
+            # p99 baseline existed) must not advertise a guard window it
+            # cannot enforce
+            "rollback_window_open": (self._promoted is not None
+                                     and self._guard_armed),
         }

@@ -472,6 +472,23 @@ class _TieredBackend:
         return tiering.search(self.tiered, q, self.k, params=self.params)
 
 
+class _KeepParams:
+    """Sentinel type — :data:`KEEP_PARAMS` is its only instance."""
+
+    def __repr__(self) -> str:  # deterministic api-doc rendering
+        return "KEEP_PARAMS"
+
+
+#: :meth:`ServeEngine.refresh`'s ``params`` default: keep the current
+#: serving params.  Any OTHER value — including ``None`` — is applied
+#: verbatim (``None`` rebuilds the backend with its library-default
+#: params).  The distinction matters to the autotuner's guarded
+#: rollback: reverting a params promotion on an engine constructed with
+#: ``params=None`` must restore that ``None``, not silently keep the
+#: regressing candidate's params.
+KEEP_PARAMS = _KeepParams()
+
+
 def _make_backend(index, k, params, metric, metric_arg, batch_size_index):
     if isinstance(index, ann_mnmg.ReplicaSet):
         return _ReplicaBackend(index, k, params)
@@ -831,15 +848,17 @@ class ServeEngine:
             store.save_costs(fn, rows)
 
     # -- index refresh ------------------------------------------------------
-    def refresh(self, index, params=None) -> None:
+    def refresh(self, index, params=KEEP_PARAMS) -> None:
         """Swap the served index for *index* without cold-serving a single
         request — the serving half of the tiled-build refresh loop
         (docs/index_build.md): rebuild or ``extend()`` the index off the
         request path (``ivf_pq.build_sharded`` for multi-device serving),
         then ``refresh()`` it in.
 
-        The replacement backend (same k; *params* defaults to the current
-        serving params) is constructed and EVERY previously-warmed
+        The replacement backend (same k; *params* defaults to
+        :data:`KEEP_PARAMS` — the current serving params; any OTHER
+        value, including ``None`` for the backend's library defaults, is
+        applied verbatim) is constructed and EVERY previously-warmed
         (bucket, dtype) signature is pre-lowered through its ``aot()``
         cache BEFORE the swap, so compiles happen here — off the request
         path — and steady-state traffic after the swap stays
@@ -864,7 +883,7 @@ class ServeEngine:
         with self._lock:  # snapshot under the lock: warmup() mutates it
             c = dict(self._ctor)
             snapshot = {dt: set(bs) for dt, bs in self._warmed.items()}
-        if params is None:
+        if params is KEEP_PARAMS:
             params = c["params"]
         backend = _make_backend(index, c["k"], params, c["metric"],
                                 c["metric_arg"], c["batch_size_index"])

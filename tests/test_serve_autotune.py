@@ -5,6 +5,8 @@ promote counter asserts, params promotion through the atomic refresh
 swap, guarded rollback, per-lane cost-EWMA gradual shedding under an
 injected stalled lane, and AOT-store cost-row cold-start seeding."""
 
+import threading
+
 import numpy as np
 import pytest
 
@@ -245,6 +247,93 @@ class TestZeroCompile:
             eng.close()
 
 
+class TestShadowIsolation:
+    def test_shadow_dispatch_serializes_under_engine_lock(self, corpus):
+        """REVIEW medium: a knob candidate replays through the LIVE
+        backend's warmed executables — each dispatch must take the
+        engine lock (the ServeEngine thread-safety contract), so an
+        off-thread explore can never interleave with a live search()'s
+        planning/dispatch."""
+        eng = _bf_engine(corpus)
+        try:
+            eng.search(_reqs(seed=2))
+            tuner = AutoTuner(eng, TunerConfig(seed=0, pairs=1,
+                                               shadow_requests=4))
+            done = threading.Event()
+            out = {}
+
+            def shadow():
+                out["score"] = tuner._measure_real(
+                    Candidate("cap16", max_batch=16), _reqs(seed=3))
+                done.set()
+
+            with eng._lock:  # a live search() in flight
+                t = threading.Thread(target=shadow)
+                t.start()
+                # the replay queues behind the lock instead of racing it
+                assert not done.wait(0.2)
+            t.join(10.0)
+            assert done.is_set()
+            assert out["score"].qps > 0 and out["score"].served == 1.0
+        finally:
+            eng.close()
+
+    def test_live_search_racing_shadow_replay(self, corpus):
+        """Smoke: live search() calls interleaved with shadow replays on
+        another thread — every live result stays bit-identical to solo
+        (the shared stream pool is never entered concurrently)."""
+        eng = _bf_engine(corpus)
+        try:
+            eng.search(_reqs(seed=2))
+            tuner = AutoTuner(eng, TunerConfig(seed=0, pairs=1,
+                                               shadow_requests=4))
+            stop = threading.Event()
+            errs = []
+
+            def shadow():
+                while not stop.is_set():
+                    try:
+                        tuner._measure_real(
+                            Candidate("cap16", max_batch=16),
+                            _reqs(seed=5))
+                    except Exception as e:  # pragma: no cover
+                        errs.append(e)
+                        return
+
+            t = threading.Thread(target=shadow)
+            t.start()
+            try:
+                for s in range(5):
+                    reqs = _reqs(seed=20 + s)
+                    outs = eng.search(reqs)
+                    for q, (d, i) in zip(reqs, outs):
+                        _, i0 = knn(corpus, q, _K)
+                        np.testing.assert_array_equal(i, np.asarray(i0))
+            finally:
+                stop.set()
+                t.join(10.0)
+            assert not errs
+        finally:
+            eng.close()
+
+    def test_shadow_sampling_without_replacement(self, corpus):
+        """REVIEW low: a ring larger than the budget contributes distinct
+        live requests (no needless duplicates); a ring smaller than the
+        budget contributes EVERY live request exactly once."""
+        eng = _bf_engine(corpus)
+        try:
+            eng.search(_reqs(seed=2))  # 6 ring entries
+            tuner = AutoTuner(eng, TunerConfig(seed=0))
+            reqs = tuner.shadow_traffic(4, seed=1)
+            assert len(reqs) == 4
+            assert len({id(q) for q in reqs}) == 4
+            reqs = tuner.shadow_traffic(50, seed=1)  # budget > ring
+            assert len(reqs) == 6
+            assert len({id(q) for q in reqs}) == 6
+        finally:
+            eng.close()
+
+
 class TestRollback:
     def test_live_p99_regression_rolls_back(self, corpus):
         eng = _bf_engine(corpus)
@@ -261,6 +350,87 @@ class TestRollback:
             assert tuner.decisions[-1][1] == "rollback"
             # the guard disarmed: a second regression report is a no-op
             assert tuner.maybe_rollback(live_p99_s=100.0 * pre) is False
+        finally:
+            eng.close()
+
+    def test_params_rollback_on_params_none_engine(self, fl_index):
+        """THE guarded-rollback regression (REVIEW high): an engine
+        constructed with params=None promotes a params candidate, live
+        p99 regresses, and the rollback must restore the params=None
+        construction — refresh applies the token's None VERBATIM
+        (KEEP_PARAMS semantics) instead of treating it as 'keep the
+        regressing candidate's params'."""
+        sp1 = ivf_flat.SearchParams(n_probes=6)
+        eng = ServeEngine(fl_index, _K, max_batch=16)  # params=None
+        eng.warmup()
+        try:
+            eng.search(_reqs(seed=3))  # arm the guard with a baseline
+            tuner = AutoTuner(eng, TunerConfig(seed=0),
+                              param_variants=[sp1])
+            tuner.warm_candidates()
+            tuner.promote(Candidate("params0", params=sp1))
+            assert eng._ctor["params"] is sp1
+            assert eng._backend.n_probes == 6
+            pre = tuner._pre_p99
+            assert pre is not None and pre > 0.0
+            assert tuner.maybe_rollback(live_p99_s=100.0 * pre) is True
+            # the rollback actually took: ctor params are None again and
+            # the engine serves the library-default config
+            assert eng._ctor["params"] is None
+            assert eng._backend.n_probes == min(
+                ivf_flat.SearchParams().n_probes, fl_index.n_lists)
+            outs = eng.search(_reqs(seed=4))
+            for q, (d, i) in zip(_reqs(seed=4), outs):
+                _, i0 = ivf_flat.search(ivf_flat.SearchParams(),
+                                        fl_index, q, _K)
+                np.testing.assert_array_equal(i, np.asarray(i0))
+        finally:
+            eng.close()
+
+    def test_params_promotion_preserves_tuned_cap(self, fl_index):
+        """REVIEW medium: refresh() re-derives max_batch from the
+        construction bound — a cap promoted by an earlier tune cycle
+        must survive a later params-only promotion, and the later
+        promotion's rollback token must carry the TUNED cap, not the
+        construction default."""
+        sp1 = ivf_flat.SearchParams(n_probes=6)
+        eng = ServeEngine(fl_index, _K, max_batch=16)
+        eng.warmup()
+        try:
+            eng.search(_reqs(seed=3))
+            tuner = AutoTuner(eng, TunerConfig(seed=0),
+                              param_variants=[sp1])
+            tuner.warm_candidates()
+            tuner.promote(Candidate("cap8", max_batch=8))  # cycle 1
+            assert eng.max_batch == 8
+            prev = tuner.promote(Candidate("params0", params=sp1))
+            assert eng.max_batch == 8  # cycle 2 left the cap alone
+            assert prev["max_batch"] == 8  # token: pre-promotion state
+        finally:
+            eng.close()
+
+    def test_promotion_without_baseline_disarms_guard(self, corpus):
+        """REVIEW low: promoting with NO pre-promotion p99 baseline (no
+        live traffic yet) cannot arm the guard — /healthz must report
+        rollback_window_open=false (not advertise a guard it cannot
+        enforce) and the disarm is counted."""
+        eng = _bf_engine(corpus)
+        try:
+            tuner = AutoTuner(eng, TunerConfig(seed=0))
+            tuner.promote(Candidate("cap16", max_batch=16))
+            assert tuner._pre_p99 is None
+            body = eng._health()
+            assert body["autotune"]["promoted"] == "cap16"
+            assert body["autotune"]["rollback_window_open"] is False
+            disarmed = telemetry.REGISTRY.get(
+                "raft_tpu_autotune_guard_disarmed_total")
+            assert sum(v for labels, v in disarmed.items()
+                       if labels == (eng._engine_id,)) == 1
+            # an unguarded promotion is accepted as-is: a later p99
+            # report cannot roll it back
+            assert tuner.maybe_rollback(live_p99_s=1e9) is False
+            assert eng.max_batch == 16
+            assert tuner._promoted is None
         finally:
             eng.close()
 
@@ -286,6 +456,27 @@ class TestRollback:
             with pytest.raises(RaftError):
                 eng.apply_tuning(max_batch=24)  # not a warmed bucket
             assert eng.max_batch == 32
+        finally:
+            eng.close()
+
+
+class TestRefreshParamsSentinel:
+    def test_refresh_keeps_vs_applies_none(self, fl_index):
+        """refresh() params semantics: omitted (KEEP_PARAMS) keeps the
+        current serving params; an EXPLICIT None applies the backend's
+        library defaults — the distinction the tuner's rollback token
+        relies on."""
+        sp = ivf_flat.SearchParams(n_probes=6)
+        eng = ServeEngine(fl_index, _K, sp, max_batch=16)
+        eng.warmup()
+        try:
+            eng.refresh(fl_index)  # default: keep current params
+            assert eng._ctor["params"] is sp
+            assert eng._backend.n_probes == 6
+            eng.refresh(fl_index, params=None)  # explicit: defaults
+            assert eng._ctor["params"] is None
+            assert eng._backend.n_probes == min(
+                ivf_flat.SearchParams().n_probes, fl_index.n_lists)
         finally:
             eng.close()
 
