@@ -17,7 +17,7 @@ value / estimate, where ≥0.8 meets the north-star target.
 Select a metric with
 BENCH_METRIC=pairwise|kmeans|kmeans_mnmg|ivf_pq|ivf_pq_search|ivf_build|
 lanczos|knn_bruteforce|serve|ann_sharded|serve_replica|select_k|
-tiered_serve|serve_autotune.
+tiered_serve|serve_autotune|mutable.
 
 Robust bring-up (the round-1 failure was an unguarded TPU backend init):
 the measurement runs in a *child* process under a watchdog.  The parent
@@ -1591,6 +1591,206 @@ def bench_lanczos():
     }
 
 
+def bench_mutable():
+    """Mutable-index churn gates (ISSUE 20; docs/mutable_index.md).
+    One 50k×64 f32 IVF-Flat corpus, four independently-asserted gates on
+    the delta/tombstone/compaction machinery, all checked in-bench
+    before any number records:
+
+    * **write absorption** — sustained ``upsert`` throughput (tombstone
+      old row + in-place delta append, O(n_new) per batch) must absorb
+      ≥ 20k rows/s.  The timed pass replays the EXACT batch schedule of
+      an untimed warm pass on a sibling index, so every extend/append
+      executable is an AOT cache hit and the number measures the write
+      machinery, not compiles;
+    * **read overhead** — main∪delta+mask qps at ~10% delta fraction
+      (plus live tombstones) must hold ≥ 85% of the delta-free qps on
+      the best PAIRED replay (two MutableIndex views of the same main:
+      drift hits both sides of a pair and cancels — the PR-14/18
+      rationale);
+    * **top-k identity** — at full probes (n_probes = n_lists) the
+      merged search must return distances bit-identical to a
+      from-scratch rebuild of exactly the live rows, and the same id
+      set per row (tie ORDER at duplicated distances is the one
+      documented divergence, docs/mutable_index.md §identity);
+    * **churn cycle** — a full upsert → delete → compact → ``refresh``
+      cycle through a warmed ``ServeEngine``, serving the seeded
+      DIURNAL traffic plan (bench/common.traffic_requests) between every
+      mutation, must finish with ZERO compiles and ZERO failed requests
+      (ingest_errors/dispatch_errors/sheds counter-asserted, every
+      response shape-checked).  An untimed prepass cycle warms the
+      bucket ladder the counted cycle revisits.
+    """
+    import jax
+
+    from bench.common import DIURNAL_PLAN, traffic_requests
+    from raft_tpu.core.aot import aot_compile_counters
+    from raft_tpu.neighbors import ivf_flat, mutable
+    from raft_tpu.serve import ServeEngine
+
+    n, dim, k, n_lists = 50_000, 64, 10, 32
+    batch = 2048
+    rng = np.random.default_rng(0)
+    x = rng.random((n, dim), dtype=np.float32)
+    ids = np.arange(n, dtype=np.int64)
+    bp = ivf_flat.IndexParams(n_lists=n_lists, seed=1)
+    main = ivf_flat.build(bp, x, ids=ids)
+
+    # ---- gate 2 setup: two views of the same main, one churned ----
+    mut = mutable.MutableIndex(main, x, ids, build_params=bp)
+    mut_clean = mutable.MutableIndex(main, x, ids, build_params=bp)
+    # replace 5120 existing rows (tombstone + delta append) and delete
+    # 1000 more: delta fraction 5120/49000 ≈ 10.4%, live tombstones in
+    # the main scan — the shape the 15% read-overhead budget is quoted at
+    rep_sched = [(0, batch), (batch, 2 * batch), (2 * batch, 5120)]
+    new_rows = rng.random((5120, dim), dtype=np.float32)
+    for lo, hi in rep_sched:
+        mut.upsert(new_rows[lo:hi], ids[lo:hi])
+    mut.delete(ids[45_000:46_000])
+    assert mut.delta_fraction() >= 0.10, mut.delta_fraction()
+
+    # ---- gate 1: write absorption ≥ 20k rows/s ----
+    # mut above already walked this exact batch schedule, so mut2's timed
+    # replay hits the warmed extend/append executables; no searcher is
+    # attached, so no serve re-warm rides the timed path.
+    mut2 = mutable.MutableIndex(main, x, ids, build_params=bp)
+    t0 = time.perf_counter()
+    for lo, hi in rep_sched:
+        mut2.upsert(new_rows[lo:hi], ids[lo:hi])
+    mut2.delete(ids[45_000:46_000])
+    write_s = time.perf_counter() - t0
+    rows_written = 5120 + 1000
+    write_rows_per_s = rows_written / write_s
+    assert write_rows_per_s >= 20_000, (
+        f"write absorption {write_rows_per_s:.0f} rows/s < 20k gate "
+        f"({rows_written} rows in {write_s * 1e3:.1f} ms)")
+
+    # ---- gate 3: top-k identity vs rebuild oracle at full probes ----
+    # at n_probes = n_lists every list is scanned, so the merged result
+    # is brute force over the live set — independent of clustering
+    nq = 256
+    q = rng.random((nq, dim), dtype=np.float32)
+    live_x = x.copy()
+    live_x[:5120] = new_rows
+    keep = np.ones(n, dtype=bool)
+    keep[45_000:46_000] = False
+    oracle = ivf_flat.build(bp, live_x[keep], ids=ids[keep])
+    sp_full = ivf_flat.SearchParams(n_probes=n_lists)
+    qd = jax.device_put(q)
+    d_m, i_m = mutable.search(mut, qd, k, params=sp_full)
+    d_o, i_o = ivf_flat.search(sp_full, oracle, qd, k)
+    d_m, i_m = np.asarray(d_m), np.asarray(i_m)
+    d_o, i_o = np.asarray(d_o), np.asarray(i_o)
+    assert np.array_equal(d_m, d_o), \
+        "merged top-k distances != rebuild oracle at full probes"
+    id_rows_equal = sum(set(a.tolist()) == set(b.tolist())
+                        for a, b in zip(i_m, i_o))
+    assert id_rows_equal == nq, (
+        f"merged top-k id SET differs from the rebuild oracle on "
+        f"{nq - id_rows_equal}/{nq} rows (beyond documented tie-order)")
+
+    # ---- gate 2: read overhead ≤ 15% qps at ~10% delta ----
+    sp8 = ivf_flat.SearchParams(n_probes=8)
+    mutable.search(mut_clean, qd, k, params=sp8)   # warm delta-free
+    mutable.search(mut, qd, k, params=sp8)         # warm merged
+    pair_ratio = 0.0
+    best = {"clean": float("inf"), "merged": float("inf")}
+    for _ in range(5):  # paired replays: drift cancels within a pair
+        t0 = time.perf_counter()
+        out = mutable.search(mut_clean, qd, k, params=sp8)
+        jax.block_until_ready(out[0])
+        t_clean = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = mutable.search(mut, qd, k, params=sp8)
+        jax.block_until_ready(out[0])
+        t_merged = time.perf_counter() - t0
+        best["clean"] = min(best["clean"], t_clean)
+        best["merged"] = min(best["merged"], t_merged)
+        pair_ratio = max(pair_ratio, t_clean / t_merged)
+    qps_clean = nq / best["clean"]
+    qps_merged = nq / best["merged"]
+    # gate on the best PAIR (drift cancels); report best-of overhead
+    overhead_pct = (qps_clean / qps_merged - 1.0) * 100.0
+    assert pair_ratio >= 1.0 / 1.15, (
+        f"main∪delta read overhead {(1 / pair_ratio - 1) * 100:.1f}% qps "
+        f"> 15% gate at {mut.delta_fraction() * 100:.1f}% delta "
+        f"({qps_merged:.0f} vs {qps_clean:.0f} qps)")
+
+    # ---- gate 4: zero-compile / zero-failure churn cycle ----
+    eng = ServeEngine(mut2, k, params=sp8, max_batch=1024)
+    eng.warmup()
+
+    # shape-idempotent churn payload: the SAME fresh-id batch and row
+    # values every cycle — upsert, delete that same batch, compact, so
+    # the live set (and with it the rebuilt main's bucketed leaf shapes
+    # AND its trained centers, which steer the delta's per-list chunk
+    # growth) is identical at every compact
+    cyc_rows = rng.random((batch, dim), dtype=np.float32)
+    fresh = np.arange(n, n + batch, dtype=np.int64)
+
+    def cycle(seed):
+        served, failed = 0, 0
+        chunks = [traffic_requests(DIURNAL_PLAN, seed=seed + j,
+                                   n_requests=10, dim=dim)
+                  for j in range(4)]
+        for j, step in enumerate((
+                lambda: mut2.upsert(cyc_rows, fresh),
+                lambda: mut2.delete(fresh),
+                lambda: mut2.compact(engine=eng),
+                lambda: None)):
+            outs = eng.search(chunks[j])
+            for req, (d, i) in zip(chunks[j], outs):
+                ok = (np.asarray(d).shape == (req.shape[0], k)
+                      and np.asarray(i).shape == (req.shape[0], k))
+                served += 1
+                failed += 0 if ok else 1
+            step()
+        return served, failed
+
+    # three untimed prepasses: cycle 1 transitions off the gate-1/2
+    # state (original main + 5120-row delta); cycle 2 runs on the first
+    # compacted main, whose live-row SNAPSHOT ORDER (and so its trained
+    # centers) still differs from later rebuilds; by cycle 3 the
+    # rebuild is a fixed point and the counted cycle 4 replays its
+    # exact signature sequence
+    cycle(seed=100)
+    cycle(seed=150)
+    cycle(seed=175)
+    err0 = sum(eng.stats[key] for key in
+               ("ingest_errors", "dispatch_errors", "sheds"))
+    c0 = aot_compile_counters["compiles"]
+    served, failed = cycle(seed=200)
+    cycle_compiles = aot_compile_counters["compiles"] - c0
+    cycle_errs = sum(eng.stats[key] for key in
+                     ("ingest_errors", "dispatch_errors", "sheds")) - err0
+    assert cycle_compiles == 0, (
+        f"{cycle_compiles} compiles across the warmed "
+        "upsert→delete→compact→refresh cycle")
+    assert failed == 0 and cycle_errs == 0, (
+        f"{failed} malformed responses / {cycle_errs} engine errors "
+        "across the churn cycle")
+    assert eng.stats["refreshes"] >= 4, "compaction never promoted"
+
+    return {
+        "metric": f"mutable_churn_ivf_flat_{n // 1000}kx{dim}",
+        "value": round(write_rows_per_s, 0),
+        "unit": "rows/s",
+        # the gate ratio: merged-read qps over delta-free qps at ~10%
+        "vs_baseline": round(qps_merged / qps_clean, 3),
+        "write_rows_per_s": round(write_rows_per_s, 0),
+        "read_qps_clean": round(qps_clean, 1),
+        "read_qps_merged": round(qps_merged, 1),
+        "read_overhead_pct": round(overhead_pct, 1),
+        "delta_fraction": round(mut.delta_fraction(), 4),
+        "tombstone_fraction": round(mut.tombstone_fraction(), 4),
+        "topk_identity": True,
+        "cycle_requests": served,
+        "cycle_failed": failed,
+        "cycle_compiles": cycle_compiles,
+        "zero_compile_cycle": True,
+    }
+
+
 _METRICS = {"pairwise": bench_pairwise, "kmeans": bench_kmeans,
             "kmeans_mnmg": bench_kmeans_mnmg, "ivf_pq": bench_ivf_pq,
             "ivf_pq_search": bench_ivf_pq_search,
@@ -1600,7 +1800,8 @@ _METRICS = {"pairwise": bench_pairwise, "kmeans": bench_kmeans,
             "serve_replica": bench_serve_replica,
             "select_k": bench_select_k,
             "tiered_serve": bench_tiered_serve,
-            "serve_autotune": bench_serve_autotune}
+            "serve_autotune": bench_serve_autotune,
+            "mutable": bench_mutable}
 
 #: Per-metric child-environment overrides.  The replica-scaling metric is
 #: a VIRTUAL-DEVICE contract gate (the 2D shard x replica carve needs a

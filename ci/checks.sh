@@ -45,7 +45,7 @@ mods = [
     "raft_tpu.spectral", "raft_tpu.solver", "raft_tpu.comms",
     "raft_tpu.neighbors", "raft_tpu.neighbors.ivf_flat",
     "raft_tpu.neighbors.ivf_pq", "raft_tpu.neighbors.ball_cover",
-    "raft_tpu.neighbors.tiering",
+    "raft_tpu.neighbors.tiering", "raft_tpu.neighbors.mutable",
     "raft_tpu.serve", "raft_tpu.serve.admission",
     "raft_tpu.serve.supervise", "raft_tpu.serve.schedule",
     "raft_tpu.serve.autotune",

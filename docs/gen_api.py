@@ -118,7 +118,7 @@ def _public_members(mod):
 # __getattr__) — rendered as their own sections.
 _SUBMODULES = {
     "neighbors": ["ivf_flat", "ivf_pq", "ball_cover", "ann", "knn_mnmg",
-                  "ann_mnmg", "tiering", "serialize"],
+                  "ann_mnmg", "tiering", "mutable", "serialize"],
     # kmeans_mnmg's surface (fit/predict/compute_new_centroids) lives on
     # the submodule, not the package namespace — without this section the
     # MNMG API (including fit's loop=/sync_every= knobs) is undocumented.

@@ -40,6 +40,7 @@ DECLARING_MODULES = (
     "raft_tpu.neighbors._build",
     "raft_tpu.neighbors.ann_mnmg",
     "raft_tpu.neighbors.tiering",
+    "raft_tpu.neighbors.mutable",
     "raft_tpu.cluster.kmeans",
     "raft_tpu.kernels.select_k",
     "raft_tpu.kernels.fused_l2nn",

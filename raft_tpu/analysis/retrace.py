@@ -710,6 +710,165 @@ def certify_tuner_closure(files: Dict[str, ast.Module]
     return out
 
 
+_MUTATE_MODULES = ("raft_tpu/neighbors/mutable.py",
+                   "raft_tpu/neighbors/_common.py",
+                   "raft_tpu/neighbors/ivf_flat.py",
+                   "raft_tpu/neighbors/ivf_pq.py")
+
+
+def _class_method(tree: ast.Module, cls: str, name: str
+                  ) -> Optional[ast.FunctionDef]:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            for sub in node.body:
+                if isinstance(sub, ast.FunctionDef) and sub.name == name:
+                    return sub
+    return None
+
+
+def certify_mutate_closure(files: Dict[str, ast.Module]
+                           ) -> List[ObligationReport]:
+    """The mutable-index obligations: the tombstone mask is applied
+    INSIDE the families' fixed-shape probe scan (so a delete/upsert can
+    never change lowered HLO), both families actually thread the mask,
+    tombstone-bitmap capacity grows only through the power-of-two
+    ``_bucket_dim`` ladder (bounded signature count over an index's
+    life), writes that change delta/bitmap shapes re-warm every recorded
+    serve signature BEFORE returning (compiles ride the write path,
+    never the read path), the warmed dispatch snapshots state under the
+    write lock (donated in-place delta appends stay safe against a
+    racing read), compaction promotes its rebuilt core ONLY through
+    ``ServeEngine.refresh`` (never a raw backend assignment), and the
+    engine actually routes ``MutableIndex`` to its delegation backend.
+    Together: serving stays zero-compile and zero-failed-request by
+    construction across upsert → delete → compact → refresh."""
+    out: List[ObligationReport] = []
+
+    def obligation(name, ok, why_fail, detail=""):
+        out.append(ObligationReport(
+            f"serve.mutate_closure.{name}", "ok" if ok else "fail",
+            [] if ok else [why_fail], detail))
+
+    trees: Dict[str, ast.Module] = dict(files)
+    for rel in _MUTATE_MODULES:
+        if rel in trees:
+            continue
+        p = REPO_ROOT / rel
+        if p.is_file():
+            trees[rel] = ast.parse(p.read_text())
+    mut = trees.get("raft_tpu/neighbors/mutable.py")
+    if mut is None:
+        return [ObligationReport(
+            "serve.mutate_closure", "fail",
+            ["raft_tpu/neighbors/mutable.py not found — the mutable "
+             "index moved; update _MUTATE_MODULES and re-prove the "
+             "closure"])]
+
+    # 1. the mask lives INSIDE the shared fixed-shape probe scan
+    common = trees.get("raft_tpu/neighbors/_common.py")
+    scan = None if common is None else _function(common,
+                                                "scan_probe_lists")
+    has_param = scan is not None and any(
+        a.arg == "tombstones" for a in scan.args.args + scan.args.kwonlyargs)
+    applies = scan is not None and any(
+        isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+        and n.func.id == "tombstone_hit" for n in ast.walk(scan))
+    obligation(
+        "mask_in_scan", has_param and applies,
+        "scan_probe_lists no longer takes/applies a `tombstones` bitmap "
+        "inside the tile program — deletes would need per-mutation "
+        "retraces (or post-hoc filtering that breaks top-k)")
+
+    # 2. both families thread the mask into that scan
+    threaded = []
+    for rel in ("raft_tpu/neighbors/ivf_flat.py",
+                "raft_tpu/neighbors/ivf_pq.py"):
+        tree = trees.get(rel)
+        ok = tree is not None and any(
+            isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+            and n.func.id == "scan_probe_lists"
+            and any(kw.arg == "tombstones" for kw in n.keywords)
+            for n in ast.walk(tree))
+        if not ok:
+            threaded.append(rel)
+    obligation(
+        "families_thread_mask", not threaded,
+        "family search impls no longer pass `tombstones=` to "
+        "scan_probe_lists: " + ", ".join(threaded),
+        "ivf_flat + ivf_pq")
+
+    # 3. bitmap capacity binds ONLY through the power-of-two ladder
+    tw = _function(mut, "_tomb_words")
+    via_ladder = tw is not None and any(
+        isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+        and n.func.id == "_bucket_dim" for n in ast.walk(tw))
+    users = sum(
+        1 for n in ast.walk(mut)
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+        and n.func.id == "_tomb_words")
+    obligation(
+        "tomb_buckets_via_ladder", via_ladder and users >= 2,
+        "_tomb_words no longer routes tombstone-bitmap capacity through "
+        "_bucket_dim (or stopped being the one sizing door) — bitmap "
+        "growth could mint one serve signature per max-id value",
+        f"{users} sizing site(s), all via _bucket_dim")
+
+    # 4. shape-changing writes re-warm before returning
+    upsert = _class_method(mut, "MutableIndex", "upsert")
+    rewarms = upsert is not None and any(
+        isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+        and n.func.attr == "_rewarm_locked" for n in ast.walk(upsert))
+    obligation(
+        "writes_rewarm_signatures", rewarms,
+        "MutableIndex.upsert no longer re-warms recorded serve "
+        "signatures on a shape change — the first read after a delta "
+        "growth would compile on the request path")
+
+    # 5. the warmed dispatch snapshots state under the write lock
+    dispatch = _class_method(mut, "MutableSearcher", "dispatch")
+    locked = dispatch is not None and any(
+        isinstance(n, ast.With) and any(
+            isinstance(item.context_expr, ast.Attribute)
+            and item.context_expr.attr == "_lock"
+            for item in n.items)
+        for n in ast.walk(dispatch))
+    obligation(
+        "dispatch_snapshots_under_lock", locked,
+        "MutableSearcher.dispatch no longer holds the write lock — a "
+        "donated in-place delta append can race a dispatch into "
+        "use-after-donate")
+
+    # 6. compaction promotes ONLY through the certified refresh swap
+    compact = _class_method(mut, "MutableIndex", "compact")
+    via_refresh = compact is not None and any(
+        isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+        and n.func.attr == "refresh" for n in ast.walk(compact))
+    raw = [f"line {t.lineno}" for n in ast.walk(mut)
+           if isinstance(n, (ast.Assign, ast.AugAssign))
+           for t in (n.targets if isinstance(n, ast.Assign)
+                     else [n.target])
+           if isinstance(t, ast.Attribute) and t.attr == "_backend"]
+    obligation(
+        "compact_promotes_via_refresh", via_refresh and not raw,
+        "MutableIndex.compact no longer promotes through "
+        "ServeEngine.refresh (or assigns a backend directly: "
+        + (", ".join(raw) or "-") + ") — the swap escaped the certified "
+        "warm-before-swap surface")
+
+    # 7. the engine routes MutableIndex to its delegation backend
+    engine = files.get("raft_tpu/serve/engine.py")
+    mk = None if engine is None else _function(engine, "_make_backend")
+    routed = mk is not None and any(
+        isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+        and n.func.id == "_MutableBackend" for n in ast.walk(mk))
+    obligation(
+        "backend_registered", routed,
+        "_make_backend no longer returns _MutableBackend for "
+        "MutableIndex — mutable serving would silently fall through to "
+        "the brute-force backend")
+    return out
+
+
 # ---------------------------------------------------------------------------
 # certificate 3: static-arg value cardinality at aot() call sites
 
@@ -889,6 +1048,7 @@ def run(names: Optional[Sequence[str]] = None, *, out=None,
     reports.extend(certify_bucket_closure(serve_files))
     reports.extend(certify_scheduler_closure(serve_files))
     reports.extend(certify_tuner_closure(serve_files))
+    reports.extend(certify_mutate_closure(serve_files))
 
     # cardinality scan over the library (or the caller-supplied roots)
     card_findings: List[str] = []

@@ -6,6 +6,7 @@ from raft_tpu.analysis.rules import (  # noqa: F401
     dtype_drift,
     error_discipline,
     host_transfer,
+    mutation_discipline,
     pallas_discipline,
     probe_scan,
     reductions,
@@ -17,6 +18,6 @@ from raft_tpu.analysis.rules import (  # noqa: F401
 )
 
 __all__ = ["collectives", "dtype_drift", "error_discipline",
-           "host_transfer", "pallas_discipline", "probe_scan",
-           "reductions", "serve_path", "static_args", "style",
-           "telemetry_discipline", "trace_purity"]
+           "host_transfer", "mutation_discipline", "pallas_discipline",
+           "probe_scan", "reductions", "serve_path", "static_args",
+           "style", "telemetry_discipline", "trace_purity"]

@@ -33,7 +33,7 @@ def __getattr__(name):
     # Lazy submodule access for the ANN index families (ivf_flat, ivf_pq,
     # ball_cover) so importing the light exact-kNN surface stays cheap.
     if name in ("ivf_flat", "ivf_pq", "ball_cover", "serialize", "ann",
-                "knn_mnmg", "ann_mnmg", "tiering"):
+                "knn_mnmg", "ann_mnmg", "tiering", "mutable"):
         import importlib
 
         return importlib.import_module(f"raft_tpu.neighbors.{name}")

@@ -405,9 +405,27 @@ def expand_probes(probe_ids, chunk_table, n_rows: int,
 _SCAN_STACK_MIN_K = 24
 
 
+def tombstone_hit(ids, words):
+    """Per-id membership test against a packed tombstone bitmap.
+
+    *words* is a (n_words,) uint32 device bitmap maintained by
+    ``neighbors.mutable.MutableIndex`` — bit ``id % 32`` of word
+    ``id // 32`` set means the row id is dead.  The writer guarantees the
+    bitmap's bit capacity covers every live id in the index (capacity is
+    grown in power-of-two word buckets BEFORE any id past it can be
+    tombstoned), so the clamp below only ever rewrites the ``-1`` padding
+    ids of empty slots — and those are masked by the live-size mask
+    regardless of what bit they read.
+    """
+    safe = jnp.clip(ids, 0, words.shape[0] * 32 - 1)
+    word = words[safe >> 5]
+    return ((word >> (safe.astype(jnp.uint32) & 31)) & 1).astype(bool)
+
+
 def scan_probe_lists(probe_ids, score_tile: Callable, list_indices,
                      list_sizes, k: int, select_min: bool, dtype,
-                     xs: Optional[Tuple] = None, engine: str = "xla"
+                     xs: Optional[Tuple] = None, engine: str = "xla",
+                     tombstones=None
                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Running top-k over per-query probed lists — the shared inner loop of
     IVF-Flat, IVF-PQ and ball-cover search.
@@ -436,6 +454,14 @@ def scan_probe_lists(probe_ids, score_tile: Callable, list_indices,
     ``raft_tpu.kernels.resolve_engine``); the sorted-run merge is
     engine-agnostic because both engines emit identical sorted runs.
 
+    *tombstones*: optional (n_words,) uint32 packed bitmap (see
+    :func:`tombstone_hit`); rows whose gathered id has its bit set score
+    the sentinel exactly like padding slots.  This is the mutable-index
+    delete/upsert mask (``neighbors.mutable``): because it rides the same
+    ``jnp.where`` as the pad-row mask inside the fixed-shape tile
+    program, mutations never change the lowered HLO — only the bitmap's
+    VALUES change, and the serve ladder stays warm.
+
     Wide k (``k >= _SCAN_STACK_MIN_K``) switches the loop body from the
     running per-step (select_k + O(k²) sorted-run merge) to STACKING the
     masked tile scores as scan ys and running ONE wide select over all
@@ -461,6 +487,11 @@ def scan_probe_lists(probe_ids, score_tile: Callable, list_indices,
         ids = list_indices[probe_col]
         sizes = list_sizes[probe_col]
         live = jnp.arange(cap)[None, :] < sizes[:, None]
+        if tombstones is not None:
+            # mutable-index delete/upsert mask: dead rows score the same
+            # sentinel as padding slots, INSIDE the fixed-shape tile
+            # program, so no mutation ever changes the lowered HLO
+            live = jnp.logical_and(live, ~tombstone_hit(ids, tombstones))
         return jnp.where(live, d, sentinel), ids
 
     if k >= _SCAN_STACK_MIN_K and n_steps * cap >= k:
@@ -492,6 +523,40 @@ def scan_probe_lists(probe_ids, score_tile: Callable, list_indices,
     (best_d, best_i), _ = jax.lax.scan(
         step, init, (jnp.swapaxes(probe_ids, 0, 1),) + tuple(xs or ()))
     return best_d, best_i
+
+
+def validate_new_ids(new_ids, list_indices, phys_sizes) -> None:
+    """Reject caller-supplied extend ids that collide — within the batch
+    or with any id already live in the index.
+
+    A duplicate id silently yields two live rows answering for one key
+    (and breaks the delete/upsert bookkeeping of
+    ``neighbors.mutable.MutableIndex``, which assumes id ↔ row is 1:1),
+    so both families fail loudly here instead.  Build-side validation
+    only — the serve path never supplies ids — so the O(index) host
+    gather of the id column is off the hot path.
+    """
+    # exempt(hot-path-host-transfer): build-side id validation, not serve
+    ids_h = np.asarray(new_ids)
+    uniq = np.unique(ids_h)
+    if uniq.size != ids_h.size:
+        dup = ids_h[np.isin(ids_h, uniq[np.bincount(
+            np.searchsorted(uniq, ids_h)) > 1])]
+        raise ValueError(
+            f"extend: duplicate ids within new_ids batch: "
+            f"{np.unique(dup)[:8].tolist()}")
+    # exempt(hot-path-host-transfer): build-side id validation, not serve
+    idx_h = np.asarray(list_indices)
+    # exempt(hot-path-host-transfer): build-side id validation, not serve
+    psz_h = np.asarray(phys_sizes)
+    live = idx_h[np.arange(idx_h.shape[1])[None, :] < psz_h[:, None]]
+    clash = np.intersect1d(ids_h, live)
+    if clash.size:
+        raise ValueError(
+            f"extend: ids already live in the index: "
+            f"{clash[:8].tolist()} — a duplicate id would yield two live "
+            f"rows for one key; use neighbors.mutable.MutableIndex.upsert "
+            f"for replace semantics")
 
 
 def empty_result(nq: int, k: int, dtype):

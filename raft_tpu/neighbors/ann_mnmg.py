@@ -463,7 +463,8 @@ def _merge_one_allgather(comms: Comms, d, i, k: int, select_min: bool):
 
 
 def _ivf_flat_program(comms: Comms, metric_val: int, k: int, n_probes: int,
-                      probe_extra: int, engine: str = "xla"):
+                      probe_extra: int, engine: str = "xla",
+                      masked: bool = False):
     sqrt = metric_val == int(DistanceType.L2SqrtExpanded)
     is_ip = metric_val == int(DistanceType.InnerProduct)
     # defer the L2Sqrt root PAST the merge: shards merge squared distances
@@ -471,11 +472,17 @@ def _ivf_flat_program(comms: Comms, metric_val: int, k: int, n_probes: int,
     # order; the root is applied once on the merged (nq, k)
     scan_metric = (int(DistanceType.L2Expanded) if sqrt else metric_val)
 
-    def program(q, centers, data, idx, psz, ctab):
+    # ``masked`` grows ONE trailing replicated input — the mutable-index
+    # tombstone bitmap (neighbors.mutable) — threaded into the per-shard
+    # scan, where _common.scan_probe_lists folds it into the pad-row mask.
+    # A separate program variant (not a runtime branch): the unmasked
+    # serving ladder's lowered HLO stays byte-identical.
+    def program(q, centers, data, idx, psz, ctab, *tomb):
         local = (centers, data[0], idx[0], psz[0], ctab[0])
         d, i = ivf_flat._search_batch_impl(q, local, scan_metric, k,
                                            n_probes, False, probe_extra,
-                                           engine)
+                                           engine,
+                                           tomb[0] if masked else None)
         d, i = _merge_one_allgather(comms, d, i, k, select_min=not is_ip)
         if sqrt:
             d = jnp.sqrt(jnp.maximum(d, 0))
@@ -487,19 +494,20 @@ def _ivf_flat_program(comms: Comms, metric_val: int, k: int, n_probes: int,
 def _ivf_pq_program(comms: Comms, metric_val: int, k: int, n_probes: int,
                     per_cluster: bool, lut_dtype: str, int_dtype: str,
                     pq_bits: int, hoisted: bool, probe_extra: int,
-                    engine: str = "xla"):
+                    engine: str = "xla", masked: bool = False):
     sqrt = metric_val == int(DistanceType.L2SqrtExpanded)
     is_ip = metric_val == int(DistanceType.InnerProduct)
     scan_metric = (int(DistanceType.L2Expanded) if sqrt else metric_val)
 
     def program(q, centers, rotation, codebooks, list_adc,
-                codes, idx, psz, ctab, owner, csum):
+                codes, idx, psz, ctab, owner, csum, *tomb):
         leaves = (centers, rotation, codebooks, codes[0], idx[0], psz[0],
                   ctab[0], owner[0], list_adc, csum[0])
         d, i = ivf_pq._full_search_impl(q, leaves, scan_metric, k, n_probes,
                                         per_cluster, lut_dtype, int_dtype,
                                         pq_bits, hoisted, probe_extra,
-                                        engine)
+                                        engine,
+                                        tomb[0] if masked else None)
         d, i = _merge_one_allgather(comms, d, i, k, select_min=not is_ip)
         if sqrt:
             d = jnp.sqrt(jnp.maximum(d, 0))
@@ -529,10 +537,14 @@ def _brute_force_program(comms: Comms, metric_val: int, metric_arg: float,
     return program
 
 
-def _searcher_fn(sharded: ShardedIndex, key, builder) -> MeshAotFunction:
+def _searcher_fn(sharded: ShardedIndex, key, builder,
+                 extra_replicated: int = 0) -> MeshAotFunction:
     """One MeshAotFunction per (communicator, program statics): program
     identity (and with it the jit/AOT caches) is stable across repeated
-    searcher constructions — the kmeans_mnmg._cached_program pattern."""
+    searcher constructions — the kmeans_mnmg._cached_program pattern.
+
+    *extra_replicated*: trailing replicated inputs AFTER the stacked
+    shard blocks (the masked program variants' tombstone bitmap)."""
     from jax.sharding import PartitionSpec as P
 
     comms = sharded.comms
@@ -541,7 +553,8 @@ def _searcher_fn(sharded: ShardedIndex, key, builder) -> MeshAotFunction:
         program = builder()
         n_rep = len(sharded.replicated)
         in_specs = ((P(),) + (P(),) * n_rep
-                    + (P(comms.axis_name),) * len(sharded.stacked))
+                    + (P(comms.axis_name),) * len(sharded.stacked)
+                    + (P(),) * extra_replicated)
         mapped = shard_map_compat(program, comms.mesh, in_specs,
                                   (P(), P()), check_vma=False)
         return MeshAotFunction(mapped)
@@ -555,12 +568,19 @@ class ShardedSearcher:
     and dispatches.  ``warm(bucket, dtype)`` pre-lowers the (bucket,
     dtype, world) signature through the MeshAot cache;
     ``dispatch(qb)`` runs one pre-bucketed query batch and returns
-    replicated (d, i)."""
+    replicated (d, i).
 
-    def __init__(self, sharded: ShardedIndex, k: int, params=None):
+    ``masked=True`` selects the tombstone-masked program variant
+    (``neighbors.mutable``): warm/dispatch then take ONE trailing
+    replicated uint32 bitmap argument.  A distinct program-cache key, so
+    masked and unmasked ladders never cross-pollute."""
+
+    def __init__(self, sharded: ShardedIndex, k: int, params=None, *,
+                 masked: bool = False):
         expects(k >= 1, "k must be >= 1")
         self.sharded = sharded
         self.k = int(k)
+        self.masked = bool(masked)
         aux = sharded.aux
         if sharded.kind == "ivf_flat":
             p = params or ivf_flat.SearchParams()
@@ -574,10 +594,10 @@ class ShardedSearcher:
 
             engine = resolve_engine("select_k", dtype=jnp.float32)
             key = ("ivf_flat", aux["metric"], self.k, self.n_probes,
-                   aux["probe_extra"], engine)
+                   aux["probe_extra"], engine, self.masked)
             builder = lambda: _ivf_flat_program(  # noqa: E731
                 sharded.comms, aux["metric"], self.k, self.n_probes,
-                aux["probe_extra"], engine)
+                aux["probe_extra"], engine, masked=self.masked)
         elif sharded.kind == "ivf_pq":
             p = params or ivf_pq.SearchParams()
             expects(p.lut_dtype in ivf_pq._LUT_DTYPES,
@@ -592,14 +612,16 @@ class ShardedSearcher:
             statics = (aux["metric"], self.k, self.n_probes, per_cluster,
                        p.lut_dtype, p.internal_distance_dtype,
                        aux["pq_bits"], hoisted, aux["probe_extra"], engine)
-            key = ("ivf_pq",) + statics
+            key = ("ivf_pq",) + statics + (self.masked,)
             builder = lambda: _ivf_pq_program(  # noqa: E731
-                sharded.comms, *statics)
+                sharded.comms, *statics, masked=self.masked)
             self.hoisted = hoisted
             self.lut_dtype = p.lut_dtype
         else:
             expects(sharded.kind == "brute_force",
                     f"unknown sharded kind {sharded.kind!r}")
+            expects(not self.masked, "tombstone masking needs an IVF kind "
+                    "(brute_force has no id-carrying probe scan)")
             expects(params is None, "brute_force sharded search takes no "
                     "SearchParams (metric rides the ShardedIndex)")
             expects(self.k <= aux["n_rows"],
@@ -609,31 +631,40 @@ class ShardedSearcher:
             builder = lambda: _brute_force_program(  # noqa: E731
                 sharded.comms, aux["metric"], aux["metric_arg"], self.k,
                 aux["tile"], aux["rows_per"])
-        self.fn = _searcher_fn(sharded, key, builder)
+        self.fn = _searcher_fn(sharded, key, builder,
+                               extra_replicated=1 if self.masked else 0)
         self._tail = tuple(sharded.replicated) + tuple(sharded.stacked)
 
     @property
     def dim(self) -> int:
         return self.sharded.dim
 
-    def _q_spec(self, bucket: int, dtype):
+    def _rep_spec(self, shape, dtype):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         return jax.ShapeDtypeStruct(
-            (int(bucket), self.dim), jnp.dtype(dtype),
+            tuple(int(s) for s in shape), jnp.dtype(dtype),
             sharding=NamedSharding(self.sharded.comms.mesh, P()))
 
-    def warm(self, bucket: int, dtype) -> None:
-        """Pre-lower+compile the (bucket, dtype, world) signature."""
-        self.fn.compiled(self._q_spec(bucket, dtype), *self._tail)
+    def _q_spec(self, bucket: int, dtype):
+        return self._rep_spec((int(bucket), self.dim), dtype)
 
-    def dispatch(self, qb):
+    def warm(self, bucket: int, dtype, *extra) -> None:
+        """Pre-lower+compile the (bucket, dtype, world) signature.
+        ``masked`` searchers pass the tombstone-bitmap word count as one
+        extra int (the bitmap shape is part of the signature)."""
+        extra = tuple(self._rep_spec((int(n),), jnp.uint32) for n in extra)
+        self.fn.compiled(self._q_spec(bucket, dtype), *self._tail, *extra)
+
+    def dispatch(self, qb, *extra):
         """Run one PRE-BUCKETED (bucket, dim) batch; returns replicated
-        (d (bucket, k), i (bucket, k))."""
+        (d (bucket, k), i (bucket, k)).  ``masked`` searchers pass the
+        replicated tombstone bitmap (already globalized — the mutable
+        writer replicates it ONCE per mutation, not per dispatch)."""
         from jax.sharding import PartitionSpec as P
 
         q = self.sharded.comms.globalize(jnp.asarray(qb), P())
-        return self.fn(q, *self._tail)
+        return self.fn(q, *self._tail, *extra)
 
 
 # ---------------------------------------------------------------------------

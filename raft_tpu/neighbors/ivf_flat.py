@@ -51,6 +51,7 @@ from raft_tpu.neighbors._common import (
     pack_lists_chunked,
     scan_probe_lists,
     subsample_trainset,
+    validate_new_ids,
 )
 from raft_tpu.random.rng import RngState
 
@@ -283,7 +284,16 @@ def extend(index: Index, new_vectors, new_ids=None, *,
     ``in_place=True`` DONATES the old blocks when no list overflows —
     O(n_new) append, no O(index) copy, the input index is consumed.
     ``tiled=False`` / ``RAFT_TPU_TILED_BUILD=0`` restores the pre-PR path
-    (bit-identical results)."""
+    (bit-identical results).
+
+    .. note::
+       Caller-supplied *new_ids* are validated for uniqueness — within
+       the batch AND against every id already live in the index — and a
+       collision raises ``ValueError`` loudly: a duplicate id would
+       silently yield two live rows answering for one key.  Replace
+       semantics (tombstone the old row, append the new) live in
+       :meth:`raft_tpu.neighbors.mutable.MutableIndex.upsert`.
+    """
     xa = jnp.asarray(new_vectors)
     expects(xa.ndim == 2 and xa.shape[1] == index.dim, "dim mismatch")
     n_new = xa.shape[0]
@@ -293,6 +303,7 @@ def extend(index: Index, new_vectors, new_ids=None, *,
     else:
         new_ids = jnp.asarray(new_ids, jnp.int32)
         expects(new_ids.shape == (n_new,), "ids must be (n_new,)")
+        validate_new_ids(new_ids, index.list_indices, index.phys_sizes)
 
     xf = xa.astype(_compute_dtype(xa))
     q = _normalize_rows(xf) if index.metric == DistanceType.CosineExpanded else xf
@@ -341,7 +352,7 @@ def _owner_of(chunk_table, n_phys_rows: int):
 
 def _search_batch_impl(queries, index_leaves, metric_val: int, k: int,
                        n_probes: int, sqrt: bool, probe_extra: int = -1,
-                       engine: str = "xla"):
+                       engine: str = "xla", tombstones=None):
     """ONE program for a query batch: coarse ranking → top-n_probes →
     probe-list scan → top-k (reference ivf_flat_search.cuh:1057 pipeline).
 
@@ -377,12 +388,13 @@ def _search_batch_impl(queries, index_leaves, metric_val: int, k: int,
     _, probe_sel = select_k(cd, n_probes, select_min=True, engine=engine)
     probe_ids = probe_sel.astype(jnp.int32)
     return _probe_search_impl(queries, probe_ids, index_leaves[1:],
-                              metric_val, k, sqrt, probe_extra, engine)
+                              metric_val, k, sqrt, probe_extra, engine,
+                              tombstones)
 
 
 def _probe_search_impl(queries, probe_ids, scan_leaves, metric_val: int,
                        k: int, sqrt: bool, probe_extra: int = -1,
-                       engine: str = "xla"):
+                       engine: str = "xla", tombstones=None):
     """The probe-scoring stage of :func:`_search_batch_impl` with the probe
     set supplied EXPLICITLY: ``scan_leaves`` is the index leaves minus the
     centroids — (list_data, list_indices, phys_sizes, chunk_table).
@@ -431,7 +443,8 @@ def _probe_search_impl(queries, probe_ids, scan_leaves, metric_val: int,
                                 extra=None if probe_extra < 0 else probe_extra)
     best_d, best_i = scan_probe_lists(phys_probes, score_tile, list_indices,
                                       phys_sizes, k, select_min=not is_ip,
-                                      dtype=acc_t, engine=engine)
+                                      dtype=acc_t, engine=engine,
+                                      tombstones=tombstones)
     if sqrt:
         best_d = jnp.sqrt(jnp.maximum(best_d, 0))
     return best_d, best_i

@@ -92,6 +92,7 @@ from raft_tpu.neighbors._common import (
     pack_lists_chunked,
     scan_probe_lists,
     subsample_trainset,
+    validate_new_ids,
 )
 from raft_tpu.random.rng import RngState
 
@@ -1029,7 +1030,16 @@ def extend(index: Index, new_vectors, new_ids=None, *,
     O(index) copy) — the input *index* is consumed and must not be used
     afterwards.  ``tiled=False`` (or ``RAFT_TPU_TILED_BUILD=0``) restores
     the pre-PR monolithic encode + grow-by-concat path (the A/B baseline,
-    bit-identical results)."""
+    bit-identical results).
+
+    .. note::
+       Caller-supplied *new_ids* are validated for uniqueness — within
+       the batch AND against every id already live in the index — and a
+       collision raises ``ValueError`` loudly: a duplicate id would
+       silently yield two live rows answering for one key.  Replace
+       semantics (tombstone the old row, append the new) live in
+       :meth:`raft_tpu.neighbors.mutable.MutableIndex.upsert`.
+    """
     x, new_dtype = _ingest_dataset(new_vectors)
     expects(new_dtype == index.dataset_dtype,
             f"extend dtype {new_dtype} != index dataset dtype "
@@ -1043,6 +1053,7 @@ def extend(index: Index, new_vectors, new_ids=None, *,
     else:
         new_ids = jnp.asarray(new_ids, jnp.int32)
         expects(new_ids.shape == (n_new,), "ids must be (n_new,)")
+        validate_new_ids(new_ids, index.list_indices, index.phys_sizes)
 
     use_tiled = _build.resolve_tiled(tiled)
     per_cluster = index.codebook_kind == CodebookKind.PER_CLUSTER
@@ -1083,7 +1094,8 @@ def _scan_hoisted(q, probe_ids, rot_q, rot_centers, centers, codebooks,
                   chunk_table, nq: int, pq_dim: int, kcb: int, ds: int,
                   k: int, is_ip: bool, per_cluster: bool,
                   lut_dtype_name: str, acc_dtype, pq_bits: int,
-                  probe_extra: int = -1, engine: str = "xla"):
+                  probe_extra: int = -1, engine: str = "xla",
+                  tombstones=None):
     """Hoisted-ADC probe scan: per-batch LUT stage + lookup-only scan body.
 
     Stage 2 of the pipeline (stage 1 is the build-time ``list_adc`` /
@@ -1222,7 +1234,8 @@ def _scan_hoisted(q, probe_ids, rot_q, rot_centers, centers, codebooks,
 
     return scan_probe_lists(phys_probes, score_tile_hoisted, list_indices,
                             phys_sizes, k, select_min=not is_ip,
-                            dtype=jnp.float32, xs=xs, engine=engine)
+                            dtype=jnp.float32, xs=xs, engine=engine,
+                            tombstones=tombstones)
 
 
 def _quantize_lut(lut, base, lut_dtype_name: str):
@@ -1255,7 +1268,8 @@ def _quantize_lut(lut, base, lut_dtype_name: str):
 def _search_batch_impl(q, probe_ids, leaves, metric_val: int, k: int,
                        per_cluster: bool, lut_dtype_name: str,
                        int_dtype_name: str, pq_bits: int, hoisted: bool,
-                       probe_extra: int = -1, engine: str = "xla"):
+                       probe_extra: int = -1, engine: str = "xla",
+                       tombstones=None):
     """Score probed lists via per-query LUTs (reference similarity kernels
     ivf_pq_search.cuh:594-738) with a running top-k merge.
 
@@ -1292,7 +1306,7 @@ def _search_batch_impl(q, probe_ids, leaves, metric_val: int, k: int,
             list_adc, list_csum, list_codes, list_indices, phys_sizes,
             chunk_table,
             nq, pq_dim, kcb, ds, k, is_ip, per_cluster, lut_dtype_name,
-            acc_dtype, pq_bits, probe_extra, engine)
+            acc_dtype, pq_bits, probe_extra, engine, tombstones)
         if metric_val == int(DistanceType.L2SqrtExpanded):
             best_d = jnp.sqrt(jnp.maximum(best_d, 0))
         return best_d, best_i
@@ -1381,7 +1395,8 @@ def _search_batch_impl(q, probe_ids, leaves, metric_val: int, k: int,
                                 extra=None if probe_extra < 0 else probe_extra)
     best_d, best_i = scan_probe_lists(phys_probes, score_tile, list_indices,
                                       phys_sizes, k, select_min=not is_ip,
-                                      dtype=jnp.float32)
+                                      dtype=jnp.float32,
+                                      tombstones=tombstones)
     if metric_val == int(DistanceType.L2SqrtExpanded):
         best_d = jnp.sqrt(jnp.maximum(best_d, 0))
     return best_d, best_i
@@ -1401,7 +1416,8 @@ _search_batch_aot = aot(_search_batch_impl, static_argnums=_SEARCH_STATICS)
 def _full_search_impl(queries, leaves, metric_val: int, k: int,
                       n_probes: int, per_cluster: bool, lut_dtype_name: str,
                       int_dtype_name: str, pq_bits: int, hoisted: bool,
-                      probe_extra: int = -1, engine: str = "xla"):
+                      probe_extra: int = -1, engine: str = "xla",
+                      tombstones=None):
     """Coarse ranking + top-n_probes + probe scoring as ONE program — the
     serving entry point (``serve.ServeEngine``): the whole query-batch →
     (d, i) computation is one AOT-cacheable executable whose signatures can
@@ -1418,7 +1434,7 @@ def _full_search_impl(queries, leaves, metric_val: int, k: int,
     return _search_batch_impl(queries, probes.astype(jnp.int32), leaves,
                               metric_val, k, per_cluster, lut_dtype_name,
                               int_dtype_name, pq_bits, hoisted, probe_extra,
-                              engine)
+                              engine, tombstones)
 
 
 _FULL_SEARCH_STATICS = (2, 3, 4, 5, 6, 7, 8, 9, 10, 11)

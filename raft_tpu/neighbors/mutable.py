@@ -1,0 +1,1017 @@
+"""Mutable fleet index: delta segment + tombstones + background compaction.
+
+The serving spine (warmed zero-retrace dispatch, admission/supervision,
+autotuning) serves an APPEND-ONLY index; a production corpus churns.
+:class:`MutableIndex` wraps the triple (main index, delta segment,
+tombstone set) and absorbs writes at O(delta) cost while the main —
+single-device family Index or the sharded fleet — keeps serving reads
+through the UNCHANGED warmed executables:
+
+* **Deletes** set bits in a fixed-capacity device bitmap keyed by row id
+  (``_common.tombstone_hit``), grown in power-of-two word buckets
+  (:func:`_tomb_words` — the serve signature ladder stays closed).  The
+  mask is applied INSIDE the fixed-shape probe-scan tile program
+  (``_common.scan_probe_lists``): dead rows score the sentinel exactly
+  like padding slots, so a mutation changes bitmap VALUES, never the
+  lowered HLO.
+* **Upserts** tombstone the old row and append into a small
+  single-device delta index that shares the main's trained model
+  (centers / rotation / codebooks — one label space), built with the
+  existing tiled ``_build`` machinery via ``extend(in_place=True)`` —
+  O(n_new) per batch, zero compiles on the warm read path.
+* **Reads** search main ∪ delta: both scanned through the family's
+  unchanged fixed-shape programs (tombstones masked in-scan), folded
+  with the on-device ``merge_sorted_parts`` merge — main is part 0, so
+  main wins ties (the ONE documented tie-order divergence vs a
+  from-scratch rebuild of the same live rows; at full probe coverage
+  every returned distance is bit-identical).
+* **Compaction** (:class:`Compactor`, a supervise.py-style seeded
+  daemon) rebuilds main ∪ delta minus tombstones through the family
+  ``build`` / ``build_sharded`` OFF the request path past a
+  delta-fraction or tombstone-fraction threshold, chases the write
+  journal, pre-warms every recorded serve signature at the new shapes,
+  swaps the core atomically, and promotes through
+  ``ServeEngine.refresh`` — zero-compile post-swap steady state, zero
+  failed requests during the swap (both counter-asserted by
+  tests/bench).
+
+Consistency model: a search dispatch snapshots (main, delta, tombstones)
+under the write lock, so every read sees a single write-ordered state;
+in-flight reads during a compaction swap finish against the OLD core
+(still warm, still consistent) and the next dispatch sees the new one.
+Writes briefly serialize with reads (the lock also makes the donated
+in-place delta append safe against a racing dispatch).
+
+State-mutation discipline (the ``mutation-discipline`` analysis rule):
+tombstone bitmaps and delta blocks are mutated ONLY through
+:class:`MutableIndex` methods — raw writes elsewhere are findings.
+
+docs/mutable_index.md has the full design note.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu import telemetry
+from raft_tpu.analysis.registry import hlo_program
+from raft_tpu.core.aot import _bucket_dim, aot, dispatch_device
+from raft_tpu.core.error import expects
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.matrix.select_k import (_merge_aot, merge_sorted_parts,
+                                      merge_sorted_runs)
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+
+#: mutable-index lifecycle events (upsert/delete batches and rows,
+#: delta rebuilds, signature rewarms, compaction errors)
+mutable_counters = telemetry.legacy_counter(
+    "raft_tpu_mutable_events_total",
+    "Mutable-index lifecycle events (upsert/delete batches + rows, delta "
+    "dedup rebuilds, write-path signature rewarms, compaction errors)")
+
+#: the four headline metrics the ISSUE names
+_delta_rows_gauge = telemetry.gauge(
+    "raft_tpu_mutable_delta_rows",
+    "Rows currently live in the write-optimized delta segment")
+_tombstones_gauge = telemetry.gauge(
+    "raft_tpu_mutable_tombstones",
+    "Row ids currently tombstoned (main + delta)")
+_compactions_counter = telemetry.counter(
+    "raft_tpu_mutable_compactions",
+    "Background compactions completed (delta + tombstones folded back "
+    "into a freshly built main)")
+compaction_seconds = telemetry.histogram(
+    "raft_tpu_mutable_compaction_seconds",
+    "Wall seconds per compaction (rebuild + journal chase + rewarm + "
+    "swap)")
+
+
+def _tomb_words(max_id: int) -> int:
+    """Tombstone-bitmap word capacity for ids up to *max_id*: the
+    power-of-two bucket ladder (``_bucket_dim``), so bitmap growth mints
+    at most O(log max_id) distinct serve signatures over an index's whole
+    life — the delta/tombstone analogue of the query-bucket ladder."""
+    need = (int(max_id) + 32) // 32
+    return _bucket_dim(max(need, 1))
+
+
+# ---------------------------------------------------------------------------
+# the delta-merged search program
+
+
+def _family_scan(q, leaves, kind: str, scan_metric: int, k: int,
+                 n_probes: int, per_cluster: bool, lut_dtype_name: str,
+                 int_dtype_name: str, pq_bits: int, hoisted: bool,
+                 engine: str, tombstones):
+    """One segment (main or delta) through the family's UNCHANGED search
+    program, tombstone mask threaded into the scan."""
+    if kind == "ivf_flat":
+        return ivf_flat._search_batch_impl(q, leaves, scan_metric, k,
+                                           n_probes, False, -1, engine,
+                                           tombstones)
+    return ivf_pq._full_search_impl(q, leaves, scan_metric, k, n_probes,
+                                    per_cluster, lut_dtype_name,
+                                    int_dtype_name, pq_bits, hoisted, -1,
+                                    engine, tombstones)
+
+
+def _merged_search_impl(q, main_leaves, delta_leaves, tomb_main, tomb_delta,
+                        kind: str, metric_val: int, k: int, n_probes: int,
+                        per_cluster: bool, lut_dtype_name: str,
+                        int_dtype_name: str, pq_bits: int, hoisted: bool,
+                        engine: str):
+    """main ∪ delta as ONE program: two fixed-shape family scans (each
+    masked by its segment's tombstone bitmap) folded by the on-device
+    ``merge_sorted_parts`` — main is part 0, so main wins duplicated
+    distances (the documented tie order).  The L2Sqrt root is deferred
+    PAST the merge (the ann_mnmg cross-shard discipline), keeping the
+    fold's tie comparisons in the exact squared domain.
+
+    ``delta_leaves=None`` (with ``tomb_delta=None``) is the delta-free
+    variant — a DISTINCT AOT signature (None flattens to zero leaves),
+    same function, so the delete-only serving state stays on this one
+    executable cache too.
+    """
+    sqrt = metric_val == int(DistanceType.L2SqrtExpanded)
+    is_ip = metric_val == int(DistanceType.InnerProduct)
+    scan_metric = (int(DistanceType.L2Expanded) if sqrt else metric_val)
+    d, i = _family_scan(q, main_leaves, kind, scan_metric, k, n_probes,
+                        per_cluster, lut_dtype_name, int_dtype_name,
+                        pq_bits, hoisted, engine, tomb_main)
+    if delta_leaves is not None:
+        dd, di = _family_scan(q, delta_leaves, kind, scan_metric, k,
+                              n_probes, per_cluster, lut_dtype_name,
+                              int_dtype_name, pq_bits, hoisted, engine,
+                              tomb_delta)
+        d, i = merge_sorted_parts(jnp.stack([d, dd]), jnp.stack([i, di]),
+                                  k=k, select_min=not is_ip)
+    if sqrt:
+        d = jnp.sqrt(jnp.maximum(d, 0))
+    return d, i
+
+
+_MERGED_STATICS = (5, 6, 7, 8, 9, 10, 11, 12, 13, 14)
+_merged_jit = jax.jit(_merged_search_impl, static_argnums=_MERGED_STATICS)
+_merged_aot = aot(_merged_search_impl, static_argnums=_MERGED_STATICS)
+
+
+# ---------------------------------------------------------------------------
+# core state (swapped wholesale by compaction)
+
+
+class _Core:
+    """One consistent (main, delta, tombstones) snapshot.  Compaction
+    builds a NEW core off the request path and swaps the reference; the
+    old core keeps serving in-flight reads unchanged."""
+
+    __slots__ = (
+        "kind", "sharded", "main", "delta", "tomb_main_bits",
+        "tomb_delta_bits", "tomb_main_mesh", "words_main", "words_delta",
+        "n_words", "main_ids", "main_dead", "delta_live", "delta_dead",
+        "store", "searcher_cache")
+
+    def __init__(self, kind, sharded, main, main_ids, store, n_words):
+        self.kind = kind
+        self.sharded = sharded
+        self.main = main
+        self.delta = None                       # family Index, lazily built
+        self.n_words = int(n_words)
+        self.words_main = np.zeros((self.n_words,), np.uint32)
+        self.words_delta = np.zeros((self.n_words,), np.uint32)
+        self.tomb_main_bits = None              # device mirrors, see _push
+        self.tomb_delta_bits = None
+        self.tomb_main_mesh = None              # replicated copy (sharded)
+        self.main_ids = np.unique(np.asarray(main_ids, np.int64))
+        self.main_dead = set()                  # ids tombstoned in main
+        self.delta_live = {}                    # id -> True (insert order)
+        self.delta_dead = set()                 # ids dead but still packed
+        self.store = store                      # id -> host row (np 1-D)
+        self.searcher_cache = {}                # (k, params) -> main searcher
+
+    @property
+    def live_count(self) -> int:
+        return (self.main_ids.size - len(self.main_dead)
+                + len(self.delta_live))
+
+    @property
+    def delta_rows(self) -> int:
+        return len(self.delta_live)
+
+    @property
+    def tombstones(self) -> int:
+        return len(self.main_dead) + len(self.delta_dead)
+
+
+def _main_leaves(core: _Core):
+    m = core.main
+    if core.kind == "ivf_flat":
+        return (m.centers, m.list_data, m.list_indices, m.phys_sizes,
+                m.chunk_table)
+    return (m.centers, m.rotation, m.codebooks, m.list_codes,
+            m.list_indices, m.phys_sizes, m.chunk_table, m.owner,
+            m.list_adc, m.list_csum)
+
+
+def _delta_leaves(core: _Core):
+    d = core.delta
+    if d is None:
+        return None
+    if core.kind == "ivf_flat":
+        return (d.centers, d.list_data, d.list_indices, d.phys_sizes,
+                d.chunk_table)
+    return (d.centers, d.rotation, d.codebooks, d.list_codes,
+            d.list_indices, d.phys_sizes, d.chunk_table, d.owner,
+            d.list_adc, d.list_csum)
+
+
+def _leaf_shapes(core: _Core):
+    """The signature-relevant shape tuple: a write that leaves this
+    unchanged cannot mint a new executable."""
+    dl = _delta_leaves(core)
+    return (core.n_words,
+            None if dl is None else tuple(a.shape for a in dl))
+
+
+# ---------------------------------------------------------------------------
+# the mutable container
+
+
+class MutableIndex:
+    """(main index, delta segment, tombstone set) with zero-stall serving.
+
+    *main* is a built family Index (``ivf_flat`` / ``ivf_pq``) or an
+    ``ann_mnmg.ShardedIndex`` of one of those kinds; *dataset* / *ids*
+    are the rows it was built from — retained host-side (the tiering
+    refine-store precedent) so compaction (and, for the lossy PQ codes,
+    ANY rebuild) can re-encode live rows exactly.  *build_params* is the
+    family IndexParams compaction rebuilds with.
+
+    All state mutation goes through :meth:`upsert` / :meth:`delete` /
+    :meth:`compact` (the ``mutation-discipline`` analysis rule enforces
+    this repo-wide).  Reads go through :func:`search` or a
+    :meth:`searcher` — the object ``serve.ServeEngine``'s
+    ``_MutableBackend`` warms and dispatches.
+    """
+
+    def __init__(self, main, dataset, ids=None, *, build_params=None,
+                 comms=None):
+        from raft_tpu.neighbors import ann_mnmg
+
+        if isinstance(main, ann_mnmg.ShardedIndex):
+            kind, sharded = main.kind, True
+            expects(kind in ("ivf_flat", "ivf_pq"),
+                    "MutableIndex needs an IVF kind (brute_force has no "
+                    "id-carrying probe scan to mask)")
+            self._comms = main.comms
+        else:
+            sharded = False
+            if isinstance(main, ivf_flat.Index):
+                kind = "ivf_flat"
+            else:
+                expects(isinstance(main, ivf_pq.Index),
+                        f"unsupported main index type {type(main)!r}")
+                kind = "ivf_pq"
+            self._comms = comms
+        x = np.asarray(dataset)
+        expects(x.ndim == 2, "dataset must be (n, dim)")
+        if ids is None:
+            ids = np.arange(x.shape[0], dtype=np.int32)
+        ids = np.asarray(ids, np.int64)
+        expects(ids.shape == (x.shape[0],), "ids must be (n,)")
+        expects(ids.size == np.unique(ids).size, "ids must be unique")
+        expects(ids.size == 0 or int(ids.min()) >= 0,
+                "ids must be non-negative")
+        store = {int(j): x[r] for r, j in enumerate(ids)}
+        max_id = int(ids.max()) if ids.size else 0
+        self._mut_core = _Core(kind, sharded, main, ids, store,
+                               _tomb_words(max_id))
+        self.build_params = build_params
+        self._lock = threading.RLock()
+        self._compact_lock = threading.Lock()
+        self._journal = None
+        self._searchers = {}
+        self._push_tombstones(self._mut_core)
+
+    # -- read-side surface -------------------------------------------------
+
+    @property
+    def kind(self) -> str:
+        return self._mut_core.kind
+
+    @property
+    def dim(self) -> int:
+        core = self._mut_core
+        return int(core.main.dim)
+
+    @property
+    def metric(self) -> DistanceType:
+        core = self._mut_core
+        if core.sharded:
+            return DistanceType(core.main.aux["metric"])
+        return core.main.metric
+
+    @property
+    def size(self) -> int:
+        """LIVE row count (main + delta minus tombstones)."""
+        return self._mut_core.live_count
+
+    @property
+    def delta_rows(self) -> int:
+        return self._mut_core.delta_rows
+
+    @property
+    def tombstone_count(self) -> int:
+        return self._mut_core.tombstones
+
+    def delta_fraction(self) -> float:
+        core = self._mut_core
+        return core.delta_rows / max(core.live_count, 1)
+
+    def tombstone_fraction(self) -> float:
+        core = self._mut_core
+        denom = core.main_ids.size + len(core.delta_live) \
+            + len(core.delta_dead)
+        return core.tombstones / max(denom, 1)
+
+    def live_rows(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(vectors, ids) of every live row, main order then delta
+        insertion order — the rebuild-oracle input."""
+        with self._lock:
+            return self._live_rows_locked(self._mut_core)
+
+    def to_index(self):
+        """From-scratch rebuild of the live rows with *build_params* —
+        the oracle tests/bench compare against (retrains the coarse
+        model, so probe sets differ below full probe coverage)."""
+        expects(self.build_params is not None,
+                "to_index()/compact() need build_params")
+        x, ids = self.live_rows()
+        family = ivf_flat if self.kind == "ivf_flat" else ivf_pq
+        if self._mut_core.sharded:
+            return family.build_sharded(self.build_params, x, self._comms,
+                                        ids=jnp.asarray(ids, jnp.int32))
+        return family.build(self.build_params, x,
+                            ids=jnp.asarray(ids, jnp.int32))
+
+    def searcher(self, k: int, params=None) -> "MutableSearcher":
+        """Get-or-create the warmed serving searcher for (k, params)."""
+        key = (int(k), repr(params))
+        with self._lock:
+            s = self._searchers.get(key)
+            if s is None:
+                s = MutableSearcher(self, int(k), params)
+                self._searchers[key] = s
+            return s
+
+    # -- write-side surface ------------------------------------------------
+
+    def delete(self, ids) -> int:
+        """Tombstone *ids*.  Unknown / already-dead ids are a no-op.
+        Returns the number of rows newly tombstoned.  O(batch) host
+        bookkeeping + one O(n_words) bitmap upload — never a recompile
+        (bitmap capacity already covers every live id)."""
+        ids = np.atleast_1d(np.asarray(ids, np.int64))
+        with self._lock:
+            if self._journal is not None:
+                self._journal.append(("delete", ids.copy()))
+            n = self._delete_core(self._mut_core, ids)
+            self._record_state(self._mut_core)
+            return n
+
+    def upsert(self, x, ids) -> None:
+        """Insert-or-replace rows: tombstone any old row with these ids
+        (in main OR delta) and append the new rows into the delta via the
+        family's tiled ``extend(in_place=True)`` — O(n_new) per batch.
+        Re-upserting an id still physically packed in the delta triggers
+        an O(delta) delta dedup rebuild first (rare; the delta stays
+        small by construction).  When a batch changes the delta/bitmap
+        SHAPES, the write path re-warms every recorded serve signature
+        before returning — reads stay zero-compile always."""
+        x = np.asarray(x)
+        expects(x.ndim == 2 and x.shape[1] == self.dim,
+                "upsert rows must be (n, dim)")
+        ids = np.asarray(ids, np.int64)
+        expects(ids.shape == (x.shape[0],), "ids must be (n,)")
+        expects(ids.size == np.unique(ids).size,
+                "upsert ids must be unique within the batch")
+        expects(ids.size == 0 or int(ids.min()) >= 0,
+                "ids must be non-negative")
+        with self._lock:
+            if self._journal is not None:
+                self._journal.append(("upsert", x.copy(), ids.copy()))
+            before = _leaf_shapes(self._mut_core)
+            self._upsert_core(self._mut_core, x, ids)
+            if _leaf_shapes(self._mut_core) != before:
+                self._rewarm_locked()
+            self._record_state(self._mut_core)
+
+    # -- internal write ops (operate on an EXPLICIT core: the public
+    # methods pass the live one, compaction's journal replay the new one)
+
+    def _delete_core(self, core: _Core, ids) -> int:
+        n = 0
+        main_member = np.isin(ids, core.main_ids)
+        for j, in_main in zip(ids.tolist(), main_member.tolist()):
+            if j in core.delta_live:
+                del core.delta_live[j]
+                core.delta_dead.add(j)
+                core.words_delta[j >> 5] |= np.uint32(1 << (j & 31))
+                n += 1
+            elif in_main and j not in core.main_dead:
+                core.main_dead.add(j)
+                core.words_main[j >> 5] |= np.uint32(1 << (j & 31))
+                n += 1
+        if n:
+            self._push_tombstones(core)
+        mutable_counters.inc("deletes")
+        mutable_counters.inc("delete_rows", n)
+        return n
+
+    def _upsert_core(self, core: _Core, x, ids) -> None:
+        max_id = int(ids.max()) if ids.size else 0
+        words = _tomb_words(max(max_id, core.n_words * 32 - 1))
+        if words != core.n_words:
+            self._grow_tombstones(core, words)
+        stale = [j for j in ids.tolist()
+                 if j in core.delta_live or j in core.delta_dead]
+        if stale:
+            self._rebuild_delta(core, exclude=set(stale))
+        # supersede main rows
+        main_hits = ids[np.isin(ids, core.main_ids)]
+        dirty = False
+        for j in main_hits.tolist():
+            if j not in core.main_dead:
+                core.main_dead.add(j)
+                core.words_main[j >> 5] |= np.uint32(1 << (j & 31))
+                dirty = True
+        if dirty:
+            self._push_tombstones(core)
+        self._delta_append(core, x, ids)
+        for r, j in enumerate(ids.tolist()):
+            core.store[j] = x[r]
+            core.delta_live[j] = True
+        mutable_counters.inc("upserts")
+        mutable_counters.inc("upsert_rows", int(ids.size))
+
+    def _delta_append(self, core: _Core, x, ids) -> None:
+        family = ivf_flat if core.kind == "ivf_flat" else ivf_pq
+        if core.delta is None:
+            core.delta = self._empty_delta(core)
+        core.delta = family.extend(core.delta, x,
+                                   jnp.asarray(ids, jnp.int32),
+                                   in_place=True)
+
+    def _rebuild_delta(self, core: _Core, exclude=()) -> None:
+        """Repack the delta from its LIVE rows minus *exclude* — the
+        O(delta) slow path a duplicate-id upsert takes (an append-only
+        segment cannot mask one of two same-id rows by id alone).  Clears
+        the delta tombstone bitmap: dead rows are physically gone."""
+        keep = [j for j in core.delta_live if j not in exclude]
+        core.words_delta[:] = 0
+        core.delta_dead.clear()
+        core.delta = None
+        old_live = core.delta_live
+        core.delta_live = {}
+        if keep:
+            x = np.stack([core.store[j] for j in keep])
+            self._delta_append(core, x, np.asarray(keep, np.int64))
+            for j in keep:
+                core.delta_live[j] = True
+        else:
+            del old_live
+        self._push_tombstones(core)
+        mutable_counters.inc("delta_rebuilds")
+
+    def _grow_tombstones(self, core: _Core, n_words: int) -> None:
+        grown = np.zeros((n_words,), np.uint32)
+        grown[:core.n_words] = core.words_main
+        core.words_main = grown
+        grown_d = np.zeros((n_words,), np.uint32)
+        grown_d[:core.n_words] = core.words_delta
+        core.words_delta = grown_d
+        core.n_words = int(n_words)
+        self._push_tombstones(core)
+
+    def _push_tombstones(self, core: _Core) -> None:
+        """Publish the host bitmaps to the device(s): one O(n_words)
+        upload per write batch (words, not rows).  Same shapes → same
+        warmed signatures; only the values change."""
+        dev = dispatch_device()
+        core.tomb_main_bits = jax.device_put(core.words_main, dev)
+        core.tomb_delta_bits = jax.device_put(core.words_delta, dev)
+        if core.sharded:
+            from jax.sharding import PartitionSpec as P
+
+            core.tomb_main_mesh = self._comms.globalize(
+                jnp.asarray(core.words_main), P())
+
+    def _empty_delta(self, core: _Core):
+        """A zero-row family Index sharing the main's trained model (one
+        label space — delta rows land in the same inverted lists a full
+        rebuild would put them in).  ``extend`` from here takes its
+        fresh-pack path, so the whole delta lifecycle rides the tiled
+        ``_build`` machinery."""
+        if core.sharded:
+            rep = core.main.replicated
+            dev = dispatch_device()
+            model = tuple(jax.device_put(np.asarray(a), dev) for a in rep)
+            aux = core.main.aux
+            n_lists = int(aux["n_lists"])
+            metric = DistanceType(aux["metric"])
+            dim = int(core.main.dim)
+            if core.kind == "ivf_flat":
+                data_dtype = core.main.stacked[0].dtype
+                return ivf_flat.Index(
+                    centers=model[0],
+                    list_data=jnp.zeros((1, 1, dim), data_dtype),
+                    list_indices=jnp.full((1, 1), -1, jnp.int32),
+                    list_sizes=jnp.zeros((n_lists,), jnp.int32),
+                    phys_sizes=jnp.zeros((1,), jnp.int32),
+                    chunk_table=jnp.zeros((n_lists, 1), jnp.int32),
+                    metric=metric, adaptive_centers=False)
+            codes_w = int(core.main.stacked[0].shape[-1])
+            return ivf_pq.Index(
+                centers=model[0], rotation=model[1], codebooks=model[2],
+                list_codes=jnp.zeros((1, 1, codes_w),
+                                     core.main.stacked[0].dtype),
+                list_indices=jnp.full((1, 1), -1, jnp.int32),
+                list_sizes=jnp.zeros((n_lists,), jnp.int32),
+                phys_sizes=jnp.zeros((1,), jnp.int32),
+                chunk_table=jnp.zeros((n_lists, 1), jnp.int32),
+                owner=jnp.zeros((1,), jnp.int32),
+                list_adc=model[3],
+                list_csum=jnp.zeros((1, 1),
+                                    core.main.stacked[5].dtype),
+                metric=metric,
+                codebook_kind=ivf_pq.CodebookKind(aux["codebook_kind"]),
+                pq_bits=int(aux["pq_bits"]),
+                dataset_dtype=aux["dataset_dtype"])
+        m = core.main
+        if core.kind == "ivf_flat":
+            return ivf_flat.Index(
+                centers=m.centers,
+                list_data=jnp.zeros((1, 1, m.dim), m.list_data.dtype),
+                list_indices=jnp.full((1, 1), -1, jnp.int32),
+                list_sizes=jnp.zeros((m.n_lists,), jnp.int32),
+                phys_sizes=jnp.zeros((1,), jnp.int32),
+                chunk_table=jnp.zeros((m.n_lists, 1), jnp.int32),
+                metric=m.metric, adaptive_centers=False)
+        return ivf_pq.Index(
+            centers=m.centers, rotation=m.rotation, codebooks=m.codebooks,
+            list_codes=jnp.zeros((1, 1, m.list_codes.shape[-1]),
+                                 m.list_codes.dtype),
+            list_indices=jnp.full((1, 1), -1, jnp.int32),
+            list_sizes=jnp.zeros((m.n_lists,), jnp.int32),
+            phys_sizes=jnp.zeros((1,), jnp.int32),
+            chunk_table=jnp.zeros((m.n_lists, 1), jnp.int32),
+            owner=jnp.zeros((1,), jnp.int32),
+            list_adc=m.list_adc,
+            list_csum=jnp.zeros((1, 1), m.list_csum.dtype),
+            metric=m.metric, codebook_kind=m.codebook_kind,
+            pq_bits=m.pq_bits, dataset_dtype=m.dataset_dtype)
+
+    def _live_rows_locked(self, core: _Core):
+        ids = [int(j) for j in core.main_ids.tolist()
+               if j not in core.main_dead]
+        ids.extend(core.delta_live)
+        if not ids:
+            return (np.zeros((0, self.dim), np.float32),
+                    np.zeros((0,), np.int64))
+        return np.stack([core.store[j] for j in ids]), \
+            np.asarray(ids, np.int64)
+
+    def _rewarm_locked(self) -> None:
+        """A write changed the delta/bitmap shapes: re-lower every
+        recorded serve signature at the new shapes BEFORE the write
+        returns — compiles ride the write path, reads stay zero-compile.
+        Amortized: shapes change only on delta chunk growth / bitmap
+        bucket growth, both power-of-two-laddered."""
+        for s in self._searchers.values():
+            s._rewarm()
+        mutable_counters.inc("rewarms")
+
+    def _record_state(self, core: _Core) -> None:
+        _delta_rows_gauge.set(core.delta_rows)
+        _tombstones_gauge.set(core.tombstones)
+
+    # -- compaction --------------------------------------------------------
+
+    def compact_due(self, delta_fraction: float = 0.10,
+                    tomb_fraction: float = 0.10) -> bool:
+        return (self.delta_fraction() >= delta_fraction
+                or self.tombstone_fraction() >= tomb_fraction)
+
+    def compact(self, engine=None) -> None:
+        """Rebuild main ∪ delta minus tombstones OFF the request path and
+        swap it in: snapshot live rows under the lock, family
+        ``build`` / ``build_sharded`` off-lock (old core keeps serving),
+        chase the write journal, pre-warm every recorded serve signature
+        at the new shapes, swap the core atomically, and — when *engine*
+        is given — promote through ``ServeEngine.refresh`` (the ONE
+        sanctioned backend-swap door; never a raw backend write)."""
+        expects(self.build_params is not None,
+                "compact() needs build_params")
+        family = ivf_flat if self.kind == "ivf_flat" else ivf_pq
+        with self._compact_lock:
+            t0 = time.perf_counter()
+            with self._lock:
+                self._journal = []
+                core = self._mut_core
+                x, ids = self._live_rows_locked(core)
+            try:
+                if core.sharded:
+                    main = family.build_sharded(
+                        self.build_params, x, self._comms,
+                        ids=jnp.asarray(ids, jnp.int32))
+                else:
+                    main = family.build(self.build_params, x,
+                                        ids=jnp.asarray(ids, jnp.int32))
+                store = {int(j): x[r] for r, j in enumerate(ids)}
+                max_id = int(ids.max()) if ids.size else 0
+                new_core = _Core(core.kind, core.sharded, main, ids, store,
+                                 _tomb_words(max_id))
+                self._push_tombstones(new_core)
+                # chase the journal off-lock until the tail is short
+                applied = 0
+                while True:
+                    with self._lock:
+                        pending = list(self._journal[applied:])
+                    if len(pending) <= 4:
+                        break
+                    for op in pending:
+                        self._apply_op(new_core, op)
+                    applied += len(pending)
+                # pre-warm the new shapes off the read path (old core
+                # still serving; warming only grows the AOT caches)
+                self._warm_for_core(new_core)
+                with self._lock:
+                    for op in self._journal[applied:]:
+                        self._apply_op(new_core, op)
+                    self._journal = None
+                    self._mut_core = new_core
+                    # tail replay rarely changes shapes; cache hits if not
+                    self._warm_for_core(new_core)
+                    self._record_state(new_core)
+            except BaseException:
+                with self._lock:
+                    self._journal = None
+                raise
+            _compactions_counter.inc(1)
+            compaction_seconds.observe(time.perf_counter() - t0)
+        if engine is not None:
+            engine.refresh(self)
+
+    def _apply_op(self, core: _Core, op) -> None:
+        if op[0] == "delete":
+            self._delete_core(core, op[1])
+        else:
+            self._upsert_core(core, op[1], op[2])
+
+    def _warm_for_core(self, core: _Core) -> None:
+        for s in list(self._searchers.values()):
+            s._warm_core(core)
+
+
+# ---------------------------------------------------------------------------
+# the serving searcher
+
+
+class MutableSearcher:
+    """Zero-retrace dispatcher for one (MutableIndex, k, params) serving
+    key — the ``_MutableBackend`` delegate.  Single-device mains dispatch
+    the ONE delta-merged program (:func:`_merged_search_impl`); sharded
+    mains dispatch the masked ``ann_mnmg.ShardedSearcher`` variant for
+    main, the same merged program (delta-only signature) for the delta,
+    and fold the two warmed sorted runs with ``merge_sorted_runs`` (main
+    is run *a* — main wins ties, matching the single-device fold)."""
+
+    def __init__(self, mutable: MutableIndex, k: int, params=None):
+        expects(k >= 1, "k must be >= 1")
+        self.mutable = mutable
+        core = mutable._mut_core
+        self.kind = core.kind
+        self.k = int(k)
+        self.name = f"mutable_{self.kind}"
+        self.metric = mutable.metric
+        self.dim = int(mutable.dim)
+        if core.sharded:
+            aux = core.main.aux
+            n_lists = int(aux["n_lists"])
+        else:
+            n_lists = int(core.main.n_lists)
+        if self.kind == "ivf_flat":
+            self.params = params or ivf_flat.SearchParams()
+            self.per_cluster = False
+            self.lut_dtype = "float32"
+            self.int_dtype = "float32"
+            self.pq_bits = 0
+            self.hoisted = False
+            from raft_tpu.kernels.engine import resolve_engine
+
+            self.engine = resolve_engine("select_k", dtype=jnp.float32)
+        else:
+            self.params = params or ivf_pq.SearchParams()
+            expects(self.params.lut_dtype in ivf_pq._LUT_DTYPES,
+                    f"lut_dtype must be one of {list(ivf_pq._LUT_DTYPES)}")
+            if core.sharded:
+                ck = int(core.main.aux["codebook_kind"])
+                self.pq_bits = int(core.main.aux["pq_bits"])
+                pq_dim = int(core.main.aux["pq_dim"])
+            else:
+                ck = int(core.main.codebook_kind)
+                self.pq_bits = int(core.main.pq_bits)
+                pq_dim = int(core.main.pq_dim)
+            self.per_cluster = ck == int(ivf_pq.CodebookKind.PER_CLUSTER)
+            self.lut_dtype = self.params.lut_dtype
+            self.int_dtype = self.params.internal_distance_dtype
+            self.hoisted = (ivf_pq.hoisted_lut_enabled()
+                            if self.params.hoisted_lut is None
+                            else bool(self.params.hoisted_lut))
+            self.engine = ivf_pq._resolve_scan_engine(pq_dim, self.pq_bits)
+        self.n_probes = int(min(self.params.n_probes, n_lists))
+        self.select_min = self.metric != DistanceType.InnerProduct
+        if core.sharded:
+            self._main_for(core)
+        # _backend_fn cost attribution reads the dispatched fn here
+        self.fn = _merged_aot
+        self._warmed = set()
+
+    def _main_for(self, core: _Core):
+        """The masked ``ShardedSearcher`` over *core*'s main — its warmed
+        ``fn`` captures the shard blocks, so it's cached ON the core
+        (compaction's new core gets its own, warmed off the read path
+        before the swap; the old core keeps serving through its own)."""
+        from raft_tpu.neighbors import ann_mnmg
+
+        key = (self.k, repr(self.params))
+        s = core.searcher_cache.get(key)
+        if s is None:
+            s = ann_mnmg.ShardedSearcher(core.main, self.k, self.params,
+                                         masked=True)
+            core.searcher_cache[key] = s
+        return s
+
+    def _statics(self):
+        return (self.kind, int(self.metric), self.k, self.n_probes,
+                self.per_cluster, self.lut_dtype, self.int_dtype,
+                self.pq_bits, self.hoisted, self.engine)
+
+    # -- warmup ------------------------------------------------------------
+
+    def warm(self, bucket: int, dtype) -> None:
+        """Pre-lower BOTH serving variants (delta-free and delta-merged)
+        at the current core shapes, and record (bucket, dtype): any write
+        that changes the delta/bitmap shapes re-lowers every recorded
+        signature (``MutableIndex._rewarm_locked``) before the write
+        returns — the zero-compile read contract under mutation."""
+        self._warmed.add((int(bucket), jnp.dtype(dtype).name))
+        self._warm_one(int(bucket), jnp.dtype(dtype).name,
+                       self.mutable._mut_core)
+
+    def _rewarm(self) -> None:
+        self._warm_core(self.mutable._mut_core)
+
+    def _warm_core(self, core: _Core) -> None:
+        for bucket, dtype in self._warmed:
+            self._warm_one(bucket, dtype, core)
+
+    def _warm_one(self, bucket: int, dtype: str, core: _Core) -> None:
+        spec = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)  # noqa: E731
+        qspec = jax.ShapeDtypeStruct((bucket, self.dim), jnp.dtype(dtype))
+        tm = spec(core.tomb_main_bits)
+        dl = _delta_leaves(core)
+        dspecs = None if dl is None else jax.tree_util.tree_map(spec, dl)
+        if core.sharded:
+            self._main_for(core).warm(bucket, jnp.dtype(dtype),
+                                      core.n_words)
+            if dl is not None:
+                _merged_aot.compiled(qspec, dspecs, None,
+                                     spec(core.tomb_delta_bits), None,
+                                     *self._statics())
+                rspec = jax.ShapeDtypeStruct((bucket, self.k), jnp.float32)
+                ispec = jax.ShapeDtypeStruct((bucket, self.k), jnp.int32)
+                _merge_aot.compiled(rspec, ispec, rspec, ispec, self.k,
+                                    self.select_min)
+            return
+        mspecs = jax.tree_util.tree_map(spec, _main_leaves(core))
+        _merged_aot.compiled(qspec, mspecs, None, tm, None,
+                             *self._statics())
+        if dl is not None:
+            _merged_aot.compiled(qspec, mspecs, dspecs, tm,
+                                 spec(core.tomb_delta_bits),
+                                 *self._statics())
+
+    # -- serving -----------------------------------------------------------
+
+    def batch_cap(self) -> Optional[int]:
+        """The hoisted compressed-LUT transient clamp (ivf_pq only),
+        sized by the MAIN layout — conservative for the small delta."""
+        if self.kind != "ivf_pq":
+            return None
+        core = self.mutable._mut_core
+        if core.sharded:
+            aux = core.main.aux
+            n_phys = int(aux["cap_n_phys"])
+            max_chunks = int(aux["cap_max_chunks"])
+            n_lists, pq_dim = int(aux["n_lists"]), int(aux["pq_dim"])
+        else:
+            m = core.main
+            n_phys = int(m.list_codes.shape[0])
+            max_chunks = int(m.chunk_table.shape[1])
+            n_lists, pq_dim = int(m.n_lists), int(m.pq_dim)
+        return ivf_pq.hoisted_batch_cap_dims(
+            self.metric, self.per_cluster, n_phys, max_chunks, n_lists,
+            pq_dim, self.pq_bits, self.n_probes, self.lut_dtype,
+            self.hoisted)
+
+    def ingest(self, q):
+        """HOST-side compute-form conversion, mirroring the family
+        backends bit for bit (the tiering ingest contract)."""
+        q = np.asarray(q)
+        expects(q.ndim == 2 and q.shape[1] == self.dim,
+                "query dim mismatch")
+        if self.kind == "ivf_pq":
+            core = self.mutable._mut_core
+            ds_dtype = (core.main.aux["dataset_dtype"] if core.sharded
+                        else core.main.dataset_dtype)
+            if q.dtype in (np.int8, np.uint8):
+                q_dtype = str(q.dtype)
+            else:
+                expects(jnp.issubdtype(q.dtype, jnp.floating),
+                        f"ivf_pq: unsupported query dtype {q.dtype}")
+                q_dtype = "float32"
+            expects(q_dtype in (ds_dtype, "float32"),
+                    f"query dtype {q_dtype} != index dataset dtype "
+                    f"{ds_dtype}")
+            return q.astype(np.float32)
+        if q.dtype in (np.int8, np.uint8):
+            q = q.astype(np.float32)  # exact widening: matches device cast
+        if self.metric == DistanceType.CosineExpanded:
+            return np.asarray(ivf_flat._normalize_rows(jnp.asarray(q)))
+        return q
+
+    def dispatch(self, qb):
+        """One PRE-BUCKETED batch against a consistent core snapshot.
+        The lock makes the read atomic against writes (and makes the
+        donated in-place delta append safe against this dispatch); every
+        executable touched is warmed — zero compiles steady-state."""
+        m = self.mutable
+        with m._lock:
+            core = m._mut_core
+            if not core.sharded:
+                return _merged_aot(jnp.asarray(qb), _main_leaves(core),
+                                   _delta_leaves(core),
+                                   core.tomb_main_bits,
+                                   (None if core.delta is None
+                                    else core.tomb_delta_bits),
+                                   *self._statics())
+            d, i = self._main_for(core).dispatch(qb, core.tomb_main_mesh)
+            if core.delta is None:
+                return d, i
+            dd, di = _merged_aot(jnp.asarray(qb), _delta_leaves(core),
+                                 None, core.tomb_delta_bits, None,
+                                 *self._statics())
+            dev = dispatch_device()
+            d = jax.device_put(d, dev)
+            i = jax.device_put(i, dev)
+            return merge_sorted_runs(d, i, dd, di, k=self.k,
+                                     select_min=self.select_min)
+
+    def solo(self, q):
+        """Uncoalesced fallback (compiles allowed — off the warmed
+        path)."""
+        return search(self.mutable, q, self.k, params=self.params)
+
+
+# ---------------------------------------------------------------------------
+# eager search
+
+
+def search(mutable: MutableIndex, queries, k: int, params=None
+           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Eager search over main ∪ delta minus tombstones.  Queries bucket
+    through the power-of-two ladder (pad + slice) exactly like the family
+    ``search`` entry points; compiles are allowed here (first call per
+    signature) — serving goes through a warmed :class:`MutableSearcher`.
+    """
+    s = mutable.searcher(int(k), params)
+    q = s.ingest(queries)
+    nq = q.shape[0]
+    if nq == 0:
+        from raft_tpu.neighbors._common import empty_result
+
+        return empty_result(0, int(k), jnp.float32)
+    bucket = _bucket_dim(nq)
+    if bucket != nq:
+        q = np.pad(q, ((0, bucket - nq), (0, 0)))
+    d, i = s.dispatch(jnp.asarray(q))
+    return d[:nq], i[:nq]
+
+
+# ---------------------------------------------------------------------------
+# background compaction
+
+
+class Compactor:
+    """supervise.py-style background compaction driver: a seeded daemon
+    thread that, past a delta-fraction or tombstone-fraction threshold,
+    runs :meth:`MutableIndex.compact` (rebuild off the request path,
+    journal chase, warmed atomic swap, promotion via
+    ``ServeEngine.refresh``).  Deterministic under test: ``auto=False``
+    (the default) never starts a thread — drive :meth:`tick` manually;
+    the thread's sleep jitter is seeded."""
+
+    def __init__(self, mutable: MutableIndex, engine=None, *,
+                 delta_fraction: float = 0.10, tomb_fraction: float = 0.10,
+                 interval_s: float = 1.0, seed: int = 0):
+        self.mutable = mutable
+        self.engine = engine
+        self.delta_fraction = float(delta_fraction)
+        self.tomb_fraction = float(tomb_fraction)
+        self.interval_s = float(interval_s)
+        self._rng = np.random.default_rng(seed)
+        self._stop = threading.Event()
+        self._thread = None
+        self.compactions = 0
+        self.errors = 0
+
+    def due(self) -> bool:
+        return self.mutable.compact_due(self.delta_fraction,
+                                        self.tomb_fraction)
+
+    def tick(self) -> bool:
+        """One deterministic check-and-compact step.  Compaction errors
+        (including injected fault-plane refresh failures) are contained:
+        the old core keeps serving, the error is counted, the next tick
+        retries."""
+        if not self.due():
+            return False
+        try:
+            self.mutable.compact(self.engine)
+        except Exception:
+            self.errors += 1
+            mutable_counters.inc("compaction_errors")
+            return False
+        self.compactions += 1
+        return True
+
+    def start(self) -> "Compactor":
+        expects(self._thread is None, "compactor already started")
+        self._stop.clear()
+
+        def run():
+            while not self._stop.is_set():
+                self.tick()
+                # seeded jitter: desynchronizes fleet members without
+                # nondeterminism under a fixed seed
+                pause = self.interval_s * (0.5 + self._rng.random())
+                self._stop.wait(pause)
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="raft-tpu-compactor")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+
+# ---------------------------------------------------------------------------
+# lowering-contract audit entry (analysis registry)
+
+
+@hlo_program(
+    "mutable.delta_merged_search",
+    collectives=0, collective_bytes=0,
+    # two family probe scans' tile transients + the (2, nq, k) part fold
+    transient_bytes=4 << 20,
+    notes="main ∪ delta with in-scan tombstone masks folded by "
+          "merge_sorted_parts as ONE program — the _MutableBackend "
+          "single-device serving executable (docs/mutable_index.md)")
+def _audit_merged_search():
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2048, 32)).astype(np.float32)
+    m = MutableIndex(ivf_flat.build(ivf_flat.IndexParams(n_lists=16), x),
+                     x, build_params=ivf_flat.IndexParams(n_lists=16))
+    m.upsert(rng.standard_normal((128, 32)).astype(np.float32),
+             np.arange(2048, 2176, dtype=np.int64))
+    m.delete(np.arange(64, dtype=np.int64))
+    core = m._mut_core
+    q = jax.ShapeDtypeStruct((64, 32), jnp.float32)
+    args = (q, _main_leaves(core), _delta_leaves(core),
+            core.tomb_main_bits, core.tomb_delta_bits, "ivf_flat",
+            int(DistanceType.L2SqrtExpanded), 8, 4, False, "float32",
+            "float32", 0, False, "xla")
+    return dict(fn=_merged_search_impl, args=args,
+                static_argnums=_MERGED_STATICS)

@@ -23,6 +23,7 @@ garbage.  Pre-manifest archives still load (verification skipped).
 from __future__ import annotations
 
 import dataclasses
+import enum
 import json
 import os
 import zipfile
@@ -47,12 +48,13 @@ _MAGIC = "raft-tpu-index"
 # residency policy (hot_lists mask, tile_phys) + the optional host refine
 # store — the residency SPLIT itself is recomputed at load (pure function
 # of mask + chunk table), never stored.
-_VERSIONS = {"ivf_flat": 1, "ivf_pq": 2, "sharded": 1, "tiered": 1}
+_VERSIONS = {"ivf_flat": 1, "ivf_pq": 2, "sharded": 1, "tiered": 1,
+             "mutable": 1}
 # Readable versions are per kind too: accepting another kind's version at
 # the gate would defer the failure to an obscure Index(**arrays) TypeError
 # instead of the clean unsupported-version error this check exists to give.
 _READABLE_VERSIONS = {"ivf_flat": (1,), "ivf_pq": (1, 2), "sharded": (1,),
-                      "tiered": (1,)}
+                      "tiered": (1,), "mutable": (1,)}
 
 
 def _checksums(arrays: dict) -> dict:
@@ -173,7 +175,15 @@ def save_sharded(path, sharded) -> None:
 
     Requires the stacked leaves to be host-fetchable (single-process mesh
     or fully-replicated layout); a multi-process OPG fleet saves from the
-    process that built the partition before distribution."""
+    process that built the partition before distribution.
+
+    A :class:`raft_tpu.neighbors.mutable.MutableIndex` wrapping a sharded
+    main routes to :func:`save_mutable` — the fleet-consistent snapshot
+    of the (main, delta, tombstone) triple."""
+    from raft_tpu.neighbors import mutable as _mutable
+
+    if isinstance(sharded, _mutable.MutableIndex):
+        return save_mutable(path, sharded)
     for leaf in tuple(sharded.replicated) + tuple(sharded.stacked):
         expects(getattr(leaf, "is_fully_addressable", True)
                 or getattr(leaf, "is_fully_replicated", False),
@@ -197,6 +207,8 @@ def load_sharded(path, comms):
     from raft_tpu.comms.comms import as_comms
     from raft_tpu.neighbors import ann_mnmg
 
+    if _peek_kind(path) == "mutable":
+        return load_mutable(path, comms)
     comms = as_comms(comms)
     aux, a = _unpack(path, "sharded")
     world = int(aux["aux"]["world"])
@@ -212,6 +224,194 @@ def load_sharded(path, comms):
         for j in range(n_st))
     return ann_mnmg.ShardedIndex(aux["kind"], comms, replicated, stacked,
                                  dict(aux["aux"]))
+
+
+def _peek_kind(path) -> str:
+    """Header-only kind probe — lets the sharded entry points accept a
+    mutable archive (and vice versa) without guessing from the caller."""
+    path = _normalize(path)
+    try:
+        with np.load(path) as z:
+            expects("__header__" in z.files,
+                    f"{path}: not a raft-tpu index file (no header)")
+            header = json.loads(bytes(z["__header__"]).decode())
+    except (zipfile.BadZipFile, zlib.error, EOFError, ValueError,
+            json.JSONDecodeError, UnicodeDecodeError, KeyError, OSError) as e:
+        raise CorruptionError(
+            f"{path}: corrupt or truncated index archive ({e})") from e
+    return header.get("kind", "")
+
+
+def _params_to_aux(params):
+    """Family IndexParams → JSON-safe dict (enums → ints)."""
+    if params is None:
+        return None
+    d = dataclasses.asdict(params)
+    return {k: (int(v) if isinstance(v, enum.IntEnum) else v)
+            for k, v in d.items()}
+
+
+def _params_from_aux(kind: str, d):
+    if d is None:
+        return None
+    d = dict(d)
+    d["metric"] = DistanceType(d["metric"])
+    if kind == "ivf_pq":
+        d["codebook_kind"] = ivf_pq.CodebookKind(d["codebook_kind"])
+        return ivf_pq.IndexParams(**d)
+    return ivf_flat.IndexParams(**d)
+
+
+def save_mutable(path, mut) -> None:
+    """Write a :class:`raft_tpu.neighbors.mutable.MutableIndex` to *path*
+    (``.npz``; atomic + CRC-manifested — module docstring): ONE
+    write-ordered snapshot of the (main, delta, tombstone) triple, taken
+    under the write lock so a save racing live upserts/deletes is still a
+    consistent state some prefix of the writes produced.
+
+    The MAIN segment is stored verbatim (single-device family leaves, or
+    the sharded ``rep{j}``/``st{j}`` blocks — the :func:`save_sharded`
+    layout, fleet-consistent: every process of a serving fleet loads the
+    same partition).  The delta and tombstones are stored as their
+    SOURCE-OF-TRUTH host books (delta rows + insertion order, dead-id
+    sets): load replays them through the normal ``upsert``/``delete``
+    write path — O(delta), delta small by the compaction invariant — so
+    the loaded triple is live-row identical and serves through the exact
+    same warmed programs, without freezing the delta's physical packing
+    into the archive format."""
+    from raft_tpu.neighbors import mutable as _mutable
+
+    expects(isinstance(mut, _mutable.MutableIndex),
+            "save_mutable needs a MutableIndex")
+    with mut._lock:
+        core = mut._mut_core
+        fam_kind = core.kind
+        if core.sharded:
+            for leaf in tuple(core.main.replicated) + tuple(core.main.stacked):
+                expects(getattr(leaf, "is_fully_addressable", True)
+                        or getattr(leaf, "is_fully_replicated", False),
+                        "save_mutable: sharded leaves span non-addressable "
+                        "devices — save from the building process")
+            arrays = {f"main_rep{j}": np.asarray(leaf)
+                      for j, leaf in enumerate(core.main.replicated)}
+            arrays.update({f"main_st{j}": np.asarray(leaf)
+                           for j, leaf in enumerate(core.main.stacked)})
+            fam = {"aux": dict(core.main.aux)}
+        else:
+            index = core.main
+            if fam_kind == "ivf_flat":
+                fam = {"metric": int(index.metric),
+                       "adaptive_centers": bool(index.adaptive_centers)}
+            else:
+                fam = {"metric": int(index.metric),
+                       "codebook_kind": int(index.codebook_kind),
+                       "pq_bits": int(index.pq_bits),
+                       "dataset_dtype": index.dataset_dtype}
+            arrays = {f"main_{f.name}": np.asarray(getattr(index, f.name))
+                      for f in dataclasses.fields(index)
+                      if f.name not in fam}
+        arrays["mut_main_ids"] = np.asarray(core.main_ids, np.int64)
+        arrays["mut_main_dead"] = np.asarray(sorted(core.main_dead),
+                                             np.int64)
+        # live main vectors re-seed the host row store (compaction's and
+        # the delta dedup-rebuild's input); dead mains replay as pure
+        # tombstones, no vector required
+        live_main = np.asarray(
+            [j for j in core.main_ids.tolist() if j not in core.main_dead],
+            np.int64)
+        arrays["mut_main_live_ids"] = live_main
+        if live_main.size:
+            arrays["mut_main_live_rows"] = np.stack(
+                [core.store[int(j)] for j in live_main])
+        delta_ids = np.asarray(list(core.delta_live), np.int64)
+        arrays["mut_delta_ids"] = delta_ids
+        if delta_ids.size:
+            arrays["mut_delta_rows"] = np.stack(
+                [core.store[int(j)] for j in delta_ids])
+        aux = {"kind": fam_kind, "sharded": bool(core.sharded),
+               "family": fam,
+               "build_params": _params_to_aux(mut.build_params)}
+    _atomic_savez(path, _finish("mutable", arrays, aux))
+
+
+def load_mutable(path, comms=None):
+    """Load a mutable index: restore the main segment verbatim (onto
+    *comms*' mesh when the archive is sharded), then REPLAY the archived
+    delta/tombstone books through the normal ``upsert``/``delete`` write
+    path — the loaded triple serves the same live rows through the same
+    warmed fixed-shape programs as the saved one."""
+    from raft_tpu.neighbors import ann_mnmg
+    from raft_tpu.neighbors import mutable as _mutable
+
+    aux, a = _unpack(path, "mutable")
+    fam_kind, fam = aux["kind"], aux["family"]
+    main_ids = a["mut_main_ids"].astype(np.int64)
+    main_dead = a["mut_main_dead"].astype(np.int64)
+    delta_ids = a["mut_delta_ids"].astype(np.int64)
+    delta_rows = a.get("mut_delta_rows")
+    if aux["sharded"]:
+        from jax.sharding import PartitionSpec as P
+
+        from raft_tpu.comms.comms import as_comms
+
+        expects(comms is not None,
+                "load_mutable: archive holds a sharded main — pass comms")
+        comms = as_comms(comms)
+        sh_aux = dict(fam["aux"])
+        world = int(sh_aux["world"])
+        expects(world == comms.get_size(),
+                f"archive was sharded for world={world}, communicator has "
+                f"{comms.get_size()} — re-shard the base index instead")
+        n_rep = sum(1 for k in a if k.startswith("main_rep"))
+        n_st = sum(1 for k in a if k.startswith("main_st"))
+        replicated = tuple(
+            comms.globalize(jnp.asarray(a[f"main_rep{j}"]), P())
+            for j in range(n_rep))
+        stacked = tuple(
+            comms.globalize(jnp.asarray(a[f"main_st{j}"]),
+                            P(comms.axis_name))
+            for j in range(n_st))
+        main = ann_mnmg.ShardedIndex(fam_kind, comms, replicated, stacked,
+                                     sh_aux)
+        dim = int(main.dim)
+    else:
+        arrays = {k[len("main_"):]: jnp.asarray(v) for k, v in a.items()
+                  if k.startswith("main_")}
+        if fam_kind == "ivf_flat":
+            main = ivf_flat.Index(
+                **arrays, metric=DistanceType(fam["metric"]),
+                adaptive_centers=fam["adaptive_centers"])
+        else:
+            main = ivf_pq.Index(
+                **arrays, metric=DistanceType(fam["metric"]),
+                codebook_kind=ivf_pq.CodebookKind(fam["codebook_kind"]),
+                pq_bits=fam["pq_bits"],
+                dataset_dtype=fam.get("dataset_dtype", "float32"))
+        dim = int(main.dim)
+    live_main = a["mut_main_live_ids"].astype(np.int64)
+    live_rows = a.get("mut_main_live_rows",
+                      np.zeros((0, dim), np.float32))
+    mut = _mutable.MutableIndex(
+        main, live_rows, live_main,
+        build_params=_params_from_aux(fam_kind, aux["build_params"]),
+        comms=comms)
+    core = mut._mut_core
+    # the constructor only saw LIVE ids; restore the full main roster
+    # (dead mains replay as tombstones below) and make sure the bitmap
+    # ladder covers the highest archived id before the tombstone replay
+    # exempt(mutation-discipline): load-time roster restore pre-serving
+    core.main_ids = main_ids
+    max_id = max([int(main_ids.max()) if main_ids.size else 0,
+                  int(delta_ids.max()) if delta_ids.size else 0])
+    words = _mutable._tomb_words(max_id)
+    if words > core.n_words:
+        mut._grow_tombstones(core, words)
+    if delta_ids.size:
+        mut.upsert(delta_rows, delta_ids)
+    dead = np.setdiff1d(main_dead, delta_ids)
+    if dead.size:
+        mut.delete(dead)
+    return mut
 
 
 def save_tiered(path, tiered) -> None:

@@ -81,7 +81,7 @@ from raft_tpu.core.error import expects
 from raft_tpu.core.handle import Handle
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.neighbors import (ann_mnmg, brute_force, ivf_flat, ivf_pq,
-                                tiering)
+                                mutable, tiering)
 from raft_tpu.serve.admission import (AdmissionController, RejectedError,
                                       ServeRequest)
 from raft_tpu.serve.schedule import (CostModel, ReplicaRouter,
@@ -472,6 +472,41 @@ class _TieredBackend:
         return tiering.search(self.tiered, q, self.k, params=self.params)
 
 
+class _MutableBackend:
+    """Adapter: ``mutable.MutableIndex`` → the delta-merged tombstone-
+    masked searcher (``neighbors.mutable``).  Pure delegation, the
+    ``_TieredBackend`` precedent: the searcher owns the warmed
+    main/delta/merge signatures and the write-ordered core snapshots;
+    writes (``upsert``/``delete``) land on the SAME MutableIndex object
+    concurrently with serving, and compaction promotes its rebuilt core
+    through ``engine.refresh(mutable_index)`` — the one sanctioned swap
+    door (the ``mutation-discipline`` analysis rule)."""
+
+    def __init__(self, mut, k: int, params):
+        expects(k >= 1, "k must be >= 1")
+        self.mutable = mut
+        self.params = params
+        self.name = f"mutable_{mut.kind}"
+        self.searcher = mut.searcher(int(k), params)
+        self.k = int(k)
+        self.dim = int(mut.dim)
+
+    def ingest(self, q):
+        return self.searcher.ingest(q)
+
+    def batch_cap(self) -> Optional[int]:
+        return self.searcher.batch_cap()
+
+    def warm(self, bucket: int, dtype) -> None:
+        self.searcher.warm(bucket, dtype)
+
+    def dispatch(self, qb):
+        return self.searcher.dispatch(qb)
+
+    def solo(self, q):
+        return mutable.search(self.mutable, q, self.k, params=self.params)
+
+
 class _KeepParams:
     """Sentinel type — :data:`KEEP_PARAMS` is its only instance."""
 
@@ -496,6 +531,8 @@ def _make_backend(index, k, params, metric, metric_arg, batch_size_index):
         return _ShardedBackend(index, k, params)
     if isinstance(index, tiering.TieredIndex):
         return _TieredBackend(index, k, params)
+    if isinstance(index, mutable.MutableIndex):
+        return _MutableBackend(index, k, params)
     if isinstance(index, ivf_flat.Index):
         return _IvfFlatBackend(index, k, params)
     if isinstance(index, ivf_pq.Index):
@@ -531,6 +568,12 @@ class ServeEngine:
       Re-tier off the request path via
       ``engine.refresh(tiering.retier(tiered, hotness))`` with the
       backend's ``searcher.hotness()`` counters.
+    * :class:`raft_tpu.neighbors.mutable.MutableIndex` → the mutable
+      (delta segment + tombstones) backend: serving stays zero-compile
+      while ``upsert()``/``delete()`` land concurrently, and background
+      compaction promotes its rebuilt core via ``engine.refresh``
+      (*params* is the underlying kind's SearchParams; see
+      docs/mutable_index.md).
 
     ``max_batch`` bounds one coalesced super-batch (and is the largest
     bucket :meth:`warmup` pins by default).  ``handle`` supplies the stream

@@ -103,6 +103,8 @@ _FAST_TESTS = {
     "test_serve_autotune.py::TestZeroCompile::"
     "test_explore_and_promote_are_zero_compile",
     "test_ivf_pq.py::test_ivf_pq_recall_pq_bits",
+    "test_mutable.py::TestWritePath::test_warm_write_path_zero_compiles",
+    "test_mutable.py::TestCompactor::test_tick_deterministic_and_contained",
     "test_kmeans_mnmg.py::test_distributed_matches_single_device",
     "test_kmeans_mnmg.py::test_fori_loop_matches_device_loop",
     "test_pallas_kernels.py::test_pallas_is_enabled_requires_experimental_flag",

@@ -77,7 +77,7 @@ class TestUnifiedMarker:
                 "hot-path-host-transfer", "collective-discipline",
                 "trace-impurity", "static-arg-hashability",
                 "dtype-drift", "telemetry-discipline",
-                "pallas-discipline"} <= ids
+                "pallas-discipline", "mutation-discipline"} <= ids
 
 
 # ---------------------------------------------------------------------------
@@ -327,6 +327,49 @@ class TestErrorDiscipline:
                 continue
             assert not [x for x in engine.check_source(posix, f.read_text())
                         if x.rule == "error-discipline"], f
+
+
+class TestMutationDiscipline:
+    """ISSUE 20: mutable-index core state is written only inside
+    neighbors/mutable.py — raw writes elsewhere skip the device-push /
+    rewarm / warm-before-swap protocol the retrace mutate_closure
+    certifies inside the module."""
+
+    _RAW = ("def hack(core, j):\n"
+            "    core.words_main[j >> 5] |= 1 << (j & 31){}\n")
+
+    def test_raw_bitmap_write_fires(self):
+        f = findings("raft_tpu/serve/patch.py", self._RAW.format(""),
+                     "mutation-discipline")
+        assert f and "words_main" in f[0].message
+
+    def test_core_swap_fires(self):
+        src = ("def swap(m, core):\n"
+               "    m._mut_core = core\n")
+        assert findings("raft_tpu/serve/patch.py", src,
+                        "mutation-discipline")
+
+    def test_fixed_form_passes(self):
+        src = ("def remove(m, ids):\n"
+               "    return m.delete(ids)\n")
+        assert not findings("raft_tpu/serve/patch.py", src,
+                            "mutation-discipline")
+
+    def test_home_module_is_the_blessed_door(self):
+        assert not findings("raft_tpu/neighbors/mutable.py",
+                            self._RAW.format(""), "mutation-discipline")
+
+    def test_marker_exempts(self):
+        src = self._RAW.format(
+            "  # exempt(mutation-discipline): load-time replay")
+        assert not findings("raft_tpu/serve/patch.py", src,
+                            "mutation-discipline")
+
+    def test_shipped_tree_clean(self):
+        for f in sorted((REPO / "raft_tpu").rglob("*.py")):
+            assert not [x for x in engine.check_source(
+                f.as_posix(), f.read_text())
+                if x.rule == "mutation-discipline"], f
 
 
 class TestTelemetryDiscipline:
@@ -598,6 +641,9 @@ class TestHostTransferRegistry:
 
 
 class TestEngineAtHead:
+    @pytest.mark.slow  # tier-1 budget (ISSUE-20 rebalance): this IS the
+    # ci/checks.sh `--ast` gate, re-run on every CI (PR-19 stale-marker
+    # precedent)
     def test_repo_surface_clean(self):
         # the acceptance contract: level 1 exits 0 at HEAD
         import io
@@ -806,12 +852,13 @@ HloModule m, input_output_alias={ {0}: (1, {}, may-alias) }
 class TestShippedRegistry:
     def test_catalog(self):
         entries = {e.name: e for e in registry.iter_programs()}
-        # the ISSUE-18 floor: >= 16 hot-path programs declared — all three
+        # the ISSUE-20 floor: >= 17 hot-path programs declared — all three
         # serve backends in sharded one-allgather form (ISSUE 12), the
         # three graduated Pallas kernels (ISSUE 13), the replica-group
-        # program on the 2D shard × replica carve (ISSUE 15), and the
-        # tiered cold-scan + exact-refine pair (ISSUE 18)
-        assert len(entries) >= 16, sorted(entries)
+        # program on the 2D shard × replica carve (ISSUE 15), the tiered
+        # cold-scan + exact-refine pair (ISSUE 18), and the mutable
+        # delta-merged masked search (ISSUE 20)
+        assert len(entries) >= 17, sorted(entries)
         for expected in ("brute_force.knn_scan", "ivf_flat.search_batch",
                          "ivf_pq.full_search", "ivf_pq.encode_tile",
                          "ivf_pq.csum_tile", "cluster.fused_em_step",
@@ -822,7 +869,8 @@ class TestShippedRegistry:
                          "ann_mnmg.ivf_flat_replica_group",
                          "kernels.select_k", "kernels.fused_l2_nn",
                          "kernels.ivf_pq_lut",
-                         "tiering.cold_scan", "tiering.refine"):
+                         "tiering.cold_scan", "tiering.refine",
+                         "mutable.delta_merged_search"):
             assert expected in entries, expected
         # every single-device entry pins a zero-collective budget; the
         # sharded entries pin exactly one launch of the packed (nq, 2k)

@@ -184,6 +184,9 @@ def test_ivf_pq_pack_roundtrip():
                                       np.asarray(codes, np.int32))
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE-20 rebalance): pq extend is
+# carried by test_ivf_pq_int_dtype_build_extend_search (same path, plus
+# int dtypes) and the ivf_build tiled/chunked extend equivalences
 def test_ivf_pq_extend():
     from raft_tpu.neighbors.ivf_pq import extend
 
@@ -530,6 +533,9 @@ def test_ivf_pq_int_dtype_serialize_roundtrip(tmp_path):
     np.testing.assert_allclose(np.array(d1), np.array(d2), rtol=1e-6)
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE-20 rebalance): bf16 storage
+# rounding is carried by the flat bf16 recall test; pq recall by the f32
+# recall grid
 def test_ivf_pq_bf16_dataset_recall_within_pq_noise():
     """bf16 datasets build and search end-to-end; recall lands within PQ
     quantization noise of the f32 index (bf16 storage rounding ~8e-3 is

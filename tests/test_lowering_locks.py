@@ -482,6 +482,9 @@ class TestShippedGoldens:
                     fingerprint.golden_path(e.name).read_text())
                 assert fingerprint.diff(golden, fp) == [], e.name
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE-20 rebalance): ci/checks.sh
+    # `--fingerprints --strict` diffs every committed golden (incl. this
+    # one) on every CI run
     def test_sharded_ivf_pq_golden_one_allgather(self, devices):
         # the new third sharded backend: its committed golden pins the
         # one-allgather contract exactly
@@ -551,6 +554,21 @@ class TestRetraceCertifier:
         for cls in ("_BruteForceBackend", "_IvfFlatBackend",
                     "_IvfPqBackend", "_ShardedBackend", "ShardedSearcher"):
             assert cls in certified, certified
+
+    def test_mutate_closure_certified(self):
+        # ISSUE 20: the mutable-index obligations prove mask-in-scan,
+        # ladder-bounded bitmaps, write-path rewarm, locked dispatch and
+        # refresh-only promotion at HEAD
+        reports, failed = retrace.run(["mutate_closure"],
+                                      out=io.StringIO())
+        assert failed == 0, [
+            (r.name, r.findings) for r in reports if r.status == "fail"]
+        names = {r.name for r in reports}
+        for ob in ("mask_in_scan", "families_thread_mask",
+                   "tomb_buckets_via_ladder", "writes_rewarm_signatures",
+                   "dispatch_snapshots_under_lock",
+                   "compact_promotes_via_refresh", "backend_registered"):
+            assert f"serve.mutate_closure.{ob}" in names, names
 
     def test_synthetic_unbounded_static_arg_flagged(self, tmp_path):
         (tmp_path / "leaky.py").write_text(

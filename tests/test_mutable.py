@@ -1,0 +1,384 @@
+"""Mutable index: delete/upsert/compaction vs a rebuild oracle (ISSUE 20).
+
+Property grid {ivf_flat, ivf_pq} × {f32, bf16} × {world 1, 2}: every
+combination runs the same churn script (replace, delete, insert, duplicate
+re-upsert) and is judged against a from-scratch rebuild of exactly the
+live rows.  For ivf_flat at FULL probe coverage the merged main∪delta
+search must be bit-identical in distances to the oracle (probe selection
+is removed, so clustering differences cannot leak in — the docs/
+mutable_index.md §identity contract); for ivf_pq the oracle retrains its
+codebooks, so the sharp properties are live-set discipline (a deleted id
+NEVER appears, every returned id is live) and delta self-retrieval.
+
+The serving-side battery drives a warmed ``ServeEngine`` concurrently
+with writes and an injected ``refresh`` fault (the swap-atomicity crash
+window) — zero failed requests throughout, and the post-fault engine
+still promotes a clean compaction.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.comms import build_comms
+from raft_tpu.core.aot import aot_compile_counters
+from raft_tpu.neighbors import ann_mnmg, ivf_flat, ivf_pq, mutable
+from raft_tpu.testing import faults
+
+_N, _DIM, _K, _LISTS = 1536, 24, 8, 8
+
+_COMMS = {}
+
+
+def _comms(world):
+    if world not in _COMMS:
+        from jax.sharding import Mesh
+
+        _COMMS[world] = build_comms(
+            Mesh(np.array(jax.devices()[:world]), ("world",)))
+    return _COMMS[world]
+
+
+def _params(kind):
+    if kind == "ivf_flat":
+        return ivf_flat.IndexParams(n_lists=_LISTS, kmeans_n_iters=4,
+                                    seed=1)
+    return ivf_pq.IndexParams(n_lists=_LISTS, pq_dim=8, pq_bits=8,
+                              kmeans_n_iters=4, seed=1)
+
+
+def _family(kind):
+    return ivf_flat if kind == "ivf_flat" else ivf_pq
+
+
+def _data(dtype, seed=0, n=_N):
+    rng = np.random.default_rng(seed)
+    x = rng.random((n, _DIM)).astype(np.float32)
+    return jnp.asarray(x, jnp.dtype(dtype))
+
+
+def _build_mut(kind, dtype, world, seed=0):
+    """(MutableIndex, live-oracle dict id → np row) for one grid point."""
+    bp = _params(kind)
+    x = _data(dtype, seed=seed)
+    ids = np.arange(_N, dtype=np.int64)
+    if world > 1:
+        main = _family(kind).build_sharded(bp, x, _comms(world),
+                                           ids=jnp.asarray(ids, jnp.int32))
+        mut = mutable.MutableIndex(main, x, ids, build_params=bp,
+                                   comms=_comms(world))
+    else:
+        main = _family(kind).build(bp, x, ids=jnp.asarray(ids, jnp.int32))
+        mut = mutable.MutableIndex(main, x, ids, build_params=bp)
+    live = {int(j): np.asarray(x[r], np.float32)
+            for r, j in enumerate(ids)}
+    return mut, live
+
+
+def _churn(mut, live, dtype, seed=1):
+    """The shared churn script; mirrors every op into *live* (the test's
+    INDEPENDENT oracle bookkeeping, deliberately not mut.live_rows())."""
+    rng = np.random.default_rng(seed)
+
+    def rows(n):
+        return jnp.asarray(rng.random((n, _DIM)).astype(np.float32),
+                           mut_dtype)
+
+    mut_dtype = jnp.dtype(dtype)
+    # replace 192 existing rows
+    rep = np.arange(0, 192, dtype=np.int64)
+    v = rows(rep.size)
+    mut.upsert(v, rep)
+    for r, j in enumerate(rep):
+        live[int(j)] = np.asarray(v[r], np.float32)
+    # delete 64 (main) rows
+    dead = np.arange(200, 264, dtype=np.int64)
+    assert mut.delete(dead) == dead.size
+    for j in dead:
+        live.pop(int(j))
+    # insert 64 brand-new ids
+    new = np.arange(5000, 5064, dtype=np.int64)
+    v = rows(new.size)
+    mut.upsert(v, new)
+    for r, j in enumerate(new):
+        live[int(j)] = np.asarray(v[r], np.float32)
+    # duplicate re-upsert (ids still packed in the delta → dedup rebuild)
+    rep2 = np.arange(0, 32, dtype=np.int64)
+    v = rows(rep2.size)
+    mut.upsert(v, rep2)
+    for r, j in enumerate(rep2):
+        live[int(j)] = np.asarray(v[r], np.float32)
+    # delete a few DELTA rows too (tombstone the write segment itself)
+    dead2 = np.arange(5000, 5008, dtype=np.int64)
+    assert mut.delete(dead2) == dead2.size
+    for j in dead2:
+        live.pop(int(j))
+    return live
+
+
+def _oracle(kind, dtype, world, live):
+    """From-scratch rebuild of exactly the live rows."""
+    bp = _params(kind)
+    ids = np.array(sorted(live), dtype=np.int64)
+    x = jnp.asarray(np.stack([live[int(j)] for j in ids]),
+                    jnp.dtype(dtype))
+    if world > 1:
+        return _family(kind).build_sharded(bp, x, _comms(world),
+                                           ids=jnp.asarray(ids, jnp.int32))
+    return _family(kind).build(bp, x, ids=jnp.asarray(ids, jnp.int32))
+
+
+def _search_oracle(kind, world, oracle, q, sp):
+    if world > 1:
+        return ann_mnmg.search(oracle, q, _K, sp)
+    return _family(kind).search(sp, oracle, q, _K)
+
+
+def _full_sp(kind):
+    if kind == "ivf_flat":
+        return ivf_flat.SearchParams(n_probes=_LISTS)
+    return ivf_pq.SearchParams(n_probes=_LISTS)
+
+
+def _assert_vs_oracle(kind, dtype, world, mut, live, seed=9):
+    """The oracle comparison both before and after compaction."""
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.random((16, _DIM)).astype(np.float32),
+                    jnp.dtype(dtype))
+    sp = _full_sp(kind)
+    d_m, i_m = mutable.search(mut, q, _K, params=sp)
+    d_m, i_m = np.asarray(d_m, np.float64), np.asarray(i_m)
+    # live-set discipline is UNCONDITIONAL: no dead id, ever
+    assert set(i_m.ravel().tolist()) <= set(live), \
+        "merged search returned a tombstoned/unknown id"
+    if kind == "ivf_flat":
+        # full probes remove probe selection: merged == rebuild oracle
+        # bit-for-bit in distances, same id SET per row (tie ORDER at
+        # duplicated distances is the one documented divergence)
+        oracle = _oracle(kind, dtype, world, live)
+        d_o, i_o = _search_oracle(kind, world, oracle, q, sp)
+        d_o, i_o = np.asarray(d_o, np.float64), np.asarray(i_o)
+        np.testing.assert_array_equal(d_m, d_o)
+        for row_m, row_o in zip(i_m, i_o):
+            assert set(row_m.tolist()) == set(row_o.tolist())
+    else:
+        # PQ: the oracle retrains its codebooks, so compare behaviorally —
+        # a delta row queried BY ITS OWN VECTOR must surface (its code is
+        # the exact encoding of the query)
+        up_ids = [j for j in (list(range(32)) + list(range(5008, 5064)))
+                  if j in live][:16]
+        qd = jnp.asarray(np.stack([live[j] for j in up_ids]),
+                         jnp.dtype(dtype))
+        _, i_self = mutable.search(mut, qd, _K, params=sp)
+        i_self = np.asarray(i_self)
+        hits = sum(j in row.tolist() for j, row in zip(up_ids, i_self))
+        assert hits >= int(0.8 * len(up_ids)), (
+            f"only {hits}/{len(up_ids)} upserted rows retrieve "
+            "themselves at full probes")
+
+
+class TestChurnVsRebuildOracle:
+    # tier-1 keeps the two f32 world-1 representatives (one per family —
+    # the cells that carry the identity/oracle load); the bf16 and
+    # world-2 cells are `slow` (tier-1 budget, ISSUE-20 rebalance):
+    # world-2 stays covered by TestSnapshotRoundTrip[2], bf16 storage
+    # rounding by the family recall tests, and the full grid runs in the
+    # slow tier plus the BENCH_METRIC=mutable identity gate
+    @pytest.mark.parametrize("kind,dtype,world", [
+        ("ivf_flat", "float32", 1),
+        ("ivf_pq", "float32", 1),
+        pytest.param("ivf_flat", "float32", 2, marks=pytest.mark.slow),
+        pytest.param("ivf_pq", "float32", 2, marks=pytest.mark.slow),
+        pytest.param("ivf_flat", "bfloat16", 1, marks=pytest.mark.slow),
+        pytest.param("ivf_pq", "bfloat16", 1, marks=pytest.mark.slow),
+        pytest.param("ivf_flat", "bfloat16", 2, marks=pytest.mark.slow),
+        pytest.param("ivf_pq", "bfloat16", 2, marks=pytest.mark.slow),
+    ])
+    def test_churn_then_compact_matches_oracle(self, kind, dtype, world):
+        mut, live = _build_mut(kind, dtype, world)
+        live = _churn(mut, live, dtype)
+        assert mut.size == len(live)
+        assert mut.delta_rows > 0 and mut.tombstone_count > 0
+        _assert_vs_oracle(kind, dtype, world, mut, live)
+        mut.compact()
+        assert mut.delta_rows == 0 and mut.tombstone_count == 0
+        assert mut.size == len(live)
+        _assert_vs_oracle(kind, dtype, world, mut, live)
+
+
+class TestWritePath:
+    def test_warm_write_path_zero_compiles(self):
+        """Steady-state writes never lower anything new: a delete is a
+        bitmap value change, and an upsert whose resulting delta shapes
+        were seen before replays warmed executables (counter-asserted —
+        the tentpole's O(n_new) zero-compile write claim)."""
+        mut, live = _build_mut("ivf_flat", "float32", 1)
+        rng = np.random.default_rng(3)
+        v = rng.random((64, _DIM)).astype(np.float32)
+        ids = np.arange(300, 364, dtype=np.int64)
+        q = rng.random((8, _DIM)).astype(np.float32)
+        sp = ivf_flat.SearchParams(n_probes=4)
+        mut.upsert(v, ids)                       # shapes first seen here
+        mutable.search(mut, q, _K, params=sp)    # warm the read signature
+        c0 = aot_compile_counters["compiles"]
+        assert mut.delete(np.arange(400, 432, dtype=np.int64)) == 32
+        # same ids + same rows → dedup rebuild lands on identical shapes
+        mut.upsert(v, ids)
+        d, i = mutable.search(mut, q, _K, params=sp)
+        assert aot_compile_counters["compiles"] == c0, \
+            "warm write path compiled"
+        assert np.asarray(d).shape == (8, _K)
+        dead = set(range(400, 432))
+        assert not (set(np.asarray(i).ravel().tolist()) & dead)
+
+    def test_upsert_duplicate_ids_in_batch_rejected(self):
+        from raft_tpu.core.error import LogicError
+
+        mut, _ = _build_mut("ivf_flat", "float32", 1)
+        v = np.zeros((2, _DIM), np.float32)
+        with pytest.raises(LogicError):
+            mut.upsert(v, np.array([7, 7], dtype=np.int64))
+
+
+class TestCompactor:
+    def test_tick_deterministic_and_contained(self):
+        mut, live = _build_mut("ivf_flat", "float32", 1)
+        live = _churn(mut, live, "float32")
+        comp = mutable.Compactor(mut, delta_fraction=0.05,
+                                 tomb_fraction=0.05, seed=3)
+        assert comp.due()
+        assert comp.tick() is True
+        assert comp.compactions == 1 and comp.errors == 0
+        assert mut.delta_rows == 0 and mut.tombstone_count == 0
+        # below threshold: tick is a no-op, deterministically
+        assert comp.tick() is False
+        assert comp.compactions == 1
+        # error containment: an injected refresh fault is counted, the
+        # old core keeps serving, and the NEXT tick retries clean
+        from raft_tpu.serve import ServeEngine
+
+        eng = ServeEngine(mut, _K,
+                          params=ivf_flat.SearchParams(n_probes=4),
+                          max_batch=8)
+        eng.warmup()
+        mut.upsert(np.zeros((160, _DIM), np.float32),
+                   np.arange(6000, 6160, dtype=np.int64))
+        comp2 = mutable.Compactor(mut, eng, delta_fraction=0.05,
+                                  tomb_fraction=0.05, seed=3)
+        with faults.plan("refresh:stage=pre_swap:raise"):
+            assert comp2.tick() is False
+        assert comp2.errors == 1
+        # the CORE swap preceded the faulted engine promote, so the data
+        # is compacted and serving (which reads the live core) continues
+        assert mut.delta_rows == 0
+        (r,) = eng.search([np.zeros((3, _DIM), np.float32)])
+        assert np.asarray(r[1]).shape == (3, _K)
+        # fresh churn re-arms the threshold; the retry promotes clean.
+        # Re-upserting the SAME ids keeps the live count constant, so the
+        # retry's rebuild + rewarm land on the shapes the faulted tick
+        # already warmed (budget: cache hits instead of fresh lowers)
+        mut.upsert(np.ones((160, _DIM), np.float32),
+                   np.arange(6000, 6160, dtype=np.int64))
+        assert comp2.tick() is True
+        assert comp2.errors == 1 and comp2.compactions == 1
+
+
+class TestServeConcurrentChurn:
+    def test_concurrent_search_during_faulted_compaction(self):
+        """Reads race writes, a compaction promotes mid-stream, an
+        injected pre-swap refresh fault fires, and an id returned by an
+        in-flight read is deleted under it — zero failed requests, and
+        the dead id stays dead."""
+        from raft_tpu.serve import ServeEngine
+
+        mut, live = _build_mut("ivf_flat", "float32", 1)
+        sp = ivf_flat.SearchParams(n_probes=4)
+        eng = ServeEngine(mut, _K, params=sp, max_batch=8)
+        eng.warmup()
+        rng = np.random.default_rng(11)
+        stop = threading.Event()
+        errors, seen = [], []
+
+        def reader():
+            r = np.random.default_rng(12)
+            while not stop.is_set():
+                q = r.random((5, _DIM)).astype(np.float32)
+                try:
+                    (res,) = eng.search([q])
+                    d, i = res
+                    if np.asarray(i).shape != (5, _K):
+                        errors.append(f"bad shape {np.asarray(i).shape}")
+                    seen.append(np.asarray(i).copy())
+                except Exception as exc:  # noqa: BLE001 — the gate
+                    errors.append(repr(exc))
+
+        t = threading.Thread(target=reader)
+        t.start()
+        try:
+            mut.upsert(rng.random((96, _DIM)).astype(np.float32),
+                       np.arange(7000, 7096, dtype=np.int64))
+            # delete an id an in-flight read just returned
+            for _ in range(200):
+                if seen:
+                    break
+                stop.wait(0.05)
+            assert seen, "reader made no progress"
+            victim = int(np.asarray(seen[-1]).ravel()[0])
+            mut.delete(np.array([victim], dtype=np.int64))
+            # faulted swap: compact raises at the pre-swap crash window;
+            # serving continues (the backend reads the already-promoted
+            # core through the engine's OLD backend object)
+            with faults.plan("refresh:stage=pre_swap:raise"):
+                with pytest.raises(faults.InjectedFault):
+                    mut.compact(engine=eng)
+            # replace EXISTING rows: the live count stays constant, so
+            # the clean compact rebuilds at the shapes the faulted one
+            # already warmed (budget: cache hits instead of fresh lowers)
+            mut.upsert(rng.random((32, _DIM)).astype(np.float32),
+                       np.arange(7000, 7032, dtype=np.int64))
+            mut.compact(engine=eng)        # clean promote
+        finally:
+            stop.set()
+            t.join(30)
+        assert not errors, errors[:5]
+        assert eng.stats["refreshes"] >= 1
+        # the deleted in-flight id must be gone at full probe coverage
+        if victim in live:
+            qv = live[victim][None, :]
+            _, i = mutable.search(mut, qv, _K, params=_full_sp("ivf_flat"))
+            assert victim not in np.asarray(i).ravel().tolist()
+
+
+class TestSnapshotRoundTrip:
+    @pytest.mark.parametrize("world", (1, 2))
+    def test_save_load_triple_preserves_results(self, tmp_path, world):
+        from raft_tpu.neighbors import serialize
+
+        mut, live = _build_mut("ivf_flat", "float32", world)
+        live = _churn(mut, live, "float32")
+        rng = np.random.default_rng(21)
+        q = rng.random((9, _DIM)).astype(np.float32)
+        sp = _full_sp("ivf_flat")
+        d0, i0 = mutable.search(mut, q, _K, params=sp)
+        path = str(tmp_path / "mut_snapshot")
+        serialize.save_sharded(path, mut)
+        loaded = serialize.load_sharded(
+            path, _comms(world) if world > 1 else None)
+        assert isinstance(loaded, mutable.MutableIndex)
+        assert loaded.size == mut.size
+        assert loaded.delta_rows == mut.delta_rows
+        # the snapshot persists LIVE delta rows only, so delta rows that
+        # were tombstoned in-place are simply absent after restore (never
+        # resurrected, never re-tombstoned) — an equivalent-but-cleaner
+        # state; main tombstones round-trip exactly
+        assert loaded.tombstone_count <= mut.tombstone_count
+        d1, i1 = mutable.search(loaded, q, _K, params=sp)
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        # the restored triple keeps mutating: compaction still works
+        loaded.compact()
+        assert loaded.size == len(live)

@@ -197,6 +197,9 @@ class TestReplicaServe:
         assert eng._health()["replicas"]["degraded"] == []
         eng.close()
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE-20 rebalance): flat/pq
+    # replica cells carry the 2D routing contract; brute-force serve
+    # identity is covered by the coalescing battery
     def test_brute_force_replicas(self, corpus):
         rep = ann_mnmg.replicate(corpus, build_comms(), 2)
         assert rep.kind == "brute_force"
